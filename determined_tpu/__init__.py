@@ -1,0 +1,29 @@
+"""determined-tpu: a TPU-native deep-learning training platform.
+
+A ground-up JAX/XLA/Pallas re-design with the capability surface of the
+Determined AI platform (reference: arnaudfroidmont/determined): distributed
+training, hyperparameter search, cluster resource management, and experiment
+tracking — built TPU-first.
+
+Layering (bottom → top):
+  - ``determined_tpu.parallel``  — device meshes, logical sharding rules, collectives
+  - ``determined_tpu.ops``       — pallas TPU kernels (flash/ring attention, ...)
+  - ``determined_tpu.models``    — reference model families (GPT-2, ResNet, MNIST)
+  - ``determined_tpu.train``     — Trial/Trainer APIs (the JAX-native analogue of
+                                   the reference's PyTorchTrial/Trainer,
+                                   harness/determined/pytorch/_trainer.py)
+  - ``determined_tpu.core``      — Core API: train/searcher/checkpoint/preempt
+                                   contexts (reference harness/determined/core/)
+  - ``determined_tpu.searcher``  — HP-search state machines (reference
+                                   master/pkg/searcher/)
+  - ``determined_tpu.expconf``   — experiment-config schema system (reference
+                                   master/pkg/schemas/expconf/)
+  - ``determined_tpu.master``    — control plane: API server, experiment/trial
+                                   state machines, topology-aware scheduler
+  - ``determined_tpu.agent``     — TPU-VM host daemon: chip detection, task launch
+  - ``determined_tpu.cli``       — the ``det`` command
+"""
+
+__version__ = "0.1.0"
+
+from determined_tpu._info import ClusterInfo, get_cluster_info  # noqa: F401

@@ -1,0 +1,132 @@
+"""Container/task-side view of the cluster: the DET_* env contract.
+
+TPU-native analogue of the reference's ClusterInfo
+(harness/determined/_info.py:162, get_cluster_info :394) and the env-var
+contract in SURVEY.md Appendix B. A task process launched by the agent reads
+everything it needs about master/trial/allocation identity from environment
+variables plus ``$DET_RUN_DIR/info/*.json`` files written at prep time.
+
+TPU additions over the reference contract: ``DET_TPU_WORKER_ID``,
+``DET_TPU_WORKER_HOSTNAMES``, ``DET_COORDINATOR_ADDR`` (for
+``jax.distributed.initialize``), and ``DET_MESH_CONFIG`` (the allocation's
+named mesh axes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+
+def _env(name: str, default: Optional[str] = None) -> Optional[str]:
+    v = os.environ.get(name)
+    return v if v not in (None, "") else default
+
+
+@dataclasses.dataclass
+class TrialInfo:
+    trial_id: int
+    experiment_id: int
+    trial_seed: int
+    hparams: Dict[str, Any]
+    config: Dict[str, Any]
+    steps_completed: int = 0
+    latest_checkpoint: Optional[str] = None
+
+    @classmethod
+    def _from_env(cls) -> Optional["TrialInfo"]:
+        tid = _env("DET_TRIAL_ID")
+        if tid is None:
+            return None
+        return cls(
+            trial_id=int(tid),
+            experiment_id=int(_env("DET_EXPERIMENT_ID", "0")),
+            trial_seed=int(_env("DET_TRIAL_SEED", "0")),
+            hparams=json.loads(_env("DET_HPARAMS", "{}")),
+            config=json.loads(_env("DET_EXPERIMENT_CONFIG", "{}")),
+            steps_completed=int(_env("DET_STEPS_COMPLETED", "0")),
+            latest_checkpoint=_env("DET_LATEST_CHECKPOINT"),
+        )
+
+
+@dataclasses.dataclass
+class RendezvousInfo:
+    """Addresses/ranks for all hosts of one allocation (reference:
+    AllocationRendezvousInfo, master/internal/api_trials.go:1495)."""
+
+    container_addrs: List[str]
+    container_rank: int
+    slot_ids: List[int]
+    coordinator_addr: Optional[str] = None  # for jax.distributed.initialize
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.container_addrs)
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    master_url: str
+    cluster_id: str = "local"
+    agent_id: str = "local"
+    task_id: Optional[str] = None
+    task_type: str = "TRIAL"
+    allocation_id: Optional[str] = None
+    session_token: Optional[str] = None
+    run_dir: Optional[str] = None
+    trial: Optional[TrialInfo] = None
+    rendezvous: Optional[RendezvousInfo] = None
+    mesh_config: Optional[Dict[str, int]] = None
+    tpu_worker_id: int = 0
+
+    @property
+    def task_container_rank(self) -> int:
+        return self.rendezvous.container_rank if self.rendezvous else 0
+
+    @classmethod
+    def from_env(cls) -> Optional["ClusterInfo"]:
+        master = _env("DET_MASTER")
+        if master is None:
+            return None
+        run_dir = _env("DET_RUN_DIR")
+        rendezvous = None
+        if run_dir and os.path.exists(os.path.join(run_dir, "info", "rendezvous.json")):
+            with open(os.path.join(run_dir, "info", "rendezvous.json")) as f:
+                rendezvous = RendezvousInfo(**json.load(f))
+        elif _env("DET_CONTAINER_ADDRS"):
+            rendezvous = RendezvousInfo(
+                container_addrs=_env("DET_CONTAINER_ADDRS", "").split(","),
+                container_rank=int(_env("DET_CONTAINER_RANK", "0")),
+                slot_ids=[int(s) for s in _env("DET_SLOT_IDS", "0").split(",") if s],
+                coordinator_addr=_env("DET_COORDINATOR_ADDR"),
+            )
+        mesh_cfg = _env("DET_MESH_CONFIG")
+        return cls(
+            master_url=master,
+            cluster_id=_env("DET_CLUSTER_ID", "local"),
+            agent_id=_env("DET_AGENT_ID", "local"),
+            task_id=_env("DET_TASK_ID"),
+            task_type=_env("DET_TASK_TYPE", "TRIAL"),
+            allocation_id=_env("DET_ALLOCATION_ID"),
+            session_token=_env("DET_SESSION_TOKEN"),
+            run_dir=run_dir,
+            trial=TrialInfo._from_env(),
+            rendezvous=rendezvous,
+            mesh_config=json.loads(mesh_cfg) if mesh_cfg else None,
+            tpu_worker_id=int(_env("DET_TPU_WORKER_ID", "0")),
+        )
+
+
+_cluster_info_cache: Optional[ClusterInfo] = None
+_cluster_info_loaded = False
+
+
+def get_cluster_info(refresh: bool = False) -> Optional[ClusterInfo]:
+    """None when running outside a determined-tpu task (local mode)."""
+    global _cluster_info_cache, _cluster_info_loaded
+    if refresh or not _cluster_info_loaded:
+        _cluster_info_cache = ClusterInfo.from_env()
+        _cluster_info_loaded = True
+    return _cluster_info_cache

@@ -1,0 +1,3 @@
+"""Shared utilities: master API session, storage backends, logging."""
+
+from determined_tpu.common.api import Session  # noqa: F401

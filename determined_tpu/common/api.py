@@ -1,0 +1,89 @@
+"""Minimal HTTP client for the master REST API.
+
+Stdlib-only (urllib) analogue of the reference's Session/bindings layer
+(harness/determined/common/api/). The API surface it speaks is the ~25
+endpoints a trial container actually uses (SURVEY.md Appendix A).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, Optional
+
+
+class APIError(Exception):
+    def __init__(self, status: int, body: str, url: str):
+        super().__init__(f"HTTP {status} from {url}: {body[:500]}")
+        self.status = status
+        self.body = body
+        self.url = url
+
+
+class Session:
+    """Authenticated master session with retry on transient failures."""
+
+    def __init__(
+        self,
+        master_url: str,
+        token: Optional[str] = None,
+        max_retries: int = 5,
+        timeout: float = 30.0,
+    ):
+        self.master_url = master_url.rstrip("/")
+        self.token = token
+        self.max_retries = max_retries
+        self.timeout = timeout
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[Dict[str, Any]] = None,
+        params: Optional[Dict[str, Any]] = None,
+        timeout: Optional[float] = None,
+    ) -> Any:
+        url = self.master_url + path
+        if params:
+            url += "?" + urllib.parse.urlencode(
+                {k: v for k, v in params.items() if v is not None}
+            )
+        data = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        last_exc: Optional[Exception] = None
+        for attempt in range(self.max_retries):
+            req = urllib.request.Request(url, data=data, headers=headers, method=method)
+            try:
+                with urllib.request.urlopen(req, timeout=timeout or self.timeout) as resp:
+                    text = resp.read().decode()
+                    return json.loads(text) if text else None
+            except urllib.error.HTTPError as e:
+                body_text = e.read().decode(errors="replace")
+                if e.code in (502, 503, 504) and attempt < self.max_retries - 1:
+                    last_exc = e
+                else:
+                    raise APIError(e.code, body_text, url) from None
+            except (urllib.error.URLError, socket.timeout, ConnectionError, OSError) as e:
+                last_exc = e
+            time.sleep(min(2.0 ** attempt * 0.1, 5.0))
+        raise ConnectionError(f"master unreachable at {url}: {last_exc}")
+
+    def get(self, path: str, params: Optional[Dict[str, Any]] = None,
+            timeout: Optional[float] = None) -> Any:
+        return self._request("GET", path, params=params, timeout=timeout)
+
+    def post(self, path: str, body: Optional[Dict[str, Any]] = None,
+             params: Optional[Dict[str, Any]] = None) -> Any:
+        return self._request("POST", path, body=body, params=params)
+
+    def patch(self, path: str, body: Optional[Dict[str, Any]] = None) -> Any:
+        return self._request("PATCH", path, body=body)
+
+    def delete(self, path: str) -> Any:
+        return self._request("DELETE", path)

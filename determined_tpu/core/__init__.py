@@ -1,0 +1,10 @@
+"""Core API — framework-agnostic training services (reference
+harness/determined/core/)."""
+
+from determined_tpu.core._checkpoint import CheckpointContext  # noqa: F401
+from determined_tpu.core._context import Context, init  # noqa: F401
+from determined_tpu.core._distributed import DistributedContext  # noqa: F401
+from determined_tpu.core._preempt import PreemptContext  # noqa: F401
+from determined_tpu.core._profiler import ProfilerContext  # noqa: F401
+from determined_tpu.core._searcher import SearcherContext, SearcherOperation  # noqa: F401
+from determined_tpu.core._train import TrainContext  # noqa: F401

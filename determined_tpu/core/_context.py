@@ -1,0 +1,133 @@
+"""core.init() — one context bundling all training services.
+
+Reference: harness/determined/core/_context.py:190-320. Two modes:
+
+  - **managed**: launched by an agent; ClusterInfo comes from DET_* env, a
+    Session talks to the master, preemption/searcher/metrics are live.
+  - **local**: no master; metrics accumulate in-memory, the searcher yields a
+    single op of `max_length`, checkpoints go to a local directory. The same
+    user code runs in both (reference "train anywhere" semantics).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+from determined_tpu._info import ClusterInfo, get_cluster_info
+from determined_tpu.common.api import Session
+from determined_tpu.core._checkpoint import CheckpointContext
+from determined_tpu.core._distributed import DistributedContext
+from determined_tpu.core._preempt import PreemptContext
+from determined_tpu.core._profiler import ProfilerContext
+from determined_tpu.core._searcher import SearcherContext
+from determined_tpu.core._train import TrainContext
+from determined_tpu.storage import from_config as storage_from_config
+
+logger = logging.getLogger("determined_tpu.core")
+
+
+class Context:
+    def __init__(
+        self,
+        train: TrainContext,
+        searcher: SearcherContext,
+        checkpoint: CheckpointContext,
+        preempt: PreemptContext,
+        distributed: DistributedContext,
+        profiler: ProfilerContext,
+        info: Optional[ClusterInfo] = None,
+    ):
+        self.train = train
+        self.searcher = searcher
+        self.checkpoint = checkpoint
+        self.preempt = preempt
+        self.distributed = distributed
+        self.profiler = profiler
+        self.info = info
+
+    @property
+    def hparams(self) -> Dict[str, Any]:
+        return self.info.trial.hparams if (self.info and self.info.trial) else {}
+
+    @property
+    def trial_seed(self) -> int:
+        return self.info.trial.trial_seed if (self.info and self.info.trial) else 0
+
+    @property
+    def latest_checkpoint(self) -> Optional[str]:
+        return self.info.trial.latest_checkpoint if (self.info and self.info.trial) else None
+
+    def close(self) -> None:
+        # Order matters (reference _context.py:79-118): drain checkpoint
+        # writes first, then stop watchers, then tear down distributed.
+        self.checkpoint.close()
+        self.profiler.close()
+        self.preempt.close()
+        self.distributed.shutdown()
+
+    def __enter__(self) -> "Context":
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb) -> None:
+        self.close()
+
+
+def init(
+    *,
+    max_length: Optional[int] = None,
+    storage_config: Optional[Dict[str, Any]] = None,
+    checkpoint_dir: str = "/tmp/determined_tpu/checkpoints",
+    distributed: Optional[DistributedContext] = None,
+    async_checkpointing: bool = True,
+) -> Context:
+    """Bring up the Core API. Managed vs local is auto-detected from env."""
+    info = get_cluster_info()
+
+    if distributed is None:
+        if info and info.rendezvous and info.rendezvous.num_hosts > 1:
+            distributed = DistributedContext.from_allocation(
+                coordinator_addr=info.rendezvous.coordinator_addr
+                or info.rendezvous.container_addrs[0] + ":8476",
+                num_processes=info.rendezvous.num_hosts,
+                process_id=info.rendezvous.container_rank,
+            )
+        else:
+            distributed = DistributedContext.local()
+
+    session: Optional[Session] = None
+    trial_id, run_id, allocation_id = 0, 0, None
+    if info is not None:
+        session = Session(info.master_url, info.session_token)
+        allocation_id = info.allocation_id
+        if info.trial is not None:
+            trial_id = info.trial.trial_id
+        if info.trial and info.trial.config.get("checkpoint_storage"):
+            storage_config = storage_config or info.trial.config["checkpoint_storage"]
+
+    storage = storage_from_config(storage_config, default_base=checkpoint_dir)
+
+    train = TrainContext(session, trial_id=trial_id, run_id=run_id, distributed=distributed)
+    searcher = SearcherContext(
+        session,
+        trial_id=trial_id,
+        distributed=distributed,
+        local_max_length=max_length,
+    )
+    checkpoint = CheckpointContext(
+        session,
+        storage,
+        trial_id=trial_id,
+        allocation_id=allocation_id,
+        distributed=distributed,
+        async_save=async_checkpointing,
+    )
+    preempt = PreemptContext(session, allocation_id=allocation_id, distributed=distributed)
+    profiler = ProfilerContext(train)
+    ctx = Context(train, searcher, checkpoint, preempt, distributed, profiler, info)
+    if session is not None:
+        try:
+            session.post(f"/api/v1/trials/{trial_id}/run_prepare", body={})
+        except Exception:
+            logger.debug("run_prepare failed", exc_info=True)
+    return ctx

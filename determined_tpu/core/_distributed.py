@@ -1,0 +1,108 @@
+"""DistributedContext — process topology + host-level control-plane collectives.
+
+The reference coordinates non-gradient data (sharded-checkpoint metadata,
+metric gathering) chief↔workers over ZMQ (harness/determined/ipc.py:34,
+core/_distributed.py:12). On TPU the data plane is XLA collectives over ICI,
+and for the *control* plane we ride the same transport: small host-level
+gather/broadcast are implemented with
+`jax.experimental.multihost_utils` (which uses the jax.distributed client) —
+no extra socket layer needed. A single-process context is the default for
+1-host allocations and local mode.
+
+Topology model (one process per TPU-VM host, owning all local chips — unlike
+the reference's process-per-GPU):
+  rank        — this process's index in the allocation (== TPU worker id)
+  size        — number of processes (hosts)
+  local_devices / global device count come from jax itself.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional
+
+
+@dataclasses.dataclass
+class DistributedContext:
+    rank: int = 0
+    size: int = 1
+    initialized_jax_distributed: bool = False
+
+    @property
+    def is_chief(self) -> bool:
+        return self.rank == 0
+
+    # -- constructors -------------------------------------------------
+
+    @classmethod
+    def local(cls) -> "DistributedContext":
+        return cls(rank=0, size=1)
+
+    @classmethod
+    def from_allocation(
+        cls,
+        coordinator_addr: str,
+        num_processes: int,
+        process_id: int,
+    ) -> "DistributedContext":
+        """Multi-host bring-up: master rendezvous supplies coordinator address
+        (= chief host) and ranks; we hand them to jax.distributed so every
+        host sees the full global device set (SURVEY.md §5 'Distributed
+        communication backend')."""
+        if num_processes <= 1:
+            return cls.local()
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=coordinator_addr,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return cls(rank=process_id, size=num_processes, initialized_jax_distributed=True)
+
+    # -- control-plane collectives ------------------------------------
+
+    def gather(self, obj: Any) -> Optional[List[Any]]:
+        """Gather python objects to the chief (None on non-chief ranks)."""
+        if self.size == 1:
+            return [obj]
+        vals = self.allgather(obj)
+        return vals if self.is_chief else None
+
+    def allgather(self, obj: Any) -> List[Any]:
+        if self.size == 1:
+            return [obj]
+        from jax.experimental import multihost_utils
+
+        return list(multihost_utils.process_allgather(_encode(obj)))  # type: ignore
+
+    def broadcast(self, obj: Any) -> Any:
+        if self.size == 1:
+            return obj
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.broadcast_one_to_all(_encode(obj))
+
+    def barrier(self, name: str = "barrier") -> None:
+        if self.size == 1:
+            return
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+    def shutdown(self) -> None:
+        if self.initialized_jax_distributed:
+            import jax
+
+            jax.distributed.shutdown()
+
+
+def _encode(obj: Any) -> Any:
+    # multihost_utils handles arrays/pytrees of arrays; plain python scalars
+    # pass through np.asarray. Strings/dicts must be pre-encoded by callers
+    # that need them; the framework only gathers numeric payloads here.
+    import numpy as np
+
+    if isinstance(obj, (int, float)):
+        return np.asarray(obj)
+    return obj

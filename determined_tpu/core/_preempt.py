@@ -1,0 +1,102 @@
+"""PreemptContext — cooperative preemption (reference
+harness/determined/core/_preempt.py:148; watcher thread :15 long-polls
+`GET /api/v1/allocations/{id}/signals/preemption`, api_trials.go:1179).
+
+The scheduler preempts a trial by raising its preemption flag; the training
+loop polls `should_preempt()` at step boundaries, checkpoints, and exits.
+Multi-host: only the chief polls the master; the decision is broadcast so all
+hosts leave their collectives in lockstep.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from determined_tpu.common.api import Session
+
+logger = logging.getLogger("determined_tpu.core")
+
+
+class _PreemptionWatcher(threading.Thread):
+    """Daemon thread long-polling the master for the preemption signal."""
+
+    def __init__(self, session: Session, allocation_id: str, poll_timeout: int = 60):
+        super().__init__(daemon=True, name="preemption-watcher")
+        self._session = session
+        self._allocation_id = allocation_id
+        self._poll_timeout = poll_timeout
+        self._preempted = threading.Event()
+        self._stop = threading.Event()
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                resp = self._session.get(
+                    f"/api/v1/allocations/{self._allocation_id}/signals/preemption",
+                    params={"timeout_seconds": self._poll_timeout},
+                    timeout=self._poll_timeout + 30,
+                )
+                if resp and resp.get("preempt"):
+                    self._preempted.set()
+                    return
+            except Exception:
+                if not self._stop.is_set():
+                    logger.debug("preemption poll failed; retrying", exc_info=True)
+                    self._stop.wait(5.0)
+
+    @property
+    def preempted(self) -> bool:
+        return self._preempted.is_set()
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+class PreemptContext:
+    def __init__(
+        self,
+        session: Optional[Session],
+        allocation_id: Optional[str] = None,
+        distributed=None,
+    ):
+        self._session = session
+        self._allocation_id = allocation_id
+        self._dist = distributed
+        self._watcher: Optional[_PreemptionWatcher] = None
+        self._forced = False  # local-mode / test hook
+        if session is not None and allocation_id and (
+            distributed is None or distributed.is_chief
+        ):
+            self._watcher = _PreemptionWatcher(session, allocation_id)
+            self._watcher.start()
+
+    def should_preempt(self, auto_ack: bool = True) -> bool:
+        flag = self._forced or (self._watcher is not None and self._watcher.preempted)
+        if self._dist is not None and self._dist.size > 1:
+            flag = bool(self._dist.broadcast(int(flag)))
+        if flag and auto_ack:
+            self.acknowledge_preemption_signal()
+        return flag
+
+    def acknowledge_preemption_signal(self) -> None:
+        """Tell the master we saw the signal and will checkpoint+exit
+        (reference ack_preemption, _preempt.py:257)."""
+        if self._session is not None and self._allocation_id and (
+            self._dist is None or self._dist.is_chief
+        ):
+            try:
+                self._session.post(
+                    f"/api/v1/allocations/{self._allocation_id}/signals/ack_preemption"
+                )
+            except Exception:
+                logger.debug("ack_preemption failed", exc_info=True)
+
+    def force(self) -> None:
+        """Local/test hook: behave as if preempted."""
+        self._forced = True
+
+    def close(self) -> None:
+        if self._watcher is not None:
+            self._watcher.close()

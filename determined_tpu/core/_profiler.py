@@ -1,0 +1,128 @@
+"""ProfilerContext — system metrics + jax.profiler traces.
+
+Reference: harness/determined/core/_profiler.py:23 (pynvml GPU collectors).
+TPU re-design: per-host collector thread samples
+  - TPU device memory (HBM) via jax.local_devices()[i].memory_stats()
+  - host CPU/mem via /proc (no psutil dependency)
+and ships them as metrics through TrainContext. `trace()` wraps a step range
+in a jax.profiler trace written to the TensorBoard dir (the XLA-native
+replacement for torch.profiler pass-through, reference _trainer.py:34).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger("determined_tpu.core")
+
+
+def _read_proc_stat() -> tuple:
+    with open("/proc/stat") as f:
+        parts = f.readline().split()[1:8]
+    vals = [int(p) for p in parts]
+    idle = vals[3] + vals[4]
+    return sum(vals), idle
+
+
+def _read_meminfo() -> Dict[str, int]:
+    out = {}
+    with open("/proc/meminfo") as f:
+        for line in f:
+            k, v = line.split(":", 1)
+            out[k] = int(v.strip().split()[0]) * 1024
+    return out
+
+
+def collect_system_metrics() -> Dict[str, Any]:
+    metrics: Dict[str, Any] = {}
+    try:
+        mem = _read_meminfo()
+        metrics["host_mem_used_bytes"] = mem["MemTotal"] - mem.get("MemAvailable", 0)
+        metrics["host_mem_total_bytes"] = mem["MemTotal"]
+    except Exception:
+        pass
+    try:
+        import jax
+
+        for i, d in enumerate(jax.local_devices()):
+            stats = d.memory_stats() or {}
+            if "bytes_in_use" in stats:
+                metrics[f"tpu{i}_hbm_used_bytes"] = stats["bytes_in_use"]
+            if "bytes_limit" in stats:
+                metrics[f"tpu{i}_hbm_total_bytes"] = stats["bytes_limit"]
+    except Exception:
+        pass
+    return metrics
+
+
+class _Collector(threading.Thread):
+    def __init__(self, train_context, interval: float, get_step):
+        super().__init__(daemon=True, name="profiler-collector")
+        self._train = train_context
+        self._interval = interval
+        self._get_step = get_step
+        self._stop = threading.Event()
+
+    def run(self) -> None:
+        prev = None
+        while not self._stop.wait(self._interval):
+            m = collect_system_metrics()
+            try:
+                total, idle = _read_proc_stat()
+                if prev is not None:
+                    dt, di = total - prev[0], idle - prev[1]
+                    if dt > 0:
+                        m["host_cpu_util"] = 1.0 - di / dt
+                prev = (total, idle)
+            except Exception:
+                pass
+            try:
+                self._train.report_metrics("profiling", self._get_step(), m)
+            except Exception:
+                logger.debug("profiler report failed", exc_info=True)
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+class ProfilerContext:
+    def __init__(self, train_context, tensorboard_dir: Optional[str] = None):
+        self._train = train_context
+        self._collector: Optional[_Collector] = None
+        self._step = 0
+        self.tensorboard_dir = tensorboard_dir or os.environ.get(
+            "DET_TENSORBOARD_PATH", "/tmp/determined_tpu/tb"
+        )
+
+    def set_step(self, step: int) -> None:
+        self._step = step
+
+    def on(self, sampling_interval: float = 5.0) -> None:
+        if self._collector is None:
+            self._collector = _Collector(self._train, sampling_interval, lambda: self._step)
+            self._collector.start()
+
+    def off(self) -> None:
+        if self._collector is not None:
+            self._collector.close()
+            self._collector = None
+
+    @contextlib.contextmanager
+    def trace(self, name: str = "train_step"):
+        """jax.profiler trace for a region → TensorBoard trace viewer."""
+        import jax
+
+        os.makedirs(self.tensorboard_dir, exist_ok=True)
+        jax.profiler.start_trace(self.tensorboard_dir)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
+
+    def close(self) -> None:
+        self.off()

@@ -1,0 +1,112 @@
+"""SearcherContext — the trial side of HP search (reference
+harness/determined/core/_searcher.py:131).
+
+`operations()` yields `SearcherOperation`s: "train until `length`, then report
+the searcher metric". Master mode polls
+`GET /api/v1/trials/{id}/searcher/operation` and completes ops via
+`POST .../searcher/completed_operation` (reference api_trials.go:1299 →
+experiment.TrialCompleteOperation); local mode synthesises a single op of
+`local_max_length` so the same loop runs without a master.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Iterator, Optional
+
+from determined_tpu.common.api import Session
+
+logger = logging.getLogger("determined_tpu.core")
+
+
+class SearcherOperation:
+    def __init__(self, context: "SearcherContext", length: int, completed: bool = False):
+        self._context = context
+        self.length = length  # cumulative units (batches) to train to
+        self._completed = completed
+
+    @property
+    def completed(self) -> bool:
+        return self._completed
+
+    def report_completed(self, searcher_metric: float) -> None:
+        if self._completed:
+            raise RuntimeError("operation already completed")
+        self._completed = True
+        self._context._complete_operation(self, searcher_metric)
+
+
+class SearcherContext:
+    def __init__(
+        self,
+        session: Optional[Session],
+        trial_id: int = 0,
+        distributed=None,
+        local_max_length: Optional[int] = None,
+        poll_interval: float = 2.0,
+    ):
+        self._session = session
+        self._trial_id = trial_id
+        self._dist = distributed
+        self._local_max_length = local_max_length
+        self._poll_interval = poll_interval
+        self.completed_metrics: list = []  # local mode record
+
+    # -- master interaction (chief only; workers follow via broadcast) --
+
+    def _get_next_op(self, last_length: int) -> dict:
+        """Long-poll the master for the next op after `last_length`.
+
+        Returns {"op": {"length": N}} or {"done": true}.
+        """
+        assert self._session is not None
+        while True:
+            resp = self._session.get(
+                f"/api/v1/trials/{self._trial_id}/searcher/operation",
+                params={"last": last_length, "timeout_seconds": 60},
+                timeout=90.0,
+            )
+            if resp and (resp.get("done") or resp.get("op")):
+                return resp
+            time.sleep(self._poll_interval)
+
+    def _complete_operation(self, op: SearcherOperation, metric: float) -> None:
+        if self._session is None:
+            self.completed_metrics.append((op.length, metric))
+            return
+        if self._dist is None or self._dist.is_chief:
+            self._session.post(
+                f"/api/v1/trials/{self._trial_id}/searcher/completed_operation",
+                body={"length": op.length, "searcher_metric": float(metric)},
+            )
+
+    def operations(self, auto_ack: bool = True) -> Iterator[SearcherOperation]:
+        """Yield ops until the searcher closes the trial.
+
+        Multi-host: only the chief talks to the master; op lengths are
+        broadcast so all hosts run identical step counts (keeps every host's
+        jitted loop in lockstep — a divergent host would hang collectives).
+        """
+        if self._session is None:
+            length = self._local_max_length
+            if length is None:
+                raise RuntimeError(
+                    "local mode needs local_max_length (pass max_length to init())"
+                )
+            yield SearcherOperation(self, length)
+            return
+
+        last_length = 0
+        while True:
+            if self._dist is None or self._dist.is_chief:
+                resp = self._get_next_op(last_length)
+                payload = -1 if resp.get("done") else int(resp["op"]["length"])
+            else:
+                payload = -1
+            if self._dist is not None and self._dist.size > 1:
+                payload = int(self._dist.broadcast(payload))
+            if payload < 0:
+                return
+            yield SearcherOperation(self, payload)
+            last_length = payload

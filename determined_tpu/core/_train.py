@@ -1,0 +1,104 @@
+"""TrainContext — metric reporting (reference harness/determined/core/_train.py:20).
+
+Master mode POSTs to `ReportTrialMetrics` (reference api_trials.go:1381);
+local mode accumulates in-memory and logs, so the same training code runs
+with or without a cluster.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+from typing import Any, Dict, List, Optional
+
+from determined_tpu.common.api import Session
+
+logger = logging.getLogger("determined_tpu.core")
+
+
+def _clean_metrics(metrics: Dict[str, Any]) -> Dict[str, Any]:
+    """JSON-safe scalars: device arrays → python floats; NaN/Inf → strings."""
+    out: Dict[str, Any] = {}
+    for k, v in metrics.items():
+        if hasattr(v, "item"):
+            try:
+                v = v.item()
+            except Exception:
+                continue
+        if isinstance(v, float) and (math.isnan(v) or math.isinf(v)):
+            v = str(v)
+        out[k] = v
+    return out
+
+
+class TrainContext:
+    def __init__(
+        self,
+        session: Optional[Session],
+        trial_id: int = 0,
+        run_id: int = 0,
+        distributed=None,
+    ):
+        self._session = session
+        self._trial_id = trial_id
+        self._run_id = run_id
+        self._dist = distributed
+        # local-mode metric store (inspectable by tests / local callers)
+        self.local_training_metrics: List[Dict[str, Any]] = []
+        self.local_validation_metrics: List[Dict[str, Any]] = []
+
+    def _report(self, group: str, steps_completed: int, metrics: Dict[str, Any]) -> None:
+        if self._dist is not None and not self._dist.is_chief:
+            return
+        metrics = _clean_metrics(metrics)
+        record = {
+            "trial_id": self._trial_id,
+            "trial_run_id": self._run_id,
+            "group": group,
+            "steps_completed": steps_completed,
+            "metrics": metrics,
+            "report_time": time.time(),
+        }
+        if self._session is None:
+            store = (
+                self.local_training_metrics
+                if group == "training"
+                else self.local_validation_metrics
+            )
+            store.append(record)
+            logger.info("[%s] step=%d %s", group, steps_completed, metrics)
+        else:
+            self._session.post(
+                f"/api/v1/trials/{self._trial_id}/metrics",
+                body=record,
+            )
+
+    def report_training_metrics(self, steps_completed: int, metrics: Dict[str, Any]) -> None:
+        self._report("training", steps_completed, metrics)
+
+    def report_validation_metrics(self, steps_completed: int, metrics: Dict[str, Any]) -> None:
+        self._report("validation", steps_completed, metrics)
+
+    def report_metrics(self, group: str, steps_completed: int, metrics: Dict[str, Any]) -> None:
+        """Arbitrary metric groups (reference: report_metrics / custom groups)."""
+        self._report(group, steps_completed, metrics)
+
+    def report_progress(self, progress: float) -> None:
+        if self._session is None or (self._dist and not self._dist.is_chief):
+            return
+        self._session.post(
+            f"/api/v1/trials/{self._trial_id}/progress",
+            body={"progress": float(progress)},
+        )
+
+    def set_status(self, status: str) -> None:
+        if self._session is None or (self._dist and not self._dist.is_chief):
+            return
+        try:
+            self._session.post(
+                f"/api/v1/trials/{self._trial_id}/runner/metadata",
+                body={"state": status},
+            )
+        except Exception:
+            logger.debug("set_status(%s) failed", status, exc_info=True)

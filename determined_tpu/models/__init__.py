@@ -1,0 +1,7 @@
+"""Reference model families (the workloads in BASELINE.md).
+
+Models are plain-JAX: params are nested dicts of jnp arrays, each model module
+exposes ``Config``, ``init(rng, cfg)``, ``apply(params, batch, cfg)``,
+``param_logical_axes(cfg)`` (pytree of logical-axis tuples for GSPMD layout,
+see determined_tpu.parallel.sharding) and ``loss_fn``.
+"""

@@ -1,0 +1,263 @@
+"""GPT-2 in plain JAX, TPU-first.
+
+The flagship workload (BASELINE.md north star: GPT-2 pretraining ≥40% MFU).
+Equivalent capability to the reference's HF-Trainer GPT-2 path
+(reference: examples/hf_trainer_api/hf_language_modeling/run_clm.py,
+harness/determined/transformers/_hf_callback.py) but re-designed for the MXU:
+
+  - bfloat16 activations, fp32 params/optimizer (mixed precision by default)
+  - transformer blocks stacked along a leading "layers" dim and iterated with
+    `lax.scan` → one compiled block regardless of depth
+  - logical-axis sharding annotations (batch/embed/heads/mlp/vocab) so the
+    same model runs DP, FSDP, TP or any combination by swapping rules
+  - optional `jax.checkpoint` rematerialisation of each block
+  - attention pluggable: "dot" (XLA-fused) or "flash" (pallas kernel,
+    determined_tpu.ops.flash_attention)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from determined_tpu.parallel.sharding import LogicalRules, shard_logical
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    d_model: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    d_ff: int = 0  # 0 → 4*d_model
+    dropout: float = 0.0  # pretraining default; rng-free when 0
+    dtype: Any = jnp.bfloat16  # activation dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    attention_impl: str = "dot"  # "dot" | "flash" | "ring"
+    layer_norm_eps: float = 1e-5
+
+    @property
+    def ff_dim(self) -> int:
+        return self.d_ff or 4 * self.d_model
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_head == 0
+        return self.d_model // self.n_head
+
+    @staticmethod
+    def small() -> "Config":
+        return Config()  # gpt2-124M
+
+    @staticmethod
+    def medium() -> "Config":
+        return Config(d_model=1024, n_layer=24, n_head=16)
+
+    @staticmethod
+    def large() -> "Config":
+        return Config(d_model=1280, n_layer=36, n_head=20)
+
+    @staticmethod
+    def tiny() -> "Config":
+        """Test-sized config (CPU-mesh unit tests, dryrun_multichip)."""
+        return Config(
+            vocab_size=512, n_positions=128, d_model=64, n_layer=2, n_head=4
+        )
+
+
+def flops_per_token(cfg: Config, seq_len: int) -> float:
+    """Approx fwd+bwd FLOPs per token (6N + attention term) for MFU math."""
+    n_params = param_count(cfg)
+    attn = 12 * cfg.n_layer * cfg.d_model * seq_len  # 2*2*3 * L * d * s
+    return 6.0 * n_params + attn
+
+
+def param_count(cfg: Config) -> int:
+    d, f, v, p, L = cfg.d_model, cfg.ff_dim, cfg.vocab_size, cfg.n_positions, cfg.n_layer
+    per_layer = (
+        3 * d * d + 3 * d  # qkv
+        + d * d + d  # attn out
+        + d * f + f  # mlp up
+        + f * d + d  # mlp down
+        + 4 * d  # 2 layernorms
+    )
+    return v * d + p * d + L * per_layer + 2 * d  # + final ln
+
+
+# ---------------------------------------------------------------- init
+
+
+def _normal(rng, shape, std, dtype):
+    return (jax.random.normal(rng, shape) * std).astype(dtype)
+
+
+def init(rng: jax.Array, cfg: Config) -> Dict[str, Any]:
+    d, f, v, p, L = cfg.d_model, cfg.ff_dim, cfg.vocab_size, cfg.n_positions, cfg.n_layer
+    pd = cfg.param_dtype
+    keys = jax.random.split(rng, 8)
+    # GPT-2 init: N(0, 0.02); residual projections scaled by 1/sqrt(2L).
+    std, res_std = 0.02, 0.02 / math.sqrt(2 * L)
+
+    def layer_params(k):
+        ks = jax.random.split(k, 4)
+        return {
+            "ln1": {"scale": jnp.ones((L, d), pd), "bias": jnp.zeros((L, d), pd)},
+            "qkv": {
+                "kernel": _normal(ks[0], (L, d, 3 * d), std, pd),
+                "bias": jnp.zeros((L, 3 * d), pd),
+            },
+            "attn_out": {
+                "kernel": _normal(ks[1], (L, d, d), res_std, pd),
+                "bias": jnp.zeros((L, d), pd),
+            },
+            "ln2": {"scale": jnp.ones((L, d), pd), "bias": jnp.zeros((L, d), pd)},
+            "mlp_up": {
+                "kernel": _normal(ks[2], (L, d, f), std, pd),
+                "bias": jnp.zeros((L, f), pd),
+            },
+            "mlp_down": {
+                "kernel": _normal(ks[3], (L, f, d), res_std, pd),
+                "bias": jnp.zeros((L, d), pd),
+            },
+        }
+
+    return {
+        "wte": _normal(keys[0], (v, d), std, pd),
+        "wpe": _normal(keys[1], (p, d), std, pd),
+        "blocks": layer_params(keys[2]),
+        "ln_f": {"scale": jnp.ones((d,), pd), "bias": jnp.zeros((d,), pd)},
+    }
+
+
+def param_logical_axes(cfg: Config) -> Dict[str, Any]:
+    """Logical axis names per param dim; leading None is the stacked-layers dim."""
+    return {
+        "wte": ("vocab", "embed"),
+        "wpe": (None, "embed"),
+        "blocks": {
+            "ln1": {"scale": (None, "embed"), "bias": (None, "embed")},
+            "qkv": {"kernel": (None, "embed", "heads"), "bias": (None, "heads")},
+            "attn_out": {"kernel": (None, "heads", "embed"), "bias": (None, "embed")},
+            "ln2": {"scale": (None, "embed"), "bias": (None, "embed")},
+            "mlp_up": {"kernel": (None, "embed", "mlp"), "bias": (None, "mlp")},
+            "mlp_down": {"kernel": (None, "mlp", "embed"), "bias": (None, "embed")},
+        },
+        "ln_f": {"scale": ("embed",), "bias": ("embed",)},
+    }
+
+
+# ---------------------------------------------------------------- forward
+
+
+def _layer_norm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _attention(q, k, v, cfg: Config, rules: Optional[LogicalRules]):
+    """q,k,v: [B, S, H, Dh]. Causal self-attention."""
+    if cfg.attention_impl == "flash":
+        from determined_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
+    if cfg.attention_impl == "ring":
+        from determined_tpu.ops.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, axis_name="context")
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    s = q.shape[1]
+    mask = jnp.tril(jnp.ones((s, s), jnp.bool_))
+    logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block(x, lp, cfg: Config, rules: Optional[LogicalRules]):
+    """One transformer block. x: [B, S, D]; lp: this layer's param slice."""
+    b, s, d = x.shape
+    h, dh = cfg.n_head, cfg.head_dim
+    dt = cfg.dtype
+
+    y = _layer_norm(x, lp["ln1"]["scale"], lp["ln1"]["bias"], cfg.layer_norm_eps)
+    qkv = jnp.einsum("bsd,de->bse", y, lp["qkv"]["kernel"].astype(dt)) + lp["qkv"][
+        "bias"
+    ].astype(dt)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, h, dh)
+    k = k.reshape(b, s, h, dh)
+    v = v.reshape(b, s, h, dh)
+    q = shard_logical(q, ("batch", "seq", "heads", "kv"), rules)
+    k = shard_logical(k, ("batch", "seq", "heads", "kv"), rules)
+    attn = _attention(q, k, v, cfg, rules).reshape(b, s, d)
+    attn = (
+        jnp.einsum("bsd,de->bse", attn, lp["attn_out"]["kernel"].astype(dt))
+        + lp["attn_out"]["bias"].astype(dt)
+    )
+    x = x + attn
+    x = shard_logical(x, ("batch", "seq", "embed"), rules)
+
+    y = _layer_norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"], cfg.layer_norm_eps)
+    up = jnp.einsum("bsd,df->bsf", y, lp["mlp_up"]["kernel"].astype(dt)) + lp["mlp_up"][
+        "bias"
+    ].astype(dt)
+    up = shard_logical(up, ("batch", "seq", "mlp"), rules)
+    up = jax.nn.gelu(up, approximate=True)
+    down = (
+        jnp.einsum("bsf,fd->bsd", up, lp["mlp_down"]["kernel"].astype(dt))
+        + lp["mlp_down"]["bias"].astype(dt)
+    )
+    x = x + down
+    return shard_logical(x, ("batch", "seq", "embed"), rules)
+
+
+def apply(
+    params: Dict[str, Any],
+    tokens: jax.Array,  # [B, S] int32
+    cfg: Config,
+    rules: Optional[LogicalRules] = None,
+) -> jax.Array:
+    """Forward pass → logits [B, S, vocab] (bf16)."""
+    b, s = tokens.shape
+    dt = cfg.dtype
+    x = params["wte"].astype(dt)[tokens] + params["wpe"].astype(dt)[:s][None]
+    x = shard_logical(x, ("batch", "seq", "embed"), rules)
+
+    block = partial(_block, cfg=cfg, rules=rules)
+    if cfg.remat:
+        block = jax.checkpoint(block)
+
+    def scan_body(carry, lp):
+        return block(carry, lp), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"], cfg.layer_norm_eps)
+    logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(dt))
+    return shard_logical(logits, ("batch", "seq", "vocab"), rules)
+
+
+def loss_fn(
+    params: Dict[str, Any],
+    batch: Dict[str, jax.Array],  # {"tokens": [B, S+1]} or {"tokens","targets"}
+    cfg: Config,
+    rules: Optional[LogicalRules] = None,
+) -> jax.Array:
+    tokens = batch["tokens"]
+    if "targets" in batch:
+        inputs, targets = tokens, batch["targets"]
+    else:
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = apply(params, inputs, cfg, rules).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)

@@ -1,0 +1,72 @@
+"""MNIST CNN — the "minimum slice" workload (SURVEY.md §7 step 2; reference
+examples/tutorials/mnist_pytorch). Plain-JAX conv net, single-chip friendly."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from determined_tpu.parallel.sharding import LogicalRules
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    n_classes: int = 10
+    c1: int = 32
+    c2: int = 64
+    hidden: int = 128
+    dtype: Any = jnp.float32
+
+
+def init(rng: jax.Array, cfg: Config = Config()) -> Dict[str, Any]:
+    k = jax.random.split(rng, 4)
+    he = jax.nn.initializers.he_normal()
+    return {
+        "conv1": {"kernel": he(k[0], (3, 3, 1, cfg.c1), cfg.dtype), "bias": jnp.zeros((cfg.c1,), cfg.dtype)},
+        "conv2": {"kernel": he(k[1], (3, 3, cfg.c1, cfg.c2), cfg.dtype), "bias": jnp.zeros((cfg.c2,), cfg.dtype)},
+        "fc1": {"kernel": he(k[2], (7 * 7 * cfg.c2, cfg.hidden), cfg.dtype), "bias": jnp.zeros((cfg.hidden,), cfg.dtype)},
+        "fc2": {"kernel": he(k[3], (cfg.hidden, cfg.n_classes), cfg.dtype), "bias": jnp.zeros((cfg.n_classes,), cfg.dtype)},
+    }
+
+
+def param_logical_axes(cfg: Config = Config()) -> Dict[str, Any]:
+    return {
+        "conv1": {"kernel": (None, None, None, None), "bias": (None,)},
+        "conv2": {"kernel": (None, None, None, None), "bias": (None,)},
+        "fc1": {"kernel": ("embed", "mlp"), "bias": ("mlp",)},
+        "fc2": {"kernel": ("mlp", None), "bias": (None,)},
+    }
+
+
+def _conv(x, p, stride=1):
+    y = jax.lax.conv_general_dilated(
+        x, p["kernel"], (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return y + p["bias"]
+
+
+def apply(params: Dict[str, Any], images: jax.Array, cfg: Config = Config(),
+          rules: Optional[LogicalRules] = None) -> jax.Array:
+    """images: [B, 28, 28, 1] → logits [B, 10]."""
+    x = _conv(images.astype(cfg.dtype), params["conv1"])
+    x = jax.nn.relu(x)
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID")
+    x = jax.nn.relu(_conv(x, params["conv2"]))
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID")
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["fc1"]["kernel"] + params["fc1"]["bias"])
+    return x @ params["fc2"]["kernel"] + params["fc2"]["bias"]
+
+
+def loss_fn(params, batch: Dict[str, jax.Array], cfg: Config = Config(),
+            rules: Optional[LogicalRules] = None):
+    logits = apply(params, batch["images"], cfg, rules)
+    labels = batch["labels"]
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    acc = jnp.mean(jnp.argmax(logits, -1) == labels)
+    return jnp.mean(nll), {"accuracy": acc}

@@ -1,0 +1,9 @@
+"""TPU kernels: fused attention (pallas) + ring attention (context parallel).
+
+The compute-hot ops the framework owns directly rather than leaving to XLA's
+default lowering. Everything here has a pure-XLA fallback so the same model
+code runs on CPU test meshes.
+"""
+
+from determined_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from determined_tpu.ops.ring_attention import ring_attention  # noqa: F401

@@ -1,0 +1,54 @@
+"""Fused causal attention.
+
+The MFU-critical op for the GPT-2 north star (BASELINE.md). Strategy:
+  - On TPU, use the pallas fused kernel (determined_tpu.ops.pallas_attention)
+    when the shapes tile cleanly onto the MXU/VMEM.
+  - Otherwise (CPU meshes, odd shapes) fall back to a numerically identical
+    XLA implementation — jnp softmax(QK^T)V with fp32 accumulation. XLA
+    already fuses the mask+softmax chain; the pallas kernel's win is avoiding
+    the S×S logits round-trip to HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _xla_attention(q, k, v, causal: bool) -> jax.Array:
+    """Reference implementation. q,k,v: [B, S, H, D] → [B, S, H, D]."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        s_q, s_k = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), jnp.bool_), k=s_k - s_q)
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _pallas_supported(q) -> bool:
+    if jax.default_backend() not in ("tpu", "axon"):
+        return False
+    b, s, h, d = q.shape
+    return s % 128 == 0 and d in (64, 128, 256)
+
+
+def flash_attention(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+) -> jax.Array:
+    if _pallas_supported(q):
+        try:
+            from determined_tpu.ops.pallas_attention import pallas_flash_attention
+
+            return pallas_flash_attention(q, k, v, causal=causal)
+        except ImportError:
+            pass
+    return _xla_attention(q, k, v, causal)

@@ -1,0 +1,20 @@
+"""Parallelism primitives: device meshes, logical sharding rules, collectives.
+
+TPU-native replacement for the reference's NCCL/Gloo/Horovod/DeepSpeed launch
+matrix (SURVEY.md §2.4): all gradient/tensor communication is expressed as
+GSPMD shardings over a `jax.sharding.Mesh` and lowered by XLA to ICI/DCN
+collectives — there is no external comm library.
+"""
+
+from determined_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    create_mesh,
+    mesh_shape_for_devices,
+)
+from determined_tpu.parallel.sharding import (  # noqa: F401
+    LogicalRules,
+    DEFAULT_RULES,
+    logical_to_mesh_spec,
+    shard_logical,
+    named_sharding,
+)

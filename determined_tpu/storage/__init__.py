@@ -1,0 +1,9 @@
+"""Checkpoint storage backends (reference harness/determined/common/storage/).
+
+A StorageManager maps (storage config) → concrete paths/upload/download.
+`shared_fs` and `directory` are fully native (GCS buckets are typically
+FUSE-mounted on TPU-VMs, so shared_fs covers gcsfuse too); `gcs`/`s3`/`azure`
+use their cloud SDKs when importable and raise a clear error otherwise.
+"""
+
+from determined_tpu.storage.base import StorageManager, from_config  # noqa: F401

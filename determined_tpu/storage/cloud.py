@@ -1,0 +1,154 @@
+"""Cloud storage backends: GCS / S3 / Azure (reference
+harness/determined/common/storage/{gcs,s3,azure}.py).
+
+On TPU-VMs the canonical checkpoint path is a GCS bucket. Two modes:
+  1. tensorstore-native: orbax writes `gs://...` URLs directly (no local
+     staging) — used automatically by CheckpointContext when the storage
+     manager exposes a `url_for` returning a gs:// path.
+  2. SDK copy mode: upload/download via the cloud SDK, for arbitrary files.
+SDKs are imported lazily; a missing SDK raises with install guidance.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Dict, List, Optional
+
+from determined_tpu.storage.base import StorageManager
+
+
+class CloudStorageManager(StorageManager):
+    scheme = ""
+
+    def __init__(self, bucket: str, prefix: str = ""):
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        # local staging area for upload/download-style use
+        super().__init__(os.path.join(tempfile.gettempdir(), "det_tpu_cloud_staging"))
+
+    def url_for(self, storage_id: str) -> str:
+        parts = [p for p in (self.bucket, self.prefix, storage_id) if p]
+        return f"{self.scheme}://" + "/".join(parts)
+
+
+class GCSStorageManager(CloudStorageManager):
+    scheme = "gs"
+
+    def __init__(self, bucket: str, prefix: str = ""):
+        super().__init__(bucket, prefix)
+        try:
+            from google.cloud import storage as _  # noqa: F401
+
+            self._sdk = True
+        except ImportError:
+            # tensorstore can still write gs:// URLs without the SDK.
+            self._sdk = False
+
+    def upload(self, src: str, storage_id: str, paths: Optional[List[str]] = None) -> None:
+        if not self._sdk:
+            raise RuntimeError(
+                "google-cloud-storage not installed; array checkpoints still "
+                "work via tensorstore gs:// paths, but file upload needs the SDK"
+            )
+        from google.cloud import storage
+
+        client = storage.Client()
+        bucket = client.bucket(self.bucket)
+        names = paths if paths is not None else os.listdir(src)
+        for name in names:
+            full = os.path.join(src, name)
+            if os.path.isdir(full):
+                for root, _, files in os.walk(full):
+                    for f in files:
+                        p = os.path.join(root, f)
+                        rel = os.path.relpath(p, src)
+                        bucket.blob(self._key(storage_id, rel)).upload_from_filename(p)
+            else:
+                bucket.blob(self._key(storage_id, name)).upload_from_filename(full)
+
+    def download(self, storage_id: str, dst: str, selector=None) -> None:
+        if not self._sdk:
+            raise RuntimeError("google-cloud-storage not installed")
+        from google.cloud import storage
+
+        client = storage.Client()
+        bucket = client.bucket(self.bucket)
+        prefix = self._key(storage_id, "")
+        for blob in client.list_blobs(bucket, prefix=prefix):
+            rel = blob.name[len(prefix):]
+            if selector is not None and not selector(rel):
+                continue
+            out = os.path.join(dst, rel)
+            os.makedirs(os.path.dirname(out), exist_ok=True)
+            blob.download_to_filename(out)
+
+    def _key(self, storage_id: str, rel: str) -> str:
+        parts = [p for p in (self.prefix, storage_id, rel) if p]
+        return "/".join(parts)
+
+
+class S3StorageManager(CloudStorageManager):
+    scheme = "s3"
+
+    def __init__(self, bucket: str, prefix: str = ""):
+        super().__init__(bucket, prefix)
+        try:
+            import boto3  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError("boto3 not installed; s3 storage unavailable") from e
+
+    def upload(self, src: str, storage_id: str, paths: Optional[List[str]] = None) -> None:
+        import boto3
+
+        s3 = boto3.client("s3")
+        names = paths if paths is not None else os.listdir(src)
+        for name in names:
+            full = os.path.join(src, name)
+            if os.path.isdir(full):
+                for root, _, files in os.walk(full):
+                    for f in files:
+                        p = os.path.join(root, f)
+                        rel = os.path.relpath(p, src)
+                        s3.upload_file(p, self.bucket, self._key(storage_id, rel))
+            else:
+                s3.upload_file(full, self.bucket, self._key(storage_id, name))
+
+    def download(self, storage_id: str, dst: str, selector=None) -> None:
+        import boto3
+
+        s3 = boto3.client("s3")
+        prefix = self._key(storage_id, "")
+        paginator = s3.get_paginator("list_objects_v2")
+        for page in paginator.paginate(Bucket=self.bucket, Prefix=prefix):
+            for obj in page.get("Contents", []):
+                rel = obj["Key"][len(prefix):]
+                if selector is not None and not selector(rel):
+                    continue
+                out = os.path.join(dst, rel)
+                os.makedirs(os.path.dirname(out), exist_ok=True)
+                s3.download_file(self.bucket, obj["Key"], out)
+
+    def _key(self, storage_id: str, rel: str) -> str:
+        parts = [p for p in (self.prefix, storage_id, rel) if p]
+        return "/".join(parts)
+
+
+class AzureStorageManager(CloudStorageManager):
+    scheme = "az"
+
+    def __init__(self, container: str, connection_string: str = "", prefix: str = ""):
+        super().__init__(container, prefix)
+        raise RuntimeError(
+            "azure-storage-blob not available in this image; use shared_fs/gcs"
+        )
+
+
+def cloud_from_config(stype: str, config: Dict[str, Any]) -> StorageManager:
+    if stype == "gcs":
+        return GCSStorageManager(config["bucket"], config.get("prefix", ""))
+    if stype == "s3":
+        return S3StorageManager(config["bucket"], config.get("prefix", ""))
+    if stype == "azure":
+        return AzureStorageManager(config.get("container", ""), config.get("connection_string", ""))
+    raise ValueError(stype)

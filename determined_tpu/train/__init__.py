@@ -1,0 +1,12 @@
+"""Trial/Trainer APIs — the JAX-native analogue of the reference's
+PyTorchTrial + Trainer (harness/determined/pytorch/_pytorch_trial.py:1391,
+_trainer.py:70), re-shaped for functional JAX: a Trial is a bundle of pure
+functions (init/loss/eval + an optax optimizer); the Trainer owns the mesh,
+sharded train state, jitted step, checkpointing, metric reporting, searcher
+ops and preemption.
+"""
+
+from determined_tpu.train.state import TrainState, create_train_state  # noqa: F401
+from determined_tpu.train.step import make_train_step, make_eval_step  # noqa: F401
+from determined_tpu.train.trial import JaxTrial  # noqa: F401
+from determined_tpu.train.trainer import Trainer  # noqa: F401

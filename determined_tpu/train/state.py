@@ -1,0 +1,130 @@
+"""Sharded train state.
+
+One pytree carrying step/params/opt_state, with helpers to compute its GSPMD
+shardings from the model's logical axes and to initialise it *already sharded*
+(params materialise directly on their owning devices via jit out_shardings —
+no host-side full copy, which matters when params exceed one chip's HBM).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from determined_tpu.parallel.sharding import LogicalRules
+
+
+@struct.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    # Non-gradient mutable collections (e.g. BatchNorm running stats). None for
+    # purely functional models.
+    extra: Any = None
+
+    def apply_gradients(
+        self, grads: Any, tx: optax.GradientTransformation, new_extra: Any = None
+    ) -> "TrainState":
+        updates, new_opt_state = tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return TrainState(
+            step=self.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            extra=self.extra if new_extra is None else new_extra,
+        )
+
+
+def param_specs(param_logical_axes: Any, rules: Optional[LogicalRules] = None) -> Any:
+    """Pytree of PartitionSpec matching a params pytree of logical-axis tuples."""
+    rules = rules or LogicalRules()
+    return jax.tree_util.tree_map(
+        lambda axes: rules.spec(axes),
+        param_logical_axes,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def state_specs(
+    init_fn: Callable[[jax.Array], Any],
+    tx: optax.GradientTransformation,
+    param_logical_axes: Any,
+    rules: Optional[LogicalRules] = None,
+    rng: Optional[jax.Array] = None,
+) -> TrainState:
+    """PartitionSpecs for the full TrainState.
+
+    Optimizer-state sharding is derived structurally: optax states are pytrees
+    whose array leaves either mirror params (mu/nu → same spec) or are scalars
+    (count → replicated). We eval the shapes abstractly and match leaves to
+    param leaves by shape.
+    """
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    pspecs = param_specs(param_logical_axes, rules)
+
+    def init_state(r):
+        params = init_fn(r)
+        return TrainState(
+            step=jax.numpy.zeros((), jax.numpy.int32),
+            params=params,
+            opt_state=tx.init(params),
+        )
+
+    shapes = jax.eval_shape(init_state, rng)
+
+    flat_params, _ = jax.tree_util.tree_flatten(shapes.params)
+    flat_pspecs, _ = jax.tree_util.tree_flatten(pspecs, is_leaf=lambda x: isinstance(x, PartitionSpec))
+    shape_to_spec = {}
+    for leaf, spec in zip(flat_params, flat_pspecs):
+        shape_to_spec.setdefault((leaf.shape, leaf.dtype), spec)
+
+    def opt_spec(leaf):
+        return shape_to_spec.get((leaf.shape, leaf.dtype), PartitionSpec())
+
+    return TrainState(
+        step=PartitionSpec(),
+        params=pspecs,
+        opt_state=jax.tree_util.tree_map(opt_spec, shapes.opt_state),
+        extra=None,
+    )
+
+
+def create_train_state(
+    init_fn: Callable[[jax.Array], Any],
+    tx: optax.GradientTransformation,
+    rng: jax.Array,
+    mesh: Optional[Mesh] = None,
+    param_logical_axes: Optional[Any] = None,
+    rules: Optional[LogicalRules] = None,
+    extra: Any = None,
+) -> TrainState:
+    """Initialise TrainState; sharded over `mesh` if given.
+
+    `extra` is a concrete pytree of non-gradient state (replicated)."""
+
+    def init_state(r):
+        params = init_fn(r)
+        return TrainState(
+            step=jax.numpy.zeros((), jax.numpy.int32),
+            params=params,
+            opt_state=tx.init(params),
+            extra=extra,
+        )
+
+    if mesh is None or param_logical_axes is None:
+        return jax.jit(init_state)(rng)
+
+    specs = state_specs(init_fn, tx, param_logical_axes, rules, rng)
+    specs = specs.replace(
+        extra=jax.tree_util.tree_map(lambda _: PartitionSpec(), extra)
+    )
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )
+    return jax.jit(init_state, out_shardings=shardings)(rng)

@@ -1,0 +1,114 @@
+"""Jitted train/eval step factories.
+
+The hot loop. One `jit` per trial covering forward+backward+optimizer update;
+batch sharded over (data, fsdp) on entry; all cross-device communication is
+GSPMD-inserted XLA collectives (psum for grads over data axes,
+reduce-scatter/all-gather for fsdp params) riding ICI — the TPU-native
+replacement for DDP allreduce / ZeRO (reference:
+harness/determined/pytorch/_pytorch_context.py:297 wrap_model → DDP).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from determined_tpu.parallel.sharding import LogicalRules
+from determined_tpu.train.state import TrainState
+
+# loss_fn(params, batch, rng) -> scalar loss OR (loss, aux_metrics)
+LossFn = Callable[..., Any]
+
+
+def _call_loss(loss_fn: LossFn, params, batch, rng) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    out = loss_fn(params, batch, rng)
+    if isinstance(out, tuple):
+        loss, aux = out
+    else:
+        loss, aux = out, {}
+    return loss, aux
+
+
+def make_train_step(
+    loss_fn: LossFn,
+    tx: optax.GradientTransformation,
+    mesh: Optional[Mesh] = None,
+    rules: Optional[LogicalRules] = None,
+    donate_state: bool = True,
+    stateful: bool = False,
+):
+    """Build `step(state, batch, rng) -> (state, metrics)`, jitted.
+
+    Stateless (default): loss_fn(params, batch, rng) -> loss | (loss, metrics).
+    Stateful (BatchNorm etc.): loss_fn(params, extra, batch, rng) ->
+    (loss, metrics, new_extra); new_extra lands in state.extra.
+
+    metrics always include `loss` and `grad_norm` (fp32 scalars, replicated).
+    """
+    rules = rules or LogicalRules()
+
+    def step(state: TrainState, batch: Any, rng: jax.Array):
+        batch = _constrain_batch(batch, mesh, rules)
+
+        def lfn(params):
+            if stateful:
+                loss, aux, new_extra = loss_fn(params, state.extra, batch, rng)
+            else:
+                loss, aux = _call_loss(loss_fn, params, batch, rng)
+                new_extra = None
+            return loss.astype(jnp.float32), (aux, new_extra)
+
+        (loss, (aux, new_extra)), grads = jax.value_and_grad(lfn, has_aux=True)(
+            state.params
+        )
+        gnorm = optax.global_norm(grads)
+        new_state = state.apply_gradients(grads, tx, new_extra)
+        metrics = {"loss": loss, "grad_norm": gnorm, **aux}
+        return new_state, metrics
+
+    return jax.jit(step, donate_argnums=(0,) if donate_state else ())
+
+
+def _constrain_batch(batch: Any, mesh: Optional[Mesh], rules: LogicalRules) -> Any:
+    """Pin batch leaves to the (data, fsdp) layout along dim 0."""
+    if mesh is None:
+        return batch
+    spec = PartitionSpec(rules.mesh_axes("batch"))
+
+    def constrain(x):
+        if getattr(x, "ndim", 0) == 0:
+            return x
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(constrain, batch)
+
+
+def batch_sharding(mesh: Mesh, rules: Optional[LogicalRules] = None) -> NamedSharding:
+    """The sharding data loaders should device_put batches with."""
+    rules = rules or LogicalRules()
+    return NamedSharding(mesh, PartitionSpec(rules.mesh_axes("batch")))
+
+
+def make_eval_step(
+    eval_fn: Callable[..., Dict[str, jax.Array]],
+    mesh: Optional[Mesh] = None,
+    rules: Optional[LogicalRules] = None,
+    stateful: bool = False,
+):
+    """Build `eval_step(state, batch) -> metrics` (per-batch sums/means).
+
+    Stateless: eval_fn(params, batch); stateful: eval_fn(params, extra, batch).
+    """
+    rules = rules or LogicalRules()
+
+    def step(state: TrainState, batch: Any):
+        batch = _constrain_batch(batch, mesh, rules)
+        if stateful:
+            return eval_fn(state.params, state.extra, batch)
+        return eval_fn(state.params, batch)
+
+    return jax.jit(step)

@@ -1,0 +1,236 @@
+"""Trainer — owns the loop (reference pytorch.Trainer.fit,
+harness/determined/pytorch/_trainer.py:70 + _PyTorchTrialController.run,
+_pytorch_trial.py:548).
+
+Responsibilities: mesh bring-up, sharded state init, jitted step, searcher-op
+loop, periodic validation/checkpoint/metric reporting, preemption, resume.
+TPU specifics:
+  - one jit compile per trial (static shapes); the op loop never retraces
+  - metric device→host syncs are batched every `report_period` steps so the
+    train loop stays ahead of the device (async dispatch)
+  - checkpoints are async orbax saves off the critical path
+  - on preemption: ack → save → exit 0 (scheduler restarts elsewhere)
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, Iterable, Iterator, Optional
+
+import jax
+import numpy as np
+
+from determined_tpu import core as core_mod
+from determined_tpu.parallel.mesh import create_mesh
+from determined_tpu.train.state import TrainState, create_train_state
+from determined_tpu.train.step import make_eval_step, make_train_step
+from determined_tpu.train.trial import JaxTrial
+
+logger = logging.getLogger("determined_tpu.train")
+
+
+def _repeat(iterable_factory) -> Iterator[Any]:
+    while True:
+        it = iterable_factory()
+        empty = True
+        for batch in it:
+            empty = False
+            yield batch
+        if empty:
+            raise RuntimeError("training data iterable is empty")
+
+
+class Trainer:
+    def __init__(
+        self,
+        trial: JaxTrial,
+        core_context: Optional[core_mod.Context] = None,
+        devices: Optional[list] = None,
+    ):
+        self.trial = trial
+        self.core = core_context
+        mesh_cfg = trial.mesh_config()
+        if devices is None:
+            devices = jax.devices()
+        self.mesh = create_mesh(mesh_cfg.resolve(len(devices)), devices)
+        self.rules = trial.sharding_rules()
+        self.state: Optional[TrainState] = None
+        self._train_step = None
+        self._eval_step = None
+
+    # -- setup ---------------------------------------------------------
+
+    def _ensure_core(self, max_length: Optional[int]) -> core_mod.Context:
+        if self.core is None:
+            self.core = core_mod.init(max_length=max_length)
+        elif max_length is not None and self.core.searcher._local_max_length is None:
+            self.core.searcher._local_max_length = max_length
+        return self.core
+
+    def _build(self, seed: int) -> None:
+        trial = self.trial
+        tx = trial.optimizer()
+        axes = trial.param_logical_axes()
+        rng = jax.random.PRNGKey(seed)
+        with jax.sharding.set_mesh(self.mesh):
+            self.state = create_train_state(
+                trial.init_params,
+                tx,
+                rng,
+                mesh=self.mesh if axes is not None else None,
+                param_logical_axes=axes,
+                rules=self.rules,
+                extra=trial.init_extra(),
+            )
+        self._train_step = make_train_step(
+            trial.loss, tx, mesh=self.mesh, rules=self.rules, stateful=trial.stateful
+        )
+        if type(trial).evaluate is not JaxTrial.evaluate:
+            self._eval_step = make_eval_step(
+                trial.evaluate, mesh=self.mesh, rules=self.rules,
+                stateful=trial.stateful,
+            )
+        else:
+            self._eval_step = None
+
+    # -- the loop --------------------------------------------------------
+
+    def fit(
+        self,
+        max_length: Optional[int] = None,
+        validation_period: int = 0,
+        checkpoint_period: int = 0,
+        report_period: int = 10,
+        seed: int = 0,
+        profile: bool = False,
+        resume_from: Optional[str] = None,
+    ) -> TrainState:
+        """Train through all searcher operations; returns final state.
+
+        Lengths are in steps (batches). validation/checkpoint_period of 0 =
+        only at op boundaries. `resume_from` overrides the cluster's
+        latest-checkpoint (managed restarts pass it via DET_LATEST_CHECKPOINT).
+        """
+        core = self._ensure_core(max_length)
+        seed = core.trial_seed or seed
+        self._build(seed)
+        assert self.state is not None
+
+        resume_from = resume_from or core.latest_checkpoint
+        if resume_from:
+            self._restore(resume_from)
+        if profile:
+            core.profiler.on()
+
+        data_iter = _repeat(self.trial.build_training_data)
+        rng = jax.random.PRNGKey(seed + 1)
+        step = int(jax.device_get(self.state.step))
+        preempted = False
+        last = None  # (step, device_metrics) of the newest step
+        last_validated = last_checkpointed = step
+        last_val: Dict[str, Any] = {}
+        t_report = time.time()
+        n_report = 0
+
+        def flush():
+            nonlocal last, t_report, n_report
+            if last is not None:
+                self._flush_metrics(core, last, t_report, n_report)
+            last, t_report, n_report = None, time.time(), 0
+
+        with jax.sharding.set_mesh(self.mesh):
+            for op in core.searcher.operations():
+                while step < op.length and not preempted:
+                    batch = next(data_iter)
+                    rng, step_rng = jax.random.split(rng)
+                    self.state, metrics = self._train_step(self.state, batch, step_rng)
+                    step += 1
+                    n_report += 1
+                    last = (step, metrics)
+
+                    if report_period and step % report_period == 0:
+                        flush()
+                        core.profiler.set_step(step)
+                    if validation_period and step % validation_period == 0:
+                        last_val = self._validate(core, step)
+                        last_validated = step
+                    if checkpoint_period and step % checkpoint_period == 0:
+                        self._checkpoint(core, step)
+                        last_checkpointed = step
+                    if step % max(report_period, 1) == 0 and core.preempt.should_preempt():
+                        preempted = True
+
+                flush()
+
+                if preempted:
+                    if last_checkpointed != step:
+                        self._checkpoint(core, step)
+                    logger.info("preempted at step %d; checkpoint saved", step)
+                    break
+
+                val = last_val if last_validated == step else self._validate(core, step)
+                if last_checkpointed != step:
+                    self._checkpoint(core, step)
+                    last_checkpointed = step
+                if not op.completed:
+                    metric = (
+                        self.trial.searcher_metric(val)
+                        if val
+                        else float(jax.device_get(self.state.step))
+                    )
+                    op.report_completed(metric)
+
+        core.checkpoint.wait()
+        if profile:
+            core.profiler.off()
+        return self.state
+
+    # -- helpers ---------------------------------------------------------
+
+    def _flush_metrics(self, core, last, t_start, n_steps) -> None:
+        last_step, last_metrics = last
+        host = {k: np.asarray(jax.device_get(v)) for k, v in last_metrics.items()}
+        dt = time.time() - t_start
+        if n_steps and dt > 0:
+            host["steps_per_second"] = n_steps / dt
+        core.train.report_training_metrics(last_step, host)
+
+    def _validate(self, core, step: int) -> Dict[str, Any]:
+        if self._eval_step is None:
+            return {}
+        sums: Dict[str, Any] = {}
+        count = 0
+        for batch in self.trial.build_validation_data():
+            m = self._eval_step(self.state, batch)
+            m = {k: float(np.asarray(jax.device_get(v))) for k, v in m.items()}
+            for k, v in m.items():
+                sums[k] = sums.get(k, 0.0) + v
+            count += 1
+        if count == 0:
+            return {}
+        avg = {f"validation_{k}" if not k.startswith("validation_") else k: v / count
+               for k, v in sums.items()}
+        core.train.report_validation_metrics(step, avg)
+        return avg
+
+    def _checkpoint(self, core, step: int) -> None:
+        core.checkpoint.save_state(self.state, step)
+
+    def _restore(self, storage_id: str) -> None:
+        assert self.state is not None
+        try:
+            self.state = self.core.checkpoint.restore_state(storage_id, self.state)
+            logger.info(
+                "restored from checkpoint %s at step %d",
+                storage_id,
+                int(jax.device_get(self.state.step)),
+            )
+        except FileNotFoundError:
+            logger.warning("latest checkpoint %s missing; starting fresh", storage_id)
+        except Exception:
+            # A partial/corrupt checkpoint (e.g. process killed mid async
+            # commit) must not crash-loop the trial — start fresh instead.
+            logger.warning(
+                "checkpoint %s unreadable; starting fresh", storage_id, exc_info=True
+            )

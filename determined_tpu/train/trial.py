@@ -1,0 +1,118 @@
+"""JaxTrial — the class users subclass (reference PyTorchTrial,
+harness/determined/pytorch/_pytorch_trial.py:1391, re-shaped functional).
+
+A trial is a bundle of pure functions over pytrees; the Trainer owns the mesh
+and the loop. Hyperparameters arrive via `self.context.hparams` exactly like
+the reference's `context.get_hparam`.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, Iterable, Optional
+
+import optax
+
+from determined_tpu.parallel.mesh import MeshConfig
+from determined_tpu.parallel.sharding import LogicalRules
+
+
+class TrialContext:
+    """What a trial sees of its environment (hparams, topology, per-host batch)."""
+
+    def __init__(
+        self,
+        hparams: Optional[Dict[str, Any]] = None,
+        core_context=None,
+        global_batch_size: Optional[int] = None,
+        n_devices: int = 1,
+    ):
+        self.hparams = dict(hparams or {})
+        self.core = core_context
+        self.n_devices = n_devices
+        self._global_batch_size = global_batch_size or self.hparams.get(
+            "global_batch_size", 32
+        )
+
+    def get_hparam(self, name: str, default: Any = None) -> Any:
+        if default is None and name not in self.hparams:
+            raise KeyError(f"hyperparameter {name!r} not set")
+        return self.hparams.get(name, default)
+
+    @property
+    def global_batch_size(self) -> int:
+        return int(self._global_batch_size)
+
+    @property
+    def per_device_batch_size(self) -> int:
+        return max(1, self.global_batch_size // max(1, self.n_devices))
+
+
+class JaxTrial(abc.ABC):
+    """Subclass and implement the pure pieces; Trainer does the rest."""
+
+    # Trials that keep non-gradient state (BatchNorm stats) set this and use
+    # the stateful loss signature (see train.step.make_train_step).
+    stateful = False
+
+    def __init__(self, context: TrialContext):
+        self.context = context
+
+    # -- model ---------------------------------------------------------
+
+    @abc.abstractmethod
+    def init_params(self, rng) -> Any:
+        """Build the initial parameter pytree (called under jit)."""
+
+    @abc.abstractmethod
+    def loss(self, params, batch, rng):
+        """Stateless: (params, batch, rng) -> loss | (loss, metrics).
+        Stateful: (params, extra, batch, rng) -> (loss, metrics, new_extra)."""
+
+    def init_extra(self) -> Any:
+        """Initial non-gradient state (stateful trials only)."""
+        return None
+
+    def optimizer(self) -> optax.GradientTransformation:
+        lr = self.context.hparams.get("learning_rate", 1e-3)
+        return optax.adamw(lr)
+
+    def param_logical_axes(self) -> Optional[Any]:
+        """Pytree of logical-axis tuples for GSPMD layout; None → replicate."""
+        return None
+
+    def sharding_rules(self) -> LogicalRules:
+        return LogicalRules()
+
+    def mesh_config(self) -> MeshConfig:
+        """Default: pure data parallel over the allocation's chips."""
+        mc = self.context.hparams.get("mesh")
+        return MeshConfig.from_dict(mc) if mc else MeshConfig()
+
+    # -- data ----------------------------------------------------------
+
+    @abc.abstractmethod
+    def build_training_data(self) -> Iterable[Any]:
+        """Iterable of global batches (numpy/jax pytrees). Restarts when
+        exhausted; infinite iterators are idiomatic for TPU."""
+
+    def build_validation_data(self) -> Iterable[Any]:
+        return ()
+
+    # -- evaluation ----------------------------------------------------
+
+    def evaluate(self, params, batch) -> Dict[str, Any]:
+        """Per-batch validation metrics; averaged over batches by the Trainer.
+        Stateful trials receive (params, extra, batch)."""
+        raise NotImplementedError(
+            "implement evaluate() or leave build_validation_data() empty"
+        )
+
+    # -- knobs ----------------------------------------------------------
+
+    def searcher_metric(self, val_metrics: Dict[str, Any]) -> float:
+        """Scalar the HP searcher optimises; default: validation loss."""
+        for k in ("validation_loss", "loss"):
+            if k in val_metrics:
+                return float(val_metrics[k])
+        return float(next(iter(val_metrics.values())))

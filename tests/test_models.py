@@ -1,0 +1,140 @@
+"""Model forward/loss sanity on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from determined_tpu.models import gpt2, mnist, resnet
+from determined_tpu.parallel import MeshConfig, create_mesh
+from determined_tpu.train import create_train_state, make_train_step
+
+
+class TestGPT2:
+    @pytest.fixture(scope="class")
+    def cfg(self):
+        return gpt2.Config.tiny()
+
+    def test_forward_shapes(self, cfg):
+        params = gpt2.init(jax.random.PRNGKey(0), cfg)
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        logits = jax.jit(lambda p, t: gpt2.apply(p, t, cfg))(params, tokens)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+
+    def test_loss_decreases(self, cfg):
+        params = gpt2.init(jax.random.PRNGKey(0), cfg)
+        tx = optax.adam(1e-2)
+        state = create_train_state(lambda r: gpt2.init(r, cfg), tx, jax.random.PRNGKey(0))
+        step = make_train_step(lambda p, b, r: gpt2.loss_fn(p, b, cfg), tx)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0, 64)
+        batch = {"tokens": tokens}
+        first = None
+        for i in range(10):
+            state, metrics = step(state, batch, jax.random.PRNGKey(i))
+            if first is None:
+                first = float(metrics["loss"])
+        assert float(metrics["loss"]) < first
+
+    def test_causality(self, cfg):
+        """Changing a future token must not change past logits."""
+        params = gpt2.init(jax.random.PRNGKey(0), cfg)
+        t1 = jnp.zeros((1, 8), jnp.int32)
+        t2 = t1.at[0, 7].set(5)
+        l1 = gpt2.apply(params, t1, cfg)
+        l2 = gpt2.apply(params, t2, cfg)
+        np.testing.assert_allclose(l1[0, :7], l2[0, :7], atol=1e-5)
+
+    def test_sharded_train_step(self, cfg, devices):
+        mesh = create_mesh(MeshConfig(data=2, fsdp=2, tensor=2), devices)
+        tx = optax.adamw(1e-3)
+        with jax.sharding.set_mesh(mesh):
+            state = create_train_state(
+                lambda r: gpt2.init(r, cfg),
+                tx,
+                jax.random.PRNGKey(0),
+                mesh=mesh,
+                param_logical_axes=gpt2.param_logical_axes(cfg),
+            )
+            step = make_train_step(lambda p, b, r: gpt2.loss_fn(p, b, cfg), tx, mesh=mesh)
+            tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0, 64)
+            state, metrics = step(state, {"tokens": tokens}, jax.random.PRNGKey(2))
+        assert np.isfinite(float(metrics["loss"]))
+        # qkv kernel sharded over fsdp rows and tensor cols
+        qkv = state.params["blocks"]["qkv"]["kernel"]
+        assert qkv.sharding.spec == jax.sharding.PartitionSpec(None, "fsdp", "tensor")
+
+    def test_sharded_matches_single_device(self, cfg, devices):
+        """DP/TP sharding must not change the math."""
+        tx = optax.sgd(1e-2)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0, 64)
+        batch = {"tokens": tokens}
+
+        state1 = create_train_state(lambda r: gpt2.init(r, cfg), tx, jax.random.PRNGKey(0))
+        step1 = make_train_step(lambda p, b, r: gpt2.loss_fn(p, b, cfg), tx)
+        _, m1 = step1(state1, batch, jax.random.PRNGKey(2))
+
+        mesh = create_mesh(MeshConfig(data=2, fsdp=2, tensor=2), devices)
+        with jax.sharding.set_mesh(mesh):
+            state8 = create_train_state(
+                lambda r: gpt2.init(r, cfg), tx, jax.random.PRNGKey(0),
+                mesh=mesh, param_logical_axes=gpt2.param_logical_axes(cfg),
+            )
+            step8 = make_train_step(lambda p, b, r: gpt2.loss_fn(p, b, cfg), tx, mesh=mesh)
+            _, m8 = step8(state8, batch, jax.random.PRNGKey(2))
+        np.testing.assert_allclose(float(m1["loss"]), float(m8["loss"]), rtol=2e-2)
+
+    def test_param_count_gpt2_small(self):
+        assert abs(gpt2.param_count(gpt2.Config.small()) - 124e6) / 124e6 < 0.02
+
+
+class TestMNIST:
+    def test_train_improves_accuracy(self, np_rng):
+        cfg = mnist.Config()
+        tx = optax.adam(1e-3)
+        state = create_train_state(lambda r: mnist.init(r, cfg), tx, jax.random.PRNGKey(0))
+        step = make_train_step(lambda p, b, r: mnist.loss_fn(p, b, cfg), tx)
+        # learnable synthetic task: label = quadrant of bright blob
+        images = np_rng.normal(size=(64, 28, 28, 1)).astype(np.float32)
+        labels = (images.sum((1, 2, 3)) > 0).astype(np.int32)
+        batch = {"images": jnp.asarray(images), "labels": jnp.asarray(labels)}
+        for i in range(30):
+            state, metrics = step(state, batch, jax.random.PRNGKey(i))
+        assert float(metrics["accuracy"]) > 0.9
+
+
+class TestResNet:
+    def test_stateful_step_updates_bn(self):
+        cfg = resnet.Config(stage_sizes=(1, 1), num_filters=8)
+        params, stats = resnet.init(jax.random.PRNGKey(0), cfg)
+        tx = optax.sgd(1e-2)
+        state = create_train_state(
+            lambda r: resnet.init(r, cfg)[0], tx, jax.random.PRNGKey(0), extra=stats
+        )
+        step = make_train_step(
+            lambda p, e, b, r: resnet.loss_fn(p, e, b, r, cfg),
+            tx,
+            stateful=True,
+        )
+        images = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+        labels = jnp.zeros((4,), jnp.int32)
+        old_mean = np.asarray(state.extra["stem_bn"]["mean"]).copy()
+        state, metrics = step(state, {"images": images, "labels": labels}, jax.random.PRNGKey(2))
+        assert np.isfinite(float(metrics["loss"]))
+        assert not np.allclose(np.asarray(state.extra["stem_bn"]["mean"]), old_mean)
+
+    def test_eval_mode_uses_running_stats(self):
+        cfg = resnet.Config(stage_sizes=(1, 1), num_filters=8)
+        params, stats = resnet.init(jax.random.PRNGKey(0), cfg)
+        images = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+        logits, new_stats = resnet.apply(params, stats, images, cfg, train=False)
+        assert logits.shape == (2, cfg.n_classes)
+        # eval must not touch stats
+        np.testing.assert_array_equal(
+            np.asarray(new_stats["stem_bn"]["mean"]), np.asarray(stats["stem_bn"]["mean"])
+        )
+
+    def test_resnet50_shapes(self):
+        cfg = resnet.Config.resnet50(n_classes=100)
+        params, stats = jax.eval_shape(lambda r: resnet.init(r, cfg), jax.random.PRNGKey(0))
+        assert params["head"]["kernel"].shape == (2048, 100)

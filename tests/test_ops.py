@@ -1,0 +1,75 @@
+"""Attention kernel tests: fused flash vs reference, ring vs single-device."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from determined_tpu.ops.flash_attention import _xla_attention, flash_attention
+from determined_tpu.ops.ring_attention import ring_attention
+from determined_tpu.parallel import MeshConfig, create_mesh
+
+
+def _qkv(key, b=2, s=32, h=4, d=8, dtype=jnp.float32):
+    ks = jax.random.split(key, 3)
+    mk = lambda k: jax.random.normal(k, (b, s, h, d), dtype)
+    return mk(ks[0]), mk(ks[1]), mk(ks[2])
+
+
+class TestFlashAttention:
+    def test_matches_reference_causal(self):
+        q, k, v = _qkv(jax.random.PRNGKey(0))
+        out = flash_attention(q, k, v, causal=True)
+        ref = _xla_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_causality(self):
+        q, k, v = _qkv(jax.random.PRNGKey(1))
+        out1 = flash_attention(q, k, v, causal=True)
+        k2 = k.at[:, -1].set(99.0)
+        v2 = v.at[:, -1].set(99.0)
+        out2 = flash_attention(q, k2, v2, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out1[:, :-1]), np.asarray(out2[:, :-1]), atol=1e-5
+        )
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_single_device(self, devices, causal):
+        mesh = create_mesh(MeshConfig(data=2, context=4), devices)
+        q, k, v = _qkv(jax.random.PRNGKey(0), b=4, s=32)
+        ref = _xla_attention(q, k, v, causal=causal)
+        with jax.sharding.set_mesh(mesh):
+            out = jax.jit(
+                lambda q, k, v: ring_attention(q, k, v, causal=causal, mesh=mesh)
+            )(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+    def test_context_axis_size_one_falls_back(self, devices):
+        mesh = create_mesh(MeshConfig(data=8), devices)
+        q, k, v = _qkv(jax.random.PRNGKey(2))
+        with jax.sharding.set_mesh(mesh):
+            out = ring_attention(q, k, v, mesh=mesh)
+        ref = _xla_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_gpt2_with_ring_attention(self, devices):
+        """End-to-end: GPT-2 tiny configured with attention_impl='ring'."""
+        from determined_tpu.models import gpt2
+
+        cfg_ring = gpt2.Config(
+            vocab_size=128, n_positions=64, d_model=32, n_layer=1, n_head=2,
+            attention_impl="ring", remat=False, dtype=jnp.float32,
+        )
+        cfg_dot = gpt2.Config(
+            vocab_size=128, n_positions=64, d_model=32, n_layer=1, n_head=2,
+            attention_impl="dot", remat=False, dtype=jnp.float32,
+        )
+        params = gpt2.init(jax.random.PRNGKey(0), cfg_dot)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 128)
+        ref = gpt2.apply(params, tokens, cfg_dot)
+        mesh = create_mesh(MeshConfig(data=2, context=4), devices)
+        with jax.sharding.set_mesh(mesh):
+            out = jax.jit(lambda p, t: gpt2.apply(p, t, cfg_ring))(params, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
