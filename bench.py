@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Headline benchmark: GPT-2 (124M) pretraining throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no training-throughput numbers (BASELINE.md), so
+`vs_baseline` is measured MFU relative to the driver's 40% MFU target
+(BASELINE.json north star): vs_baseline = MFU / 0.40. >1.0 beats the target.
+
+Config: GPT-2 small, bf16, remat, seq 1024, per-chip batch 16 — the
+single-chip unit of the v5e-64 GPT-2 north-star workload.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import optax
+
+    from determined_tpu.models import gpt2
+    from determined_tpu.train import create_train_state, make_train_step
+
+    cfg = gpt2.Config.small()
+    B, S = 16, 1024
+    peak_flops = _peak_flops()
+
+    tx = optax.adamw(3e-4)
+    state = create_train_state(lambda r: gpt2.init(r, cfg), tx, jax.random.PRNGKey(0))
+    step = make_train_step(lambda p, b, r: gpt2.loss_fn(p, b, cfg), tx)
+    batch = {
+        "tokens": np.random.default_rng(0)
+        .integers(0, cfg.vocab_size, size=(B, S + 1))
+        .astype(np.int32)
+    }
+
+    # warmup / compile
+    for i in range(2):
+        state, m = step(state, batch, jax.random.PRNGKey(i))
+    float(m["loss"])  # full sync (block_until_ready is a no-op on some PJRT backends)
+
+    n_steps = 10
+    t0 = time.time()
+    for i in range(n_steps):
+        state, m = step(state, batch, jax.random.PRNGKey(100 + i))
+    float(m["loss"])
+    dt = (time.time() - t0) / n_steps
+
+    tokens_per_sec = B * S / dt
+    samples_per_sec = B / dt
+    mfu = gpt2.flops_per_token(cfg, S) * tokens_per_sec / peak_flops
+
+    print(
+        json.dumps(
+            {
+                "metric": "gpt2_124m_samples_per_sec_per_chip",
+                "value": round(samples_per_sec, 2),
+                "unit": "samples/sec/chip (seq=1024)",
+                "vs_baseline": round(mfu / 0.40, 3),
+                "detail": {
+                    "tokens_per_sec": round(tokens_per_sec),
+                    "step_ms": round(dt * 1000, 1),
+                    "mfu": round(mfu, 4),
+                    "batch": B,
+                    "seq": S,
+                    "device": str(jax.devices()[0]),
+                },
+            }
+        )
+    )
+
+
+def _peak_flops() -> float:
+    """bf16 peak of the bench chip; v5e ≈ 197 TFLOP/s."""
+    return 197e12
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,10 @@ class Config:
     dtype: Any = jnp.bfloat16  # activation dtype
     param_dtype: Any = jnp.float32
     remat: bool = True
+    # jax.checkpoint policy name: None = full remat; "dots" saves matmul
+    # outputs and recomputes only elementwise/softmax (less recompute, more
+    # HBM); see jax.checkpoint_policies.
+    remat_policy: Optional[str] = None
     attention_impl: str = "dot"  # "dot" | "flash" | "ring"
     layer_norm_eps: float = 1e-5
 
@@ -235,7 +239,14 @@ def apply(
 
     block = partial(_block, cfg=cfg, rules=rules)
     if cfg.remat:
-        block = jax.checkpoint(block)
+        policies = {
+            None: None,
+            "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            "nothing": jax.checkpoint_policies.nothing_saveable,
+            "everything": jax.checkpoint_policies.everything_saveable,
+        }
+        policy = policies[cfg.remat_policy]
+        block = jax.checkpoint(block, policy=policy) if policy else jax.checkpoint(block)
 
     def scan_body(carry, lp):
         return block(carry, lp), None
@@ -257,7 +268,11 @@ def loss_fn(
         inputs, targets = tokens, batch["targets"]
     else:
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits = apply(params, inputs, cfg, rules).astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    logits = apply(params, inputs, cfg, rules)
+    # NLL without materialising a full fp32 log-softmax over the vocab:
+    # nll = logsumexp(logits) - logits[target]. XLA fuses the f32 upcast into
+    # the reduction, so the [B,S,V] array stays bf16 in HBM.
+    lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = lse - tgt.astype(jnp.float32)
     return jnp.mean(nll)
