@@ -1,0 +1,316 @@
+#include "http.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <mutex>
+#include <sstream>
+#include <stdexcept>
+
+namespace det {
+
+namespace {
+
+// Read until we have a full request head + body (Content-Length framed).
+// Returns false on EOF / malformed input.
+bool read_request(int fd, HttpRequest* req, std::string* buf) {
+  char chunk[8192];
+  size_t head_end = std::string::npos;
+  while ((head_end = buf->find("\r\n\r\n")) == std::string::npos) {
+    ssize_t n = ::recv(fd, chunk, sizeof(chunk), 0);
+    if (n <= 0) return false;
+    buf->append(chunk, static_cast<size_t>(n));
+    if (buf->size() > (16u << 20)) return false;  // 16 MiB head guard
+  }
+
+  std::string head = buf->substr(0, head_end);
+  std::istringstream hs(head);
+  std::string line;
+  if (!std::getline(hs, line)) return false;
+  if (!line.empty() && line.back() == '\r') line.pop_back();
+  {
+    std::istringstream rl(line);
+    std::string target, version;
+    if (!(rl >> req->method >> target >> version)) return false;
+    auto qpos = target.find('?');
+    req->path = url_decode(target.substr(0, qpos));
+    if (qpos != std::string::npos) {
+      std::string qs = target.substr(qpos + 1);
+      size_t start = 0;
+      while (start <= qs.size()) {
+        size_t amp = qs.find('&', start);
+        std::string pair = qs.substr(
+            start, amp == std::string::npos ? std::string::npos : amp - start);
+        auto eq = pair.find('=');
+        if (eq != std::string::npos) {
+          req->query[url_decode(pair.substr(0, eq))] =
+              url_decode(pair.substr(eq + 1));
+        } else if (!pair.empty()) {
+          req->query[url_decode(pair)] = "";
+        }
+        if (amp == std::string::npos) break;
+        start = amp + 1;
+      }
+    }
+  }
+  while (std::getline(hs, line)) {
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    auto colon = line.find(':');
+    if (colon == std::string::npos) continue;
+    std::string key = line.substr(0, colon);
+    for (auto& c : key) c = static_cast<char>(tolower(c));
+    size_t vstart = line.find_first_not_of(' ', colon + 1);
+    req->headers[key] =
+        vstart == std::string::npos ? "" : line.substr(vstart);
+  }
+
+  size_t content_len = 0;
+  auto it = req->headers.find("content-length");
+  if (it != req->headers.end()) content_len = std::stoul(it->second);
+  size_t body_start = head_end + 4;
+  while (buf->size() < body_start + content_len) {
+    ssize_t n = ::recv(fd, chunk, sizeof(chunk), 0);
+    if (n <= 0) return false;
+    buf->append(chunk, static_cast<size_t>(n));
+  }
+  req->body = buf->substr(body_start, content_len);
+  buf->erase(0, body_start + content_len);
+  return true;
+}
+
+bool write_all(int fd, const std::string& data) {
+  size_t off = 0;
+  while (off < data.size()) {
+    ssize_t n = ::send(fd, data.data() + off, data.size() - off, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    off += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+const char* status_text(int code) {
+  switch (code) {
+    case 200: return "OK";
+    case 201: return "Created";
+    case 204: return "No Content";
+    case 400: return "Bad Request";
+    case 401: return "Unauthorized";
+    case 403: return "Forbidden";
+    case 404: return "Not Found";
+    case 408: return "Request Timeout";
+    case 409: return "Conflict";
+    case 500: return "Internal Server Error";
+    default: return "Unknown";
+  }
+}
+
+}  // namespace
+
+std::string url_decode(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (size_t i = 0; i < s.size(); ++i) {
+    if (s[i] == '%' && i + 2 < s.size() && isxdigit(s[i + 1]) &&
+        isxdigit(s[i + 2])) {
+      out += static_cast<char>(std::stoi(s.substr(i + 1, 2), nullptr, 16));
+      i += 2;
+    } else if (s[i] == '+') {
+      out += ' ';
+    } else {
+      out += s[i];
+    }
+  }
+  return out;
+}
+
+int HttpServer::listen(const std::string& host, int port, Handler handler) {
+  handler_ = std::move(handler);
+  listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) throw std::runtime_error("socket() failed");
+  int opt = 1;
+  setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &opt, sizeof(opt));
+
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (host.empty() || host == "0.0.0.0") {
+    addr.sin_addr.s_addr = INADDR_ANY;
+  } else if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    throw std::runtime_error("bad listen host: " + host);
+  }
+  if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    throw std::runtime_error("bind failed on port " + std::to_string(port) +
+                             ": " + strerror(errno));
+  }
+  if (::listen(listen_fd_, 256) != 0) {
+    throw std::runtime_error("listen failed");
+  }
+  socklen_t len = sizeof(addr);
+  getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+  port_ = ntohs(addr.sin_port);
+  running_ = true;
+  return port_;
+}
+
+void HttpServer::serve_forever() { accept_loop(); }
+
+void HttpServer::start() {
+  accept_thread_ = std::thread([this] { accept_loop(); });
+}
+
+void HttpServer::stop() {
+  if (!running_.exchange(false)) return;
+  if (listen_fd_ >= 0) {
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+  }
+  if (accept_thread_.joinable()) accept_thread_.join();
+  for (auto& w : workers_) {
+    if (w.joinable()) w.join();
+  }
+  workers_.clear();
+}
+
+void HttpServer::accept_loop() {
+  while (running_) {
+    sockaddr_in peer{};
+    socklen_t len = sizeof(peer);
+    int fd = ::accept(listen_fd_, reinterpret_cast<sockaddr*>(&peer), &len);
+    if (fd < 0) {
+      if (!running_) break;
+      continue;
+    }
+    char ip[INET_ADDRSTRLEN] = "?";
+    inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof(ip));
+    // Detached-style worker threads, joined on stop. Reap finished ones
+    // opportunistically to bound the vector on long-lived servers.
+    static std::mutex mu;
+    std::lock_guard<std::mutex> lock(mu);
+    if (workers_.size() > 512) {
+      for (auto& w : workers_) {
+        if (w.joinable()) w.join();
+      }
+      workers_.clear();
+    }
+    workers_.emplace_back(
+        [this, fd, remote = std::string(ip)] { handle_connection(fd, remote); });
+  }
+}
+
+void HttpServer::handle_connection(int fd, const std::string& remote) {
+  int opt = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &opt, sizeof(opt));
+  std::string buf;
+  while (running_) {
+    HttpRequest req;
+    req.remote_addr = remote;
+    if (!read_request(fd, &req, &buf)) break;
+    HttpResponse resp;
+    try {
+      resp = handler_(req);
+    } catch (const std::exception& e) {
+      resp.status = 500;
+      resp.body = std::string("{\"error\":\"") + e.what() + "\"}";
+    }
+    std::ostringstream out;
+    out << "HTTP/1.1 " << resp.status << ' ' << status_text(resp.status)
+        << "\r\nContent-Type: " << resp.content_type
+        << "\r\nContent-Length: " << resp.body.size()
+        << "\r\nConnection: keep-alive\r\n";
+    for (const auto& [k, v] : resp.headers) out << k << ": " << v << "\r\n";
+    out << "\r\n" << resp.body;
+    if (!write_all(fd, out.str())) break;
+    auto conn = req.headers.find("connection");
+    if (conn != req.headers.end() && conn->second == "close") break;
+  }
+  ::close(fd);
+}
+
+HttpClientResponse http_request(const std::string& method,
+                                const std::string& url, const std::string& path,
+                                const std::string& body, double timeout_s,
+                                const std::map<std::string, std::string>&
+                                    headers) {
+  // Parse "http://host:port".
+  std::string rest = url;
+  const std::string scheme = "http://";
+  if (rest.rfind(scheme, 0) == 0) rest = rest.substr(scheme.size());
+  auto slash = rest.find('/');
+  if (slash != std::string::npos) rest = rest.substr(0, slash);
+  std::string host = rest;
+  int port = 80;
+  auto colon = rest.rfind(':');
+  if (colon != std::string::npos) {
+    host = rest.substr(0, colon);
+    port = std::stoi(rest.substr(colon + 1));
+  }
+
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints, &res) !=
+      0) {
+    throw std::runtime_error("resolve failed: " + host);
+  }
+  int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd < 0) {
+    freeaddrinfo(res);
+    throw std::runtime_error("socket() failed");
+  }
+  if (timeout_s > 0) {
+    timeval tv;
+    tv.tv_sec = static_cast<long>(timeout_s);
+    tv.tv_usec = static_cast<long>((timeout_s - tv.tv_sec) * 1e6);
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  }
+  int rc = ::connect(fd, res->ai_addr, res->ai_addrlen);
+  freeaddrinfo(res);
+  if (rc != 0) {
+    ::close(fd);
+    throw std::runtime_error("connect failed: " + host + ":" +
+                             std::to_string(port));
+  }
+
+  std::ostringstream out;
+  out << method << ' ' << path << " HTTP/1.1\r\nHost: " << host
+      << "\r\nContent-Length: " << body.size()
+      << "\r\nContent-Type: application/json\r\nConnection: close\r\n";
+  for (const auto& [k, v] : headers) out << k << ": " << v << "\r\n";
+  out << "\r\n" << body;
+  if (!write_all(fd, out.str())) {
+    ::close(fd);
+    throw std::runtime_error("send failed");
+  }
+
+  std::string resp_buf;
+  char chunk[8192];
+  ssize_t n;
+  while ((n = ::recv(fd, chunk, sizeof(chunk), 0)) > 0) {
+    resp_buf.append(chunk, static_cast<size_t>(n));
+  }
+  ::close(fd);
+
+  auto head_end = resp_buf.find("\r\n\r\n");
+  if (head_end == std::string::npos) {
+    throw std::runtime_error("malformed/timeout response from " + host + path);
+  }
+  HttpClientResponse r;
+  {
+    std::istringstream hs(resp_buf.substr(0, head_end));
+    std::string version;
+    hs >> version >> r.status;
+  }
+  r.body = resp_buf.substr(head_end + 4);
+  return r;
+}
+
+}  // namespace det

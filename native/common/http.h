@@ -1,0 +1,100 @@
+// http.h — minimal HTTP/1.1 server + client over POSIX sockets.
+//
+// The reference master serves REST+gRPC on one port via cmux
+// (master/internal/core.go:744-763); agents hold a websocket to the master
+// (agent/internal/agent.go:246-270). The TPU-native design replaces both with
+// plain HTTP/1.1: REST for clients/harness, long-poll for agent↔master and
+// preemption/rendezvous signalling. Thread-per-connection with keep-alive —
+// the control plane is low-QPS (hundreds of agents / trials), so simplicity
+// beats epoll here; the data plane never touches this path.
+
+#pragma once
+
+#include <atomic>
+#include <functional>
+#include <map>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace det {
+
+struct HttpRequest {
+  std::string method;
+  std::string path;                         // without query string
+  std::map<std::string, std::string> query;
+  std::map<std::string, std::string> headers;  // lower-cased keys
+  std::string body;
+  std::string remote_addr;
+
+  std::string query_param(const std::string& key,
+                          const std::string& dflt = "") const {
+    auto it = query.find(key);
+    return it == query.end() ? dflt : it->second;
+  }
+};
+
+struct HttpResponse {
+  int status = 200;
+  std::string content_type = "application/json";
+  std::string body;
+  std::map<std::string, std::string> headers;
+
+  static HttpResponse json(int status, const std::string& body) {
+    HttpResponse r;
+    r.status = status;
+    r.body = body;
+    return r;
+  }
+};
+
+class HttpServer {
+ public:
+  using Handler = std::function<HttpResponse(const HttpRequest&)>;
+
+  HttpServer() = default;
+  ~HttpServer() { stop(); }
+
+  // Binds and listens; returns the bound port (useful with port=0).
+  // Throws std::runtime_error on bind failure.
+  int listen(const std::string& host, int port, Handler handler);
+  void serve_forever();  // blocks; call after listen()
+  void start();          // serve in a background thread
+  void stop();
+
+  int port() const { return port_; }
+
+ private:
+  void accept_loop();
+  void handle_connection(int fd, const std::string& remote);
+
+  int listen_fd_ = -1;
+  int port_ = 0;
+  Handler handler_;
+  std::atomic<bool> running_{false};
+  std::thread accept_thread_;
+  std::vector<std::thread> workers_;
+};
+
+// Blocking HTTP/1.1 client (one request per connection). Used by the agent
+// to talk to the master and by tests.
+struct HttpClientResponse {
+  int status = 0;
+  std::string body;
+  bool ok() const { return status >= 200 && status < 300; }
+};
+
+// url like "http://127.0.0.1:8080"; path like "/api/v1/...".
+// timeout_s <= 0 means no timeout. Throws std::runtime_error on transport
+// errors (connect/read failure), not on HTTP error statuses.
+HttpClientResponse http_request(const std::string& method,
+                                const std::string& url,
+                                const std::string& path,
+                                const std::string& body = "",
+                                double timeout_s = 30.0,
+                                const std::map<std::string, std::string>&
+                                    headers = {});
+
+std::string url_decode(const std::string& s);
+
+}  // namespace det

@@ -1,0 +1,420 @@
+// json.h — minimal JSON value type + parser + serializer (header-only).
+//
+// The reference platform speaks JSON everywhere (grpc-gateway REST bodies,
+// expconf configs, searcher snapshots). This is the native-side equivalent of
+// that wire format for the TPU master/agent, hand-rolled because the build
+// environment vendors no third-party C++ JSON library.
+//
+// Supports the full JSON grammar; numbers are stored as double plus an
+// int64 fast-path to keep ids exact.
+
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace det {
+
+class Json;
+using JsonArray = std::vector<Json>;
+// std::map keeps serialized objects deterministically ordered — handy for
+// snapshot round-trip tests.
+using JsonObject = std::map<std::string, Json>;
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Int, Double, String, Array, Object };
+
+  Json() : type_(Type::Null) {}
+  Json(std::nullptr_t) : type_(Type::Null) {}
+  Json(bool b) : type_(Type::Bool), bool_(b) {}
+  Json(int v) : type_(Type::Int), int_(v) {}
+  Json(int64_t v) : type_(Type::Int), int_(v) {}
+  Json(uint64_t v) : type_(Type::Int), int_(static_cast<int64_t>(v)) {}
+  Json(double v) : type_(Type::Double), double_(v) {}
+  Json(const char* s) : type_(Type::String), str_(s) {}
+  Json(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Json(JsonArray a) : type_(Type::Array), arr_(std::move(a)) {}
+  Json(JsonObject o) : type_(Type::Object), obj_(std::move(o)) {}
+
+  static Json array() { return Json(JsonArray{}); }
+  static Json object() { return Json(JsonObject{}); }
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_bool() const { return type_ == Type::Bool; }
+  bool is_number() const { return type_ == Type::Int || type_ == Type::Double; }
+  bool is_int() const { return type_ == Type::Int; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_array() const { return type_ == Type::Array; }
+  bool is_object() const { return type_ == Type::Object; }
+
+  bool as_bool(bool dflt = false) const {
+    return type_ == Type::Bool ? bool_ : dflt;
+  }
+  int64_t as_int(int64_t dflt = 0) const {
+    if (type_ == Type::Int) return int_;
+    if (type_ == Type::Double) return static_cast<int64_t>(double_);
+    return dflt;
+  }
+  double as_double(double dflt = 0.0) const {
+    if (type_ == Type::Double) return double_;
+    if (type_ == Type::Int) return static_cast<double>(int_);
+    return dflt;
+  }
+  const std::string& as_string() const {
+    static const std::string empty;
+    return type_ == Type::String ? str_ : empty;
+  }
+  std::string as_string(const std::string& dflt) const {
+    return type_ == Type::String ? str_ : dflt;
+  }
+
+  const JsonArray& as_array() const {
+    static const JsonArray empty;
+    return type_ == Type::Array ? arr_ : empty;
+  }
+  JsonArray& mutable_array() {
+    require(Type::Array, "array");
+    return arr_;
+  }
+  const JsonObject& as_object() const {
+    static const JsonObject empty;
+    return type_ == Type::Object ? obj_ : empty;
+  }
+  JsonObject& mutable_object() {
+    require(Type::Object, "object");
+    return obj_;
+  }
+
+  // Object access. operator[] on a const Json returns null for a missing key.
+  const Json& operator[](const std::string& key) const {
+    static const Json null_json;
+    if (type_ != Type::Object) return null_json;
+    auto it = obj_.find(key);
+    return it == obj_.end() ? null_json : it->second;
+  }
+  Json& operator[](const std::string& key) {
+    if (type_ == Type::Null) type_ = Type::Object;
+    require(Type::Object, "object");
+    return obj_[key];
+  }
+  bool contains(const std::string& key) const {
+    return type_ == Type::Object && obj_.count(key) > 0;
+  }
+
+  // Array access.
+  const Json& at(size_t i) const {
+    static const Json null_json;
+    if (type_ != Type::Array || i >= arr_.size()) return null_json;
+    return arr_[i];
+  }
+  void push_back(Json v) {
+    if (type_ == Type::Null) type_ = Type::Array;
+    require(Type::Array, "array");
+    arr_.push_back(std::move(v));
+  }
+  size_t size() const {
+    if (type_ == Type::Array) return arr_.size();
+    if (type_ == Type::Object) return obj_.size();
+    return 0;
+  }
+
+  std::string dump(int indent = -1) const {
+    std::string out;
+    dump_to(out, indent, 0);
+    return out;
+  }
+
+  static Json parse(const std::string& text) {
+    Parser p(text);
+    Json v = p.parse_value();
+    p.skip_ws();
+    if (!p.done()) throw std::runtime_error("json: trailing characters");
+    return v;
+  }
+  // Returns Null on malformed input instead of throwing.
+  static Json parse_or_null(const std::string& text) {
+    try {
+      return parse(text);
+    } catch (const std::exception&) {
+      return Json();
+    }
+  }
+
+ private:
+  void require(Type t, const char* name) const {
+    if (type_ != t) {
+      throw std::runtime_error(std::string("json: not an ") + name);
+    }
+  }
+
+  static void escape(const std::string& s, std::string& out) {
+    out += '"';
+    for (unsigned char c : s) {
+      switch (c) {
+        case '"': out += "\\\""; break;
+        case '\\': out += "\\\\"; break;
+        case '\b': out += "\\b"; break;
+        case '\f': out += "\\f"; break;
+        case '\n': out += "\\n"; break;
+        case '\r': out += "\\r"; break;
+        case '\t': out += "\\t"; break;
+        default:
+          if (c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof(buf), "\\u%04x", c);
+            out += buf;
+          } else {
+            out += static_cast<char>(c);
+          }
+      }
+    }
+    out += '"';
+  }
+
+  void dump_to(std::string& out, int indent, int depth) const {
+    auto newline = [&](int d) {
+      if (indent >= 0) {
+        out += '\n';
+        out.append(static_cast<size_t>(indent) * d, ' ');
+      }
+    };
+    switch (type_) {
+      case Type::Null: out += "null"; break;
+      case Type::Bool: out += bool_ ? "true" : "false"; break;
+      case Type::Int: out += std::to_string(int_); break;
+      case Type::Double: {
+        if (double_ != double_) {  // NaN is not representable in JSON
+          out += "null";
+        } else {
+          char buf[32];
+          snprintf(buf, sizeof(buf), "%.17g", double_);
+          out += buf;
+        }
+        break;
+      }
+      case Type::String: escape(str_, out); break;
+      case Type::Array: {
+        out += '[';
+        for (size_t i = 0; i < arr_.size(); ++i) {
+          if (i) out += ',';
+          newline(depth + 1);
+          arr_[i].dump_to(out, indent, depth + 1);
+        }
+        if (!arr_.empty()) newline(depth);
+        out += ']';
+        break;
+      }
+      case Type::Object: {
+        out += '{';
+        bool first = true;
+        for (const auto& [k, v] : obj_) {
+          if (!first) out += ',';
+          first = false;
+          newline(depth + 1);
+          escape(k, out);
+          out += indent >= 0 ? ": " : ":";
+          v.dump_to(out, indent, depth + 1);
+        }
+        if (!obj_.empty()) newline(depth);
+        out += '}';
+        break;
+      }
+    }
+  }
+
+  class Parser {
+   public:
+    explicit Parser(const std::string& s) : s_(s) {}
+    bool done() const { return pos_ >= s_.size(); }
+    void skip_ws() {
+      while (pos_ < s_.size() &&
+             (s_[pos_] == ' ' || s_[pos_] == '\t' || s_[pos_] == '\n' ||
+              s_[pos_] == '\r')) {
+        ++pos_;
+      }
+    }
+    Json parse_value() {
+      skip_ws();
+      if (done()) throw std::runtime_error("json: unexpected end");
+      char c = s_[pos_];
+      switch (c) {
+        case '{': return parse_object();
+        case '[': return parse_array();
+        case '"': return Json(parse_string());
+        case 't': expect("true"); return Json(true);
+        case 'f': expect("false"); return Json(false);
+        case 'n': expect("null"); return Json();
+        default: return parse_number();
+      }
+    }
+
+   private:
+    void expect(const char* word) {
+      size_t n = strlen(word);
+      if (s_.compare(pos_, n, word) != 0) {
+        throw std::runtime_error("json: bad literal");
+      }
+      pos_ += n;
+    }
+    Json parse_object() {
+      ++pos_;  // '{'
+      JsonObject obj;
+      skip_ws();
+      if (!done() && s_[pos_] == '}') {
+        ++pos_;
+        return Json(std::move(obj));
+      }
+      while (true) {
+        skip_ws();
+        if (done() || s_[pos_] != '"') throw std::runtime_error("json: expected key");
+        std::string key = parse_string();
+        skip_ws();
+        if (done() || s_[pos_] != ':') throw std::runtime_error("json: expected ':'");
+        ++pos_;
+        obj[std::move(key)] = parse_value();
+        skip_ws();
+        if (done()) throw std::runtime_error("json: unterminated object");
+        if (s_[pos_] == ',') {
+          ++pos_;
+          continue;
+        }
+        if (s_[pos_] == '}') {
+          ++pos_;
+          return Json(std::move(obj));
+        }
+        throw std::runtime_error("json: expected ',' or '}'");
+      }
+    }
+    Json parse_array() {
+      ++pos_;  // '['
+      JsonArray arr;
+      skip_ws();
+      if (!done() && s_[pos_] == ']') {
+        ++pos_;
+        return Json(std::move(arr));
+      }
+      while (true) {
+        arr.push_back(parse_value());
+        skip_ws();
+        if (done()) throw std::runtime_error("json: unterminated array");
+        if (s_[pos_] == ',') {
+          ++pos_;
+          continue;
+        }
+        if (s_[pos_] == ']') {
+          ++pos_;
+          return Json(std::move(arr));
+        }
+        throw std::runtime_error("json: expected ',' or ']'");
+      }
+    }
+    std::string parse_string() {
+      ++pos_;  // '"'
+      std::string out;
+      while (pos_ < s_.size() && s_[pos_] != '"') {
+        char c = s_[pos_++];
+        if (c != '\\') {
+          out += c;
+          continue;
+        }
+        if (done()) throw std::runtime_error("json: bad escape");
+        char e = s_[pos_++];
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            if (pos_ + 4 > s_.size()) throw std::runtime_error("json: bad \\u");
+            unsigned cp = static_cast<unsigned>(
+                std::stoul(s_.substr(pos_, 4), nullptr, 16));
+            pos_ += 4;
+            // Surrogate pair → one code point.
+            if (cp >= 0xD800 && cp <= 0xDBFF && pos_ + 6 <= s_.size() &&
+                s_[pos_] == '\\' && s_[pos_ + 1] == 'u') {
+              unsigned lo = static_cast<unsigned>(
+                  std::stoul(s_.substr(pos_ + 2, 4), nullptr, 16));
+              if (lo >= 0xDC00 && lo <= 0xDFFF) {
+                cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                pos_ += 6;
+              }
+            }
+            append_utf8(cp, out);
+            break;
+          }
+          default: throw std::runtime_error("json: bad escape");
+        }
+      }
+      if (done()) throw std::runtime_error("json: unterminated string");
+      ++pos_;  // closing '"'
+      return out;
+    }
+    static void append_utf8(unsigned cp, std::string& out) {
+      if (cp < 0x80) {
+        out += static_cast<char>(cp);
+      } else if (cp < 0x800) {
+        out += static_cast<char>(0xC0 | (cp >> 6));
+        out += static_cast<char>(0x80 | (cp & 0x3F));
+      } else if (cp < 0x10000) {
+        out += static_cast<char>(0xE0 | (cp >> 12));
+        out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+        out += static_cast<char>(0x80 | (cp & 0x3F));
+      } else {
+        out += static_cast<char>(0xF0 | (cp >> 18));
+        out += static_cast<char>(0x80 | ((cp >> 12) & 0x3F));
+        out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+        out += static_cast<char>(0x80 | (cp & 0x3F));
+      }
+    }
+    Json parse_number() {
+      size_t start = pos_;
+      if (!done() && (s_[pos_] == '-' || s_[pos_] == '+')) ++pos_;
+      bool is_double = false;
+      while (pos_ < s_.size()) {
+        char c = s_[pos_];
+        if (c >= '0' && c <= '9') {
+          ++pos_;
+        } else if (c == '.' || c == 'e' || c == 'E' || c == '-' || c == '+') {
+          if (c == '.' || c == 'e' || c == 'E') is_double = true;
+          ++pos_;
+        } else {
+          break;
+        }
+      }
+      std::string num = s_.substr(start, pos_ - start);
+      if (num.empty()) throw std::runtime_error("json: bad number");
+      try {
+        if (!is_double) return Json(static_cast<int64_t>(std::stoll(num)));
+      } catch (const std::out_of_range&) {
+        // fall through to double
+      }
+      return Json(std::stod(num));
+    }
+
+    const std::string& s_;
+    size_t pos_ = 0;
+  };
+
+  Type type_;
+  bool bool_ = false;
+  int64_t int_ = 0;
+  double double_ = 0.0;
+  std::string str_;
+  JsonArray arr_;
+  JsonObject obj_;
+};
+
+}  // namespace det

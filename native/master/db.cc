@@ -1,0 +1,378 @@
+#include "db.h"
+
+#include <functional>
+#include <stdexcept>
+
+#include "sqlite3.h"  // vendored header; libsqlite3 linked from system
+
+namespace det {
+
+namespace {
+
+void check(int rc, sqlite3* db, const std::string& ctx) {
+  if (rc != SQLITE_OK && rc != SQLITE_ROW && rc != SQLITE_DONE) {
+    throw std::runtime_error("sqlite: " + ctx + ": " +
+                             (db ? sqlite3_errmsg(db) : "unknown"));
+  }
+}
+
+}  // namespace
+
+Db::Db(const std::string& path) {
+  int rc = sqlite3_open(path.c_str(), &db_);
+  check(rc, db_, "open " + path);
+  sqlite3_busy_timeout(db_, 10000);
+  exec("PRAGMA journal_mode=WAL");
+  exec("PRAGMA foreign_keys=ON");
+  exec("PRAGMA synchronous=NORMAL");
+}
+
+Db::~Db() {
+  if (db_) sqlite3_close(db_);
+}
+
+std::vector<Row> Db::query(const std::string& sql,
+                           const std::vector<Json>& params) {
+  std::lock_guard<std::recursive_mutex> lock(mu_);
+  sqlite3_stmt* stmt = nullptr;
+  check(sqlite3_prepare_v2(db_, sql.c_str(), -1, &stmt, nullptr), db_,
+        "prepare: " + sql);
+  for (size_t i = 0; i < params.size(); ++i) {
+    const Json& p = params[i];
+    int idx = static_cast<int>(i + 1);
+    int rc;
+    switch (p.type()) {
+      case Json::Type::Null:
+        rc = sqlite3_bind_null(stmt, idx);
+        break;
+      case Json::Type::Bool:
+        rc = sqlite3_bind_int64(stmt, idx, p.as_bool() ? 1 : 0);
+        break;
+      case Json::Type::Int:
+        rc = sqlite3_bind_int64(stmt, idx, p.as_int());
+        break;
+      case Json::Type::Double:
+        rc = sqlite3_bind_double(stmt, idx, p.as_double());
+        break;
+      case Json::Type::String:
+        rc = sqlite3_bind_text(stmt, idx, p.as_string().c_str(), -1,
+                               SQLITE_TRANSIENT);
+        break;
+      default: {  // Array/Object stored as JSON text
+        std::string s = p.dump();
+        rc = sqlite3_bind_text(stmt, idx, s.c_str(), -1, SQLITE_TRANSIENT);
+      }
+    }
+    check(rc, db_, "bind");
+  }
+
+  std::vector<Row> rows;
+  int rc;
+  while ((rc = sqlite3_step(stmt)) == SQLITE_ROW) {
+    Row row;
+    int ncol = sqlite3_column_count(stmt);
+    for (int c = 0; c < ncol; ++c) {
+      std::string name = sqlite3_column_name(stmt, c);
+      switch (sqlite3_column_type(stmt, c)) {
+        case SQLITE_INTEGER:
+          row[name] = Json(static_cast<int64_t>(sqlite3_column_int64(stmt, c)));
+          break;
+        case SQLITE_FLOAT:
+          row[name] = Json(sqlite3_column_double(stmt, c));
+          break;
+        case SQLITE_TEXT:
+          row[name] = Json(std::string(
+              reinterpret_cast<const char*>(sqlite3_column_text(stmt, c))));
+          break;
+        case SQLITE_NULL:
+        default:
+          row[name] = Json();
+      }
+    }
+    rows.push_back(std::move(row));
+  }
+  if (rc != SQLITE_DONE) {
+    std::string msg = sqlite3_errmsg(db_);
+    sqlite3_finalize(stmt);
+    throw std::runtime_error("sqlite step: " + msg + " in: " + sql);
+  }
+  sqlite3_finalize(stmt);
+  return rows;
+}
+
+int64_t Db::exec(const std::string& sql, const std::vector<Json>& params) {
+  std::lock_guard<std::recursive_mutex> lock(mu_);
+  query(sql, params);
+  return sqlite3_changes(db_);
+}
+
+int64_t Db::last_insert_id() {
+  std::lock_guard<std::recursive_mutex> lock(mu_);
+  return sqlite3_last_insert_rowid(db_);
+}
+
+void Db::tx(const std::function<void()>& fn) {
+  std::lock_guard<std::recursive_mutex> lock(mu_);
+  exec("BEGIN IMMEDIATE");
+  try {
+    fn();
+    exec("COMMIT");
+  } catch (...) {
+    exec("ROLLBACK");
+    throw;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Migrations. Same discipline as master/static/migrations/ in the reference:
+// append-only, numbered, applied in order, recorded in schema_migrations.
+// ---------------------------------------------------------------------------
+
+const std::vector<std::pair<int, std::string>>& migrations() {
+  static const std::vector<std::pair<int, std::string>> kMigrations = {
+      {1, R"sql(
+CREATE TABLE schema_migrations (
+  version INTEGER PRIMARY KEY,
+  applied_at TEXT NOT NULL DEFAULT (datetime('now'))
+);
+)sql"},
+      {2, R"sql(
+CREATE TABLE users (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  username TEXT NOT NULL UNIQUE,
+  password_hash TEXT NOT NULL DEFAULT '',
+  display_name TEXT NOT NULL DEFAULT '',
+  admin INTEGER NOT NULL DEFAULT 0,
+  active INTEGER NOT NULL DEFAULT 1,
+  created_at TEXT NOT NULL DEFAULT (datetime('now'))
+);
+CREATE TABLE user_sessions (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  user_id INTEGER NOT NULL REFERENCES users(id),
+  token TEXT NOT NULL UNIQUE,
+  created_at TEXT NOT NULL DEFAULT (datetime('now')),
+  expires_at TEXT
+);
+)sql"},
+      {3, R"sql(
+CREATE TABLE workspaces (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  name TEXT NOT NULL UNIQUE,
+  user_id INTEGER REFERENCES users(id),
+  archived INTEGER NOT NULL DEFAULT 0,
+  created_at TEXT NOT NULL DEFAULT (datetime('now'))
+);
+CREATE TABLE projects (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  name TEXT NOT NULL,
+  description TEXT NOT NULL DEFAULT '',
+  workspace_id INTEGER NOT NULL REFERENCES workspaces(id),
+  user_id INTEGER REFERENCES users(id),
+  archived INTEGER NOT NULL DEFAULT 0,
+  created_at TEXT NOT NULL DEFAULT (datetime('now')),
+  UNIQUE(workspace_id, name)
+);
+INSERT INTO workspaces (id, name) VALUES (1, 'Uncategorized');
+INSERT INTO projects (id, name, workspace_id) VALUES (1, 'Uncategorized', 1);
+)sql"},
+      {4, R"sql(
+CREATE TABLE jobs (
+  id TEXT PRIMARY KEY,
+  type TEXT NOT NULL,
+  submission_time TEXT NOT NULL DEFAULT (datetime('now')),
+  queue_position REAL NOT NULL DEFAULT 0
+);
+CREATE TABLE experiments (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  state TEXT NOT NULL DEFAULT 'ACTIVE',
+  config TEXT NOT NULL,
+  original_config TEXT NOT NULL DEFAULT '',
+  model_def BLOB,
+  owner_id INTEGER REFERENCES users(id),
+  project_id INTEGER NOT NULL DEFAULT 1 REFERENCES projects(id),
+  job_id TEXT REFERENCES jobs(id),
+  notes TEXT NOT NULL DEFAULT '',
+  progress REAL NOT NULL DEFAULT 0,
+  archived INTEGER NOT NULL DEFAULT 0,
+  parent_id INTEGER,
+  start_time TEXT NOT NULL DEFAULT (datetime('now')),
+  end_time TEXT,
+  unmanaged INTEGER NOT NULL DEFAULT 0
+);
+CREATE TABLE experiment_snapshots (
+  experiment_id INTEGER PRIMARY KEY REFERENCES experiments(id),
+  version INTEGER NOT NULL,
+  content TEXT NOT NULL,
+  updated_at TEXT NOT NULL DEFAULT (datetime('now'))
+);
+CREATE TABLE trials (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  experiment_id INTEGER NOT NULL REFERENCES experiments(id),
+  request_id TEXT NOT NULL,
+  state TEXT NOT NULL DEFAULT 'ACTIVE',
+  hparams TEXT NOT NULL DEFAULT '{}',
+  seed INTEGER NOT NULL DEFAULT 0,
+  restarts INTEGER NOT NULL DEFAULT 0,
+  run_id INTEGER NOT NULL DEFAULT 0,
+  runner_state TEXT NOT NULL DEFAULT '',
+  latest_checkpoint TEXT,
+  total_batches INTEGER NOT NULL DEFAULT 0,
+  searcher_metric_value REAL,
+  summary_metrics TEXT NOT NULL DEFAULT '{}',
+  start_time TEXT NOT NULL DEFAULT (datetime('now')),
+  end_time TEXT,
+  last_activity TEXT,
+  UNIQUE(experiment_id, request_id)
+);
+CREATE INDEX idx_trials_experiment ON trials(experiment_id);
+)sql"},
+      {5, R"sql(
+CREATE TABLE allocations (
+  id TEXT PRIMARY KEY,
+  task_id TEXT NOT NULL,
+  trial_id INTEGER REFERENCES trials(id),
+  state TEXT NOT NULL DEFAULT 'PENDING',
+  resource_pool TEXT NOT NULL DEFAULT 'default',
+  slots INTEGER NOT NULL DEFAULT 0,
+  agent_id TEXT,
+  slot_ids TEXT NOT NULL DEFAULT '[]',
+  ports TEXT NOT NULL DEFAULT '{}',
+  start_time TEXT NOT NULL DEFAULT (datetime('now')),
+  end_time TEXT,
+  exit_reason TEXT
+);
+CREATE TABLE tasks (
+  id TEXT PRIMARY KEY,
+  type TEXT NOT NULL,
+  state TEXT NOT NULL DEFAULT 'PENDING',
+  config TEXT NOT NULL DEFAULT '{}',
+  owner_id INTEGER REFERENCES users(id),
+  job_id TEXT REFERENCES jobs(id),
+  start_time TEXT NOT NULL DEFAULT (datetime('now')),
+  end_time TEXT
+);
+)sql"},
+      {6, R"sql(
+CREATE TABLE raw_metrics (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  trial_id INTEGER NOT NULL REFERENCES trials(id),
+  trial_run_id INTEGER NOT NULL DEFAULT 0,
+  group_name TEXT NOT NULL DEFAULT 'training',
+  total_batches INTEGER NOT NULL DEFAULT 0,
+  metrics TEXT NOT NULL DEFAULT '{}',
+  end_time TEXT NOT NULL DEFAULT (datetime('now'))
+);
+CREATE INDEX idx_metrics_trial ON raw_metrics(trial_id, group_name, total_batches);
+CREATE TABLE checkpoints (
+  uuid TEXT PRIMARY KEY,
+  task_id TEXT,
+  allocation_id TEXT,
+  trial_id INTEGER REFERENCES trials(id),
+  state TEXT NOT NULL DEFAULT 'COMPLETED',
+  report_time TEXT NOT NULL DEFAULT (datetime('now')),
+  resources TEXT NOT NULL DEFAULT '{}',
+  metadata TEXT NOT NULL DEFAULT '{}',
+  steps_completed INTEGER NOT NULL DEFAULT 0,
+  storage_id INTEGER
+);
+CREATE INDEX idx_checkpoints_trial ON checkpoints(trial_id);
+CREATE TABLE task_logs (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  task_id TEXT NOT NULL,
+  allocation_id TEXT,
+  agent_id TEXT,
+  container_id TEXT,
+  rank_id INTEGER,
+  level TEXT,
+  stdtype TEXT,
+  source TEXT,
+  log TEXT NOT NULL,
+  timestamp TEXT NOT NULL DEFAULT (datetime('now'))
+);
+CREATE INDEX idx_task_logs_task ON task_logs(task_id, id);
+)sql"},
+      {7, R"sql(
+CREATE TABLE models (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  name TEXT NOT NULL UNIQUE,
+  description TEXT NOT NULL DEFAULT '',
+  metadata TEXT NOT NULL DEFAULT '{}',
+  labels TEXT NOT NULL DEFAULT '[]',
+  user_id INTEGER REFERENCES users(id),
+  workspace_id INTEGER NOT NULL DEFAULT 1 REFERENCES workspaces(id),
+  archived INTEGER NOT NULL DEFAULT 0,
+  creation_time TEXT NOT NULL DEFAULT (datetime('now')),
+  last_updated_time TEXT NOT NULL DEFAULT (datetime('now'))
+);
+CREATE TABLE model_versions (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  model_id INTEGER NOT NULL REFERENCES models(id),
+  version INTEGER NOT NULL,
+  checkpoint_uuid TEXT NOT NULL REFERENCES checkpoints(uuid),
+  name TEXT NOT NULL DEFAULT '',
+  comment TEXT NOT NULL DEFAULT '',
+  metadata TEXT NOT NULL DEFAULT '{}',
+  user_id INTEGER REFERENCES users(id),
+  creation_time TEXT NOT NULL DEFAULT (datetime('now')),
+  UNIQUE(model_id, version)
+);
+CREATE TABLE templates (
+  name TEXT PRIMARY KEY,
+  config TEXT NOT NULL,
+  workspace_id INTEGER NOT NULL DEFAULT 1 REFERENCES workspaces(id)
+);
+CREATE TABLE webhooks (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  url TEXT NOT NULL,
+  webhook_type TEXT NOT NULL DEFAULT 'DEFAULT',
+  triggers TEXT NOT NULL DEFAULT '[]'
+);
+)sql"},
+      {8, R"sql(
+CREATE TABLE searcher_events (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  experiment_id INTEGER NOT NULL REFERENCES experiments(id),
+  event TEXT NOT NULL,
+  processed INTEGER NOT NULL DEFAULT 0
+);
+)sql"},
+  };
+  return kMigrations;
+}
+
+void Db::migrate() {
+  std::lock_guard<std::recursive_mutex> lock(mu_);
+  auto existing = query(
+      "SELECT name FROM sqlite_master WHERE type='table' AND "
+      "name='schema_migrations'");
+  int64_t current = 0;
+  if (!existing.empty()) {
+    auto rows = query("SELECT COALESCE(MAX(version),0) AS v FROM schema_migrations");
+    current = rows[0]["v"].as_int();
+  }
+  for (const auto& [version, sql] : migrations()) {
+    if (version <= current) continue;
+    tx([&] {
+      // Migrations may contain several statements; run them one by one.
+      size_t start = 0;
+      while (start < sql.size()) {
+        size_t semi = sql.find(';', start);
+        if (semi == std::string::npos) break;
+        std::string stmt = sql.substr(start, semi - start);
+        // Skip pure-whitespace fragments.
+        if (stmt.find_first_not_of(" \t\r\n") != std::string::npos) {
+          exec(stmt);
+        }
+        start = semi + 1;
+      }
+      if (version > 1) {
+        exec("INSERT INTO schema_migrations (version) VALUES (?)",
+             {Json(static_cast<int64_t>(version))});
+      } else {
+        exec("INSERT INTO schema_migrations (version) VALUES (1)");
+      }
+    });
+  }
+}
+
+}  // namespace det

@@ -1,0 +1,61 @@
+// db.h — metadata store on SQLite.
+//
+// The reference keeps all platform state in Postgres behind a Go layer
+// (master/internal/db/, 339 SQL migrations under master/static/migrations/).
+// The TPU master uses embedded SQLite (WAL mode) with the same migration
+// discipline: ordered, numbered migrations applied once, recorded in a
+// schema_migrations table. Single-writer is fine — the master serializes
+// state changes through its own locks, and the control plane is low-QPS.
+
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "../common/json.h"
+
+struct sqlite3;
+struct sqlite3_stmt;
+
+namespace det {
+
+using Row = std::map<std::string, Json>;
+
+class Db {
+ public:
+  // path ":memory:" for tests.
+  explicit Db(const std::string& path);
+  ~Db();
+
+  Db(const Db&) = delete;
+  Db& operator=(const Db&) = delete;
+
+  // Applies all unapplied migrations (ordered by version).
+  void migrate();
+
+  // Execute a statement with ?-placeholders; returns rows for SELECTs.
+  // Json binds: Null→NULL, Int→int64, Double→double, String→text,
+  // Array/Object→serialized JSON text.
+  std::vector<Row> query(const std::string& sql,
+                         const std::vector<Json>& params = {});
+  // Execute without result; returns number of affected rows.
+  int64_t exec(const std::string& sql, const std::vector<Json>& params = {});
+  int64_t last_insert_id();
+
+  // Run fn inside a transaction (BEGIN IMMEDIATE … COMMIT/ROLLBACK).
+  void tx(const std::function<void()>& fn);
+
+ private:
+  sqlite3* db_ = nullptr;
+  std::recursive_mutex mu_;
+};
+
+// The full schema, exposed for introspection/tests.
+const std::vector<std::pair<int, std::string>>& migrations();
+
+}  // namespace det

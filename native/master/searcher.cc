@@ -1,0 +1,696 @@
+#include "searcher.h"
+
+#include <algorithm>
+#include <cmath>
+#include <set>
+#include <sstream>
+#include <stdexcept>
+
+namespace det {
+
+namespace {
+
+// expconf max_length may be {"batches": N} (reference length units) or a
+// plain integer. The TPU framework standardizes on batches internally.
+int64_t parse_length(const Json& v) {
+  if (v.is_number()) return v.as_int();
+  if (v.is_object()) {
+    for (const char* unit : {"batches", "records", "epochs"}) {
+      if (v.contains(unit)) return v[unit].as_int();
+    }
+  }
+  return 0;
+}
+
+std::string rng_to_string(const std::mt19937_64& rng) {
+  std::ostringstream os;
+  os << rng;
+  return os.str();
+}
+
+void rng_from_string(std::mt19937_64& rng, const std::string& s) {
+  std::istringstream is(s);
+  is >> rng;
+}
+
+}  // namespace
+
+Json SearcherOp::to_json() const {
+  Json j = Json::object();
+  switch (kind) {
+    case Kind::Create: j["type"] = "Create"; break;
+    case Kind::ValidateAfter: j["type"] = "ValidateAfter"; break;
+    case Kind::Close: j["type"] = "Close"; break;
+    case Kind::Shutdown: j["type"] = "Shutdown"; break;
+  }
+  if (!request_id.empty()) j["request_id"] = request_id;
+  if (kind == Kind::Create) {
+    j["hparams"] = hparams;
+    j["seed"] = seed;
+  }
+  if (kind == Kind::ValidateAfter) j["length"] = length;
+  if (kind == Kind::Shutdown) {
+    j["cancel"] = cancel;
+    j["failure"] = failure;
+  }
+  return j;
+}
+
+SearcherOp SearcherOp::from_json(const Json& j) {
+  const std::string& t = j["type"].as_string();
+  if (t == "Create") {
+    return create(j["request_id"].as_string(), j["hparams"],
+                  j["seed"].as_int());
+  }
+  if (t == "ValidateAfter") {
+    return validate_after(j["request_id"].as_string(), j["length"].as_int());
+  }
+  if (t == "Close") return close(j["request_id"].as_string());
+  return shutdown(j["cancel"].as_bool(), j["failure"].as_bool());
+}
+
+// ---------------------------------------------------------------------------
+// Hyperparameter sampling (reference: expconf hyperparameter variants +
+// pkg/searcher sampling; grid expansion per grid.go).
+// ---------------------------------------------------------------------------
+
+Json sample_hparams(const Json& spec, std::mt19937_64& rng) {
+  if (!spec.is_object()) return spec;  // bare value = const
+  const Json& type = spec["type"];
+  if (!type.is_string()) {
+    // Nested hparam group: recurse.
+    Json out = Json::object();
+    for (const auto& [k, v] : spec.as_object()) {
+      out[k] = sample_hparams(v, rng);
+    }
+    return out;
+  }
+  const std::string& t = type.as_string();
+  if (t == "const") return spec["val"];
+  if (t == "categorical") {
+    const auto& vals = spec["vals"].as_array();
+    if (vals.empty()) return Json();
+    std::uniform_int_distribution<size_t> d(0, vals.size() - 1);
+    return vals[d(rng)];
+  }
+  if (t == "int") {
+    std::uniform_int_distribution<int64_t> d(spec["minval"].as_int(),
+                                             spec["maxval"].as_int());
+    return Json(d(rng));
+  }
+  if (t == "double") {
+    std::uniform_real_distribution<double> d(spec["minval"].as_double(),
+                                             spec["maxval"].as_double());
+    return Json(d(rng));
+  }
+  if (t == "log") {
+    double base = spec["base"].as_double(10.0);
+    std::uniform_real_distribution<double> d(spec["minval"].as_double(),
+                                             spec["maxval"].as_double());
+    return Json(std::pow(base, d(rng)));
+  }
+  throw std::runtime_error("unknown hparam type: " + t);
+}
+
+namespace {
+
+// Axis values for one grid dimension.
+std::vector<Json> axis_values(const Json& spec) {
+  const std::string& t = spec["type"].as_string();
+  if (t == "categorical") return spec["vals"].as_array();
+  if (t == "const") return {spec["val"]};
+  int64_t count = spec["count"].as_int(0);
+  if (count <= 0) {
+    throw std::runtime_error("grid search requires `count` on numeric hparams");
+  }
+  std::vector<Json> out;
+  if (t == "int") {
+    int64_t lo = spec["minval"].as_int(), hi = spec["maxval"].as_int();
+    if (count == 1) return {Json(lo)};
+    for (int64_t i = 0; i < count; ++i) {
+      out.push_back(Json(lo + (hi - lo) * i / (count - 1)));
+    }
+    return out;
+  }
+  double lo = spec["minval"].as_double(), hi = spec["maxval"].as_double();
+  bool log = t == "log";
+  double base = spec["base"].as_double(10.0);
+  for (int64_t i = 0; i < count; ++i) {
+    double v = count == 1 ? lo : lo + (hi - lo) * i / (count - 1);
+    out.push_back(Json(log ? std::pow(base, v) : v));
+  }
+  return out;
+}
+
+void grid_expand(const Json& spec, Json current, std::vector<Json>* out);
+
+// Expand one key into all its values, recursing over the remaining keys.
+void grid_expand_keys(const std::vector<std::pair<std::string, Json>>& keys,
+                      size_t idx, Json current, std::vector<Json>* out) {
+  if (idx == keys.size()) {
+    out->push_back(std::move(current));
+    return;
+  }
+  const auto& [key, spec] = keys[idx];
+  if (spec.is_object() && !spec["type"].is_string()) {
+    // Nested group: expand the subtree into full sub-assignments.
+    std::vector<Json> subs;
+    grid_expand(spec, Json::object(), &subs);
+    for (const auto& sub : subs) {
+      Json next = current;
+      next[key] = sub;
+      grid_expand_keys(keys, idx + 1, std::move(next), out);
+    }
+    return;
+  }
+  std::vector<Json> vals =
+      spec.is_object() ? axis_values(spec) : std::vector<Json>{spec};
+  for (const auto& v : vals) {
+    Json next = current;
+    next[key] = v;
+    grid_expand_keys(keys, idx + 1, std::move(next), out);
+  }
+}
+
+void grid_expand(const Json& spec, Json current, std::vector<Json>* out) {
+  std::vector<std::pair<std::string, Json>> keys(spec.as_object().begin(),
+                                                 spec.as_object().end());
+  grid_expand_keys(keys, 0, std::move(current), out);
+}
+
+}  // namespace
+
+std::vector<Json> grid_points(const Json& spec) {
+  std::vector<Json> out;
+  grid_expand(spec, Json::object(), &out);
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Simple searchers: single, random, grid (reference single.go / random.go /
+// grid.go). Random and grid share wave logic bounded by max_concurrent_trials.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+class WaveSearch : public SearchMethod {
+ public:
+  WaveSearch(Json hparam_spec, uint64_t seed, int64_t max_length,
+             int64_t max_trials, int64_t max_concurrent, std::string prefix)
+      : hparam_spec_(std::move(hparam_spec)),
+        rng_(seed),
+        max_length_(max_length),
+        max_trials_(max_trials),
+        max_concurrent_(std::max<int64_t>(1, max_concurrent)),
+        prefix_(std::move(prefix)) {}
+
+  std::vector<SearcherOp> initial_operations() override {
+    std::vector<SearcherOp> ops;
+    int64_t n = std::min(max_trials_, max_concurrent_);
+    for (int64_t i = 0; i < n; ++i) spawn(&ops);
+    return ops;
+  }
+
+  std::vector<SearcherOp> validation_completed(const std::string& rid,
+                                               double metric,
+                                               int64_t length) override {
+    (void)metric;
+    std::vector<SearcherOp> ops;
+    if (length >= max_length_) ops.push_back(SearcherOp::close(rid));
+    return ops;
+  }
+
+  std::vector<SearcherOp> trial_closed(const std::string& rid) override {
+    closed_.insert(rid);
+    std::vector<SearcherOp> ops;
+    if (created_ < max_trials_) {
+      spawn(&ops);
+    } else if (static_cast<int64_t>(closed_.size()) >= max_trials_) {
+      ops.push_back(SearcherOp::shutdown());
+    }
+    return ops;
+  }
+
+  std::vector<SearcherOp> trial_exited_early(const std::string& rid,
+                                             const std::string&) override {
+    return trial_closed(rid);
+  }
+
+  double progress(int64_t units) const override {
+    double total = static_cast<double>(max_trials_) *
+                   static_cast<double>(std::max<int64_t>(1, max_length_));
+    return std::min(1.0, static_cast<double>(units) / total);
+  }
+
+  Json snapshot() const override {
+    Json j = Json::object();
+    j["created"] = created_;
+    j["rng"] = rng_to_string(rng_);
+    Json closed = Json::array();
+    for (const auto& rid : closed_) closed.push_back(rid);
+    j["closed"] = closed;
+    return j;
+  }
+  void restore(const Json& j) override {
+    created_ = j["created"].as_int();
+    rng_from_string(rng_, j["rng"].as_string());
+    closed_.clear();
+    for (const auto& rid : j["closed"].as_array()) {
+      closed_.insert(rid.as_string());
+    }
+  }
+
+ protected:
+  // Subclasses define how hparams for the i-th trial are chosen.
+  virtual Json hparams_for(int64_t index) {
+    return sample_hparams(hparam_spec_, rng_);
+  }
+
+  void spawn(std::vector<SearcherOp>* ops) {
+    std::string rid = prefix_ + std::to_string(created_);
+    Json hp = hparams_for(created_);
+    ++created_;
+    std::uniform_int_distribution<int64_t> d(0, (1LL << 31) - 1);
+    ops->push_back(SearcherOp::create(rid, std::move(hp), d(rng_)));
+    ops->push_back(SearcherOp::validate_after(rid, max_length_));
+  }
+
+  Json hparam_spec_;
+  std::mt19937_64 rng_;
+  int64_t max_length_;
+  int64_t max_trials_;
+  int64_t max_concurrent_;
+  std::string prefix_;
+  int64_t created_ = 0;
+  std::set<std::string> closed_;
+};
+
+class GridSearch : public WaveSearch {
+ public:
+  GridSearch(Json hparam_spec, uint64_t seed, int64_t max_length,
+             int64_t max_concurrent)
+      : WaveSearch(hparam_spec, seed, max_length, 0, max_concurrent, "grid-"),
+        points_(grid_points(hparam_spec)) {
+    max_trials_ = static_cast<int64_t>(points_.size());
+  }
+
+ protected:
+  Json hparams_for(int64_t index) override {
+    return points_[static_cast<size_t>(index)];
+  }
+
+ private:
+  std::vector<Json> points_;
+};
+
+// ---------------------------------------------------------------------------
+// ASHA (asynchronous successive halving) — promote and stop_once variants
+// (reference asha.go:55, asha_stopping.go). Rung r needs
+// max_length / divisor^(num_rungs-1-r) cumulative units; a validation
+// arriving at rung r joins the rung's sorted metrics and is promoted iff it
+// lies in the top 1/divisor fraction seen so far.
+// ---------------------------------------------------------------------------
+
+struct Rung {
+  int64_t units = 0;
+  // Sorted ascending (smaller = better after sign normalization).
+  std::vector<std::pair<double, std::string>> metrics;
+};
+
+class AshaSearch : public SearchMethod {
+ public:
+  AshaSearch(Json hparam_spec, uint64_t seed, const Json& cfg,
+             int64_t max_trials, int64_t max_concurrent, std::string prefix)
+      : hparam_spec_(std::move(hparam_spec)),
+        rng_(seed),
+        prefix_(std::move(prefix)),
+        max_trials_(max_trials),
+        max_concurrent_(std::max<int64_t>(1, max_concurrent)),
+        divisor_(std::max<int64_t>(2, cfg["divisor"].as_int(4))),
+        stop_once_(cfg["stop_once"].as_bool(false)) {
+    int64_t max_length = parse_length(cfg["max_length"]);
+    int64_t num_rungs = std::max<int64_t>(1, cfg["num_rungs"].as_int(5));
+    for (int64_t r = 0; r < num_rungs; ++r) {
+      Rung rung;
+      double denom = std::pow(static_cast<double>(divisor_),
+                              static_cast<double>(num_rungs - 1 - r));
+      rung.units = std::max<int64_t>(
+          1, static_cast<int64_t>(std::llround(max_length / denom)));
+      rungs_.push_back(std::move(rung));
+    }
+  }
+
+  std::vector<SearcherOp> initial_operations() override {
+    std::vector<SearcherOp> ops;
+    int64_t n = std::min(max_trials_, max_concurrent_);
+    for (int64_t i = 0; i < n; ++i) spawn(&ops);
+    return ops;
+  }
+
+  std::vector<SearcherOp> validation_completed(const std::string& rid,
+                                               double metric,
+                                               int64_t length) override {
+    std::vector<SearcherOp> ops;
+    size_t r = rung_for(length);
+    Rung& rung = rungs_[r];
+    auto pos = std::lower_bound(rung.metrics.begin(), rung.metrics.end(),
+                                std::make_pair(metric, rid));
+    bool top = static_cast<int64_t>(pos - rung.metrics.begin()) <
+               promotable(static_cast<int64_t>(rung.metrics.size()) + 1);
+    rung.metrics.insert(pos, {metric, rid});
+
+    bool final_rung = r + 1 == rungs_.size();
+    bool advance = stop_once_
+                       ? (top || static_cast<int64_t>(rung.metrics.size()) <
+                                     divisor_)
+                       : top;
+    if (final_rung || !advance) {
+      ops.push_back(SearcherOp::close(rid));
+    } else {
+      ops.push_back(SearcherOp::validate_after(rid, rungs_[r + 1].units));
+    }
+    return ops;
+  }
+
+  std::vector<SearcherOp> trial_closed(const std::string& rid) override {
+    closed_.insert(rid);
+    std::vector<SearcherOp> ops;
+    if (created_ < max_trials_) {
+      spawn(&ops);
+    } else if (static_cast<int64_t>(closed_.size()) >= max_trials_) {
+      ops.push_back(SearcherOp::shutdown());
+    }
+    return ops;
+  }
+
+  std::vector<SearcherOp> trial_exited_early(const std::string& rid,
+                                             const std::string&) override {
+    // An errored trial never promotes; it simply leaves the tournament and
+    // is backfilled by trial_closed's spawn logic.
+    return trial_closed(rid);
+  }
+
+  double progress(int64_t units) const override {
+    // Expected units per trial under geometric survival 1/divisor per rung.
+    double expected = 0, survive = 1.0, prev = 0;
+    for (const auto& rung : rungs_) {
+      expected += survive * static_cast<double>(rung.units - prev);
+      prev = static_cast<double>(rung.units);
+      survive /= static_cast<double>(divisor_);
+    }
+    double total = expected * static_cast<double>(max_trials_);
+    if (total <= 0) return 0;
+    return std::min(1.0, static_cast<double>(units) / total);
+  }
+
+  Json snapshot() const override {
+    Json j = Json::object();
+    j["created"] = created_;
+    j["rng"] = rng_to_string(rng_);
+    Json closed = Json::array();
+    for (const auto& rid : closed_) closed.push_back(rid);
+    j["closed"] = closed;
+    Json rungs = Json::array();
+    for (const auto& rung : rungs_) {
+      Json metrics = Json::array();
+      for (const auto& [m, rid] : rung.metrics) {
+        Json e = Json::array();
+        e.push_back(m);
+        e.push_back(rid);
+        metrics.push_back(std::move(e));
+      }
+      Json rj = Json::object();
+      rj["units"] = rung.units;
+      rj["metrics"] = metrics;
+      rungs.push_back(std::move(rj));
+    }
+    j["rungs"] = rungs;
+    return j;
+  }
+
+  void restore(const Json& j) override {
+    created_ = j["created"].as_int();
+    rng_from_string(rng_, j["rng"].as_string());
+    closed_.clear();
+    for (const auto& rid : j["closed"].as_array()) closed_.insert(rid.as_string());
+    const auto& rungs = j["rungs"].as_array();
+    for (size_t r = 0; r < rungs_.size() && r < rungs.size(); ++r) {
+      rungs_[r].units = rungs[r]["units"].as_int();
+      rungs_[r].metrics.clear();
+      for (const auto& e : rungs[r]["metrics"].as_array()) {
+        rungs_[r].metrics.push_back({e.at(0).as_double(), e.at(1).as_string()});
+      }
+    }
+  }
+
+ private:
+  int64_t promotable(int64_t n) const { return n / divisor_; }
+
+  size_t rung_for(int64_t length) const {
+    size_t best = 0;
+    for (size_t r = 0; r < rungs_.size(); ++r) {
+      if (length >= rungs_[r].units) best = r;
+    }
+    return best;
+  }
+
+  void spawn(std::vector<SearcherOp>* ops) {
+    std::string rid = prefix_ + std::to_string(created_);
+    Json hp = sample_hparams(hparam_spec_, rng_);
+    ++created_;
+    std::uniform_int_distribution<int64_t> d(0, (1LL << 31) - 1);
+    ops->push_back(SearcherOp::create(rid, std::move(hp), d(rng_)));
+    ops->push_back(SearcherOp::validate_after(rid, rungs_.front().units));
+  }
+
+  Json hparam_spec_;
+  std::mt19937_64 rng_;
+  std::string prefix_;
+  int64_t max_trials_;
+  int64_t max_concurrent_;
+  int64_t divisor_;
+  bool stop_once_;
+  std::vector<Rung> rungs_;
+  int64_t created_ = 0;
+  std::set<std::string> closed_;
+};
+
+// ---------------------------------------------------------------------------
+// Adaptive ASHA: a tournament of ASHA brackets with different rung counts
+// (reference adaptive_asha.go:71 + tournament.go). Bracket count by mode:
+// aggressive=1, standard=ceil(R/2), conservative=R. Trials are split across
+// brackets evenly with the remainder going to the deeper (earlier) brackets.
+// ---------------------------------------------------------------------------
+
+class AdaptiveAshaSearch : public SearchMethod {
+ public:
+  AdaptiveAshaSearch(Json hparam_spec, uint64_t seed, const Json& cfg) {
+    int64_t num_rungs = std::max<int64_t>(
+        1, cfg["max_rungs"].as_int(cfg["num_rungs"].as_int(5)));
+    std::string mode = cfg["mode"].as_string("standard");
+    int64_t brackets = cfg["bracket_rungs"].is_array()
+                           ? static_cast<int64_t>(cfg["bracket_rungs"].size())
+                           : (mode == "aggressive" ? 1
+                              : mode == "conservative"
+                                  ? num_rungs
+                                  : (num_rungs + 1) / 2);
+    brackets = std::max<int64_t>(1, std::min(brackets, num_rungs));
+    int64_t max_trials = std::max<int64_t>(1, cfg["max_trials"].as_int(16));
+    int64_t max_concurrent = cfg["max_concurrent_trials"].as_int(
+        std::min<int64_t>(max_trials, 16));
+
+    for (int64_t b = 0; b < brackets; ++b) {
+      int64_t bracket_rungs = cfg["bracket_rungs"].is_array()
+                                  ? cfg["bracket_rungs"].at(b).as_int()
+                                  : num_rungs - b;
+      int64_t trials = max_trials / brackets +
+                       (b < max_trials % brackets ? 1 : 0);
+      int64_t conc = std::max<int64_t>(
+          1, max_concurrent / brackets +
+                 (b < max_concurrent % brackets ? 1 : 0));
+      if (trials == 0) continue;
+      Json sub_cfg = cfg;
+      sub_cfg["num_rungs"] = bracket_rungs;
+      sub_brackets_.push_back(std::make_unique<AshaSearch>(
+          hparam_spec, seed + static_cast<uint64_t>(b) * 7919, sub_cfg, trials,
+          conc, "b" + std::to_string(b) + "-trial-"));
+      prefixes_.push_back("b" + std::to_string(b) + "-");
+    }
+  }
+
+  std::vector<SearcherOp> initial_operations() override {
+    std::vector<SearcherOp> ops;
+    for (auto& b : sub_brackets_) {
+      auto sub = b->initial_operations();
+      ops.insert(ops.end(), sub.begin(), sub.end());
+    }
+    return ops;
+  }
+
+  std::vector<SearcherOp> validation_completed(const std::string& rid,
+                                               double metric,
+                                               int64_t length) override {
+    return route(rid, [&](SearchMethod& m) {
+      return m.validation_completed(rid, metric, length);
+    });
+  }
+  std::vector<SearcherOp> trial_closed(const std::string& rid) override {
+    return route(rid, [&](SearchMethod& m) { return m.trial_closed(rid); });
+  }
+  std::vector<SearcherOp> trial_exited_early(const std::string& rid,
+                                             const std::string& why) override {
+    return route(rid,
+                 [&](SearchMethod& m) { return m.trial_exited_early(rid, why); });
+  }
+
+  double progress(int64_t units) const override {
+    // Units aren't split per bracket; approximate with the mean of bracket
+    // progress at proportional unit counts.
+    if (sub_brackets_.empty()) return 1.0;
+    double p = 0;
+    for (const auto& b : sub_brackets_) {
+      p += b->progress(units / static_cast<int64_t>(sub_brackets_.size()));
+    }
+    return p / static_cast<double>(sub_brackets_.size());
+  }
+
+  Json snapshot() const override {
+    Json j = Json::object();
+    Json subs = Json::array();
+    for (const auto& b : sub_brackets_) subs.push_back(b->snapshot());
+    j["brackets"] = subs;
+    j["shutdowns"] = shutdowns_;
+    return j;
+  }
+  void restore(const Json& j) override {
+    const auto& subs = j["brackets"].as_array();
+    for (size_t i = 0; i < sub_brackets_.size() && i < subs.size(); ++i) {
+      sub_brackets_[i]->restore(subs[i]);
+    }
+    shutdowns_ = j["shutdowns"].as_int();
+  }
+
+ private:
+  // Dispatch to the owning bracket by request-id prefix; a bracket-level
+  // Shutdown only becomes a real Shutdown when every bracket has finished
+  // (tournament.go semantics).
+  template <typename Fn>
+  std::vector<SearcherOp> route(const std::string& rid, Fn fn) {
+    for (size_t i = 0; i < prefixes_.size(); ++i) {
+      if (rid.rfind(prefixes_[i], 0) == 0) {
+        auto ops = fn(*sub_brackets_[i]);
+        std::vector<SearcherOp> out;
+        for (auto& op : ops) {
+          if (op.kind == SearcherOp::Kind::Shutdown) {
+            if (++shutdowns_ == static_cast<int64_t>(sub_brackets_.size())) {
+              out.push_back(op);
+            }
+          } else {
+            out.push_back(op);
+          }
+        }
+        return out;
+      }
+    }
+    return {};
+  }
+
+  std::vector<std::unique_ptr<AshaSearch>> sub_brackets_;
+  std::vector<std::string> prefixes_;
+  int64_t shutdowns_ = 0;
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// Factory + Searcher wrapper.
+// ---------------------------------------------------------------------------
+
+std::unique_ptr<SearchMethod> make_search_method(const Json& cfg,
+                                                 const Json& hparam_spec,
+                                                 uint64_t seed) {
+  std::string name = cfg["name"].as_string("single");
+  int64_t max_length = parse_length(cfg["max_length"]);
+  if (max_length <= 0) max_length = 1;
+  if (name == "single") {
+    return std::make_unique<WaveSearch>(hparam_spec, seed, max_length, 1, 1,
+                                        "trial-");
+  }
+  if (name == "random") {
+    int64_t max_trials = std::max<int64_t>(1, cfg["max_trials"].as_int(1));
+    int64_t conc = cfg["max_concurrent_trials"].as_int(
+        std::min<int64_t>(max_trials, 16));
+    return std::make_unique<WaveSearch>(hparam_spec, seed, max_length,
+                                        max_trials, conc, "trial-");
+  }
+  if (name == "grid") {
+    int64_t conc = cfg["max_concurrent_trials"].as_int(16);
+    return std::make_unique<GridSearch>(hparam_spec, seed, max_length, conc);
+  }
+  if (name == "async_halving" || name == "sync_halving") {
+    int64_t max_trials = std::max<int64_t>(1, cfg["max_trials"].as_int(16));
+    int64_t conc = cfg["max_concurrent_trials"].as_int(
+        std::min<int64_t>(max_trials, 16));
+    return std::make_unique<AshaSearch>(hparam_spec, seed, cfg, max_trials,
+                                        conc, "trial-");
+  }
+  if (name == "adaptive_asha" || name == "adaptive" ||
+      name == "adaptive_simple") {
+    return std::make_unique<AdaptiveAshaSearch>(hparam_spec, seed, cfg);
+  }
+  throw std::runtime_error("unknown searcher: " + name);
+}
+
+Searcher::Searcher(const Json& cfg, const Json& hparam_spec, uint64_t seed)
+    : method_(make_search_method(cfg, hparam_spec, seed)),
+      metric_name_(cfg["metric"].as_string("loss")),
+      smaller_is_better_(cfg["smaller_is_better"].as_bool(true)) {}
+
+std::vector<SearcherOp> Searcher::initial_operations() {
+  return method_->initial_operations();
+}
+
+std::vector<SearcherOp> Searcher::validation_completed(
+    const std::string& rid, double raw_metric, int64_t length) {
+  double metric = smaller_is_better_ ? raw_metric : -raw_metric;
+  units_[rid] = std::max(units_[rid], length);
+  return method_->validation_completed(rid, metric, length);
+}
+
+std::vector<SearcherOp> Searcher::trial_closed(const std::string& rid) {
+  return method_->trial_closed(rid);
+}
+
+std::vector<SearcherOp> Searcher::trial_exited_early(
+    const std::string& rid, const std::string& reason) {
+  return method_->trial_exited_early(rid, reason);
+}
+
+void Searcher::record_units(const std::string& rid, int64_t total_units) {
+  units_[rid] = std::max(units_[rid], total_units);
+}
+
+double Searcher::progress() const {
+  int64_t total = 0;
+  for (const auto& [rid, u] : units_) total += u;
+  return method_->progress(total);
+}
+
+Json Searcher::snapshot() const {
+  Json j = Json::object();
+  j["method"] = method_->snapshot();
+  Json units = Json::object();
+  for (const auto& [rid, u] : units_) units[rid] = u;
+  j["units"] = units;
+  return j;
+}
+
+void Searcher::restore(const Json& snap) {
+  method_->restore(snap["method"]);
+  units_.clear();
+  for (const auto& [rid, u] : snap["units"].as_object()) {
+    units_[rid] = u.as_int();
+  }
+}
+
+}  // namespace det

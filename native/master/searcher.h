@@ -1,0 +1,137 @@
+// searcher.h — hyperparameter-search engine.
+//
+// Mirrors the reference's server-side searcher state machines
+// (master/pkg/searcher/: searcher.go:48 NewSearcher, search_method.go:17
+// SearchMethod iface, asha.go:55, adaptive_asha.go:71, grid.go, random.go):
+// event-driven methods that emit operations (Create / ValidateAfter / Close /
+// Shutdown), are snapshotable to JSON for exact resume after master restart
+// (reference restore.go:27-35), and sample hparams deterministically from the
+// experiment seed.
+//
+// TPU-specific concern (SURVEY.md §7 hard part b): ASHA promote/stop cycles
+// must stay cheap on TPU — the scheduler reuses warm sub-slices between
+// rungs and the harness keeps its XLA compilation cache across trials, so
+// the searcher emits ValidateAfter continuations (same process continues
+// training) rather than kill+respawn wherever possible.
+
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <random>
+#include <string>
+#include <vector>
+
+#include "../common/json.h"
+
+namespace det {
+
+struct SearcherOp {
+  enum class Kind { Create, ValidateAfter, Close, Shutdown };
+  Kind kind;
+  std::string request_id;  // which trial (not set for Shutdown)
+  Json hparams;            // Create only
+  int64_t seed = 0;        // Create only
+  int64_t length = 0;      // ValidateAfter: cumulative units to train to
+  bool cancel = false;     // Shutdown
+  bool failure = false;    // Shutdown
+
+  Json to_json() const;
+  static SearcherOp from_json(const Json& j);
+
+  static SearcherOp create(std::string rid, Json hp, int64_t seed) {
+    SearcherOp op;
+    op.kind = Kind::Create;
+    op.request_id = std::move(rid);
+    op.hparams = std::move(hp);
+    op.seed = seed;
+    return op;
+  }
+  static SearcherOp validate_after(std::string rid, int64_t length) {
+    SearcherOp op;
+    op.kind = Kind::ValidateAfter;
+    op.request_id = std::move(rid);
+    op.length = length;
+    return op;
+  }
+  static SearcherOp close(std::string rid) {
+    SearcherOp op;
+    op.kind = Kind::Close;
+    op.request_id = std::move(rid);
+    return op;
+  }
+  static SearcherOp shutdown(bool cancel = false, bool failure = false) {
+    SearcherOp op;
+    op.kind = Kind::Shutdown;
+    op.cancel = cancel;
+    op.failure = failure;
+    return op;
+  }
+};
+
+// Hyperparameter sampling from the expconf `hyperparameters:` block
+// (schemas/expconf/v0/hyperparameter.json semantics): const / int / double /
+// log / categorical; nested objects recurse; bare values are consts.
+Json sample_hparams(const Json& spec, std::mt19937_64& rng);
+// Cartesian grid (`count` on numeric axes, all vals of categoricals);
+// reference grid.go.
+std::vector<Json> grid_points(const Json& spec);
+
+// SearchMethod: one per experiment; NOT thread-safe (the owning experiment
+// serializes events, like the reference's per-experiment goroutine).
+class SearchMethod {
+ public:
+  virtual ~SearchMethod() = default;
+
+  virtual std::vector<SearcherOp> initial_operations() = 0;
+  // metric is already sign-normalized: smaller is always better here.
+  virtual std::vector<SearcherOp> validation_completed(
+      const std::string& request_id, double metric, int64_t length) = 0;
+  virtual std::vector<SearcherOp> trial_closed(const std::string& request_id) = 0;
+  // reason: "errored" (max_restarts exhausted) or "user_canceled".
+  virtual std::vector<SearcherOp> trial_exited_early(
+      const std::string& request_id, const std::string& reason) = 0;
+  virtual double progress(int64_t total_units_completed) const = 0;
+
+  virtual Json snapshot() const = 0;
+  virtual void restore(const Json& snap) = 0;
+};
+
+// Searcher wraps a method with metric sign handling + bookkeeping
+// (reference searcher.go NewSearcher + searcher_state).
+class Searcher {
+ public:
+  Searcher(const Json& searcher_cfg, const Json& hparam_spec, uint64_t seed);
+
+  std::vector<SearcherOp> initial_operations();
+  std::vector<SearcherOp> validation_completed(const std::string& request_id,
+                                               double raw_metric,
+                                               int64_t length);
+  std::vector<SearcherOp> trial_closed(const std::string& request_id);
+  std::vector<SearcherOp> trial_exited_early(const std::string& request_id,
+                                             const std::string& reason);
+  double progress() const;
+  void record_units(const std::string& request_id, int64_t total_units);
+
+  const std::string& metric_name() const { return metric_name_; }
+  bool smaller_is_better() const { return smaller_is_better_; }
+
+  Json snapshot() const;
+  void restore(const Json& snap);
+
+ private:
+  std::unique_ptr<SearchMethod> method_;
+  std::string metric_name_;
+  bool smaller_is_better_ = true;
+  // request_id → units completed so far (for progress()).
+  std::map<std::string, int64_t> units_;
+};
+
+// Factory (reference search_method.go:73). Config variants: single, random,
+// grid, async_halving, adaptive_asha (+ legacy aliases adaptive,
+// adaptive_simple, sync_halving mapped onto their modern equivalents).
+std::unique_ptr<SearchMethod> make_search_method(const Json& searcher_cfg,
+                                                 const Json& hparam_spec,
+                                                 uint64_t seed);
+
+}  // namespace det

@@ -1,0 +1,152 @@
+// searcher_sim — simulate an entire HP search without a cluster.
+//
+// Native analogue of the reference's search simulation
+// (master/pkg/searcher/simulate.go): drives a SearchMethod end-to-end with a
+// synthetic metric and prints a JSON summary. Used by the Python test suite
+// to validate searcher math (rung sizes, promotions, trial counts,
+// determinism, snapshot/restore round-trips).
+//
+// stdin:  {"searcher": {...}, "hyperparameters": {...}, "seed": N,
+//          "metric_fn": "sum_hparams" | "random",
+//          "restore_midway": bool}
+// stdout: {"trials_created": N, "validations": N, "total_units": N,
+//          "best_metric": x, "trials": {rid: {"units": N, "metric": x}}}
+
+#include <cstdio>
+#include <deque>
+#include <iostream>
+#include <map>
+
+#include "../common/json.h"
+#include "searcher.h"
+
+using det::Json;
+using det::Searcher;
+using det::SearcherOp;
+
+namespace {
+
+double flatten_sum(const Json& v) {
+  if (v.is_number()) return v.as_double();
+  double s = 0;
+  if (v.is_object()) {
+    for (const auto& [k, x] : v.as_object()) s += flatten_sum(x);
+  }
+  if (v.is_array()) {
+    for (const auto& x : v.as_array()) s += flatten_sum(x);
+  }
+  return s;
+}
+
+struct SimTrial {
+  Json hparams;
+  int64_t units = 0;
+  int64_t target = 0;  // next ValidateAfter length
+  double last_metric = 0;
+  bool closed = false;
+};
+
+}  // namespace
+
+int main() {
+  std::string input((std::istreambuf_iterator<char>(std::cin)),
+                    std::istreambuf_iterator<char>());
+  Json cfg = Json::parse(input);
+  uint64_t seed = static_cast<uint64_t>(cfg["seed"].as_int(42));
+  bool restore_midway = cfg["restore_midway"].as_bool(false);
+  std::string metric_fn = cfg["metric_fn"].as_string("sum_hparams");
+
+  auto searcher = std::make_unique<Searcher>(cfg["searcher"],
+                                             cfg["hyperparameters"], seed);
+
+  std::map<std::string, SimTrial> trials;
+  std::deque<SearcherOp> queue;
+  for (auto& op : searcher->initial_operations()) queue.push_back(op);
+
+  int64_t validations = 0, events = 0;
+  bool shutdown = false;
+  std::mt19937_64 noise(seed ^ 0x9e3779b97f4a7c15ULL);
+
+  auto metric_of = [&](const SimTrial& t) {
+    // Decreases with training length so longer training always helps;
+    // separates configs by their hparam sum.
+    double base = metric_fn == "random"
+                      ? std::uniform_real_distribution<double>(0, 1)(noise)
+                      : flatten_sum(t.hparams);
+    return base / (1.0 + static_cast<double>(t.units));
+  };
+
+  while (!queue.empty() && !shutdown) {
+    // Snapshot/restore round-trip mid-search to prove exact resumability.
+    if (restore_midway && events == 7) {
+      Json snap = searcher->snapshot();
+      auto fresh = std::make_unique<Searcher>(cfg["searcher"],
+                                              cfg["hyperparameters"], seed);
+      fresh->restore(snap);
+      searcher = std::move(fresh);
+    }
+    ++events;
+    SearcherOp op = queue.front();
+    queue.pop_front();
+    switch (op.kind) {
+      case SearcherOp::Kind::Create: {
+        SimTrial t;
+        t.hparams = op.hparams;
+        trials[op.request_id] = t;
+        break;
+      }
+      case SearcherOp::Kind::ValidateAfter: {
+        SimTrial& t = trials[op.request_id];
+        if (t.closed) break;
+        t.units = op.length;
+        t.last_metric = metric_of(t);
+        ++validations;
+        for (auto& next : searcher->validation_completed(
+                 op.request_id, t.last_metric, op.length)) {
+          queue.push_back(next);
+        }
+        break;
+      }
+      case SearcherOp::Kind::Close: {
+        SimTrial& t = trials[op.request_id];
+        if (t.closed) break;
+        t.closed = true;
+        for (auto& next : searcher->trial_closed(op.request_id)) {
+          queue.push_back(next);
+        }
+        break;
+      }
+      case SearcherOp::Kind::Shutdown:
+        shutdown = true;
+        break;
+    }
+    if (events > 1000000) {
+      std::cerr << "simulation did not converge" << std::endl;
+      return 1;
+    }
+  }
+
+  int64_t total_units = 0;
+  double best = 1e300;
+  Json tj = Json::object();
+  for (const auto& [rid, t] : trials) {
+    total_units += t.units;
+    if (t.units > 0) best = std::min(best, t.last_metric);
+    Json e = Json::object();
+    e["units"] = t.units;
+    e["metric"] = t.last_metric;
+    e["closed"] = t.closed;
+    tj[rid] = std::move(e);
+  }
+
+  Json out = Json::object();
+  out["trials_created"] = static_cast<int64_t>(trials.size());
+  out["validations"] = validations;
+  out["total_units"] = total_units;
+  out["best_metric"] = best;
+  out["shutdown"] = shutdown;
+  out["progress"] = searcher->progress();
+  out["trials"] = tj;
+  std::cout << out.dump() << std::endl;
+  return 0;
+}
