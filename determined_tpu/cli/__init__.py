@@ -1,0 +1,491 @@
+"""`det` — the command-line interface.
+
+Reference: harness/determined/cli/ (~9.2k LoC, declarative argparse). Covers
+experiments, trials, checkpoints, users, workspaces/projects, the model
+registry, templates, the job queue and master/agent admin against the
+TPU-native master's REST API.
+
+Usage: ``python -m determined_tpu.cli <command> ...`` (alias ``det`` when
+installed as a console script).
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import io
+import json
+import os
+import sys
+import tarfile
+import time
+from typing import Any, Dict, Optional
+
+from determined_tpu.common.api import APIError, Session
+from determined_tpu import expconf
+
+TOKEN_CACHE = os.path.expanduser("~/.config/determined_tpu/tokens.json")
+
+
+def _load_config_file(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        text = f.read()
+    if path.endswith(".json"):
+        return json.loads(text)
+    import yaml
+
+    return yaml.safe_load(text)
+
+
+def _login(master: str, user: str) -> Session:
+    """Session with token cache (reference: authentication.login_with_cache)."""
+    cache: Dict[str, str] = {}
+    try:
+        with open(TOKEN_CACHE) as f:
+            cache = json.load(f)
+    except (OSError, ValueError):
+        pass
+    key = f"{master}::{user}"
+    session = Session(master, cache.get(key))
+    if cache.get(key):
+        try:
+            session.get("/api/v1/me")
+            return session
+        except APIError:
+            pass
+    resp = Session(master).post(
+        "/api/v1/auth/login", body={"username": user, "password": ""}
+    )
+    token = resp["token"]
+    cache[key] = token
+    os.makedirs(os.path.dirname(TOKEN_CACHE), exist_ok=True)
+    with open(TOKEN_CACHE, "w") as f:
+        json.dump(cache, f)
+    return Session(master, token)
+
+
+def _tar_context(context_dir: str) -> str:
+    """Pack the model-def directory as base64 tar.gz (reference: context
+    directory upload in cli/experiment.py submit_experiment)."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        for root, dirs, files in os.walk(context_dir):
+            dirs[:] = [d for d in dirs if not d.startswith(".") and d != "__pycache__"]
+            for name in files:
+                full = os.path.join(root, name)
+                arcname = os.path.relpath(full, context_dir)
+                tar.add(full, arcname=arcname)
+    raw = buf.getvalue()
+    if len(raw) > 96 * 1024 * 1024:
+        raise SystemExit("context directory exceeds 96MB limit")
+    return base64.b64encode(raw).decode()
+
+
+def _print_table(rows, columns) -> None:
+    if not rows:
+        print("(none)")
+        return
+    widths = [max(len(str(c)), max(len(str(r.get(c, ""))) for r in rows)) for c in columns]
+    print(" | ".join(str(c).ljust(w) for c, w in zip(columns, widths)))
+    print("-+-".join("-" * w for w in widths))
+    for r in rows:
+        print(" | ".join(str(r.get(c, "")).ljust(w) for c, w in zip(columns, widths)))
+
+
+# ---------------------------------------------------------------------------
+# experiment commands
+# ---------------------------------------------------------------------------
+
+
+def cmd_experiment_create(session: Session, args) -> int:
+    config = _load_config_file(args.config)
+    config = expconf.check(config)
+    model_def = _tar_context(args.context_dir) if args.context_dir else ""
+    resp = session.post(
+        "/api/v1/experiments",
+        body={
+            "config": config,
+            "model_definition": model_def,
+            "activate": not args.paused,
+            "project_id": args.project_id,
+        },
+    )
+    eid = resp["id"]
+    print(f"Created experiment {eid}")
+    if args.follow:
+        return _follow_experiment(session, eid)
+    return 0
+
+
+def _follow_experiment(session: Session, eid: int) -> int:
+    last_state = None
+    seen_logs: Dict[int, int] = {}
+    while True:
+        exp = session.get(f"/api/v1/experiments/{eid}")["experiment"]
+        state = exp["state"]
+        if state != last_state:
+            print(f"experiment {eid}: {state} (progress {exp.get('progress', 0):.0%})")
+            last_state = state
+        trials = session.get(f"/api/v1/experiments/{eid}/trials")["trials"]
+        for t in trials:
+            offset = seen_logs.get(t["id"], 0)
+            logs = session.get(
+                f"/api/v1/tasks/trial-{t['id']}/logs", params={"offset": offset}
+            )["logs"]
+            for line in logs:
+                print(f"[trial {t['id']}] {line['log']}")
+                seen_logs[t["id"]] = max(seen_logs.get(t["id"], 0), line["id"])
+        if state in ("COMPLETED", "CANCELED", "ERROR", "DELETED"):
+            return 0 if state == "COMPLETED" else 1
+        time.sleep(1.0)
+
+
+def cmd_experiment_list(session: Session, args) -> int:
+    exps = session.get("/api/v1/experiments")["experiments"]
+    rows = [
+        {
+            "id": e["id"],
+            "name": (e.get("name") or ""),
+            "state": e["state"],
+            "progress": f"{(e.get('progress') or 0):.0%}",
+            "started": e.get("start_time", ""),
+        }
+        for e in exps
+    ]
+    _print_table(rows, ["id", "name", "state", "progress", "started"])
+    return 0
+
+
+def cmd_experiment_verb(session: Session, args) -> int:
+    if args.verb == "describe":
+        print(json.dumps(session.get(f"/api/v1/experiments/{args.id}"), indent=2))
+    elif args.verb == "delete":
+        session.delete(f"/api/v1/experiments/{args.id}")
+        print(f"deleted experiment {args.id}")
+    else:
+        session.post(f"/api/v1/experiments/{args.id}/{args.verb}")
+        print(f"{args.verb} experiment {args.id}")
+    return 0
+
+
+def cmd_experiment_wait(session: Session, args) -> int:
+    return _follow_experiment(session, args.id)
+
+
+# ---------------------------------------------------------------------------
+# trial / checkpoint / task commands
+# ---------------------------------------------------------------------------
+
+
+def cmd_trial_list(session: Session, args) -> int:
+    trials = session.get(f"/api/v1/experiments/{args.experiment_id}/trials")["trials"]
+    rows = [
+        {
+            "id": t["id"],
+            "state": t["state"],
+            "batches": t.get("total_batches", 0),
+            "metric": t.get("searcher_metric_value"),
+            "restarts": t.get("restarts", 0),
+            "checkpoint": t.get("latest_checkpoint") or "",
+        }
+        for t in trials
+    ]
+    _print_table(rows, ["id", "state", "batches", "metric", "restarts", "checkpoint"])
+    return 0
+
+
+def cmd_trial_describe(session: Session, args) -> int:
+    print(json.dumps(session.get(f"/api/v1/trials/{args.id}"), indent=2))
+    return 0
+
+
+def cmd_trial_logs(session: Session, args) -> int:
+    offset = 0
+    task_id = f"trial-{args.id}"
+    while True:
+        resp = session.get(
+            f"/api/v1/tasks/{task_id}/logs",
+            params={"offset": offset, "follow": "true" if args.follow else "false"},
+            timeout=60.0,
+        )
+        logs = resp["logs"]
+        for line in logs:
+            print(line["log"])
+            offset = max(offset, line["id"])
+        if not args.follow and not logs:
+            return 0
+        if not logs:
+            time.sleep(0.5)
+
+
+def cmd_checkpoint_list(session: Session, args) -> int:
+    cps = session.get(f"/api/v1/experiments/{args.experiment_id}/checkpoints")[
+        "checkpoints"
+    ]
+    rows = [
+        {
+            "uuid": c["uuid"],
+            "trial": c.get("trial_id"),
+            "steps": c.get("steps_completed"),
+            "state": c.get("state"),
+            "reported": c.get("report_time", ""),
+        }
+        for c in cps
+    ]
+    _print_table(rows, ["uuid", "trial", "steps", "state", "reported"])
+    return 0
+
+
+def cmd_checkpoint_describe(session: Session, args) -> int:
+    print(json.dumps(session.get(f"/api/v1/checkpoints/{args.uuid}"), indent=2))
+    return 0
+
+
+def cmd_task_logs(session: Session, args) -> int:
+    ns = argparse.Namespace(id=None, follow=args.follow)
+    offset = 0
+    while True:
+        resp = session.get(
+            f"/api/v1/tasks/{args.task_id}/logs",
+            params={"offset": offset, "follow": "true" if args.follow else "false"},
+            timeout=60.0,
+        )
+        logs = resp["logs"]
+        for line in logs:
+            print(line["log"])
+            offset = max(offset, line["id"])
+        if not args.follow and not logs:
+            return 0
+        if not logs:
+            time.sleep(0.5)
+
+
+# ---------------------------------------------------------------------------
+# admin / registry commands
+# ---------------------------------------------------------------------------
+
+
+def cmd_master_info(session: Session, args) -> int:
+    print(json.dumps(session.get("/api/v1/master"), indent=2))
+    return 0
+
+
+def cmd_agent_list(session: Session, args) -> int:
+    agents = session.get("/api/v1/agents")["agents"]
+    rows = [
+        {
+            "id": a["id"],
+            "pool": a["resource_pool"],
+            "alive": a["alive"],
+            "slots": len(a["slots"]),
+            "used": sum(1 for s in a["slots"] if s.get("allocation_id")),
+        }
+        for a in agents
+    ]
+    _print_table(rows, ["id", "pool", "alive", "slots", "used"])
+    return 0
+
+
+def cmd_job_list(session: Session, args) -> int:
+    jobs = session.get("/api/v1/job-queues")["jobs"]
+    _print_table(jobs, ["allocation_id", "experiment_id", "state", "slots", "priority"])
+    return 0
+
+
+def cmd_user_list(session: Session, args) -> int:
+    users = session.get("/api/v1/users")["users"]
+    _print_table(users, ["id", "username", "admin", "active"])
+    return 0
+
+
+def cmd_user_create(session: Session, args) -> int:
+    session.post("/api/v1/users", body={"username": args.username})
+    print(f"created user {args.username}")
+    return 0
+
+
+def cmd_workspace(session: Session, args) -> int:
+    if args.action == "list":
+        _print_table(session.get("/api/v1/workspaces")["workspaces"],
+                     ["id", "name", "archived"])
+    else:
+        session.post("/api/v1/workspaces", body={"name": args.name})
+        print(f"created workspace {args.name}")
+    return 0
+
+
+def cmd_project(session: Session, args) -> int:
+    if args.action == "list":
+        _print_table(
+            session.get(f"/api/v1/workspaces/{args.workspace_id}/projects")["projects"],
+            ["id", "name", "workspace_id", "archived"],
+        )
+    else:
+        session.post(
+            "/api/v1/projects",
+            body={"name": args.name, "workspace_id": args.workspace_id},
+        )
+        print(f"created project {args.name}")
+    return 0
+
+
+def cmd_model(session: Session, args) -> int:
+    if args.action == "list":
+        _print_table(session.get("/api/v1/models")["models"],
+                     ["id", "name", "description", "archived"])
+    elif args.action == "create":
+        session.post("/api/v1/models", body={"name": args.name, "metadata": {},
+                                             "labels": []})
+        print(f"created model {args.name}")
+    elif args.action == "describe":
+        print(json.dumps(session.get(f"/api/v1/models/{args.name}"), indent=2))
+    elif args.action == "register-version":
+        resp = session.post(
+            f"/api/v1/models/{args.name}/versions",
+            body={"checkpoint_uuid": args.uuid, "metadata": {}},
+        )
+        print(f"registered version {resp['model_version']['version']}")
+    elif args.action == "versions":
+        _print_table(
+            session.get(f"/api/v1/models/{args.name}/versions")["model_versions"],
+            ["id", "version", "checkpoint_uuid", "creation_time"],
+        )
+    return 0
+
+
+def cmd_template(session: Session, args) -> int:
+    if args.action == "list":
+        _print_table(session.get("/api/v1/templates")["templates"], ["name"])
+    else:
+        config = _load_config_file(args.config)
+        session.post("/api/v1/templates", body={"name": args.name, "config": config})
+        print(f"set template {args.name}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="det", description=__doc__)
+    p.add_argument("-m", "--master", default=os.environ.get("DET_MASTER",
+                                                            "http://127.0.0.1:8080"))
+    p.add_argument("-u", "--user", default=os.environ.get("DET_USER", "determined"))
+    sub = p.add_subparsers(dest="command", required=True)
+
+    exp = sub.add_parser("experiment", aliases=["e"]).add_subparsers(
+        dest="subcommand", required=True)
+    c = exp.add_parser("create")
+    c.add_argument("config")
+    c.add_argument("context_dir", nargs="?")
+    c.add_argument("--paused", action="store_true")
+    c.add_argument("-f", "--follow", action="store_true")
+    c.add_argument("--project-id", type=int, default=1)
+    c.set_defaults(func=cmd_experiment_create)
+    exp.add_parser("list").set_defaults(func=cmd_experiment_list)
+    for verb in ("describe", "activate", "pause", "cancel", "kill", "archive",
+                 "unarchive", "delete"):
+        v = exp.add_parser(verb)
+        v.add_argument("id", type=int)
+        v.set_defaults(func=cmd_experiment_verb, verb=verb)
+    w = exp.add_parser("wait")
+    w.add_argument("id", type=int)
+    w.set_defaults(func=cmd_experiment_wait)
+
+    tr = sub.add_parser("trial", aliases=["t"]).add_subparsers(
+        dest="subcommand", required=True)
+    t = tr.add_parser("list")
+    t.add_argument("experiment_id", type=int)
+    t.set_defaults(func=cmd_trial_list)
+    t = tr.add_parser("describe")
+    t.add_argument("id", type=int)
+    t.set_defaults(func=cmd_trial_describe)
+    t = tr.add_parser("logs")
+    t.add_argument("id", type=int)
+    t.add_argument("-f", "--follow", action="store_true")
+    t.set_defaults(func=cmd_trial_logs)
+
+    cp = sub.add_parser("checkpoint").add_subparsers(dest="subcommand", required=True)
+    c = cp.add_parser("list")
+    c.add_argument("experiment_id", type=int)
+    c.set_defaults(func=cmd_checkpoint_list)
+    c = cp.add_parser("describe")
+    c.add_argument("uuid")
+    c.set_defaults(func=cmd_checkpoint_describe)
+
+    tk = sub.add_parser("task").add_subparsers(dest="subcommand", required=True)
+    t = tk.add_parser("logs")
+    t.add_argument("task_id")
+    t.add_argument("-f", "--follow", action="store_true")
+    t.set_defaults(func=cmd_task_logs)
+
+    m = sub.add_parser("master").add_subparsers(dest="subcommand", required=True)
+    m.add_parser("info").set_defaults(func=cmd_master_info)
+
+    a = sub.add_parser("agent").add_subparsers(dest="subcommand", required=True)
+    a.add_parser("list").set_defaults(func=cmd_agent_list)
+
+    j = sub.add_parser("job").add_subparsers(dest="subcommand", required=True)
+    j.add_parser("list").set_defaults(func=cmd_job_list)
+
+    u = sub.add_parser("user").add_subparsers(dest="subcommand", required=True)
+    u.add_parser("list").set_defaults(func=cmd_user_list)
+    uc = u.add_parser("create")
+    uc.add_argument("username")
+    uc.set_defaults(func=cmd_user_create)
+
+    ws = sub.add_parser("workspace").add_subparsers(dest="subcommand", required=True)
+    ws.add_parser("list").set_defaults(func=cmd_workspace, action="list")
+    wc = ws.add_parser("create")
+    wc.add_argument("name")
+    wc.set_defaults(func=cmd_workspace, action="create")
+
+    pj = sub.add_parser("project").add_subparsers(dest="subcommand", required=True)
+    pl = pj.add_parser("list")
+    pl.add_argument("workspace_id", type=int)
+    pl.set_defaults(func=cmd_project, action="list")
+    pc = pj.add_parser("create")
+    pc.add_argument("workspace_id", type=int)
+    pc.add_argument("name")
+    pc.set_defaults(func=cmd_project, action="create")
+
+    md = sub.add_parser("model").add_subparsers(dest="subcommand", required=True)
+    md.add_parser("list").set_defaults(func=cmd_model, action="list")
+    mc = md.add_parser("create")
+    mc.add_argument("name")
+    mc.set_defaults(func=cmd_model, action="create")
+    mdd = md.add_parser("describe")
+    mdd.add_argument("name")
+    mdd.set_defaults(func=cmd_model, action="describe")
+    mv = md.add_parser("register-version")
+    mv.add_argument("name")
+    mv.add_argument("uuid")
+    mv.set_defaults(func=cmd_model, action="register-version")
+    mvs = md.add_parser("versions")
+    mvs.add_argument("name")
+    mvs.set_defaults(func=cmd_model, action="versions")
+
+    tp = sub.add_parser("template").add_subparsers(dest="subcommand", required=True)
+    tp.add_parser("list").set_defaults(func=cmd_template, action="list")
+    ts = tp.add_parser("set")
+    ts.add_argument("name")
+    ts.add_argument("config")
+    ts.set_defaults(func=cmd_template, action="set")
+
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    session = _login(args.master, args.user)
+    try:
+        return args.func(session, args)
+    except APIError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

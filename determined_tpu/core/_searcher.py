@@ -51,6 +51,7 @@ class SearcherContext:
         self._dist = distributed
         self._local_max_length = local_max_length
         self._poll_interval = poll_interval
+        self._idle_grace = 15.0  # seconds holding the slice waiting for an op
         self.completed_metrics: list = []  # local mode record
 
     # -- master interaction (chief only; workers follow via broadcast) --
@@ -58,17 +59,26 @@ class SearcherContext:
     def _get_next_op(self, last_length: int) -> dict:
         """Long-poll the master for the next op after `last_length`.
 
-        Returns {"op": {"length": N}} or {"done": true}.
+        Returns {"op": {"length": N}}, {"done": true}, or {"idle": true}.
+
+        The idle case is TPU-specific: an ASHA trial paused in its rung (not
+        yet promoted, not yet closed — reference asha.go promotionsAsync
+        semantics) must RELEASE its slice rather than hold an idle ICI mesh,
+        so after a grace window with no op the trial exits cleanly and the
+        master re-allocates it if a promotion arrives later.
         """
         assert self._session is not None
+        deadline = time.time() + self._idle_grace
         while True:
             resp = self._session.get(
                 f"/api/v1/trials/{self._trial_id}/searcher/operation",
-                params={"last": last_length, "timeout_seconds": 60},
-                timeout=90.0,
+                params={"last": last_length, "timeout_seconds": 10},
+                timeout=40.0,
             )
             if resp and (resp.get("done") or resp.get("op")):
                 return resp
+            if time.time() >= deadline:
+                return {"idle": True}
             time.sleep(self._poll_interval)
 
     def _complete_operation(self, op: SearcherOperation, metric: float) -> None:
@@ -101,7 +111,10 @@ class SearcherContext:
         while True:
             if self._dist is None or self._dist.is_chief:
                 resp = self._get_next_op(last_length)
-                payload = -1 if resp.get("done") else int(resp["op"]["length"])
+                if resp.get("done") or resp.get("idle"):
+                    payload = -1
+                else:
+                    payload = int(resp["op"]["length"])
             else:
                 payload = -1
             if self._dist is not None and self._dist.size > 1:

@@ -1,0 +1,1 @@
+"""Task-container bootstrap shims (reference: harness/determined/exec/)."""

@@ -1,0 +1,77 @@
+"""Task entrypoint: prep, then spawn the experiment's entrypoint.
+
+Reference: harness/determined/exec/launch.py:29 (spawn + signal forwarding,
+SIGTERM→preemption :49-55) combined with the launch layers under
+harness/determined/launch/. The TPU launch model is simpler than
+torchrun/horovodrun: ONE process per host owns all local chips, so there is
+no per-device process fan-out — the "distributed launcher" reduces to
+exporting the jax.distributed coordination env and exec'ing the user
+entrypoint.
+
+Exported for multi-host JAX (consumed by determined_tpu.core.init /
+user code):
+  DET_COORDINATOR_ADDR  chief_host:port  (jax.distributed.initialize)
+  DET_NODE_RANK / DET_NUM_NODES          (process_id / num_processes)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shlex
+import signal
+import subprocess
+import sys
+
+from determined_tpu.exec import prep as prep_mod
+
+logger = logging.getLogger("determined_tpu.exec")
+
+
+def build_command() -> list:
+    """Resolve the experiment entrypoint into an argv list."""
+    import json
+
+    config = json.loads(os.environ.get("DET_EXPERIMENT_CONFIG", "{}"))
+    entrypoint = config.get("entrypoint")
+    if entrypoint is None:
+        entrypoint = os.environ.get("DET_ENTRYPOINT")
+    if entrypoint is None:
+        raise RuntimeError("no entrypoint in experiment config")
+    if isinstance(entrypoint, list):
+        return [str(x) for x in entrypoint]
+    return shlex.split(str(entrypoint))
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO, format="%(name)s: %(message)s")
+
+    info = prep_mod.prep()
+    env = dict(os.environ)
+    if info is not None and len(info["container_addrs"]) > 1:
+        env["DET_COORDINATOR_ADDR"] = info["coordinator_addr"]
+    # Make the extracted context importable.
+    workdir = env.get("DET_WORKDIR", os.getcwd())
+    env["PYTHONPATH"] = workdir + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("PYTHONUNBUFFERED", "1")
+
+    cmd = build_command()
+    logger.info("launching entrypoint: %s", cmd)
+    proc = subprocess.Popen(cmd, env=env, cwd=workdir)
+
+    # Forward termination signals so preemption/kill reaches the training
+    # process (reference exec/launch.py:49-55).
+    def forward(signum, frame):
+        try:
+            proc.send_signal(signum)
+        except ProcessLookupError:
+            pass
+
+    signal.signal(signal.SIGTERM, forward)
+    signal.signal(signal.SIGINT, forward)
+
+    return proc.wait()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,91 @@
+"""Task prep: context download + rendezvous.
+
+Reference: harness/determined/exec/prep_container.py — downloads the user
+code tarball (GetTaskContextDirectory), performs rendezvous against the
+master (AllocationRendezvousInfo, api_trials.go:1495; master side gathers
+addresses in task/rendezvous.go:94), and writes
+``$DET_RUN_DIR/info/rendezvous.json`` for later processes to read through
+``get_cluster_info()``.
+
+TPU addition: the rendezvous result includes ``coordinator_addr`` — the
+chief host plus a fixed port — which ``jax.distributed.initialize`` uses to
+form the multi-host runtime over ICI/DCN (SURVEY.md §5 "Distributed
+communication backend").
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import logging
+import os
+import tarfile
+from typing import Optional
+
+from determined_tpu.common.api import Session
+
+logger = logging.getLogger("determined_tpu.exec")
+
+JAX_COORDINATOR_PORT = 12355
+
+
+def download_context(session: Session, task_id: str, workdir: str) -> None:
+    """Extract the experiment's model-def tarball into the workdir."""
+    resp = session.get(f"/api/v1/tasks/{task_id}/context")
+    b64 = (resp or {}).get("b64_tgz") or ""
+    if not b64:
+        logger.info("no context directory for task %s", task_id)
+        return
+    raw = base64.b64decode(b64)
+    with tarfile.open(fileobj=io.BytesIO(raw), mode="r:gz") as tar:
+        for member in tar.getmembers():
+            # refuse path escapes
+            target = os.path.realpath(os.path.join(workdir, member.name))
+            if not target.startswith(os.path.realpath(workdir)):
+                raise RuntimeError(f"unsafe path in context tar: {member.name}")
+        tar.extractall(workdir)
+    logger.info("extracted context (%d bytes) into %s", len(raw), workdir)
+
+
+def rendezvous(session: Session, allocation_id: str, run_dir: str) -> dict:
+    """Block until every host of the allocation is up; persist the result."""
+    resp = session.get(
+        f"/api/v1/allocations/{allocation_id}/rendezvous",
+        params={"timeout_seconds": 600},
+        timeout=630.0,
+    )
+    addrs = resp["addresses"]
+    rank = int(os.environ.get("DET_NODE_RANK", "0"))
+    slot_ids = json.loads(os.environ.get("DET_SLOT_IDS", "[]"))
+    info = {
+        "container_addrs": addrs,
+        "container_rank": rank,
+        "slot_ids": slot_ids,
+        "coordinator_addr": f"{addrs[0]}:{JAX_COORDINATOR_PORT}",
+    }
+    info_dir = os.path.join(run_dir, "info")
+    os.makedirs(info_dir, exist_ok=True)
+    with open(os.path.join(info_dir, "rendezvous.json"), "w") as f:
+        json.dump(info, f)
+    # Chief ip for launch layers (reference exec/prep_container.py exports
+    # DET_CHIEF_IP).
+    os.environ["DET_CHIEF_IP"] = addrs[0]
+    return info
+
+
+def prep(session: Optional[Session] = None) -> Optional[dict]:
+    """Full prep flow; returns rendezvous info (None outside a cluster)."""
+    master = os.environ.get("DET_MASTER")
+    if not master:
+        return None
+    session = session or Session(master, os.environ.get("DET_SESSION_TOKEN"))
+    workdir = os.environ.get("DET_WORKDIR", os.getcwd())
+    run_dir = os.environ.get("DET_RUN_DIR", workdir)
+    task_id = os.environ.get("DET_TASK_ID", "")
+    allocation_id = os.environ.get("DET_ALLOCATION_ID", "")
+    if task_id:
+        download_context(session, task_id, workdir)
+    if allocation_id:
+        return rendezvous(session, allocation_id, run_dir)
+    return None

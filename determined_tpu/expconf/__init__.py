@@ -1,0 +1,218 @@
+"""expconf — the experiment-config schema system.
+
+Reference: the JSON-schema-driven expconf machinery
+(schemas/expconf/v0/*.json code-genned into master/pkg/schemas/expconf/,
+~11.5k LoC; SURVEY.md §5 "Config/flag system"): validation, defaulting,
+cluster-default merging and legacy shims. Here the same three operations are
+implemented directly over dicts — `validate`, `apply_defaults`, `merge` —
+and run client-side before submit; the master re-checks the load-bearing
+invariants (searcher + entrypoint present).
+
+Searcher variants mirror schemas/expconf/v0/searcher.json:16-51: single,
+random, grid, async_halving, adaptive_asha (+ legacy aliases adaptive,
+adaptive_simple, sync_halving).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional
+
+SEARCHER_NAMES = {
+    "single",
+    "random",
+    "grid",
+    "async_halving",
+    "adaptive_asha",
+    # legacy aliases (reference legacy.go shims)
+    "adaptive",
+    "adaptive_simple",
+    "sync_halving",
+    "custom",
+}
+
+HPARAM_TYPES = {"const", "int", "double", "log", "categorical"}
+
+STORAGE_TYPES = {"shared_fs", "directory", "gcs", "s3", "azure"}
+
+
+def _is_hparam_spec(v: Any) -> bool:
+    return isinstance(v, dict) and isinstance(v.get("type"), str)
+
+
+def _validate_hparam(name: str, spec: Any, errors: List[str]) -> None:
+    if not isinstance(spec, dict):
+        return  # bare value == const
+    t = spec.get("type")
+    if t is None:
+        # nested hparam group
+        for k, v in spec.items():
+            _validate_hparam(f"{name}.{k}", v, errors)
+        return
+    if t not in HPARAM_TYPES:
+        errors.append(f"hyperparameters.{name}: unknown type {t!r}")
+        return
+    if t == "const" and "val" not in spec:
+        errors.append(f"hyperparameters.{name}: const requires `val`")
+    if t == "categorical" and not spec.get("vals"):
+        errors.append(f"hyperparameters.{name}: categorical requires `vals`")
+    if t in ("int", "double", "log"):
+        for field in ("minval", "maxval"):
+            if field not in spec:
+                errors.append(f"hyperparameters.{name}: {t} requires `{field}`")
+        if "minval" in spec and "maxval" in spec and spec["minval"] > spec["maxval"]:
+            errors.append(f"hyperparameters.{name}: minval > maxval")
+
+
+def _length_units(v: Any) -> Optional[int]:
+    if isinstance(v, (int, float)):
+        return int(v)
+    if isinstance(v, dict):
+        for unit in ("batches", "records", "epochs"):
+            if unit in v:
+                return int(v[unit])
+    return None
+
+
+def validate(config: Dict[str, Any]) -> List[str]:
+    """Return a list of human-readable schema errors (empty = valid)."""
+    errors: List[str] = []
+    if not isinstance(config, dict):
+        return ["config must be a mapping"]
+
+    if not config.get("entrypoint"):
+        errors.append("entrypoint is required")
+
+    searcher = config.get("searcher")
+    if not isinstance(searcher, dict):
+        errors.append("searcher is required")
+    else:
+        name = searcher.get("name")
+        if name not in SEARCHER_NAMES:
+            errors.append(f"searcher.name must be one of {sorted(SEARCHER_NAMES)}")
+        if name != "custom":
+            if not searcher.get("metric"):
+                errors.append("searcher.metric is required")
+            if _length_units(searcher.get("max_length")) in (None, 0):
+                errors.append("searcher.max_length is required (batches)")
+        if name in ("random", "async_halving", "adaptive_asha", "adaptive"):
+            if name == "random" and not searcher.get("max_trials"):
+                errors.append("searcher.max_trials is required for random search")
+        if name in ("async_halving", "sync_halving"):
+            if not searcher.get("num_rungs"):
+                errors.append("searcher.num_rungs is required for async_halving")
+        if name in ("adaptive_asha", "adaptive", "adaptive_simple"):
+            if not searcher.get("max_trials"):
+                errors.append("searcher.max_trials is required for adaptive_asha")
+        divisor = searcher.get("divisor")
+        if divisor is not None and divisor <= 1:
+            errors.append("searcher.divisor must be > 1")
+
+    hparams = config.get("hyperparameters", {})
+    if not isinstance(hparams, dict):
+        errors.append("hyperparameters must be a mapping")
+    else:
+        for k, v in hparams.items():
+            _validate_hparam(k, v, errors)
+        if isinstance(searcher, dict) and searcher.get("name") == "grid":
+            def needs_count(spec: Any) -> bool:
+                if not _is_hparam_spec(spec):
+                    if isinstance(spec, dict):
+                        return any(needs_count(v) for v in spec.values())
+                    return False
+                return spec["type"] in ("int", "double", "log") and not spec.get("count")
+
+            for k, v in hparams.items():
+                if needs_count(v):
+                    errors.append(
+                        f"hyperparameters.{k}: grid search requires `count` on numeric ranges"
+                    )
+
+    res = config.get("resources", {})
+    if not isinstance(res, dict):
+        errors.append("resources must be a mapping")
+    else:
+        spt = res.get("slots_per_trial", 1)
+        if not isinstance(spt, int) or spt < 0:
+            errors.append("resources.slots_per_trial must be a non-negative int")
+
+    storage = config.get("checkpoint_storage")
+    if storage is not None:
+        if not isinstance(storage, dict) or storage.get("type") not in STORAGE_TYPES:
+            errors.append(
+                f"checkpoint_storage.type must be one of {sorted(STORAGE_TYPES)}"
+            )
+        elif storage["type"] in ("gcs", "s3") and not storage.get("bucket"):
+            errors.append("checkpoint_storage.bucket is required for cloud storage")
+
+    mr = config.get("max_restarts")
+    if mr is not None and (not isinstance(mr, int) or mr < 0):
+        errors.append("max_restarts must be a non-negative int")
+
+    return errors
+
+
+def apply_defaults(config: Dict[str, Any]) -> Dict[str, Any]:
+    """Fill schema defaults (reference: WithDefaults code-gen)."""
+    c = copy.deepcopy(config)
+    c.setdefault("name", "unnamed-experiment")
+    c.setdefault("description", "")
+    c.setdefault("labels", [])
+    c.setdefault("hyperparameters", {})
+    c.setdefault("max_restarts", 5)
+    c.setdefault("scheduling_unit", 100)
+    c.setdefault("records_per_epoch", 0)
+    c.setdefault("min_validation_period", {"batches": 0})
+    c.setdefault("min_checkpoint_period", {"batches": 0})
+    c.setdefault("perform_initial_validation", False)
+    res = c.setdefault("resources", {})
+    res.setdefault("slots_per_trial", 1)
+    res.setdefault("resource_pool", "default")
+    res.setdefault("priority", 42)
+    searcher = c.setdefault("searcher", {})
+    searcher.setdefault("smaller_is_better", True)
+    name = searcher.get("name")
+    if name in ("async_halving", "sync_halving", "adaptive_asha", "adaptive",
+                "adaptive_simple"):
+        searcher.setdefault("divisor", 4)
+        searcher.setdefault("mode", "standard")
+        if name in ("async_halving", "sync_halving"):
+            searcher.setdefault("num_rungs", 5)
+        else:
+            searcher.setdefault("max_rungs", 5)
+    if name in ("random", "adaptive_asha", "adaptive", "adaptive_simple",
+                "async_halving"):
+        mt = searcher.get("max_trials", 16)
+        searcher.setdefault("max_trials", mt)
+        searcher.setdefault("max_concurrent_trials", min(mt, 16))
+    c.setdefault("reproducibility", {})
+    c.setdefault("environment", {})
+    c.setdefault("profiling", {"enabled": False})
+    c.setdefault("tpu", {})  # TPU-native block: topology/mesh defaults
+    c["tpu"].setdefault("mesh", {})  # e.g. {"data": -1, "fsdp": 8}
+    return c
+
+
+def merge(config: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge cluster-level defaults under the user config (reference:
+    task_container_defaults merging in pkg/schemas/expconf/merge logic).
+    User values win; dicts merge recursively; lists replace."""
+    out = copy.deepcopy(defaults)
+
+    def _merge(dst: Dict[str, Any], src: Dict[str, Any]) -> None:
+        for k, v in src.items():
+            if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                _merge(dst[k], v)
+            else:
+                dst[k] = copy.deepcopy(v)
+
+    _merge(out, config)
+    return out
+
+
+def check(config: Dict[str, Any]) -> Dict[str, Any]:
+    """validate + defaults; raises ValueError with all errors joined."""
+    errors = validate(config)
+    if errors:
+        raise ValueError("invalid experiment config:\n  " + "\n  ".join(errors))
+    return apply_defaults(config)

@@ -1,0 +1,450 @@
+// determined-agent — TPU-VM node daemon.
+//
+// Native analogue of the reference Go agent (agent/internal/agent.go:86
+// run loop; device detection detect/detect.go:19; container lifecycle
+// containers/manager.go + container/container.go). Differences, by design:
+//  - transport is HTTP long-poll against the master instead of a websocket;
+//  - tasks are host processes, not docker containers (a TPU-VM host runs
+//    one process owning all local chips; the agent supervises it directly);
+//  - slots are TPU chips detected from /dev/accel* (or vfio), with
+//    DET_AGENT_SLOTS as the "artificial slots" testing override
+//    (detect.go:39-56).
+//
+// Log shipping follows master/static/srv/ship_logs.py: reader threads
+// collect child stdout/stderr lines, a shipper thread batches them to
+// POST /api/v1/task/logs.
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <signal.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <iostream>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <thread>
+#include <vector>
+
+#include "../common/http.h"
+#include "../common/json.h"
+
+namespace {
+
+using det::HttpClientResponse;
+using det::Json;
+using det::JsonObject;
+
+struct AgentOptions {
+  std::string master_url = "http://127.0.0.1:8080";
+  std::string id;
+  std::string resource_pool = "default";
+  std::string addr;  // host address peers can reach (rendezvous)
+  std::string work_root = "/tmp/determined-agent";
+  int slots_override = -1;  // DET_AGENT_SLOTS / --slots ("artificial")
+  std::string slot_type = "auto";
+  double poll_timeout_s = 20.0;
+};
+
+struct Task {
+  std::string allocation_id;
+  std::string container_id;
+  std::string task_id;
+  pid_t pid = -1;
+  std::atomic<bool> exited{false};
+};
+
+std::mutex g_mu;
+std::map<std::string, std::shared_ptr<Task>> g_tasks;  // by container_id
+
+// ---- log shipping -------------------------------------------------------
+
+struct LogEntry {
+  Json entry;
+};
+std::mutex g_log_mu;
+std::condition_variable g_log_cv;
+std::deque<Json> g_log_queue;
+std::atomic<bool> g_running{true};
+
+void enqueue_log(const std::string& task_id, const std::string& alloc_id,
+                 const std::string& container_id, const std::string& agent_id,
+                 int rank, const std::string& stdtype,
+                 const std::string& line) {
+  Json e = Json::object();
+  e["task_id"] = task_id;
+  e["allocation_id"] = alloc_id;
+  e["container_id"] = container_id;
+  e["agent_id"] = agent_id;
+  e["rank_id"] = static_cast<int64_t>(rank);
+  e["stdtype"] = stdtype;
+  e["source"] = "task";
+  e["level"] = stdtype == "stderr" ? "ERROR" : "INFO";
+  e["log"] = line;
+  std::lock_guard<std::mutex> lock(g_log_mu);
+  g_log_queue.push_back(std::move(e));
+  g_log_cv.notify_one();
+}
+
+void shipper_loop(const AgentOptions& opts) {
+  while (g_running) {
+    std::vector<Json> batch;
+    {
+      std::unique_lock<std::mutex> lock(g_log_mu);
+      g_log_cv.wait_for(lock, std::chrono::milliseconds(500),
+                        [] { return !g_log_queue.empty() || !g_running; });
+      while (!g_log_queue.empty() && batch.size() < 500) {
+        batch.push_back(std::move(g_log_queue.front()));
+        g_log_queue.pop_front();
+      }
+    }
+    if (batch.empty()) continue;
+    Json body = Json::object();
+    Json logs = Json::array();
+    for (auto& e : batch) logs.push_back(std::move(e));
+    body["logs"] = logs;
+    for (int attempt = 0; attempt < 3; ++attempt) {
+      try {
+        auto r = det::http_request("POST", opts.master_url,
+                                   "/api/v1/task/logs", body.dump(), 10.0);
+        if (r.ok()) break;
+      } catch (const std::exception&) {
+      }
+      std::this_thread::sleep_for(std::chrono::seconds(1));
+    }
+  }
+}
+
+// ---- device detection ---------------------------------------------------
+
+int detect_tpu_chips() {
+  // TPU VMs expose chips as /dev/accel0..N (PCI) or /dev/vfio entries.
+  int count = 0;
+  DIR* d = opendir("/dev");
+  if (d != nullptr) {
+    dirent* e;
+    while ((e = readdir(d)) != nullptr) {
+      if (strncmp(e->d_name, "accel", 5) == 0) ++count;
+    }
+    closedir(d);
+  }
+  return count;
+}
+
+Json detect_slots(AgentOptions& opts) {
+  Json slots = Json::array();
+  int n;
+  std::string type;
+  if (opts.slots_override >= 0) {
+    n = opts.slots_override;
+    type = opts.slot_type == "auto" ? "tpu" : opts.slot_type;
+  } else if ((n = detect_tpu_chips()) > 0) {
+    type = "tpu";
+  } else {
+    n = 1;  // cpu fallback: one schedulable slot per host
+    type = "cpu";
+  }
+  for (int i = 0; i < n; ++i) {
+    slots.push_back(Json(JsonObject{{"id", Json(static_cast<int64_t>(i))},
+                                    {"type", Json(type)}}));
+  }
+  return slots;
+}
+
+// ---- task lifecycle -----------------------------------------------------
+
+void reader_thread(int fd, std::shared_ptr<Task> task,
+                   const std::string& agent_id, int rank,
+                   const std::string& stdtype) {
+  FILE* f = fdopen(fd, "r");
+  if (f == nullptr) {
+    close(fd);
+    return;
+  }
+  char* line = nullptr;
+  size_t cap = 0;
+  ssize_t len;
+  while ((len = getline(&line, &cap, f)) != -1) {
+    if (len > 0 && line[len - 1] == '\n') line[len - 1] = '\0';
+    enqueue_log(task->task_id, task->allocation_id, task->container_id,
+                agent_id, rank, stdtype, line);
+  }
+  free(line);
+  fclose(f);
+}
+
+void report_state(const AgentOptions& opts, const std::string& alloc_id,
+                  const Json& body) {
+  std::string path = "/api/v1/agents/" + opts.id + "/allocations/" + alloc_id +
+                     "/state";
+  for (int attempt = 0; attempt < 5; ++attempt) {
+    try {
+      auto r = det::http_request("POST", opts.master_url, path, body.dump(),
+                                 10.0);
+      if (r.ok() || r.status == 404) return;
+    } catch (const std::exception&) {
+    }
+    std::this_thread::sleep_for(std::chrono::seconds(1));
+  }
+}
+
+void start_task(const AgentOptions& opts, const Json& action) {
+  auto task = std::make_shared<Task>();
+  task->allocation_id = action["allocation_id"].as_string();
+  task->container_id = action["container_id"].as_string();
+  const Json& env = action["env"];
+  task->task_id = env["DET_TASK_ID"].as_string();
+  int rank = static_cast<int>(env["DET_NODE_RANK"].as_int(0));
+
+  std::string workdir = opts.work_root + "/" + task->allocation_id + "-r" +
+                        std::to_string(rank);
+  mkdir(opts.work_root.c_str(), 0755);
+  mkdir(workdir.c_str(), 0755);
+
+  int out_pipe[2], err_pipe[2];
+  if (pipe(out_pipe) != 0 || pipe(err_pipe) != 0) {
+    std::cerr << "pipe() failed" << std::endl;
+    return;
+  }
+
+  pid_t pid = fork();
+  if (pid == 0) {
+    // Child: own process group so kill() reaps the whole task tree.
+    setpgid(0, 0);
+    dup2(out_pipe[1], STDOUT_FILENO);
+    dup2(err_pipe[1], STDERR_FILENO);
+    close(out_pipe[0]);
+    close(out_pipe[1]);
+    close(err_pipe[0]);
+    close(err_pipe[1]);
+    if (chdir(workdir.c_str()) != 0) _exit(125);
+    for (const auto& [k, v] : env.as_object()) {
+      std::string val = v.is_string() ? v.as_string() : v.dump();
+      setenv(k.c_str(), val.c_str(), 1);
+    }
+    setenv("DET_WORKDIR", workdir.c_str(), 1);
+    setenv("DET_RUN_DIR", workdir.c_str(), 1);
+    setenv("PYTHONUNBUFFERED", "1", 1);
+    // The in-container bootstrap (reference entrypoint.sh →
+    // exec/prep_container.py → exec/launch.py) lives in the Python
+    // harness; python resolves the experiment entrypoint from env.
+    execlp("python3", "python3", "-m", "determined_tpu.exec.launch",
+           static_cast<char*>(nullptr));
+    _exit(127);
+  }
+  close(out_pipe[1]);
+  close(err_pipe[1]);
+  if (pid < 0) {
+    std::cerr << "fork() failed" << std::endl;
+    return;
+  }
+  task->pid = pid;
+  std::cerr << "agent: started " << task->container_id << " pid=" << pid
+            << " workdir=" << workdir << std::endl;
+  {
+    std::lock_guard<std::mutex> lock(g_mu);
+    g_tasks[task->container_id] = task;
+  }
+
+  std::thread(reader_thread, out_pipe[0], task, opts.id, rank, "stdout")
+      .detach();
+  std::thread(reader_thread, err_pipe[0], task, opts.id, rank, "stderr")
+      .detach();
+
+  // Report RUNNING with our reachable address (feeds rendezvous).
+  Json body = Json::object();
+  body["container_id"] = task->container_id;
+  body["state"] = "RUNNING";
+  body["daemon_addr"] = opts.addr;
+  report_state(opts, task->allocation_id, body);
+
+  // Waiter thread: reap + report exit.
+  std::thread([task, opts] {
+    int status = 0;
+    waitpid(task->pid, &status, 0);
+    int code = WIFEXITED(status) ? WEXITSTATUS(status)
+                                 : 128 + WTERMSIG(status);
+    task->exited = true;
+    Json done = Json::object();
+    done["container_id"] = task->container_id;
+    done["state"] = "EXITED";
+    done["exit_code"] = static_cast<int64_t>(code);
+    report_state(opts, task->allocation_id, done);
+    std::lock_guard<std::mutex> lock(g_mu);
+    g_tasks.erase(task->container_id);
+  }).detach();
+}
+
+void kill_allocation(const std::string& alloc_id) {
+  std::vector<std::shared_ptr<Task>> victims;
+  {
+    std::lock_guard<std::mutex> lock(g_mu);
+    for (auto& [cid, t] : g_tasks) {
+      if (t->allocation_id == alloc_id) victims.push_back(t);
+    }
+  }
+  for (auto& t : victims) {
+    if (t->pid > 0 && !t->exited) {
+      kill(-t->pid, SIGTERM);  // whole process group
+    }
+  }
+  // Escalate after a grace period.
+  std::thread([victims] {
+    std::this_thread::sleep_for(std::chrono::seconds(15));
+    for (auto& t : victims) {
+      if (t->pid > 0 && !t->exited) kill(-t->pid, SIGKILL);
+    }
+  }).detach();
+}
+
+bool register_with_master(const AgentOptions& opts, bool reconnect) {
+  Json body = Json::object();
+  body["id"] = opts.id;
+  body["resource_pool"] = opts.resource_pool;
+  body["addr"] = opts.addr;
+  body["reconnect"] = reconnect;
+  AgentOptions mut = opts;
+  body["slots"] = detect_slots(mut);
+  try {
+    auto r = det::http_request("POST", opts.master_url,
+                               "/api/v1/agents/register", body.dump(), 10.0);
+    if (!r.ok()) return false;
+    Json resp = Json::parse_or_null(r.body);
+    // Kill anything the master no longer recognizes (reattach reconcile).
+    std::vector<std::string> keep;
+    for (const auto& k : resp["keep_allocations"].as_array()) {
+      keep.push_back(k.as_string());
+    }
+    std::vector<std::string> to_kill;
+    {
+      std::lock_guard<std::mutex> lock(g_mu);
+      for (auto& [cid, t] : g_tasks) {
+        bool ok = false;
+        for (const auto& k : keep) ok |= k == t->allocation_id;
+        if (!ok) to_kill.push_back(t->allocation_id);
+      }
+    }
+    for (const auto& aid : to_kill) kill_allocation(aid);
+    return true;
+  } catch (const std::exception& e) {
+    std::cerr << "register failed: " << e.what() << std::endl;
+    return false;
+  }
+}
+
+void heartbeat_loop(const AgentOptions& opts) {
+  while (g_running) {
+    std::this_thread::sleep_for(std::chrono::seconds(10));
+    Json body = Json::object();
+    Json running = Json::array();
+    {
+      std::lock_guard<std::mutex> lock(g_mu);
+      for (auto& [cid, t] : g_tasks) running.push_back(Json(t->allocation_id));
+    }
+    body["running"] = running;
+    try {
+      auto r = det::http_request("POST", opts.master_url,
+                                 "/api/v1/agents/" + opts.id + "/heartbeat",
+                                 body.dump(), 10.0);
+      if (r.status == 404) {
+        register_with_master(opts, true);  // master restarted
+      } else if (r.ok()) {
+        Json doc = Json::parse_or_null(r.body);
+        for (const auto& aid : doc["kill_allocations"].as_array()) {
+          kill_allocation(aid.as_string());
+        }
+      }
+    } catch (const std::exception&) {
+      // master temporarily unreachable; keep running tasks (reference
+      // reconnect-with-reattach, agent.go:330-362)
+    }
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  AgentOptions opts;
+  char hostname[256] = "agent";
+  gethostname(hostname, sizeof(hostname));
+  opts.id = hostname;
+  opts.addr = "127.0.0.1";
+  if (const char* p = getenv("DET_MASTER")) opts.master_url = p;
+  if (const char* p = getenv("DET_AGENT_SLOTS")) {
+    opts.slots_override = atoi(p);
+  }
+
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&]() -> std::string {
+      return i + 1 < argc ? argv[++i] : "";
+    };
+    if (a == "--master-url") opts.master_url = next();
+    else if (a == "--id") opts.id = next();
+    else if (a == "--resource-pool") opts.resource_pool = next();
+    else if (a == "--addr") opts.addr = next();
+    else if (a == "--slots") opts.slots_override = atoi(next().c_str());
+    else if (a == "--slot-type") opts.slot_type = next();
+    else if (a == "--work-root") opts.work_root = next();
+    else if (a == "--help" || a == "-h") {
+      std::cout << "determined-agent --master-url URL [--id ID] "
+                   "[--resource-pool P] [--addr A] [--slots N] "
+                   "[--slot-type tpu|cpu] [--work-root DIR]\n";
+      return 0;
+    }
+  }
+
+  signal(SIGPIPE, SIG_IGN);
+
+  // Register (retry until master is up).
+  while (!register_with_master(opts, false)) {
+    std::this_thread::sleep_for(std::chrono::seconds(2));
+  }
+  std::cout << "agent " << opts.id << " registered with " << opts.master_url
+            << std::endl;
+
+  std::thread(shipper_loop, std::cref(opts)).detach();
+  std::thread(heartbeat_loop, std::cref(opts)).detach();
+
+  // Action long-poll loop.
+  std::string actions_path = "/api/v1/agents/" + opts.id +
+                             "/actions?timeout_seconds=" +
+                             std::to_string(opts.poll_timeout_s);
+  while (g_running) {
+    try {
+      auto r = det::http_request("GET", opts.master_url, actions_path, "",
+                                 opts.poll_timeout_s + 10.0);
+      if (r.status == 404) {
+        register_with_master(opts, true);
+        continue;
+      }
+      if (!r.ok()) {
+        std::this_thread::sleep_for(std::chrono::seconds(1));
+        continue;
+      }
+      // Bind the parsed document to a named value: iterating a reference
+      // obtained through a temporary would dangle.
+      Json doc = Json::parse_or_null(r.body);
+      for (const auto& action : doc["actions"].as_array()) {
+        const std::string& type = action["type"].as_string();
+        std::cerr << "agent: action " << type << " alloc="
+                  << action["allocation_id"].as_string() << std::endl;
+        if (type == "start") {
+          start_task(opts, action);
+        } else if (type == "kill") {
+          kill_allocation(action["allocation_id"].as_string());
+        }
+      }
+    } catch (const std::exception&) {
+      std::this_thread::sleep_for(std::chrono::seconds(2));
+    }
+  }
+  return 0;
+}

@@ -1,0 +1,83 @@
+// determined-master — entrypoint.
+//
+// Config precedence flags > env (DET_MASTER_*) > JSON config file, the same
+// viper-style layering as the reference (cmd/determined-master/init.go:13).
+
+#include <csignal>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <sstream>
+
+#include "master.h"
+
+namespace {
+
+det::Master* g_master = nullptr;
+
+void on_signal(int) {
+  if (g_master != nullptr) g_master->stop();
+  _exit(0);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  det::MasterConfig cfg;
+
+  // 1. config file
+  const char* cfg_env = getenv("DET_MASTER_CONFIG");
+  std::string cfg_path = cfg_env != nullptr ? cfg_env : "";
+  for (int i = 1; i < argc - 1; ++i) {
+    if (strcmp(argv[i], "--config") == 0) cfg_path = argv[i + 1];
+  }
+  if (!cfg_path.empty()) {
+    std::ifstream f(cfg_path);
+    if (!f) {
+      std::cerr << "cannot read config " << cfg_path << std::endl;
+      return 1;
+    }
+    std::stringstream ss;
+    ss << f.rdbuf();
+    cfg = det::MasterConfig::from_json(det::Json::parse(ss.str()));
+  }
+
+  // 2. env
+  if (const char* p = getenv("DET_MASTER_PORT")) cfg.port = atoi(p);
+  if (const char* p = getenv("DET_MASTER_DB")) cfg.db_path = p;
+
+  // 3. flags
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&]() -> std::string {
+      return i + 1 < argc ? argv[++i] : "";
+    };
+    if (a == "--port") cfg.port = atoi(next().c_str());
+    else if (a == "--host") cfg.host = next();
+    else if (a == "--db") cfg.db_path = next();
+    else if (a == "--cluster-name") cfg.cluster_name = next();
+    else if (a == "--agent-timeout") cfg.agent_timeout_s = atof(next().c_str());
+    else if (a == "--config") next();
+    else if (a == "--help" || a == "-h") {
+      std::cout << "determined-master [--port N] [--host H] [--db PATH] "
+                   "[--config file.json]\n";
+      return 0;
+    }
+  }
+
+  try {
+    det::Master master(cfg);
+    g_master = &master;
+    signal(SIGINT, on_signal);
+    signal(SIGTERM, on_signal);
+    int port = master.start();
+    std::cout << "determined-master listening on " << cfg.host << ":" << port
+              << " (db: " << cfg.db_path << ")" << std::endl;
+    master.run();
+  } catch (const std::exception& e) {
+    std::cerr << "fatal: " << e.what() << std::endl;
+    return 1;
+  }
+  return 0;
+}

@@ -1,0 +1,279 @@
+// master.cc — control-plane implementation. See master.h for the design and
+// the reference citations (master/internal/{core,experiment,trial}.go,
+// task/allocation.go, rm/agentrm/).
+
+#include "master.h"
+
+#include <chrono>
+#include <cstring>
+#include <fstream>
+#include <random>
+#include <sstream>
+#include <thread>
+
+namespace det {
+
+std::string random_hex(size_t nbytes) {
+  static thread_local std::mt19937_64 rng(std::random_device{}());
+  static const char* hex = "0123456789abcdef";
+  std::string out;
+  for (size_t i = 0; i < nbytes; ++i) {
+    unsigned byte = static_cast<unsigned>(rng() & 0xff);
+    out += hex[byte >> 4];
+    out += hex[byte & 0xf];
+  }
+  return out;
+}
+
+namespace {
+
+std::vector<std::string> split_path(const std::string& path) {
+  std::vector<std::string> parts;
+  size_t start = 0;
+  while (start < path.size()) {
+    size_t slash = path.find('/', start);
+    if (slash == std::string::npos) slash = path.size();
+    if (slash > start) parts.push_back(path.substr(start, slash - start));
+    start = slash + 1;
+  }
+  return parts;
+}
+
+Json err_body(const std::string& msg) {
+  Json j = Json::object();
+  j["error"] = msg;
+  return j;
+}
+
+HttpResponse json_resp(int status, const Json& j) {
+  return HttpResponse::json(status, j.dump());
+}
+
+HttpResponse not_found() { return json_resp(404, err_body("not found")); }
+
+int64_t to_id(const std::string& s) {
+  try {
+    return std::stoll(s);
+  } catch (...) {
+    return -1;
+  }
+}
+
+std::string iso_now() {
+  char buf[64];
+  time_t t = time(nullptr);
+  struct tm tmv;
+  gmtime_r(&t, &tmv);
+  strftime(buf, sizeof(buf), "%Y-%m-%dT%H:%M:%SZ", &tmv);
+  return buf;
+}
+
+}  // namespace
+
+MasterConfig MasterConfig::from_json(const Json& j) {
+  MasterConfig c;
+  if (j["host"].is_string()) c.host = j["host"].as_string();
+  if (j["port"].is_number()) c.port = static_cast<int>(j["port"].as_int());
+  if (j["db_path"].is_string()) c.db_path = j["db_path"].as_string();
+  if (j["cluster_id"].is_string()) c.cluster_id = j["cluster_id"].as_string();
+  if (j["cluster_name"].is_string()) {
+    c.cluster_name = j["cluster_name"].as_string();
+  }
+  if (j["agent_timeout_s"].is_number()) {
+    c.agent_timeout_s = j["agent_timeout_s"].as_double();
+  }
+  for (const auto& [pool, policy] : j["resource_pools"].as_object()) {
+    c.pool_policies[pool] = policy["scheduler"].as_string("priority");
+  }
+  return c;
+}
+
+Master::Master(MasterConfig cfg) : cfg_(std::move(cfg)), db_(cfg_.db_path) {
+  db_.migrate();
+  // Default users, as in the reference bootstrap (user "determined" and
+  // "admin" with empty passwords).
+  for (const char* name : {"determined", "admin"}) {
+    auto rows = db_.query("SELECT id FROM users WHERE username=?", {Json(name)});
+    if (rows.empty()) {
+      db_.exec("INSERT INTO users (username, admin) VALUES (?, ?)",
+               {Json(name), Json(std::string(name) == "admin" ? 1 : 0)});
+    }
+  }
+  restore_experiments();
+}
+
+Master::~Master() { stop(); }
+
+double Master::now() const {
+  return std::chrono::duration<double>(Clock::now().time_since_epoch()).count();
+}
+
+int Master::start() {
+  int port = server_.listen(cfg_.host, cfg_.port,
+                            [this](const HttpRequest& r) { return handle(r); });
+  running_ = true;
+  scheduler_thread_ = std::thread([this] { scheduler_loop(); });
+  server_.start();
+  return port;
+}
+
+void Master::run() {
+  if (!running_) start();
+  while (running_) std::this_thread::sleep_for(std::chrono::seconds(1));
+}
+
+void Master::stop() {
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    if (!running_) return;
+    running_ = false;
+  }
+  cv_.notify_all();
+  if (scheduler_thread_.joinable()) scheduler_thread_.join();
+  server_.stop();
+}
+
+// ---------------------------------------------------------------------------
+// Routing.
+// ---------------------------------------------------------------------------
+
+HttpResponse Master::handle(const HttpRequest& req) {
+  auto parts = split_path(req.path);
+  // All routes live under /api/v1/.
+  if (parts.size() < 3 || parts[0] != "api" || parts[1] != "v1") {
+    if (req.path == "/" || req.path == "/health") {
+      return HttpResponse::json(200, "{\"status\":\"ok\"}");
+    }
+    return not_found();
+  }
+  std::vector<std::string> rest(parts.begin() + 2, parts.end());
+  const std::string& root = rest[0];
+
+  try {
+    if (root == "auth") return handle_login(req);
+    if (root == "master") return handle_master_info(req);
+    if (root == "users" || root == "me") return handle_users(req);
+    if (root == "agents") return handle_agents_api(req, rest);
+    if (root == "experiments") return handle_experiments(req, rest);
+    if (root == "trials") return handle_trials(req, rest);
+    if (root == "allocations") return handle_allocations(req, rest);
+    if (root == "checkpoints") return handle_checkpoints(req, rest);
+    if (root == "task") return handle_task_logs(req);
+    if (root == "tasks") return handle_tasks(req, rest);
+    if (root == "workspaces") return handle_workspaces(req, rest);
+    if (root == "projects") return handle_projects(req, rest);
+    if (root == "models") return handle_models(req, rest);
+    if (root == "templates") return handle_templates(req, rest);
+    if (root == "webhooks") return handle_webhooks(req, rest);
+    if (root == "job-queues") return handle_job_queue(req);
+  } catch (const std::exception& e) {
+    return json_resp(500, err_body(e.what()));
+  }
+  return not_found();
+}
+
+// ---------------------------------------------------------------------------
+// Auth + users (reference master/internal/user/; basic sessions, no RBAC
+// enforcement yet — authz model is "any authenticated user").
+// ---------------------------------------------------------------------------
+
+HttpResponse Master::handle_login(const HttpRequest& req) {
+  auto parts = split_path(req.path);
+  const std::string& action = parts.size() >= 4 ? parts[3] : "";
+  if (action == "login" && req.method == "POST") {
+    Json body = Json::parse_or_null(req.body);
+    std::string username = body["username"].as_string("determined");
+    auto rows = db_.query(
+        "SELECT id, password_hash, active FROM users WHERE username=?",
+        {Json(username)});
+    if (rows.empty() || rows[0]["active"].as_int() == 0) {
+      return json_resp(403, err_body("invalid credentials"));
+    }
+    // Empty-password default users; hashed passwords compared verbatim
+    // (the CLI sends the already-salted hash, as the reference does).
+    const std::string& want = rows[0]["password_hash"].as_string();
+    if (!want.empty() && want != body["password"].as_string()) {
+      return json_resp(403, err_body("invalid credentials"));
+    }
+    std::string token = random_hex(24);
+    db_.exec(
+        "INSERT INTO user_sessions (user_id, token, expires_at) "
+        "VALUES (?, ?, datetime('now', '+30 days'))",
+        {rows[0]["id"], Json(token)});
+    Json out = Json::object();
+    out["token"] = token;
+    Json user = Json::object();
+    user["username"] = username;
+    user["id"] = rows[0]["id"];
+    out["user"] = user;
+    return json_resp(200, out);
+  }
+  if (action == "logout" && req.method == "POST") {
+    auto it = req.headers.find("authorization");
+    if (it != req.headers.end() && it->second.rfind("Bearer ", 0) == 0) {
+      db_.exec("DELETE FROM user_sessions WHERE token=?",
+               {Json(it->second.substr(7))});
+    }
+    return json_resp(200, Json::object());
+  }
+  return not_found();
+}
+
+int64_t Master::auth_user_locked(const HttpRequest& req) {
+  auto it = req.headers.find("authorization");
+  if (it == req.headers.end() || it->second.rfind("Bearer ", 0) != 0) return -1;
+  auto rows = db_.query(
+      "SELECT user_id FROM user_sessions WHERE token=? AND "
+      "(expires_at IS NULL OR expires_at > datetime('now'))",
+      {Json(it->second.substr(7))});
+  return rows.empty() ? -1 : rows[0]["user_id"].as_int();
+}
+
+HttpResponse Master::handle_users(const HttpRequest& req) {
+  auto parts = split_path(req.path);
+  if (parts[2] == "me") {
+    std::lock_guard<std::mutex> lock(mu_);
+    int64_t uid = auth_user_locked(req);
+    if (uid < 0) return json_resp(401, err_body("unauthenticated"));
+    auto rows = db_.query("SELECT id, username, admin FROM users WHERE id=?",
+                          {Json(uid)});
+    Json out = Json::object();
+    out["user"] = Json(JsonObject{{"id", rows[0]["id"]},
+                                  {"username", rows[0]["username"]},
+                                  {"admin", rows[0]["admin"]}});
+    return json_resp(200, out);
+  }
+  if (req.method == "GET") {
+    Json users = Json::array();
+    for (auto& row : db_.query(
+             "SELECT id, username, admin, active, created_at FROM users")) {
+      users.push_back(Json(JsonObject(row.begin(), row.end())));
+    }
+    Json out = Json::object();
+    out["users"] = users;
+    return json_resp(200, out);
+  }
+  if (req.method == "POST") {
+    Json body = Json::parse_or_null(req.body);
+    const std::string& name = body["username"].as_string();
+    if (name.empty()) return json_resp(400, err_body("username required"));
+    db_.exec(
+        "INSERT INTO users (username, password_hash, admin) VALUES (?, ?, ?)",
+        {Json(name), body["password"], Json(body["admin"].as_bool() ? 1 : 0)});
+    Json out = Json::object();
+    out["id"] = db_.last_insert_id();
+    return json_resp(200, out);
+  }
+  return not_found();
+}
+
+HttpResponse Master::handle_master_info(const HttpRequest& req) {
+  Json out = Json::object();
+  out["version"] = "0.1.0";
+  out["cluster_id"] = cfg_.cluster_id;
+  out["cluster_name"] = cfg_.cluster_name;
+  out["master_id"] = "master";
+  return json_resp(200, out);
+}
+
+}  // namespace det

@@ -1,0 +1,230 @@
+// master.h — the TPU-native control plane (reference: Go master,
+// master/internal/core.go).
+//
+// One process serves the full REST API on one port (the reference muxes
+// REST+gRPC via cmux, core.go:744-763; here it is plain REST/JSON), owns the
+// experiment/trial/allocation state machines (experiment.go, trial.go,
+// task/allocation.go), runs the topology-aware scheduler (rm/agentrm/), the
+// searcher engine, and persists everything to SQLite (internal/db/).
+//
+// Device model (SURVEY.md §7): a slot is a TPU chip, an agent is a TPU-VM
+// worker host, an allocation is a set of hosts forming one ICI mesh. One
+// task process runs per host and owns all the host's chips — unlike the
+// reference's GPU process-per-device model.
+//
+// Concurrency: one mutex guards all in-memory state; long-polls (agent
+// actions, preemption signals, searcher ops, rendezvous, log follow) wait on
+// a single condition variable broadcast at every state change. The control
+// plane is low-QPS; correctness beats lock granularity.
+
+#pragma once
+
+#include <condition_variable>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "../common/http.h"
+#include "../common/json.h"
+#include "db.h"
+#include "searcher.h"
+
+namespace det {
+
+// Shared helpers (defined in master.cc).
+std::string random_hex(size_t nbytes);
+
+struct MasterConfig {
+  std::string host = "0.0.0.0";
+  int port = 8080;
+  std::string db_path = "determined.db";
+  std::string cluster_id = "tpu-cluster";
+  std::string cluster_name = "determined-tpu";
+  // resource pool name → scheduler policy ("priority" | "fair_share" |
+  // "round_robin"); pools appear implicitly when agents register.
+  std::map<std::string, std::string> pool_policies;
+  std::string default_pool = "default";
+  double agent_timeout_s = 60.0;  // heartbeat grace before marking dead
+
+  static MasterConfig from_json(const Json& j);
+};
+
+struct SlotState {
+  int id = 0;
+  std::string type = "tpu";
+  bool enabled = true;
+  std::string allocation_id;  // empty = free
+};
+
+struct AgentState {
+  std::string id;
+  std::string resource_pool;
+  std::string addr;  // host reachable by peers (for rendezvous)
+  std::vector<SlotState> slots;
+  std::deque<Json> actions;  // pending actions drained by agent long-poll
+  double last_heartbeat = 0;
+  bool alive = true;
+};
+
+// One host's share of an allocation.
+struct AllocResource {
+  std::string agent_id;
+  std::vector<int> slot_ids;
+  std::string container_id;
+  std::string state = "ASSIGNED";  // ASSIGNED → RUNNING → EXITED
+  int exit_code = -1;
+  std::string daemon_addr;  // reported by the task process at startup
+};
+
+struct Allocation {
+  std::string id;
+  std::string task_id;
+  int64_t experiment_id = -1;
+  std::string request_id;  // searcher request id ("" for NTSC tasks)
+  int64_t trial_id = -1;
+  std::string state = "PENDING";  // PENDING/ASSIGNED/RUNNING/TERMINATED
+  std::string resource_pool;
+  int slots = 0;
+  int priority = 42;
+  double submitted_at = 0;
+  std::vector<AllocResource> resources;
+  bool preempting = false;
+  bool killed = false;
+  int exit_code = -1;
+  std::string exit_reason;
+  // REST-level allgather before the in-mesh collectives are up
+  // (reference task/allgather/): rank → payload.
+  std::map<int64_t, Json> allgather;
+  int64_t allgather_round = 0;
+  std::map<int64_t, std::string> proxy_addresses;
+};
+
+struct TrialState {
+  int64_t id = 0;  // db id
+  std::string request_id;
+  int64_t experiment_id = 0;
+  Json hparams;
+  int64_t seed = 0;
+  std::string state = "ACTIVE";
+  std::deque<int64_t> pending_ops;  // cumulative ValidateAfter lengths
+  bool close_requested = false;
+  bool searcher_done = false;  // trial_closed delivered to searcher
+  int64_t restarts = 0;
+  int64_t run_id = 0;
+  int64_t steps_completed = 0;
+  std::string latest_checkpoint;
+  std::string allocation_id;  // current, "" when none
+};
+
+struct ExperimentState {
+  int64_t id = 0;
+  Json config;
+  std::string state = "ACTIVE";
+  std::unique_ptr<Searcher> searcher;
+  std::map<std::string, TrialState> trials;  // by request id
+  std::string job_id;
+  int priority = 42;
+  int slots_per_trial = 1;
+  std::string resource_pool;
+  int64_t max_restarts = 5;
+  bool searcher_shutdown = false;
+};
+
+class Master {
+ public:
+  explicit Master(MasterConfig cfg);
+  ~Master();
+
+  // Blocks serving; test harnesses use start()/stop() instead.
+  void run();
+  int start();  // returns bound port
+  void stop();
+
+  HttpResponse handle(const HttpRequest& req);
+
+ private:
+  using Clock = std::chrono::steady_clock;
+  double now() const;
+
+  // --- route handlers (all called with specific path segments parsed) ---
+  HttpResponse handle_login(const HttpRequest& req);
+  HttpResponse handle_users(const HttpRequest& req);
+  HttpResponse handle_master_info(const HttpRequest& req);
+  HttpResponse handle_agents_api(const HttpRequest& req,
+                                 const std::vector<std::string>& parts);
+  HttpResponse handle_experiments(const HttpRequest& req,
+                                  const std::vector<std::string>& parts);
+  HttpResponse handle_trials(const HttpRequest& req,
+                             const std::vector<std::string>& parts);
+  HttpResponse handle_allocations(const HttpRequest& req,
+                                  const std::vector<std::string>& parts);
+  HttpResponse handle_checkpoints(const HttpRequest& req,
+                                  const std::vector<std::string>& parts);
+  HttpResponse handle_task_logs(const HttpRequest& req);
+  HttpResponse handle_tasks(const HttpRequest& req,
+                            const std::vector<std::string>& parts);
+  HttpResponse handle_workspaces(const HttpRequest& req,
+                                 const std::vector<std::string>& parts);
+  HttpResponse handle_projects(const HttpRequest& req,
+                               const std::vector<std::string>& parts);
+  HttpResponse handle_models(const HttpRequest& req,
+                             const std::vector<std::string>& parts);
+  HttpResponse handle_templates(const HttpRequest& req,
+                                const std::vector<std::string>& parts);
+  HttpResponse handle_webhooks(const HttpRequest& req,
+                               const std::vector<std::string>& parts);
+  HttpResponse handle_job_queue(const HttpRequest& req);
+
+  // --- experiment/trial/searcher machinery (mu_ held) ---
+  int64_t create_experiment_locked(const Json& config,
+                                   const std::string& model_def_b64,
+                                   int64_t user_id, int64_t project_id,
+                                   bool activate);
+  void activate_experiment_locked(ExperimentState& exp);
+  void process_ops_locked(ExperimentState& exp,
+                          const std::vector<SearcherOp>& ops);
+  void request_allocation_locked(ExperimentState& exp, TrialState& trial);
+  void finish_trial_locked(ExperimentState& exp, TrialState& trial,
+                           const std::string& state);
+  void maybe_complete_experiment_locked(ExperimentState& exp);
+  void set_experiment_state_locked(ExperimentState& exp,
+                                   const std::string& state);
+  void snapshot_experiment_locked(ExperimentState& exp);
+  void restore_experiments();  // on boot
+  void preempt_allocation_locked(Allocation& alloc, const std::string& why);
+  void kill_allocation_locked(Allocation& alloc);
+  void on_allocation_exit_locked(Allocation& alloc);
+  void fire_webhooks_locked(const ExperimentState& exp);
+
+  // --- scheduler (reference rm/agentrm/resource_pool.go:348 schedulerTick) ---
+  void scheduler_loop();
+  void schedule_locked();
+  bool try_fit_locked(Allocation& alloc);
+  void release_resources_locked(Allocation& alloc);
+  void check_agents_locked();
+
+  ExperimentState* find_experiment_locked(int64_t id);
+  TrialState* find_trial_locked(int64_t trial_id, ExperimentState** exp_out);
+  int64_t auth_user_locked(const HttpRequest& req);  // -1 if unauthenticated
+
+  MasterConfig cfg_;
+  Db db_;
+  HttpServer server_;
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, AgentState> agents_;
+  std::map<std::string, Allocation> allocations_;
+  std::map<int64_t, ExperimentState> experiments_;
+  std::deque<std::string> pending_;  // allocation ids waiting for resources
+  std::map<std::string, int> pool_rr_cursor_;  // round-robin state per pool
+  bool running_ = false;
+  std::thread scheduler_thread_;
+  int64_t alloc_counter_ = 0;
+};
+
+}  // namespace det

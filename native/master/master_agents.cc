@@ -1,0 +1,541 @@
+// master_agents.cc — agent registration/long-poll protocol + the scheduler.
+//
+// Replaces the reference's master↔agent websocket (aproto messages,
+// agent/internal/agent.go:246-270) with HTTP long-poll, and the agentrm
+// scheduler (rm/agentrm/resource_pool.go:348 schedulerTick, priority.go,
+// fair_share.go, round_robin.go, fitting.go) with a topology-aware variant:
+// slots are TPU chips, fits prefer contiguous chip runs (sub-slices) on one
+// host or whole free hosts for multi-host ICI meshes.
+
+#include <algorithm>
+#include <chrono>
+#include <thread>
+
+#include "master.h"
+
+namespace det {
+
+namespace {
+
+Json err_body(const std::string& msg) {
+  Json j = Json::object();
+  j["error"] = msg;
+  return j;
+}
+
+HttpResponse json_resp(int status, const Json& j) {
+  return HttpResponse::json(status, j.dump());
+}
+
+}  // namespace
+
+HttpResponse Master::handle_agents_api(const HttpRequest& req,
+                                       const std::vector<std::string>& parts) {
+  // GET /api/v1/agents — list for CLI/SDK.
+  if (parts.size() == 1 && req.method == "GET") {
+    std::lock_guard<std::mutex> lock(mu_);
+    Json agents = Json::array();
+    for (const auto& [id, a] : agents_) {
+      Json slots = Json::array();
+      for (const auto& s : a.slots) {
+        slots.push_back(Json(JsonObject{
+            {"id", Json(static_cast<int64_t>(s.id))},
+            {"type", Json(s.type)},
+            {"enabled", Json(s.enabled)},
+            {"allocation_id", Json(s.allocation_id)},
+        }));
+      }
+      agents.push_back(Json(JsonObject{
+          {"id", Json(id)},
+          {"resource_pool", Json(a.resource_pool)},
+          {"addr", Json(a.addr)},
+          {"alive", Json(a.alive)},
+          {"slots", slots},
+      }));
+    }
+    Json out = Json::object();
+    out["agents"] = agents;
+    return json_resp(200, out);
+  }
+
+  // POST /api/v1/agents/register
+  if (parts.size() == 2 && parts[1] == "register" && req.method == "POST") {
+    Json body = Json::parse_or_null(req.body);
+    const std::string& id = body["id"].as_string();
+    if (id.empty()) return json_resp(400, err_body("agent id required"));
+    std::lock_guard<std::mutex> lock(mu_);
+    bool reconnect = body["reconnect"].as_bool(false);
+    AgentState& a = agents_[id];
+    bool fresh = a.id.empty() || !reconnect;
+    a.id = id;
+    a.resource_pool = body["resource_pool"].as_string(cfg_.default_pool);
+    a.addr = body["addr"].as_string(req.remote_addr);
+    a.last_heartbeat = now();
+    a.alive = true;
+    if (fresh) {
+      a.actions.clear();
+      a.slots.clear();
+      int i = 0;
+      for (const auto& s : body["slots"].as_array()) {
+        SlotState slot;
+        slot.id = s["id"].is_number() ? static_cast<int>(s["id"].as_int()) : i;
+        slot.type = s["type"].as_string("tpu");
+        a.slots.push_back(slot);
+        ++i;
+      }
+    }
+    // Reconnect-with-reattach (reference agent.go:330-362): tell the agent
+    // which allocations it should still be running; it kills the rest.
+    Json keep = Json::array();
+    for (const auto& [aid, alloc] : allocations_) {
+      for (const auto& r : alloc.resources) {
+        if (r.agent_id == id && r.state != "EXITED" &&
+            alloc.state != "TERMINATED") {
+          keep.push_back(Json(aid));
+        }
+      }
+    }
+    cv_.notify_all();
+    Json out = Json::object();
+    out["agent_id"] = id;
+    out["keep_allocations"] = keep;
+    out["master_time"] = now();
+    return json_resp(200, out);
+  }
+
+  if (parts.size() < 3) return json_resp(404, err_body("not found"));
+  const std::string& agent_id = parts[1];
+
+  // GET /api/v1/agents/{id}/actions?timeout_seconds=N — long-poll drain.
+  if (parts[2] == "actions" && req.method == "GET") {
+    double timeout = std::stod(req.query_param("timeout_seconds", "30"));
+    std::unique_lock<std::mutex> lock(mu_);
+    auto deadline = Clock::now() +
+                    std::chrono::milliseconds(static_cast<int>(timeout * 1000));
+    auto it = agents_.find(agent_id);
+    if (it == agents_.end()) {
+      return json_resp(404, err_body("unknown agent; re-register"));
+    }
+    cv_.wait_until(lock, deadline, [&] {
+      return !running_ || !agents_[agent_id].actions.empty();
+    });
+    AgentState& a = agents_[agent_id];
+    a.last_heartbeat = now();
+    Json actions = Json::array();
+    while (!a.actions.empty()) {
+      actions.push_back(a.actions.front());
+      a.actions.pop_front();
+    }
+    Json out = Json::object();
+    out["actions"] = actions;
+    return json_resp(200, out);
+  }
+
+  // POST /api/v1/agents/{id}/heartbeat {running: [allocation ids]}
+  if (parts[2] == "heartbeat" && req.method == "POST") {
+    Json body = Json::parse_or_null(req.body);
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = agents_.find(agent_id);
+    if (it == agents_.end()) {
+      return json_resp(404, err_body("unknown agent; re-register"));
+    }
+    it->second.last_heartbeat = now();
+    it->second.alive = true;
+    // Reconcile: agent-side allocations the master no longer tracks → kill.
+    Json kill = Json::array();
+    for (const auto& rid : body["running"].as_array()) {
+      const std::string& aid = rid.as_string();
+      auto ait = allocations_.find(aid);
+      if (ait == allocations_.end() || ait->second.state == "TERMINATED") {
+        kill.push_back(Json(aid));
+      }
+    }
+    Json out = Json::object();
+    out["kill_allocations"] = kill;
+    return json_resp(200, out);
+  }
+
+  // POST /api/v1/agents/{id}/allocations/{aid}/state
+  //   {container_id, state: RUNNING|EXITED, exit_code, daemon_addr}
+  if (parts.size() == 5 && parts[2] == "allocations" && parts[4] == "state" &&
+      req.method == "POST") {
+    Json body = Json::parse_or_null(req.body);
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = allocations_.find(parts[3]);
+    if (it == allocations_.end()) {
+      return json_resp(404, err_body("unknown allocation"));
+    }
+    Allocation& alloc = it->second;
+    const std::string& state = body["state"].as_string();
+    bool all_running = true, all_exited = true;
+    for (auto& r : alloc.resources) {
+      if (r.agent_id == agent_id) {
+        r.state = state;
+        if (state == "EXITED") {
+          r.exit_code = static_cast<int>(body["exit_code"].as_int(-1));
+        }
+        if (body["daemon_addr"].is_string()) {
+          r.daemon_addr = body["daemon_addr"].as_string();
+        }
+      }
+      all_running &= r.state == "RUNNING" || r.state == "EXITED";
+      all_exited &= r.state == "EXITED";
+    }
+    if (alloc.state == "ASSIGNED" && all_running) {
+      alloc.state = "RUNNING";
+      db_.exec("UPDATE allocations SET state='RUNNING' WHERE id=?",
+               {Json(alloc.id)});
+    }
+    if (all_exited && alloc.state != "TERMINATED") {
+      on_allocation_exit_locked(alloc);
+    }
+    cv_.notify_all();
+    return json_resp(200, Json::object());
+  }
+
+  return json_resp(404, err_body("not found"));
+}
+
+// ---------------------------------------------------------------------------
+// Scheduler.
+// ---------------------------------------------------------------------------
+
+void Master::scheduler_loop() {
+  while (true) {
+    std::unique_lock<std::mutex> lock(mu_);
+    cv_.wait_for(lock, std::chrono::milliseconds(200));
+    if (!running_) return;
+    check_agents_locked();
+    schedule_locked();
+  }
+}
+
+void Master::check_agents_locked() {
+  double t = now();
+  for (auto& [id, a] : agents_) {
+    if (!a.alive) continue;
+    if (t - a.last_heartbeat > cfg_.agent_timeout_s) {
+      a.alive = false;
+      // Fail every allocation with resources on the dead agent (reference
+      // task/allocation.go:850 restoreResourceFailure).
+      for (auto& [aid, alloc] : allocations_) {
+        if (alloc.state == "TERMINATED") continue;
+        for (auto& r : alloc.resources) {
+          if (r.agent_id == id && r.state != "EXITED") {
+            r.state = "EXITED";
+            r.exit_code = 137;
+            alloc.exit_reason = "agent " + id + " lost";
+          }
+        }
+        bool all_exited = !alloc.resources.empty();
+        for (auto& r : alloc.resources) all_exited &= r.state == "EXITED";
+        if (all_exited) on_allocation_exit_locked(alloc);
+      }
+    }
+  }
+}
+
+void Master::schedule_locked() {
+  if (pending_.empty()) return;
+
+  // Order the queue per pool policy. priority: (priority, submit time).
+  // fair_share: fewest currently-running slots of the owning experiment
+  // first (fair_share.go:52). round_robin: rotate over experiments
+  // (round_robin.go).
+  auto running_slots = [&](int64_t eid) {
+    int n = 0;
+    for (const auto& [aid, a] : allocations_) {
+      if (a.experiment_id == eid &&
+          (a.state == "ASSIGNED" || a.state == "RUNNING")) {
+        n += a.slots;
+      }
+    }
+    return n;
+  };
+  std::vector<std::string> queue(pending_.begin(), pending_.end());
+  std::stable_sort(queue.begin(), queue.end(), [&](const std::string& x,
+                                                   const std::string& y) {
+    const Allocation& ax = allocations_[x];
+    const Allocation& ay = allocations_[y];
+    const std::string policy_x = cfg_.pool_policies.count(ax.resource_pool)
+                                     ? cfg_.pool_policies.at(ax.resource_pool)
+                                     : "priority";
+    if (policy_x == "fair_share") {
+      return running_slots(ax.experiment_id) < running_slots(ay.experiment_id);
+    }
+    if (ax.priority != ay.priority) return ax.priority < ay.priority;
+    return ax.submitted_at < ay.submitted_at;
+  });
+
+  std::vector<std::string> still_pending;
+  for (const auto& aid : queue) {
+    auto it = allocations_.find(aid);
+    if (it == allocations_.end() || it->second.state != "PENDING") continue;
+    if (!try_fit_locked(it->second)) still_pending.push_back(aid);
+  }
+  pending_.assign(still_pending.begin(), still_pending.end());
+
+  // Priority preemption (priority.go:200): a pending allocation may evict
+  // strictly-lower-priority running work in its pool if that frees enough
+  // slots.
+  for (const auto& aid : pending_) {
+    Allocation& want = allocations_[aid];
+    const std::string policy = cfg_.pool_policies.count(want.resource_pool)
+                                   ? cfg_.pool_policies.at(want.resource_pool)
+                                   : "priority";
+    if (policy != "priority") continue;
+    int free = 0;
+    for (const auto& [id, a] : agents_) {
+      if (!a.alive || a.resource_pool != want.resource_pool) continue;
+      for (const auto& s : a.slots) {
+        if (s.enabled && s.allocation_id.empty()) ++free;
+      }
+    }
+    if (free >= want.slots) continue;  // will fit once fragmentation clears
+    std::vector<Allocation*> victims;
+    for (auto& [id, a] : allocations_) {
+      if (a.resource_pool == want.resource_pool && a.priority > want.priority &&
+          (a.state == "ASSIGNED" || a.state == "RUNNING") && !a.preempting) {
+        victims.push_back(&a);
+      }
+    }
+    std::sort(victims.begin(), victims.end(),
+              [](const Allocation* x, const Allocation* y) {
+                return x->priority > y->priority;
+              });
+    int reclaim = 0;
+    for (Allocation* v : victims) {
+      if (free + reclaim >= want.slots) break;
+      preempt_allocation_locked(*v, "higher-priority job");
+      reclaim += v->slots;
+    }
+  }
+}
+
+bool Master::try_fit_locked(Allocation& alloc) {
+  // Collect alive agents in the pool with their free slot runs.
+  struct Candidate {
+    AgentState* agent;
+    std::vector<int> free_slots;  // sorted ids
+  };
+  std::vector<Candidate> cands;
+  for (auto& [id, a] : agents_) {
+    if (!a.alive || a.resource_pool != alloc.resource_pool) continue;
+    Candidate c{&a, {}};
+    for (const auto& s : a.slots) {
+      if (s.enabled && s.allocation_id.empty()) c.free_slots.push_back(s.id);
+    }
+    std::sort(c.free_slots.begin(), c.free_slots.end());
+    cands.push_back(std::move(c));
+  }
+  if (cands.empty()) return false;
+  std::sort(cands.begin(), cands.end(), [](const Candidate& x,
+                                           const Candidate& y) {
+    return x.agent->id < y.agent->id;
+  });
+
+  std::vector<std::pair<AgentState*, std::vector<int>>> assignment;
+  int need = alloc.slots;
+
+  if (need == 0) {
+    // Zero-slot aux task: any alive agent.
+    assignment.push_back({cands[0].agent, {}});
+  } else {
+    // Single-host fit first: best-fit (fitting_methods.go:41) with a
+    // topology preference for a contiguous chip run whose start is aligned
+    // to the sub-slice size — those map onto ICI sub-slices.
+    AgentState* best = nullptr;
+    std::vector<int> best_slots;
+    int best_score = -1;
+    for (auto& c : cands) {
+      if (static_cast<int>(c.free_slots.size()) < need) continue;
+      // Find the best contiguous aligned run of `need` slots.
+      std::vector<int> pick;
+      for (size_t i = 0; i + need <= c.free_slots.size() && pick.empty(); ++i) {
+        if (c.free_slots[i] % need != 0) continue;
+        bool contiguous = true;
+        for (int k = 1; k < need; ++k) {
+          contiguous &= c.free_slots[i + k] == c.free_slots[i] + k;
+        }
+        if (contiguous) {
+          pick.assign(c.free_slots.begin() + i, c.free_slots.begin() + i + need);
+        }
+      }
+      int score = 0;  // higher is better
+      if (!pick.empty()) score += 1000;  // aligned contiguous sub-slice
+      if (pick.empty()) {
+        pick.assign(c.free_slots.begin(), c.free_slots.begin() + need);
+      }
+      // Best-fit: prefer the agent with the least leftover.
+      score += 500 - static_cast<int>(c.free_slots.size() - pick.size());
+      if (score > best_score) {
+        best_score = score;
+        best = c.agent;
+        best_slots = pick;
+      }
+    }
+    if (best != nullptr) {
+      assignment.push_back({best, best_slots});
+    } else {
+      // Multi-host: whole free hosts only (an ICI mesh spans complete
+      // hosts; fractional hosts can't join the slice).
+      std::vector<Candidate*> whole;
+      for (auto& c : cands) {
+        if (!c.agent->slots.empty() &&
+            c.free_slots.size() == c.agent->slots.size()) {
+          whole.push_back(&c);
+        }
+      }
+      int got = 0;
+      for (auto* c : whole) {
+        if (got >= need) break;
+        got += static_cast<int>(c->free_slots.size());
+      }
+      if (got < need || whole.empty()) return false;
+      int per_host = static_cast<int>(whole[0]->free_slots.size());
+      if (per_host == 0 || need % per_host != 0) return false;
+      int hosts = need / per_host;
+      for (int h = 0; h < hosts; ++h) {
+        assignment.push_back({whole[h]->agent, whole[h]->free_slots});
+      }
+    }
+  }
+
+  // Commit the assignment: mark slots, build resources, enqueue start
+  // actions (reference agentrm/agent.go:164 AllocateFreeDevices +
+  // agent.go:202 StartTaskContainer).
+  alloc.resources.clear();
+  int num_nodes = static_cast<int>(assignment.size());
+  std::string chief_addr =
+      assignment.empty() ? "" : assignment[0].first->addr;
+  ExperimentState* exp = find_experiment_locked(alloc.experiment_id);
+  TrialState* trial = nullptr;
+  if (exp != nullptr) {
+    auto tit = exp->trials.find(alloc.request_id);
+    if (tit != exp->trials.end()) trial = &tit->second;
+  }
+
+  for (int rank = 0; rank < num_nodes; ++rank) {
+    AgentState* agent = assignment[rank].first;
+    const std::vector<int>& slot_ids = assignment[rank].second;
+    AllocResource res;
+    res.agent_id = agent->id;
+    res.slot_ids = slot_ids;
+    res.container_id = alloc.id + "." + std::to_string(rank);
+    alloc.resources.push_back(res);
+    for (auto& s : agent->slots) {
+      for (int sid : slot_ids) {
+        if (s.id == sid) s.allocation_id = alloc.id;
+      }
+    }
+
+    Json env = Json::object();
+    env["DET_MASTER"] = "http://" +
+                        (cfg_.host == "0.0.0.0" ? "127.0.0.1" : cfg_.host) +
+                        ":" + std::to_string(server_.port());
+    env["DET_CLUSTER_ID"] = cfg_.cluster_id;
+    env["DET_AGENT_ID"] = agent->id;
+    env["DET_TASK_ID"] = alloc.task_id;
+    env["DET_TASK_TYPE"] = trial != nullptr ? "TRIAL" : "GENERIC";
+    env["DET_ALLOCATION_ID"] = alloc.id;
+    env["DET_RESOURCES_ID"] = res.container_id;
+    env["DET_CONTAINER_ID"] = res.container_id;
+    env["DET_NODE_RANK"] = static_cast<int64_t>(rank);
+    env["DET_NUM_NODES"] = static_cast<int64_t>(num_nodes);
+    env["DET_CHIEF_IP"] = chief_addr;
+    Json sids = Json::array();
+    for (int sid : slot_ids) sids.push_back(Json(static_cast<int64_t>(sid)));
+    env["DET_SLOT_IDS"] = sids.dump();
+    if (exp != nullptr) {
+      // Experiment-config environment variables (expconf environment
+      // block): either {"K": "V", ...} or
+      // {"environment_variables": ["K=V", ...]}.
+      const Json& env_cfg = exp->config["environment"];
+      for (const auto& [k, v] : env_cfg.as_object()) {
+        if (k == "environment_variables") continue;
+        if (v.is_string()) env[k] = v;
+      }
+      for (const auto& kv : env_cfg["environment_variables"].as_array()) {
+        const std::string& s = kv.as_string();
+        auto eq = s.find('=');
+        if (eq != std::string::npos) {
+          env[s.substr(0, eq)] = s.substr(eq + 1);
+        }
+      }
+    }
+    if (exp != nullptr && trial != nullptr) {
+      env["DET_EXPERIMENT_ID"] = exp->id;
+      env["DET_EXPERIMENT_CONFIG"] = exp->config.dump();
+      env["DET_TRIAL_ID"] = trial->id;
+      env["DET_TRIAL_REQUEST_ID"] = trial->request_id;
+      env["DET_TRIAL_RUN_ID"] = trial->run_id;
+      env["DET_TRIAL_SEED"] = trial->seed;
+      env["DET_HPARAMS"] = trial->hparams.dump();
+      env["DET_STEPS_COMPLETED"] = trial->steps_completed;
+      if (!trial->latest_checkpoint.empty()) {
+        env["DET_LATEST_CHECKPOINT"] = trial->latest_checkpoint;
+      }
+    }
+    // Pre-issued session token (reference: containers get
+    // DET_SESSION_TOKEN, tasks/task.go:194-234).
+    std::string token = random_hex(24);
+    db_.exec(
+        "INSERT INTO user_sessions (user_id, token, expires_at) "
+        "VALUES (1, ?, datetime('now', '+7 days'))",
+        {Json(token)});
+    env["DET_SESSION_TOKEN"] = token;
+
+    Json action = Json::object();
+    action["type"] = "start";
+    action["allocation_id"] = alloc.id;
+    action["container_id"] = res.container_id;
+    action["env"] = env;
+    agent->actions.push_back(action);
+  }
+
+  alloc.state = "ASSIGNED";
+  alloc.preempting = false;
+  if (trial != nullptr) trial->allocation_id = alloc.id;
+  Json sids = Json::array();
+  db_.exec(
+      "UPDATE allocations SET state='ASSIGNED', agent_id=?, slot_ids=? "
+      "WHERE id=?",
+      {Json(assignment.empty() ? "" : assignment[0].first->id),
+       Json(sids.dump()), Json(alloc.id)});
+  cv_.notify_all();
+  return true;
+}
+
+void Master::release_resources_locked(Allocation& alloc) {
+  for (const auto& res : alloc.resources) {
+    auto it = agents_.find(res.agent_id);
+    if (it == agents_.end()) continue;
+    for (auto& s : it->second.slots) {
+      if (s.allocation_id == alloc.id) s.allocation_id.clear();
+    }
+  }
+}
+
+void Master::preempt_allocation_locked(Allocation& alloc,
+                                       const std::string& why) {
+  if (alloc.preempting) return;
+  alloc.preempting = true;
+  alloc.exit_reason = why;
+  cv_.notify_all();  // wakes the preemption long-poll watchers
+}
+
+void Master::kill_allocation_locked(Allocation& alloc) {
+  alloc.killed = true;
+  for (const auto& res : alloc.resources) {
+    auto it = agents_.find(res.agent_id);
+    if (it == agents_.end()) continue;
+    Json action = Json::object();
+    action["type"] = "kill";
+    action["allocation_id"] = alloc.id;
+    action["container_id"] = res.container_id;
+    it->second.actions.push_back(action);
+  }
+  cv_.notify_all();
+}
+
+}  // namespace det

@@ -1,0 +1,778 @@
+// master_api.cc — REST handlers for experiments, trials, allocations,
+// checkpoints, task logs and task context.
+//
+// Implements the minimal surface a trial container actually uses
+// (SURVEY.md Appendix A; reference handlers master/internal/api_trials.go,
+// api_experiment.go, api_tasks.go) plus the experiment-management calls the
+// CLI/SDK need.
+
+#include <algorithm>
+#include <chrono>
+
+#include "master.h"
+
+namespace det {
+
+namespace {
+
+Json err_body(const std::string& msg) {
+  Json j = Json::object();
+  j["error"] = msg;
+  return j;
+}
+
+HttpResponse json_resp(int status, const Json& j) {
+  return HttpResponse::json(status, j.dump());
+}
+
+int64_t to_id(const std::string& s) {
+  try {
+    return std::stoll(s);
+  } catch (...) {
+    return -1;
+  }
+}
+
+bool is_terminal(const std::string& state) {
+  return state == "COMPLETED" || state == "CANCELED" || state == "ERROR" ||
+         state == "DELETED";
+}
+
+Json row_to_json(const Row& row) { return Json(JsonObject(row.begin(), row.end())); }
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// /api/v1/experiments
+// ---------------------------------------------------------------------------
+
+HttpResponse Master::handle_experiments(const HttpRequest& req,
+                                        const std::vector<std::string>& parts) {
+  // POST /api/v1/experiments — CreateExperiment (api_experiment.go:1627).
+  if (parts.size() == 1 && req.method == "POST") {
+    Json body = Json::parse(req.body);
+    std::lock_guard<std::mutex> lock(mu_);
+    int64_t uid = auth_user_locked(req);
+    if (uid < 0) return json_resp(401, err_body("unauthenticated"));
+    int64_t eid = create_experiment_locked(
+        body["config"], body["model_definition"].as_string(), uid,
+        body["project_id"].as_int(1), body["activate"].as_bool(true));
+    Json out = Json::object();
+    out["experiment"] = Json(JsonObject{
+        {"id", Json(eid)}, {"state", Json(experiments_[eid].state)}});
+    out["id"] = eid;
+    return json_resp(200, out);
+  }
+
+  // GET /api/v1/experiments — list.
+  if (parts.size() == 1 && req.method == "GET") {
+    std::string where = "WHERE archived=0";
+    std::vector<Json> params;
+    if (!req.query_param("project_id").empty()) {
+      where += " AND project_id=?";
+      params.push_back(Json(to_id(req.query_param("project_id"))));
+    }
+    if (req.query_param("archived") == "true") where = "WHERE 1=1";
+    auto rows = db_.query(
+        "SELECT id, state, config, progress, project_id, archived, "
+        "start_time, end_time FROM experiments " + where +
+            " ORDER BY id DESC LIMIT " +
+            std::to_string(to_id(req.query_param("limit", "200"))),
+        params);
+    Json exps = Json::array();
+    for (auto& row : rows) {
+      Json e = row_to_json(row);
+      Json cfg = Json::parse_or_null(e["config"].as_string());
+      e["name"] = cfg["name"];
+      e["config"] = cfg;
+      exps.push_back(std::move(e));
+    }
+    Json out = Json::object();
+    out["experiments"] = exps;
+    return json_resp(200, out);
+  }
+
+  if (parts.size() < 2) return json_resp(404, err_body("not found"));
+  int64_t eid = to_id(parts[1]);
+
+  // GET /api/v1/experiments/{id}
+  if (parts.size() == 2 && req.method == "GET") {
+    auto rows = db_.query(
+        "SELECT id, state, config, progress, project_id, archived, notes, "
+        "start_time, end_time, job_id FROM experiments WHERE id=?",
+        {Json(eid)});
+    if (rows.empty()) return json_resp(404, err_body("no such experiment"));
+    Json e = row_to_json(rows[0]);
+    e["config"] = Json::parse_or_null(e["config"].as_string());
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      ExperimentState* exp = find_experiment_locked(eid);
+      if (exp != nullptr) {
+        e["state"] = exp->state;
+        e["progress"] = exp->searcher->progress();
+      }
+    }
+    Json out = Json::object();
+    out["experiment"] = std::move(e);
+    return json_resp(200, out);
+  }
+
+  // DELETE /api/v1/experiments/{id}
+  if (parts.size() == 2 && req.method == "DELETE") {
+    std::lock_guard<std::mutex> lock(mu_);
+    ExperimentState* exp = find_experiment_locked(eid);
+    if (exp != nullptr && !is_terminal(exp->state)) {
+      return json_resp(400, err_body("experiment still active"));
+    }
+    db_.exec("UPDATE experiments SET state='DELETED', archived=1 WHERE id=?",
+             {Json(eid)});
+    experiments_.erase(eid);
+    return json_resp(200, Json::object());
+  }
+
+  // GET /api/v1/experiments/{id}/trials
+  if (parts.size() == 3 && parts[2] == "trials" && req.method == "GET") {
+    auto rows = db_.query(
+        "SELECT id, request_id, state, hparams, restarts, run_id, "
+        "total_batches, searcher_metric_value, latest_checkpoint, "
+        "summary_metrics, start_time, end_time FROM trials "
+        "WHERE experiment_id=? ORDER BY id",
+        {Json(eid)});
+    Json trials = Json::array();
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      ExperimentState* exp = find_experiment_locked(eid);
+      for (auto& row : rows) {
+        Json t = row_to_json(row);
+        t["experiment_id"] = eid;
+        t["hparams"] = Json::parse_or_null(t["hparams"].as_string());
+        t["summary_metrics"] =
+            Json::parse_or_null(t["summary_metrics"].as_string());
+        if (exp != nullptr) {
+          for (const auto& [rid, trial] : exp->trials) {
+            if (trial.id == row["id"].as_int()) t["state"] = trial.state;
+          }
+        }
+        trials.push_back(std::move(t));
+      }
+    }
+    Json out = Json::object();
+    out["trials"] = trials;
+    return json_resp(200, out);
+  }
+
+  // GET /api/v1/experiments/{id}/checkpoints
+  if (parts.size() == 3 && parts[2] == "checkpoints" && req.method == "GET") {
+    auto rows = db_.query(
+        "SELECT c.uuid, c.trial_id, c.state, c.report_time, c.resources, "
+        "c.metadata, c.steps_completed FROM checkpoints c JOIN trials t ON "
+        "c.trial_id = t.id WHERE t.experiment_id=? ORDER BY c.report_time",
+        {Json(eid)});
+    Json cps = Json::array();
+    for (auto& row : rows) {
+      Json c = row_to_json(row);
+      c["resources"] = Json::parse_or_null(c["resources"].as_string());
+      c["metadata"] = Json::parse_or_null(c["metadata"].as_string());
+      cps.push_back(std::move(c));
+    }
+    Json out = Json::object();
+    out["checkpoints"] = cps;
+    return json_resp(200, out);
+  }
+
+  // GET /api/v1/experiments/{id}/model_def
+  if (parts.size() == 3 && parts[2] == "model_def" && req.method == "GET") {
+    auto rows = db_.query("SELECT model_def FROM experiments WHERE id=?",
+                          {Json(eid)});
+    if (rows.empty()) return json_resp(404, err_body("no such experiment"));
+    Json out = Json::object();
+    out["b64_tgz"] = rows[0]["model_def"];
+    return json_resp(200, out);
+  }
+
+  // POST /api/v1/experiments/{id}/{activate|pause|cancel|kill|archive|
+  // unarchive}
+  if (parts.size() == 3 && req.method == "POST") {
+    const std::string& verb = parts[2];
+    if (verb == "archive" || verb == "unarchive") {
+      db_.exec("UPDATE experiments SET archived=? WHERE id=?",
+               {Json(verb == "archive" ? 1 : 0), Json(eid)});
+      return json_resp(200, Json::object());
+    }
+    std::lock_guard<std::mutex> lock(mu_);
+    ExperimentState* exp = find_experiment_locked(eid);
+    if (exp == nullptr) return json_resp(404, err_body("no such experiment"));
+    if (verb == "activate") {
+      activate_experiment_locked(*exp);
+      return json_resp(200, Json::object());
+    }
+    if (verb == "pause") {
+      if (exp->state == "ACTIVE") {
+        set_experiment_state_locked(*exp, "PAUSED");
+        for (auto& [rid, trial] : exp->trials) {
+          if (!trial.allocation_id.empty()) {
+            auto ait = allocations_.find(trial.allocation_id);
+            if (ait != allocations_.end()) {
+              if (ait->second.state == "PENDING") {
+                ait->second.state = "TERMINATED";
+                release_resources_locked(ait->second);
+                trial.allocation_id.clear();
+              } else {
+                preempt_allocation_locked(ait->second, "experiment paused");
+              }
+            }
+          }
+        }
+      }
+      return json_resp(200, Json::object());
+    }
+    if (verb == "cancel" || verb == "kill") {
+      if (is_terminal(exp->state)) return json_resp(200, Json::object());
+      set_experiment_state_locked(
+          *exp, verb == "cancel" ? "STOPPING_CANCELED" : "STOPPING_KILLED");
+      for (auto& [rid, trial] : exp->trials) {
+        if (trial.allocation_id.empty()) continue;
+        auto ait = allocations_.find(trial.allocation_id);
+        if (ait == allocations_.end()) continue;
+        if (ait->second.state == "PENDING") {
+          ait->second.state = "TERMINATED";
+          trial.allocation_id.clear();
+        } else if (verb == "cancel") {
+          preempt_allocation_locked(ait->second, "experiment canceled");
+        } else {
+          kill_allocation_locked(ait->second);
+        }
+      }
+      maybe_complete_experiment_locked(*exp);
+      return json_resp(200, Json::object());
+    }
+    return json_resp(404, err_body("unknown verb " + verb));
+  }
+
+  return json_resp(404, err_body("not found"));
+}
+
+// ---------------------------------------------------------------------------
+// /api/v1/trials
+// ---------------------------------------------------------------------------
+
+HttpResponse Master::handle_trials(const HttpRequest& req,
+                                   const std::vector<std::string>& parts) {
+  if (parts.size() < 2) return json_resp(404, err_body("not found"));
+  int64_t tid = to_id(parts[1]);
+
+  // GET /api/v1/trials/{id}
+  if (parts.size() == 2 && req.method == "GET") {
+    auto rows = db_.query(
+        "SELECT id, experiment_id, request_id, state, hparams, restarts, "
+        "run_id, total_batches, latest_checkpoint, summary_metrics, "
+        "searcher_metric_value, start_time, end_time FROM trials WHERE id=?",
+        {Json(tid)});
+    if (rows.empty()) return json_resp(404, err_body("no such trial"));
+    Json t = row_to_json(rows[0]);
+    t["hparams"] = Json::parse_or_null(t["hparams"].as_string());
+    t["summary_metrics"] = Json::parse_or_null(t["summary_metrics"].as_string());
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      ExperimentState* exp = nullptr;
+      TrialState* trial = find_trial_locked(tid, &exp);
+      if (trial != nullptr) t["state"] = trial->state;
+    }
+    Json out = Json::object();
+    out["trial"] = std::move(t);
+    return json_resp(200, out);
+  }
+
+  // GET /api/v1/trials/{id}/progress (core/_searcher.py:88).
+  if (parts.size() == 3 && parts[2] == "progress") {
+    std::lock_guard<std::mutex> lock(mu_);
+    ExperimentState* exp = nullptr;
+    TrialState* trial = find_trial_locked(tid, &exp);
+    Json out = Json::object();
+    out["progress"] = exp != nullptr ? exp->searcher->progress() : 0.0;
+    return json_resp(200, out);
+  }
+
+  // Searcher op long-poll (core/_searcher.py:199 ← api_trials.go ops).
+  // GET /api/v1/trials/{id}/searcher/operation
+  // → {"op": {"length": N}} | {"done": true} | {} (no op yet; re-poll)
+  if (parts.size() == 4 && parts[2] == "searcher" &&
+      parts[3] == "operation" && req.method == "GET") {
+    double timeout =
+        std::stod(req.query_param("timeout_seconds", "30"));
+    std::unique_lock<std::mutex> lock(mu_);
+    auto deadline = Clock::now() + std::chrono::milliseconds(
+                                       static_cast<int>(timeout * 1000));
+    while (true) {
+      ExperimentState* exp = nullptr;
+      TrialState* trial = find_trial_locked(tid, &exp);
+      if (trial == nullptr) return json_resp(404, err_body("no such trial"));
+      Json out = Json::object();
+      if (trial->close_requested || is_terminal(trial->state) ||
+          exp->searcher_shutdown || is_terminal(exp->state) ||
+          exp->state == "STOPPING_CANCELED" ||
+          exp->state == "STOPPING_KILLED") {
+        out["done"] = true;
+        return json_resp(200, out);
+      }
+      if (!trial->pending_ops.empty()) {
+        Json op = Json::object();
+        op["length"] = trial->pending_ops.front();
+        out["op"] = std::move(op);
+        return json_resp(200, out);
+      }
+      if (cv_.wait_until(lock, deadline) == std::cv_status::timeout) {
+        return json_resp(200, out);  // no op yet; harness re-polls
+      }
+    }
+  }
+
+  // POST /api/v1/trials/{id}/searcher/completed_operation
+  //   {length, searcher_metric}
+  // (api_trials.go:1299 → experiment.go:321 TrialCompleteOperation).
+  if (parts.size() == 4 && parts[2] == "searcher" &&
+      parts[3] == "completed_operation" && req.method == "POST") {
+    Json body = Json::parse(req.body);
+    std::lock_guard<std::mutex> lock(mu_);
+    ExperimentState* exp = nullptr;
+    TrialState* trial = find_trial_locked(tid, &exp);
+    if (trial == nullptr) return json_resp(404, err_body("no such trial"));
+    int64_t length = body["length"].as_int(
+        body["op"]["validate_after"]["length"].as_int());
+    double metric = body["searcher_metric"].as_double();
+    if (!trial->pending_ops.empty() &&
+        trial->pending_ops.front() == length) {
+      trial->pending_ops.pop_front();
+    }
+    trial->steps_completed = std::max(trial->steps_completed, length);
+    db_.exec(
+        "UPDATE trials SET searcher_metric_value=?, total_batches=? WHERE id=?",
+        {Json(metric), Json(trial->steps_completed), Json(tid)});
+    exp->searcher->record_units(trial->request_id, length);
+    process_ops_locked(
+        *exp, exp->searcher->validation_completed(trial->request_id, metric,
+                                                  length));
+    db_.exec("UPDATE experiments SET progress=? WHERE id=?",
+             {Json(exp->searcher->progress()), Json(exp->id)});
+    return json_resp(200, Json::object());
+  }
+
+  // POST /api/v1/trials/{id}/metrics — ReportTrialMetrics
+  // (api_trials.go:1381 → db/postgres_trial_metrics.go).
+  if (parts.size() == 3 && parts[2] == "metrics" && req.method == "POST") {
+    Json body = Json::parse(req.body);
+    const std::string& group = body["group"].as_string("training");
+    int64_t batches = body["steps_completed"].as_int();
+    db_.exec(
+        "INSERT INTO raw_metrics (trial_id, trial_run_id, group_name, "
+        "total_batches, metrics) VALUES (?, ?, ?, ?, ?)",
+        {Json(tid), body["trial_run_id"], Json(group), Json(batches),
+         Json(body["metrics"].dump())});
+    db_.exec(
+        "UPDATE trials SET total_batches=MAX(total_batches, ?), "
+        "last_activity=datetime('now') WHERE id=?",
+        {Json(batches), Json(tid)});
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      ExperimentState* exp = nullptr;
+      TrialState* trial = find_trial_locked(tid, &exp);
+      if (trial != nullptr) {
+        trial->steps_completed = std::max(trial->steps_completed, batches);
+      }
+      cv_.notify_all();  // wake log/metric followers
+    }
+    return json_resp(200, Json::object());
+  }
+
+  // GET /api/v1/trials/{id}/metrics?group=
+  if (parts.size() == 3 && parts[2] == "metrics" && req.method == "GET") {
+    std::string group = req.query_param("group", "");
+    std::string sql =
+        "SELECT id, trial_run_id, group_name, total_batches, metrics, "
+        "end_time FROM raw_metrics WHERE trial_id=?";
+    std::vector<Json> params{Json(tid)};
+    if (!group.empty()) {
+      sql += " AND group_name=?";
+      params.push_back(Json(group));
+    }
+    sql += " ORDER BY total_batches, id";
+    Json metrics = Json::array();
+    for (auto& row : db_.query(sql, params)) {
+      Json m = row_to_json(row);
+      m["metrics"] = Json::parse_or_null(m["metrics"].as_string());
+      metrics.push_back(std::move(m));
+    }
+    Json out = Json::object();
+    out["metrics"] = metrics;
+    return json_resp(200, out);
+  }
+
+  // POST /api/v1/trials/{id}/run_prepare — RunPrepareForReporting
+  // analogue (core/_context.py:300); registers the trial for reporting.
+  if (parts.size() == 3 && parts[2] == "run_prepare" && req.method == "POST") {
+    return json_resp(200, Json::object());
+  }
+
+  // POST /api/v1/trials/{id}/progress — chief-reported progress.
+  if (parts.size() == 3 && parts[2] == "progress" && req.method == "POST") {
+    Json body = Json::parse_or_null(req.body);
+    std::lock_guard<std::mutex> lock(mu_);
+    ExperimentState* exp = nullptr;
+    TrialState* trial = find_trial_locked(tid, &exp);
+    if (exp != nullptr) {
+      db_.exec("UPDATE experiments SET progress=? WHERE id=?",
+               {Json(exp->searcher->progress()), Json(exp->id)});
+    }
+    (void)body;
+    return json_resp(200, Json::object());
+  }
+
+  // POST /api/v1/trials/{id}/runner/metadata — heartbeat
+  // (core/_heartbeat.py → api "runner metadata").
+  if (parts.size() == 4 && parts[2] == "runner" && parts[3] == "metadata") {
+    Json body = Json::parse_or_null(req.body);
+    db_.exec(
+        "UPDATE trials SET runner_state=?, last_activity=datetime('now') "
+        "WHERE id=?",
+        {body["state"], Json(tid)});
+    return json_resp(200, Json::object());
+  }
+
+  // GET /api/v1/trials/{id}/logs → task log alias.
+  if (parts.size() == 3 && parts[2] == "logs" && req.method == "GET") {
+    HttpRequest alias = req;
+    alias.path = "/api/v1/tasks/trial-" + std::to_string(tid) + "/logs";
+    return handle_tasks(alias, {"tasks", "trial-" + std::to_string(tid),
+                                "logs"});
+  }
+
+  return json_resp(404, err_body("not found"));
+}
+
+// ---------------------------------------------------------------------------
+// /api/v1/allocations — preemption signals, rendezvous, allgather, proxies
+// (reference api_trials.go:1179,1495; task/rendezvous.go:94;
+// task/allgather/; core/_preempt.py long-poll contract).
+// ---------------------------------------------------------------------------
+
+HttpResponse Master::handle_allocations(const HttpRequest& req,
+                                        const std::vector<std::string>& parts) {
+  if (parts.size() < 2) return json_resp(404, err_body("not found"));
+  const std::string& aid = parts[1];
+
+  // GET /api/v1/allocations/{id}/signals/preemption?timeout_seconds=60
+  if (parts.size() == 4 && parts[2] == "signals" &&
+      parts[3] == "preemption" && req.method == "GET") {
+    double timeout = std::stod(req.query_param("timeout_seconds", "60"));
+    std::unique_lock<std::mutex> lock(mu_);
+    auto deadline = Clock::now() + std::chrono::milliseconds(
+                                       static_cast<int>(timeout * 1000));
+    cv_.wait_until(lock, deadline, [&] {
+      auto it = allocations_.find(aid);
+      return !running_ || it == allocations_.end() || it->second.preempting ||
+             it->second.state == "TERMINATED";
+    });
+    auto it = allocations_.find(aid);
+    Json out = Json::object();
+    out["preempt"] = it == allocations_.end() || it->second.preempting ||
+                     it->second.state == "TERMINATED";
+    return json_resp(200, out);
+  }
+
+  // POST /api/v1/allocations/{id}/signals/ack_preemption
+  if (parts.size() == 4 && parts[2] == "signals" &&
+      parts[3] == "ack_preemption") {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = allocations_.find(aid);
+    if (it != allocations_.end()) it->second.exit_reason = "preempted (acked)";
+    return json_resp(200, Json::object());
+  }
+
+  // GET /api/v1/allocations/{id}/rendezvous — blocks until every host's
+  // task process is up, then returns ranked addresses
+  // (task/rendezvous.go:94 try(); exec/prep_container.py:49).
+  if (parts.size() == 3 && parts[2] == "rendezvous" && req.method == "GET") {
+    double timeout = std::stod(req.query_param("timeout_seconds", "600"));
+    std::unique_lock<std::mutex> lock(mu_);
+    auto deadline = Clock::now() + std::chrono::milliseconds(
+                                       static_cast<int>(timeout * 1000));
+    bool ok = cv_.wait_until(lock, deadline, [&] {
+      auto it = allocations_.find(aid);
+      return !running_ || it == allocations_.end() ||
+             it->second.state == "RUNNING" ||
+             it->second.state == "TERMINATED";
+    });
+    auto it = allocations_.find(aid);
+    if (!ok || it == allocations_.end() || it->second.state != "RUNNING") {
+      return json_resp(408, err_body("rendezvous timeout"));
+    }
+    Json addrs = Json::array();
+    Json slot_counts = Json::array();
+    for (const auto& r : it->second.resources) {
+      auto agent_it = agents_.find(r.agent_id);
+      std::string host =
+          agent_it != agents_.end() ? agent_it->second.addr : r.agent_id;
+      addrs.push_back(Json(!r.daemon_addr.empty() ? r.daemon_addr : host));
+      slot_counts.push_back(Json(static_cast<int64_t>(r.slot_ids.size())));
+    }
+    Json out = Json::object();
+    out["addresses"] = addrs;
+    out["slots_per_node"] = slot_counts;
+    return json_resp(200, out);
+  }
+
+  // POST /api/v1/allocations/{id}/all_gather
+  //   {rank, num_peers, round, data} — REST-level barrier/allgather used
+  //   before the in-mesh collectives exist (api_tasks.go:245).
+  if (parts.size() == 3 && parts[2] == "all_gather" && req.method == "POST") {
+    Json body = Json::parse(req.body);
+    int64_t rank = body["rank"].as_int();
+    int64_t num_peers = body["num_peers"].as_int(1);
+    int64_t round = body["round"].as_int(0);
+    double timeout = std::stod(req.query_param("timeout_seconds", "120"));
+    std::unique_lock<std::mutex> lock(mu_);
+    auto it = allocations_.find(aid);
+    if (it == allocations_.end()) {
+      return json_resp(404, err_body("unknown allocation"));
+    }
+    // Store under a per-round key (rank → payload).
+    it->second.allgather[round * 100000 + rank] = body["data"];
+    cv_.notify_all();
+    auto deadline = Clock::now() + std::chrono::milliseconds(
+                                       static_cast<int>(timeout * 1000));
+    bool ok = cv_.wait_until(lock, deadline, [&] {
+      auto it2 = allocations_.find(aid);
+      if (it2 == allocations_.end()) return true;
+      int64_t have = 0;
+      for (const auto& [k, v] : it2->second.allgather) {
+        if (k / 100000 == round) ++have;
+      }
+      return !running_ || have >= num_peers;
+    });
+    if (!ok) return json_resp(408, err_body("all_gather timeout"));
+    it = allocations_.find(aid);
+    if (it == allocations_.end()) {
+      return json_resp(404, err_body("allocation gone"));
+    }
+    Json data = Json::array();
+    for (int64_t r = 0; r < num_peers; ++r) {
+      data.push_back(it->second.allgather[round * 100000 + r]);
+    }
+    Json out = Json::object();
+    out["data"] = data;
+    return json_resp(200, out);
+  }
+
+  // POST /api/v1/allocations/{id}/proxy_address
+  if (parts.size() == 3 && parts[2] == "proxy_address") {
+    Json body = Json::parse_or_null(req.body);
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = allocations_.find(aid);
+    if (it != allocations_.end()) {
+      it->second.proxy_addresses[body["rank"].as_int()] =
+          body["address"].as_string();
+    }
+    return json_resp(200, Json::object());
+  }
+
+  // POST /api/v1/allocations/{id}/ready — NotifyContainerRunning analogue.
+  if (parts.size() == 3 && parts[2] == "ready") {
+    return json_resp(200, Json::object());
+  }
+
+  // GET /api/v1/allocations/{id} — introspection.
+  if (parts.size() == 2 && req.method == "GET") {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = allocations_.find(aid);
+    if (it == allocations_.end()) {
+      auto rows = db_.query("SELECT * FROM allocations WHERE id=?", {Json(aid)});
+      if (rows.empty()) return json_resp(404, err_body("unknown allocation"));
+      Json out = Json::object();
+      out["allocation"] = row_to_json(rows[0]);
+      return json_resp(200, out);
+    }
+    const Allocation& a = it->second;
+    Json resources = Json::array();
+    for (const auto& r : a.resources) {
+      resources.push_back(Json(JsonObject{
+          {"agent_id", Json(r.agent_id)},
+          {"container_id", Json(r.container_id)},
+          {"state", Json(r.state)},
+          {"exit_code", Json(static_cast<int64_t>(r.exit_code))}}));
+    }
+    Json out = Json::object();
+    out["allocation"] = Json(JsonObject{
+        {"id", Json(a.id)},
+        {"task_id", Json(a.task_id)},
+        {"state", Json(a.state)},
+        {"slots", Json(static_cast<int64_t>(a.slots))},
+        {"preempting", Json(a.preempting)},
+        {"resources", resources}});
+    return json_resp(200, out);
+  }
+
+  return json_resp(404, err_body("not found"));
+}
+
+// ---------------------------------------------------------------------------
+// /api/v1/checkpoints (reference internal/checkpoints/, v2 model).
+// ---------------------------------------------------------------------------
+
+HttpResponse Master::handle_checkpoints(const HttpRequest& req,
+                                        const std::vector<std::string>& parts) {
+  // POST /api/v1/checkpoints — ReportCheckpoint.
+  if (parts.size() == 1 && req.method == "POST") {
+    Json body = Json::parse(req.body);
+    const std::string& uuid = body["uuid"].as_string();
+    if (uuid.empty()) return json_resp(400, err_body("uuid required"));
+    int64_t trial_id = body["trial_id"].as_int(-1);
+    db_.exec(
+        "INSERT OR REPLACE INTO checkpoints (uuid, task_id, allocation_id, "
+        "trial_id, state, resources, metadata, steps_completed) "
+        "VALUES (?, ?, ?, ?, 'COMPLETED', ?, ?, ?)",
+        {Json(uuid), body["task_id"], body["allocation_id"],
+         trial_id >= 0 ? Json(trial_id) : Json(),
+         Json(body["resources"].dump()), Json(body["metadata"].dump()),
+         body["steps_completed"]});
+    if (trial_id >= 0) {
+      db_.exec("UPDATE trials SET latest_checkpoint=? WHERE id=?",
+               {Json(uuid), Json(trial_id)});
+      std::lock_guard<std::mutex> lock(mu_);
+      ExperimentState* exp = nullptr;
+      TrialState* trial = find_trial_locked(trial_id, &exp);
+      if (trial != nullptr) {
+        trial->latest_checkpoint = uuid;
+        snapshot_experiment_locked(*exp);
+      }
+    }
+    return json_resp(200, Json::object());
+  }
+
+  // PATCH /api/v1/checkpoints {checkpoints: [{uuid, state}]} — GC support.
+  if (parts.size() == 1 && req.method == "PATCH") {
+    Json body = Json::parse(req.body);
+    for (const auto& c : body["checkpoints"].as_array()) {
+      db_.exec("UPDATE checkpoints SET state=? WHERE uuid=?",
+               {c["state"], c["uuid"]});
+    }
+    return json_resp(200, Json::object());
+  }
+
+  // GET /api/v1/checkpoints/{uuid}
+  if (parts.size() == 2 && req.method == "GET") {
+    auto rows = db_.query("SELECT * FROM checkpoints WHERE uuid=?",
+                          {Json(parts[1])});
+    if (rows.empty()) return json_resp(404, err_body("no such checkpoint"));
+    Json c = row_to_json(rows[0]);
+    c["resources"] = Json::parse_or_null(c["resources"].as_string());
+    c["metadata"] = Json::parse_or_null(c["metadata"].as_string());
+    // Attach experiment config so Checkpoint.download can find storage.
+    if (c["trial_id"].is_int()) {
+      auto exp_rows = db_.query(
+          "SELECT e.id, e.config FROM experiments e JOIN trials t ON "
+          "t.experiment_id = e.id WHERE t.id=?",
+          {c["trial_id"]});
+      if (!exp_rows.empty()) {
+        c["experiment_id"] = exp_rows[0]["id"];
+        c["experiment_config"] =
+            Json::parse_or_null(exp_rows[0]["config"].as_string());
+      }
+    }
+    Json out = Json::object();
+    out["checkpoint"] = std::move(c);
+    return json_resp(200, out);
+  }
+
+  return json_resp(404, err_body("not found"));
+}
+
+// ---------------------------------------------------------------------------
+// Task logs + task context (reference ship_logs.py → POST /task/logs;
+// GetTaskContextDirectory).
+// ---------------------------------------------------------------------------
+
+HttpResponse Master::handle_task_logs(const HttpRequest& req) {
+  // POST /api/v1/task/logs — batched shipping.
+  if (req.method == "POST") {
+    Json body = Json::parse(req.body);
+    const JsonArray& logs =
+        body.is_array() ? body.as_array() : body["logs"].as_array();
+    db_.tx([&] {
+      for (const auto& entry : logs) {
+        db_.exec(
+            "INSERT INTO task_logs (task_id, allocation_id, agent_id, "
+            "container_id, rank_id, level, stdtype, source, log, timestamp) "
+            "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, COALESCE(?, "
+            "datetime('now')))",
+            {entry["task_id"], entry["allocation_id"], entry["agent_id"],
+             entry["container_id"], entry["rank_id"], entry["level"],
+             entry["stdtype"], entry["source"], entry["log"],
+             entry["timestamp"]});
+      }
+    });
+    cv_.notify_all();
+    return json_resp(200, Json::object());
+  }
+  return json_resp(404, err_body("not found"));
+}
+
+HttpResponse Master::handle_tasks(const HttpRequest& req,
+                                  const std::vector<std::string>& parts) {
+  if (parts.size() < 2) return json_resp(404, err_body("not found"));
+  const std::string& task_id = parts[1];
+
+  // GET /api/v1/tasks/{id}/context — model-def tarball (base64)
+  // (GetTaskContextDirectory; harness/determined/exec/prep_container.py).
+  if (parts.size() == 3 && parts[2] == "context") {
+    std::string sql =
+        "SELECT e.model_def FROM experiments e JOIN trials t ON "
+        "t.experiment_id = e.id WHERE t.id=?";
+    int64_t trial_id = -1;
+    if (task_id.rfind("trial-", 0) == 0) {
+      trial_id = to_id(task_id.substr(6));
+    }
+    auto rows = db_.query(sql, {Json(trial_id)});
+    Json out = Json::object();
+    out["b64_tgz"] = rows.empty() ? Json("") : rows[0]["model_def"];
+    return json_resp(200, out);
+  }
+
+  // GET /api/v1/tasks/{id}/logs?offset=&follow=&timeout_seconds=
+  if (parts.size() == 3 && parts[2] == "logs" && req.method == "GET") {
+    int64_t offset = to_id(req.query_param("offset", "0"));
+    bool follow = req.query_param("follow") == "true";
+    double timeout = std::stod(req.query_param("timeout_seconds", "30"));
+    auto fetch = [&] {
+      return db_.query(
+          "SELECT id, rank_id, level, stdtype, log, timestamp FROM task_logs "
+          "WHERE task_id=? AND id>? ORDER BY id LIMIT 1000",
+          {Json(task_id), Json(offset)});
+    };
+    auto rows = fetch();
+    if (rows.empty() && follow) {
+      std::unique_lock<std::mutex> lock(mu_);
+      cv_.wait_for(lock, std::chrono::milliseconds(
+                             static_cast<int>(timeout * 1000)));
+      lock.unlock();
+      rows = fetch();
+    }
+    Json logs = Json::array();
+    for (auto& row : rows) logs.push_back(row_to_json(row));
+    Json out = Json::object();
+    out["logs"] = logs;
+    return json_resp(200, out);
+  }
+
+  // GET /api/v1/tasks/{id}
+  if (parts.size() == 2 && req.method == "GET") {
+    auto rows = db_.query("SELECT * FROM tasks WHERE id=?", {Json(task_id)});
+    if (rows.empty()) return json_resp(404, err_body("no such task"));
+    Json out = Json::object();
+    out["task"] = row_to_json(rows[0]);
+    return json_resp(200, out);
+  }
+
+  return json_resp(404, err_body("not found"));
+}
+
+}  // namespace det

@@ -1,0 +1,314 @@
+// master_breadth.cc — organization + registry surfaces: workspaces,
+// projects, model registry, templates, webhooks, job queue.
+//
+// Reference: master/internal/{workspace,project,model,templates,webhooks}/
+// and job/jobservice. CRUD over the metadata store; authz model is
+// "any authenticated user" (the reference's basic authz class).
+
+#include <algorithm>
+
+#include "master.h"
+
+namespace det {
+
+namespace {
+
+Json err_body(const std::string& msg) {
+  Json j = Json::object();
+  j["error"] = msg;
+  return j;
+}
+
+HttpResponse json_resp(int status, const Json& j) {
+  return HttpResponse::json(status, j.dump());
+}
+
+int64_t to_id(const std::string& s) {
+  try {
+    return std::stoll(s);
+  } catch (...) {
+    return -1;
+  }
+}
+
+Json row_to_json(const Row& row) {
+  return Json(JsonObject(row.begin(), row.end()));
+}
+
+Json rows_to_json(const std::vector<Row>& rows) {
+  Json arr = Json::array();
+  for (const auto& row : rows) arr.push_back(row_to_json(row));
+  return arr;
+}
+
+}  // namespace
+
+HttpResponse Master::handle_workspaces(const HttpRequest& req,
+                                       const std::vector<std::string>& parts) {
+  if (parts.size() == 1 && req.method == "GET") {
+    Json out = Json::object();
+    out["workspaces"] = rows_to_json(db_.query(
+        "SELECT id, name, user_id, archived, created_at FROM workspaces "
+        "ORDER BY id"));
+    return json_resp(200, out);
+  }
+  if (parts.size() == 1 && req.method == "POST") {
+    Json body = Json::parse(req.body);
+    std::lock_guard<std::mutex> lock(mu_);
+    int64_t uid = auth_user_locked(req);
+    if (uid < 0) return json_resp(401, err_body("unauthenticated"));
+    db_.exec("INSERT INTO workspaces (name, user_id) VALUES (?, ?)",
+             {body["name"], Json(uid)});
+    Json out = Json::object();
+    out["workspace"] = Json(JsonObject{{"id", Json(db_.last_insert_id())},
+                                       {"name", body["name"]}});
+    return json_resp(200, out);
+  }
+  if (parts.size() >= 2) {
+    int64_t wid = to_id(parts[1]);
+    if (parts.size() == 3 && parts[2] == "projects" && req.method == "GET") {
+      Json out = Json::object();
+      out["projects"] = rows_to_json(db_.query(
+          "SELECT id, name, description, workspace_id, archived, created_at "
+          "FROM projects WHERE workspace_id=? ORDER BY id",
+          {Json(wid)}));
+      return json_resp(200, out);
+    }
+    if (parts.size() == 2 && req.method == "GET") {
+      auto rows = db_.query("SELECT * FROM workspaces WHERE id=?", {Json(wid)});
+      if (rows.empty()) return json_resp(404, err_body("no such workspace"));
+      Json out = Json::object();
+      out["workspace"] = row_to_json(rows[0]);
+      return json_resp(200, out);
+    }
+    if (parts.size() == 2 && req.method == "DELETE") {
+      db_.exec("UPDATE workspaces SET archived=1 WHERE id=?", {Json(wid)});
+      return json_resp(200, Json::object());
+    }
+  }
+  return json_resp(404, err_body("not found"));
+}
+
+HttpResponse Master::handle_projects(const HttpRequest& req,
+                                     const std::vector<std::string>& parts) {
+  if (parts.size() == 1 && req.method == "POST") {
+    Json body = Json::parse(req.body);
+    std::lock_guard<std::mutex> lock(mu_);
+    int64_t uid = auth_user_locked(req);
+    if (uid < 0) return json_resp(401, err_body("unauthenticated"));
+    db_.exec(
+        "INSERT INTO projects (name, description, workspace_id, user_id) "
+        "VALUES (?, ?, ?, ?)",
+        {body["name"], Json(body["description"].as_string()),
+         Json(body["workspace_id"].as_int(1)), Json(uid)});
+    Json out = Json::object();
+    out["project"] = Json(JsonObject{{"id", Json(db_.last_insert_id())},
+                                     {"name", body["name"]}});
+    return json_resp(200, out);
+  }
+  if (parts.size() == 2 && req.method == "GET") {
+    auto rows =
+        db_.query("SELECT * FROM projects WHERE id=?", {Json(to_id(parts[1]))});
+    if (rows.empty()) return json_resp(404, err_body("no such project"));
+    Json out = Json::object();
+    out["project"] = row_to_json(rows[0]);
+    return json_resp(200, out);
+  }
+  if (parts.size() == 2 && req.method == "DELETE") {
+    db_.exec("UPDATE projects SET archived=1 WHERE id=?",
+             {Json(to_id(parts[1]))});
+    return json_resp(200, Json::object());
+  }
+  return json_resp(404, err_body("not found"));
+}
+
+// Model registry (reference internal/model/; versions reference
+// checkpoints by uuid).
+HttpResponse Master::handle_models(const HttpRequest& req,
+                                   const std::vector<std::string>& parts) {
+  if (parts.size() == 1 && req.method == "GET") {
+    Json models = Json::array();
+    for (auto& row : db_.query("SELECT * FROM models ORDER BY id")) {
+      Json m = row_to_json(row);
+      m["metadata"] = Json::parse_or_null(m["metadata"].as_string());
+      m["labels"] = Json::parse_or_null(m["labels"].as_string());
+      models.push_back(std::move(m));
+    }
+    Json out = Json::object();
+    out["models"] = models;
+    return json_resp(200, out);
+  }
+  if (parts.size() == 1 && req.method == "POST") {
+    Json body = Json::parse(req.body);
+    std::lock_guard<std::mutex> lock(mu_);
+    int64_t uid = auth_user_locked(req);
+    if (uid < 0) return json_resp(401, err_body("unauthenticated"));
+    db_.exec(
+        "INSERT INTO models (name, description, metadata, labels, user_id, "
+        "workspace_id) VALUES (?, ?, ?, ?, ?, ?)",
+        {body["name"], Json(body["description"].as_string()),
+         Json(body["metadata"].dump()), Json(body["labels"].dump()), Json(uid),
+         Json(body["workspace_id"].as_int(1))});
+    Json out = Json::object();
+    out["model"] = Json(JsonObject{{"id", Json(db_.last_insert_id())},
+                                   {"name", body["name"]}});
+    return json_resp(200, out);
+  }
+  if (parts.size() >= 2) {
+    // Address models by name (reference uses name as the natural key).
+    const std::string& name = parts[1];
+    auto mrows =
+        db_.query("SELECT * FROM models WHERE name=?", {Json(name)});
+    if (mrows.empty()) return json_resp(404, err_body("no such model"));
+    int64_t mid = mrows[0]["id"].as_int();
+    if (parts.size() == 2 && req.method == "GET") {
+      Json m = row_to_json(mrows[0]);
+      m["metadata"] = Json::parse_or_null(m["metadata"].as_string());
+      m["labels"] = Json::parse_or_null(m["labels"].as_string());
+      Json out = Json::object();
+      out["model"] = std::move(m);
+      return json_resp(200, out);
+    }
+    if (parts.size() == 3 && parts[2] == "versions") {
+      if (req.method == "GET") {
+        Json out = Json::object();
+        out["model_versions"] = rows_to_json(db_.query(
+            "SELECT * FROM model_versions WHERE model_id=? ORDER BY version",
+            {Json(mid)}));
+        return json_resp(200, out);
+      }
+      if (req.method == "POST") {
+        Json body = Json::parse(req.body);
+        auto vrows = db_.query(
+            "SELECT COALESCE(MAX(version),0)+1 AS v FROM model_versions "
+            "WHERE model_id=?",
+            {Json(mid)});
+        int64_t version = vrows[0]["v"].as_int();
+        db_.exec(
+            "INSERT INTO model_versions (model_id, version, checkpoint_uuid, "
+            "name, comment, metadata) VALUES (?, ?, ?, ?, ?, ?)",
+            {Json(mid), Json(version), body["checkpoint_uuid"],
+             Json(body["name"].as_string()), Json(body["comment"].as_string()),
+             Json(body["metadata"].dump())});
+        db_.exec(
+            "UPDATE models SET last_updated_time=datetime('now') WHERE id=?",
+            {Json(mid)});
+        Json out = Json::object();
+        out["model_version"] = Json(JsonObject{
+            {"id", Json(db_.last_insert_id())}, {"version", Json(version)}});
+        return json_resp(200, out);
+      }
+    }
+    if (parts.size() == 2 && req.method == "DELETE") {
+      db_.exec("UPDATE models SET archived=1 WHERE id=?", {Json(mid)});
+      return json_resp(200, Json::object());
+    }
+  }
+  return json_resp(404, err_body("not found"));
+}
+
+HttpResponse Master::handle_templates(const HttpRequest& req,
+                                      const std::vector<std::string>& parts) {
+  if (parts.size() == 1 && req.method == "GET") {
+    Json tpls = Json::array();
+    for (auto& row : db_.query("SELECT * FROM templates ORDER BY name")) {
+      Json t = row_to_json(row);
+      t["config"] = Json::parse_or_null(t["config"].as_string());
+      tpls.push_back(std::move(t));
+    }
+    Json out = Json::object();
+    out["templates"] = tpls;
+    return json_resp(200, out);
+  }
+  if (parts.size() == 1 && req.method == "POST") {
+    Json body = Json::parse(req.body);
+    db_.exec(
+        "INSERT OR REPLACE INTO templates (name, config, workspace_id) "
+        "VALUES (?, ?, ?)",
+        {body["name"], Json(body["config"].dump()),
+         Json(body["workspace_id"].as_int(1))});
+    return json_resp(200, Json::object());
+  }
+  if (parts.size() == 2 && req.method == "GET") {
+    auto rows =
+        db_.query("SELECT * FROM templates WHERE name=?", {Json(parts[1])});
+    if (rows.empty()) return json_resp(404, err_body("no such template"));
+    Json t = row_to_json(rows[0]);
+    t["config"] = Json::parse_or_null(t["config"].as_string());
+    Json out = Json::object();
+    out["template"] = std::move(t);
+    return json_resp(200, out);
+  }
+  if (parts.size() == 2 && req.method == "DELETE") {
+    db_.exec("DELETE FROM templates WHERE name=?", {Json(parts[1])});
+    return json_resp(200, Json::object());
+  }
+  return json_resp(404, err_body("not found"));
+}
+
+HttpResponse Master::handle_webhooks(const HttpRequest& req,
+                                     const std::vector<std::string>& parts) {
+  if (parts.size() == 1 && req.method == "GET") {
+    Json hooks = Json::array();
+    for (auto& row : db_.query("SELECT * FROM webhooks ORDER BY id")) {
+      Json h = row_to_json(row);
+      h["triggers"] = Json::parse_or_null(h["triggers"].as_string());
+      hooks.push_back(std::move(h));
+    }
+    Json out = Json::object();
+    out["webhooks"] = hooks;
+    return json_resp(200, out);
+  }
+  if (parts.size() == 1 && req.method == "POST") {
+    Json body = Json::parse(req.body);
+    db_.exec(
+        "INSERT INTO webhooks (url, webhook_type, triggers) VALUES (?, ?, ?)",
+        {body["url"], Json(body["webhook_type"].as_string("DEFAULT")),
+         Json(body["triggers"].dump())});
+    Json out = Json::object();
+    out["id"] = db_.last_insert_id();
+    return json_resp(200, out);
+  }
+  if (parts.size() == 2 && req.method == "DELETE") {
+    db_.exec("DELETE FROM webhooks WHERE id=?", {Json(to_id(parts[1]))});
+    return json_resp(200, Json::object());
+  }
+  return json_resp(404, err_body("not found"));
+}
+
+// Job queue introspection (reference job/jobservice/jobservice.go +
+// rm/tasklist/): queued/scheduled jobs per pool with queue positions.
+HttpResponse Master::handle_job_queue(const HttpRequest& req) {
+  std::lock_guard<std::mutex> lock(mu_);
+  Json jobs = Json::array();
+  int64_t pos = 0;
+  for (const auto& aid : pending_) {
+    auto it = allocations_.find(aid);
+    if (it == allocations_.end()) continue;
+    const Allocation& a = it->second;
+    jobs.push_back(Json(JsonObject{
+        {"allocation_id", Json(a.id)},
+        {"experiment_id", Json(a.experiment_id)},
+        {"resource_pool", Json(a.resource_pool)},
+        {"slots", Json(static_cast<int64_t>(a.slots))},
+        {"priority", Json(static_cast<int64_t>(a.priority))},
+        {"state", Json("QUEUED")},
+        {"queue_position", Json(pos++)}}));
+  }
+  for (const auto& [aid, a] : allocations_) {
+    if (a.state == "ASSIGNED" || a.state == "RUNNING") {
+      jobs.push_back(Json(JsonObject{
+          {"allocation_id", Json(a.id)},
+          {"experiment_id", Json(a.experiment_id)},
+          {"resource_pool", Json(a.resource_pool)},
+          {"slots", Json(static_cast<int64_t>(a.slots))},
+          {"priority", Json(static_cast<int64_t>(a.priority))},
+          {"state", Json("SCHEDULED")}}));
+    }
+  }
+  Json out = Json::object();
+  out["jobs"] = jobs;
+  return json_resp(200, out);
+}
+
+}  // namespace det

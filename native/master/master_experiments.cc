@@ -1,0 +1,512 @@
+// master_experiments.cc — experiment + trial state machines and the
+// searcher event loop.
+//
+// Reference: per-experiment goroutine owning searcher state
+// (master/internal/experiment.go:93 newExperiment, :763 processOperations),
+// trial state machine mapping searcher ops to allocations
+// (trial.go:105, restart-on-failure trial.go:617-628), snapshot/restore
+// (restore.go:27-35,60). Here the same machinery runs under the master
+// mutex, driven by REST events instead of actor messages.
+
+#include <algorithm>
+#include <cmath>
+
+#include "master.h"
+
+namespace det {
+
+namespace {
+
+Json err_body(const std::string& msg) {
+  Json j = Json::object();
+  j["error"] = msg;
+  return j;
+}
+
+HttpResponse json_resp(int status, const Json& j) {
+  return HttpResponse::json(status, j.dump());
+}
+
+int64_t to_id(const std::string& s) {
+  try {
+    return std::stoll(s);
+  } catch (...) {
+    return -1;
+  }
+}
+
+bool is_terminal(const std::string& state) {
+  return state == "COMPLETED" || state == "CANCELED" || state == "ERROR" ||
+         state == "DELETED";
+}
+
+std::string trial_task_id(int64_t trial_id) {
+  return "trial-" + std::to_string(trial_id);
+}
+
+}  // namespace
+
+ExperimentState* Master::find_experiment_locked(int64_t id) {
+  auto it = experiments_.find(id);
+  return it == experiments_.end() ? nullptr : &it->second;
+}
+
+TrialState* Master::find_trial_locked(int64_t trial_id,
+                                      ExperimentState** exp_out) {
+  for (auto& [eid, exp] : experiments_) {
+    for (auto& [rid, trial] : exp.trials) {
+      if (trial.id == trial_id) {
+        if (exp_out != nullptr) *exp_out = &exp;
+        return &trial;
+      }
+    }
+  }
+  return nullptr;
+}
+
+// ---------------------------------------------------------------------------
+// Experiment lifecycle.
+// ---------------------------------------------------------------------------
+
+int64_t Master::create_experiment_locked(const Json& config,
+                                         const std::string& model_def_b64,
+                                         int64_t user_id, int64_t project_id,
+                                         bool activate) {
+  // Minimal server-side validation; the Python expconf layer does full
+  // schema validation/defaulting before submit (reference does both
+  // master-side, pkg/schemas/expconf/parse.go).
+  if (!config["searcher"].is_object()) {
+    throw std::runtime_error("config.searcher is required");
+  }
+  if (!config["entrypoint"].is_string() && !config["entrypoint"].is_array()) {
+    throw std::runtime_error("config.entrypoint is required");
+  }
+
+  std::string job_id = "job-" + std::to_string(db_.last_insert_id()) + "-" +
+                       std::to_string(now());
+  db_.exec("INSERT INTO jobs (id, type) VALUES (?, 'EXPERIMENT')",
+           {Json(job_id)});
+  db_.exec(
+      "INSERT INTO experiments (state, config, original_config, model_def, "
+      "owner_id, project_id, job_id) VALUES ('PAUSED', ?, ?, ?, ?, ?, ?)",
+      {Json(config.dump()), Json(config.dump()), Json(model_def_b64),
+       Json(user_id), Json(project_id), Json(job_id)});
+  int64_t eid = db_.last_insert_id();
+
+  ExperimentState exp;
+  exp.id = eid;
+  exp.config = config;
+  exp.state = "PAUSED";
+  exp.job_id = job_id;
+  const Json& res = config["resources"];
+  exp.slots_per_trial =
+      static_cast<int>(res["slots_per_trial"].as_int(1));
+  exp.resource_pool = res["resource_pool"].as_string(cfg_.default_pool);
+  exp.priority = static_cast<int>(res["priority"].as_int(42));
+  exp.max_restarts = config["max_restarts"].as_int(5);
+  uint64_t seed = static_cast<uint64_t>(
+      config["reproducibility"]["experiment_seed"].as_int(eid * 2654435761));
+  exp.searcher = std::make_unique<Searcher>(config["searcher"],
+                                            config["hyperparameters"], seed);
+  experiments_[eid] = std::move(exp);
+
+  if (activate) activate_experiment_locked(experiments_[eid]);
+  return eid;
+}
+
+void Master::activate_experiment_locked(ExperimentState& exp) {
+  if (exp.state != "PAUSED") return;
+  set_experiment_state_locked(exp, "ACTIVE");
+  if (exp.trials.empty()) {
+    // First activation: seed the search (experiment.go:307
+    // InitialOperations).
+    process_ops_locked(exp, exp.searcher->initial_operations());
+  } else {
+    // Resume: re-queue every trial with outstanding work.
+    for (auto& [rid, trial] : exp.trials) {
+      if (!is_terminal(trial.state) && trial.allocation_id.empty() &&
+          (!trial.pending_ops.empty() || trial.close_requested)) {
+        request_allocation_locked(exp, trial);
+      }
+    }
+  }
+  snapshot_experiment_locked(exp);
+}
+
+void Master::set_experiment_state_locked(ExperimentState& exp,
+                                         const std::string& state) {
+  exp.state = state;
+  std::string sql = is_terminal(state)
+                        ? "UPDATE experiments SET state=?, "
+                          "end_time=datetime('now') WHERE id=?"
+                        : "UPDATE experiments SET state=? WHERE id=?";
+  db_.exec(sql, {Json(state), Json(exp.id)});
+  if (is_terminal(state)) fire_webhooks_locked(exp);
+  cv_.notify_all();
+}
+
+void Master::process_ops_locked(ExperimentState& exp,
+                                const std::vector<SearcherOp>& ops) {
+  for (const auto& op : ops) {
+    switch (op.kind) {
+      case SearcherOp::Kind::Create: {
+        db_.exec(
+            "INSERT INTO trials (experiment_id, request_id, hparams, seed) "
+            "VALUES (?, ?, ?, ?)",
+            {Json(exp.id), Json(op.request_id), Json(op.hparams.dump()),
+             Json(op.seed)});
+        TrialState trial;
+        trial.id = db_.last_insert_id();
+        trial.request_id = op.request_id;
+        trial.experiment_id = exp.id;
+        trial.hparams = op.hparams;
+        trial.seed = op.seed;
+        exp.trials[op.request_id] = std::move(trial);
+        db_.exec("INSERT OR IGNORE INTO tasks (id, type, state, job_id) "
+                 "VALUES (?, 'TRIAL', 'ACTIVE', ?)",
+                 {Json(trial_task_id(exp.trials[op.request_id].id)),
+                  Json(exp.job_id)});
+        break;
+      }
+      case SearcherOp::Kind::ValidateAfter: {
+        auto it = exp.trials.find(op.request_id);
+        if (it == exp.trials.end()) break;
+        it->second.pending_ops.push_back(op.length);
+        if (exp.state == "ACTIVE" && it->second.allocation_id.empty() &&
+            !is_terminal(it->second.state)) {
+          request_allocation_locked(exp, it->second);
+        }
+        break;
+      }
+      case SearcherOp::Kind::Close: {
+        auto it = exp.trials.find(op.request_id);
+        if (it == exp.trials.end()) break;
+        TrialState& trial = it->second;
+        trial.close_requested = true;
+        if (trial.allocation_id.empty() && !is_terminal(trial.state)) {
+          // Not running: close immediately.
+          finish_trial_locked(exp, trial, "COMPLETED");
+        }
+        break;
+      }
+      case SearcherOp::Kind::Shutdown: {
+        exp.searcher_shutdown = true;
+        break;
+      }
+    }
+  }
+  snapshot_experiment_locked(exp);
+  maybe_complete_experiment_locked(exp);
+  cv_.notify_all();
+}
+
+void Master::request_allocation_locked(ExperimentState& exp,
+                                       TrialState& trial) {
+  Allocation alloc;
+  alloc.id = "alloc-" + std::to_string(++alloc_counter_) + "-" +
+             std::to_string(trial.id) + "." + std::to_string(trial.run_id);
+  alloc.task_id = trial_task_id(trial.id);
+  alloc.experiment_id = exp.id;
+  alloc.request_id = trial.request_id;
+  alloc.trial_id = trial.id;
+  alloc.resource_pool = exp.resource_pool;
+  alloc.slots = exp.slots_per_trial;
+  alloc.priority = exp.priority;
+  alloc.submitted_at = now();
+  trial.allocation_id = alloc.id;
+  db_.exec(
+      "INSERT INTO allocations (id, task_id, trial_id, resource_pool, slots) "
+      "VALUES (?, ?, ?, ?, ?)",
+      {Json(alloc.id), Json(alloc.task_id), Json(trial.id),
+       Json(alloc.resource_pool), Json(static_cast<int64_t>(alloc.slots))});
+  std::string aid = alloc.id;
+  allocations_[aid] = std::move(alloc);
+  pending_.push_back(aid);
+  cv_.notify_all();
+}
+
+void Master::finish_trial_locked(ExperimentState& exp, TrialState& trial,
+                                 const std::string& state) {
+  if (is_terminal(trial.state)) return;
+  trial.state = state;
+  db_.exec(
+      "UPDATE trials SET state=?, end_time=datetime('now') WHERE id=?",
+      {Json(state), Json(trial.id)});
+  db_.exec("UPDATE tasks SET state=?, end_time=datetime('now') WHERE id=?",
+           {Json(state), Json(trial_task_id(trial.id))});
+  if (!trial.searcher_done) {
+    trial.searcher_done = true;
+    std::vector<SearcherOp> ops;
+    if (state == "ERROR") {
+      ops = exp.searcher->trial_exited_early(trial.request_id, "errored");
+    } else {
+      ops = exp.searcher->trial_closed(trial.request_id);
+    }
+    process_ops_locked(exp, ops);
+  } else {
+    maybe_complete_experiment_locked(exp);
+  }
+}
+
+void Master::maybe_complete_experiment_locked(ExperimentState& exp) {
+  if (is_terminal(exp.state)) return;
+  if (exp.state == "STOPPING_CANCELED" || exp.state == "STOPPING_KILLED") {
+    // Finished once every allocation is gone.
+    for (const auto& [rid, trial] : exp.trials) {
+      if (!trial.allocation_id.empty()) return;
+    }
+    for (auto& [rid, trial] : exp.trials) {
+      if (!is_terminal(trial.state)) {
+        trial.state = "CANCELED";
+        db_.exec("UPDATE trials SET state='CANCELED', "
+                 "end_time=datetime('now') WHERE id=?",
+                 {Json(trial.id)});
+      }
+    }
+    set_experiment_state_locked(exp, "CANCELED");
+    return;
+  }
+  if (!exp.searcher_shutdown) return;
+  bool all_done = true, any_ok = false;
+  for (const auto& [rid, trial] : exp.trials) {
+    all_done &= is_terminal(trial.state);
+    any_ok |= trial.state == "COMPLETED";
+  }
+  if (!all_done) return;
+  set_experiment_state_locked(exp, any_ok ? "COMPLETED" : "ERROR");
+  db_.exec("UPDATE experiments SET progress=1.0 WHERE id=?", {Json(exp.id)});
+}
+
+// ---------------------------------------------------------------------------
+// Allocation exit → trial outcome (reference trial.go:617-628 restart
+// policy + task/allocation.go terminal handling).
+// ---------------------------------------------------------------------------
+
+void Master::on_allocation_exit_locked(Allocation& alloc) {
+  alloc.state = "TERMINATED";
+  int exit_code = 0;
+  for (const auto& r : alloc.resources) {
+    exit_code = std::max(exit_code, r.exit_code == -1 ? 1 : r.exit_code);
+  }
+  alloc.exit_code = exit_code;
+  release_resources_locked(alloc);
+  // A multi-host allocation where one host failed must kill the rest —
+  // the ICI mesh is dead anyway (SURVEY.md §7 hard part d).
+  for (auto& r : alloc.resources) {
+    if (r.state != "EXITED") {
+      kill_allocation_locked(alloc);
+      break;
+    }
+  }
+  db_.exec(
+      "UPDATE allocations SET state='TERMINATED', end_time=datetime('now'), "
+      "exit_reason=? WHERE id=?",
+      {Json(alloc.exit_reason), Json(alloc.id)});
+
+  ExperimentState* exp = find_experiment_locked(alloc.experiment_id);
+  if (exp == nullptr) {
+    cv_.notify_all();
+    return;
+  }
+  auto tit = exp->trials.find(alloc.request_id);
+  if (tit == exp->trials.end()) {
+    cv_.notify_all();
+    return;
+  }
+  TrialState& trial = tit->second;
+  if (trial.allocation_id == alloc.id) trial.allocation_id.clear();
+
+  if (is_terminal(trial.state)) {
+    maybe_complete_experiment_locked(*exp);
+    cv_.notify_all();
+    return;
+  }
+
+  if (exp->state == "STOPPING_CANCELED" || exp->state == "STOPPING_KILLED") {
+    trial.state = "CANCELED";
+    db_.exec("UPDATE trials SET state='CANCELED', end_time=datetime('now') "
+             "WHERE id=?",
+             {Json(trial.id)});
+    maybe_complete_experiment_locked(*exp);
+    cv_.notify_all();
+    return;
+  }
+
+  if (exit_code == 0) {
+    if (trial.close_requested ||
+        (trial.pending_ops.empty() && exp->searcher_shutdown)) {
+      finish_trial_locked(*exp, trial, "COMPLETED");
+    } else if (trial.pending_ops.empty()) {
+      // Idle exit: an ASHA trial paused in its rung released its slice and
+      // waits out-of-container for a possible later promotion; process_ops
+      // re-allocates when a ValidateAfter (promotion) or Close arrives.
+      trial.run_id += 1;
+      db_.exec("UPDATE trials SET run_id=? WHERE id=?",
+               {Json(trial.run_id), Json(trial.id)});
+    } else if (exp->state == "ACTIVE") {
+      // Clean exit with work left — preemption or pause/resume path;
+      // resume from the latest checkpoint.
+      trial.run_id += 1;
+      db_.exec("UPDATE trials SET run_id=? WHERE id=?",
+               {Json(trial.run_id), Json(trial.id)});
+      request_allocation_locked(*exp, trial);
+    }
+    // exp PAUSED: trial stays idle; activate re-queues it.
+  } else {
+    if (trial.pending_ops.empty() && !trial.close_requested) {
+      // A paused (idle) trial died — it has no work, so restarting it would
+      // only boot a container that idles and exits. Leave it paused;
+      // process_ops re-allocates if a promotion or close arrives.
+      trial.run_id += 1;
+      db_.exec("UPDATE trials SET run_id=? WHERE id=?",
+               {Json(trial.run_id), Json(trial.id)});
+    } else if (trial.restarts < exp->max_restarts && exp->state == "ACTIVE") {
+      trial.restarts += 1;
+      trial.run_id += 1;
+      db_.exec("UPDATE trials SET restarts=?, run_id=? WHERE id=?",
+               {Json(trial.restarts), Json(trial.run_id), Json(trial.id)});
+      request_allocation_locked(*exp, trial);
+    } else {
+      finish_trial_locked(*exp, trial, "ERROR");
+    }
+  }
+  snapshot_experiment_locked(*exp);
+  cv_.notify_all();
+}
+
+// ---------------------------------------------------------------------------
+// Snapshot / restore (reference restore.go; snapshot version 1).
+// ---------------------------------------------------------------------------
+
+void Master::snapshot_experiment_locked(ExperimentState& exp) {
+  Json snap = Json::object();
+  snap["searcher"] = exp.searcher->snapshot();
+  snap["searcher_shutdown"] = exp.searcher_shutdown;
+  Json trials = Json::object();
+  for (const auto& [rid, t] : exp.trials) {
+    Json tj = Json::object();
+    tj["id"] = t.id;
+    tj["hparams"] = t.hparams;
+    tj["seed"] = t.seed;
+    tj["state"] = t.state;
+    Json ops = Json::array();
+    for (int64_t len : t.pending_ops) ops.push_back(Json(len));
+    tj["pending_ops"] = ops;
+    tj["close_requested"] = t.close_requested;
+    tj["searcher_done"] = t.searcher_done;
+    tj["restarts"] = t.restarts;
+    tj["run_id"] = t.run_id;
+    tj["steps_completed"] = t.steps_completed;
+    tj["latest_checkpoint"] = t.latest_checkpoint;
+    trials[rid] = std::move(tj);
+  }
+  snap["trials"] = trials;
+  db_.exec(
+      "INSERT INTO experiment_snapshots (experiment_id, version, content, "
+      "updated_at) VALUES (?, 1, ?, datetime('now')) "
+      "ON CONFLICT(experiment_id) DO UPDATE SET content=excluded.content, "
+      "updated_at=excluded.updated_at",
+      {Json(exp.id), Json(snap.dump())});
+}
+
+void Master::restore_experiments() {
+  auto rows = db_.query(
+      "SELECT e.id, e.state, e.config, s.content FROM experiments e "
+      "LEFT JOIN experiment_snapshots s ON s.experiment_id = e.id "
+      "WHERE e.state IN ('ACTIVE','PAUSED','STOPPING_CANCELED',"
+      "'STOPPING_KILLED','STOPPING_COMPLETED')");
+  for (auto& row : rows) {
+    int64_t eid = row["id"].as_int();
+    Json config = Json::parse_or_null(row["config"].as_string());
+    ExperimentState exp;
+    exp.id = eid;
+    exp.config = config;
+    exp.state = row["state"].as_string();
+    const Json& res = config["resources"];
+    exp.slots_per_trial = static_cast<int>(res["slots_per_trial"].as_int(1));
+    exp.resource_pool = res["resource_pool"].as_string(cfg_.default_pool);
+    exp.priority = static_cast<int>(res["priority"].as_int(42));
+    exp.max_restarts = config["max_restarts"].as_int(5);
+    uint64_t seed = static_cast<uint64_t>(
+        config["reproducibility"]["experiment_seed"].as_int(
+            eid * 2654435761));
+    exp.searcher = std::make_unique<Searcher>(
+        config["searcher"], config["hyperparameters"], seed);
+
+    Json snap = Json::parse_or_null(row["content"].as_string());
+    if (snap.is_object()) {
+      exp.searcher->restore(snap["searcher"]);
+      exp.searcher_shutdown = snap["searcher_shutdown"].as_bool();
+      for (const auto& [rid, tj] : snap["trials"].as_object()) {
+        TrialState t;
+        t.id = tj["id"].as_int();
+        t.request_id = rid;
+        t.experiment_id = eid;
+        t.hparams = tj["hparams"];
+        t.seed = tj["seed"].as_int();
+        t.state = tj["state"].as_string("ACTIVE");
+        for (const auto& len : tj["pending_ops"].as_array()) {
+          t.pending_ops.push_back(len.as_int());
+        }
+        t.close_requested = tj["close_requested"].as_bool();
+        t.searcher_done = tj["searcher_done"].as_bool();
+        t.restarts = tj["restarts"].as_int();
+        // In-flight runs died with the old master; bump run id so the next
+        // allocation resumes from the checkpoint (no process reattach for
+        // trial runs in v1; agents reattach at the allocation level).
+        t.run_id = tj["run_id"].as_int() + 1;
+        t.steps_completed = tj["steps_completed"].as_int();
+        t.latest_checkpoint = tj["latest_checkpoint"].as_string();
+        exp.trials[rid] = std::move(t);
+      }
+    }
+    experiments_[eid] = std::move(exp);
+    ExperimentState& e = experiments_[eid];
+    if (e.state == "ACTIVE") {
+      if (e.trials.empty()) {
+        process_ops_locked(e, e.searcher->initial_operations());
+      } else {
+        for (auto& [rid, trial] : e.trials) {
+          if (!is_terminal(trial.state) &&
+              (!trial.pending_ops.empty() || trial.close_requested)) {
+            trial.allocation_id.clear();
+            request_allocation_locked(e, trial);
+          }
+        }
+      }
+    }
+    maybe_complete_experiment_locked(e);
+  }
+}
+
+void Master::fire_webhooks_locked(const ExperimentState& exp) {
+  // Reference internal/webhooks/shipper.go: POST event JSON to registered
+  // URLs on experiment state change. Fire-and-forget from a detached
+  // thread; failures are logged to stderr only.
+  auto hooks = db_.query("SELECT url, triggers FROM webhooks");
+  if (hooks.empty()) return;
+  Json event = Json::object();
+  event["type"] = "EXPERIMENT_STATE_CHANGE";
+  event["experiment_id"] = exp.id;
+  event["state"] = exp.state;
+  std::string payload = event.dump();
+  for (auto& h : hooks) {
+    std::string url = h["url"].as_string();
+    std::thread([url, payload] {
+      try {
+        // Split "http://host:port/path".
+        auto path_pos = url.find('/', url.find("//") + 2);
+        std::string base = path_pos == std::string::npos
+                               ? url
+                               : url.substr(0, path_pos);
+        std::string path =
+            path_pos == std::string::npos ? "/" : url.substr(path_pos);
+        http_request("POST", base, path, payload, 10.0);
+      } catch (const std::exception& e) {
+        fprintf(stderr, "webhook %s failed: %s\n", url.c_str(), e.what());
+      }
+    }).detach();
+  }
+}
+
+}  // namespace det

@@ -223,11 +223,7 @@ class WaveSearch : public SearchMethod {
   std::vector<SearcherOp> trial_closed(const std::string& rid) override {
     closed_.insert(rid);
     std::vector<SearcherOp> ops;
-    if (created_ < max_trials_) {
-      spawn(&ops);
-    } else if (static_cast<int64_t>(closed_.size()) >= max_trials_) {
-      ops.push_back(SearcherOp::shutdown());
-    }
+    if (created_ < max_trials_) spawn(&ops);
     return ops;
   }
 
@@ -304,17 +300,32 @@ class GridSearch : public WaveSearch {
 };
 
 // ---------------------------------------------------------------------------
-// ASHA (asynchronous successive halving) — promote and stop_once variants
-// (reference asha.go:55, asha_stopping.go). Rung r needs
-// max_length / divisor^(num_rungs-1-r) cumulative units; a validation
-// arriving at rung r joins the rung's sorted metrics and is promoted iff it
-// lies in the top 1/divisor fraction seen so far.
+// ASHA (asynchronous successive halving) — promote and stop_once variants.
+//
+// Faithful to the reference's semantics (asha.go:55): rung r's cumulative
+// units are the SUM of per-rung increments max_length / divisor^(R-1-i) for
+// i ≤ r; a validation arriving at rung r joins the rung's sorted metrics
+// (promotionsAsync, asha.go:92-127) and either promotes immediately, or
+// enables the promotion of an earlier better trial, or leaves the trial
+// PAUSED in the rung (it may be promoted later — unlike an eager-stopping
+// scheme). When the bottom rung has seen max_trials results, unpromotable
+// trials in settled rungs are closed (closeOutRungs, asha.go:258).
+// The stop_once variant (asha_stopping.go) makes the stop/continue decision
+// immediately and never revisits it.
 // ---------------------------------------------------------------------------
 
+constexpr double kAshaExitedMetric = 1e300;
+
+struct RungMetric {
+  double metric = 0;
+  std::string rid;
+  bool promoted = false;
+};
+
 struct Rung {
-  int64_t units = 0;
-  // Sorted ascending (smaller = better after sign normalization).
-  std::vector<std::pair<double, std::string>> metrics;
+  int64_t units = 0;  // cumulative
+  std::vector<RungMetric> metrics;  // sorted ascending by metric
+  int64_t outstanding = 0;
 };
 
 class AshaSearch : public SearchMethod {
@@ -325,73 +336,74 @@ class AshaSearch : public SearchMethod {
         rng_(seed),
         prefix_(std::move(prefix)),
         max_trials_(max_trials),
-        max_concurrent_(std::max<int64_t>(1, max_concurrent)),
         divisor_(std::max<int64_t>(2, cfg["divisor"].as_int(4))),
         stop_once_(cfg["stop_once"].as_bool(false)) {
     int64_t max_length = parse_length(cfg["max_length"]);
     int64_t num_rungs = std::max<int64_t>(1, cfg["num_rungs"].as_int(5));
+    int64_t cumulative = 0;
     for (int64_t r = 0; r < num_rungs; ++r) {
-      Rung rung;
       double denom = std::pow(static_cast<double>(divisor_),
                               static_cast<double>(num_rungs - 1 - r));
-      rung.units = std::max<int64_t>(
-          1, static_cast<int64_t>(std::llround(max_length / denom)));
+      cumulative += std::max<int64_t>(
+          1, static_cast<int64_t>(max_length / denom));
+      Rung rung;
+      rung.units = cumulative;
       rungs_.push_back(std::move(rung));
+    }
+    // Default concurrency guarantees at least one top-rung trial
+    // (asha.go:139-147).
+    if (max_concurrent > 0) {
+      max_concurrent_ = std::min(max_concurrent, max_trials_);
+    } else {
+      double top = std::pow(static_cast<double>(divisor_),
+                            static_cast<double>(num_rungs - 1));
+      max_concurrent_ = std::max<int64_t>(
+          1, std::min<int64_t>(static_cast<int64_t>(top), max_trials_));
     }
   }
 
   std::vector<SearcherOp> initial_operations() override {
     std::vector<SearcherOp> ops;
-    int64_t n = std::min(max_trials_, max_concurrent_);
-    for (int64_t i = 0; i < n; ++i) spawn(&ops);
+    for (int64_t i = 0; i < max_concurrent_; ++i) spawn(&ops);
     return ops;
   }
 
   std::vector<SearcherOp> validation_completed(const std::string& rid,
                                                double metric,
                                                int64_t length) override {
+    (void)length;
     std::vector<SearcherOp> ops;
-    size_t r = rung_for(length);
-    Rung& rung = rungs_[r];
-    auto pos = std::lower_bound(rung.metrics.begin(), rung.metrics.end(),
-                                std::make_pair(metric, rid));
-    bool top = static_cast<int64_t>(pos - rung.metrics.begin()) <
-               promotable(static_cast<int64_t>(rung.metrics.size()) + 1);
-    rung.metrics.insert(pos, {metric, rid});
-
-    bool final_rung = r + 1 == rungs_.size();
-    bool advance = stop_once_
-                       ? (top || static_cast<int64_t>(rung.metrics.size()) <
-                                     divisor_)
-                       : top;
-    if (final_rung || !advance) {
-      ops.push_back(SearcherOp::close(rid));
-    } else {
-      ops.push_back(SearcherOp::validate_after(rid, rungs_[r + 1].units));
-    }
+    promote_async(rid, metric, &ops);
     return ops;
   }
 
   std::vector<SearcherOp> trial_closed(const std::string& rid) override {
     closed_.insert(rid);
-    std::vector<SearcherOp> ops;
-    if (created_ < max_trials_) {
-      spawn(&ops);
-    } else if (static_cast<int64_t>(closed_.size()) >= max_trials_) {
-      ops.push_back(SearcherOp::shutdown());
-    }
-    return ops;
+    return {};
   }
 
   std::vector<SearcherOp> trial_exited_early(const std::string& rid,
                                              const std::string&) override {
-    // An errored trial never promotes; it simply leaves the tournament and
-    // is backfilled by trial_closed's spawn logic.
-    return trial_closed(rid);
+    // The errored trial takes the worst possible metric in its rung so the
+    // promotion fractions stay honest (asha.go ashaExitedMetricValue), and
+    // anything its result unblocks gets promoted. If the trial already
+    // reported its metric at its current rung (it died while idle-waiting
+    // for a promotion), its result is already in the tournament — recording
+    // it again would double-decrement `outstanding` and wedge close-out.
+    std::vector<SearcherOp> ops;
+    early_exit_.insert(rid);
+    closed_.insert(rid);
+    size_t r = trial_rungs_.count(rid) ? trial_rungs_[rid] : 0;
+    bool already_reported = false;
+    for (const auto& m : rungs_[r].metrics) {
+      already_reported |= m.rid == rid;
+    }
+    if (!already_reported) promote_async(rid, kAshaExitedMetric, &ops);
+    return ops;
   }
 
   double progress(int64_t units) const override {
-    // Expected units per trial under geometric survival 1/divisor per rung.
+    // Expected cumulative units per trial under geometric survival.
     double expected = 0, survive = 1.0, prev = 0;
     for (const auto& rung : rungs_) {
       expected += survive * static_cast<double>(rung.units - prev);
@@ -407,20 +419,32 @@ class AshaSearch : public SearchMethod {
     Json j = Json::object();
     j["created"] = created_;
     j["rng"] = rng_to_string(rng_);
-    Json closed = Json::array();
-    for (const auto& rid : closed_) closed.push_back(rid);
-    j["closed"] = closed;
+    auto dump_set = [](const std::set<std::string>& s) {
+      Json a = Json::array();
+      for (const auto& rid : s) a.push_back(rid);
+      return a;
+    };
+    j["closed"] = dump_set(closed_);
+    j["early_exit"] = dump_set(early_exit_);
+    j["pending_close"] = dump_set(pending_close_);
+    Json trial_rungs = Json::object();
+    for (const auto& [rid, r] : trial_rungs_) {
+      trial_rungs[rid] = static_cast<int64_t>(r);
+    }
+    j["trial_rungs"] = trial_rungs;
     Json rungs = Json::array();
     for (const auto& rung : rungs_) {
       Json metrics = Json::array();
-      for (const auto& [m, rid] : rung.metrics) {
-        Json e = Json::array();
-        e.push_back(m);
-        e.push_back(rid);
+      for (const auto& m : rung.metrics) {
+        Json e = Json::object();
+        e["metric"] = m.metric;
+        e["rid"] = m.rid;
+        e["promoted"] = m.promoted;
         metrics.push_back(std::move(e));
       }
       Json rj = Json::object();
       rj["units"] = rung.units;
+      rj["outstanding"] = rung.outstanding;
       rj["metrics"] = metrics;
       rungs.push_back(std::move(rj));
     }
@@ -431,33 +455,132 @@ class AshaSearch : public SearchMethod {
   void restore(const Json& j) override {
     created_ = j["created"].as_int();
     rng_from_string(rng_, j["rng"].as_string());
-    closed_.clear();
-    for (const auto& rid : j["closed"].as_array()) closed_.insert(rid.as_string());
+    auto load_set = [](const Json& a, std::set<std::string>* out) {
+      out->clear();
+      for (const auto& rid : a.as_array()) out->insert(rid.as_string());
+    };
+    load_set(j["closed"], &closed_);
+    load_set(j["early_exit"], &early_exit_);
+    load_set(j["pending_close"], &pending_close_);
+    trial_rungs_.clear();
+    for (const auto& [rid, r] : j["trial_rungs"].as_object()) {
+      trial_rungs_[rid] = static_cast<size_t>(r.as_int());
+    }
     const auto& rungs = j["rungs"].as_array();
     for (size_t r = 0; r < rungs_.size() && r < rungs.size(); ++r) {
       rungs_[r].units = rungs[r]["units"].as_int();
+      rungs_[r].outstanding = rungs[r]["outstanding"].as_int();
       rungs_[r].metrics.clear();
       for (const auto& e : rungs[r]["metrics"].as_array()) {
-        rungs_[r].metrics.push_back({e.at(0).as_double(), e.at(1).as_string()});
+        rungs_[r].metrics.push_back(
+            {e["metric"].as_double(), e["rid"].as_string(),
+             e["promoted"].as_bool()});
       }
     }
   }
 
  private:
-  int64_t promotable(int64_t n) const { return n / divisor_; }
+  // Sorted-ascending insert position for a new rung result.
+  static size_t insert_pos(const Rung& rung, double metric) {
+    size_t i = 0;
+    while (i < rung.metrics.size() && rung.metrics[i].metric <= metric) ++i;
+    return i;
+  }
 
-  size_t rung_for(int64_t length) const {
-    size_t best = 0;
-    for (size_t r = 0; r < rungs_.size(); ++r) {
-      if (length >= rungs_[r].units) best = r;
+  // Insert into the rung; return request-ids to promote now
+  // (asha.go promotionsAsync).
+  std::vector<std::string> rung_promotions(Rung& rung, const std::string& rid,
+                                           double metric) {
+    int64_t n = static_cast<int64_t>(rung.metrics.size());
+    int64_t old_promote = n / divisor_;
+    int64_t new_promote = (n + 1) / divisor_;
+    size_t insert_at = insert_pos(rung, metric);
+    bool promote_now = static_cast<int64_t>(insert_at) < new_promote;
+    rung.metrics.insert(rung.metrics.begin() + insert_at,
+                        {metric, rid, promote_now});
+    if (promote_now) return {rid};
+    if (new_promote != old_promote &&
+        !rung.metrics[static_cast<size_t>(old_promote)].promoted) {
+      rung.metrics[static_cast<size_t>(old_promote)].promoted = true;
+      return {rung.metrics[static_cast<size_t>(old_promote)].rid};
     }
-    return best;
+    return {};
+  }
+
+  void promote_async(const std::string& rid, double metric,
+                     std::vector<SearcherOp>* ops) {
+    size_t r = trial_rungs_[rid];
+    Rung& rung = rungs_[r];
+    rung.outstanding = std::max<int64_t>(0, rung.outstanding - 1);
+    bool added_train = false;
+
+    if (r + 1 == rungs_.size()) {
+      // Top rung: record and close.
+      size_t insert_at = insert_pos(rung, metric);
+      rung.metrics.insert(rung.metrics.begin() + insert_at,
+                          {metric, rid, false});
+      if (early_exit_.count(rid) == 0) {
+        ops->push_back(SearcherOp::close(rid));
+      }
+    } else if (stop_once_) {
+      // Stopping variant: immediate keep/stop decision, never revisited.
+      int64_t n = static_cast<int64_t>(rung.metrics.size());
+      size_t insert_at = insert_pos(rung, metric);
+      bool keep = static_cast<int64_t>(insert_at) < (n + 1) / divisor_ ||
+                  n + 1 < divisor_;
+      rung.metrics.insert(rung.metrics.begin() + insert_at,
+                          {metric, rid, keep});
+      if (keep && early_exit_.count(rid) == 0) {
+        trial_rungs_[rid] = r + 1;
+        rungs_[r + 1].outstanding++;
+        ops->push_back(SearcherOp::validate_after(rid, rungs_[r + 1].units));
+        added_train = true;
+      } else if (early_exit_.count(rid) == 0) {
+        ops->push_back(SearcherOp::close(rid));
+      }
+    } else {
+      for (const std::string& pid : rung_promotions(rung, rid, metric)) {
+        trial_rungs_[pid] = r + 1;
+        rungs_[r + 1].outstanding++;
+        if (early_exit_.count(pid) == 0) {
+          ops->push_back(
+              SearcherOp::validate_after(pid, rungs_[r + 1].units));
+          added_train = true;
+        } else {
+          // Act as if the dead trial ran the next rung and came in last.
+          promote_async(pid, kAshaExitedMetric, ops);
+        }
+      }
+    }
+
+    if (!added_train && created_ < max_trials_) spawn(ops);
+
+    if (static_cast<int64_t>(rungs_.front().metrics.size()) >= max_trials_) {
+      close_out_rungs(ops);
+    }
+  }
+
+  // Close unpromoted trials in rungs that have fully settled
+  // (asha.go:258 closeOutRungs).
+  void close_out_rungs(std::vector<SearcherOp>* ops) {
+    for (auto& rung : rungs_) {
+      if (rung.outstanding > 0) break;
+      for (auto& m : rung.metrics) {
+        if (!m.promoted && closed_.count(m.rid) == 0 &&
+            early_exit_.count(m.rid) == 0 && !pending_close_.count(m.rid)) {
+          pending_close_.insert(m.rid);
+          ops->push_back(SearcherOp::close(m.rid));
+        }
+      }
+    }
   }
 
   void spawn(std::vector<SearcherOp>* ops) {
     std::string rid = prefix_ + std::to_string(created_);
     Json hp = sample_hparams(hparam_spec_, rng_);
     ++created_;
+    trial_rungs_[rid] = 0;
+    rungs_.front().outstanding++;
     std::uniform_int_distribution<int64_t> d(0, (1LL << 31) - 1);
     ops->push_back(SearcherOp::create(rid, std::move(hp), d(rng_)));
     ops->push_back(SearcherOp::validate_after(rid, rungs_.front().units));
@@ -467,12 +590,15 @@ class AshaSearch : public SearchMethod {
   std::mt19937_64 rng_;
   std::string prefix_;
   int64_t max_trials_;
-  int64_t max_concurrent_;
+  int64_t max_concurrent_ = 1;
   int64_t divisor_;
   bool stop_once_;
   std::vector<Rung> rungs_;
+  std::map<std::string, size_t> trial_rungs_;
   int64_t created_ = 0;
   std::set<std::string> closed_;
+  std::set<std::string> early_exit_;
+  std::set<std::string> pending_close_;
 };
 
 // ---------------------------------------------------------------------------
@@ -559,7 +685,6 @@ class AdaptiveAshaSearch : public SearchMethod {
     Json subs = Json::array();
     for (const auto& b : sub_brackets_) subs.push_back(b->snapshot());
     j["brackets"] = subs;
-    j["shutdowns"] = shutdowns_;
     return j;
   }
   void restore(const Json& j) override {
@@ -567,37 +692,22 @@ class AdaptiveAshaSearch : public SearchMethod {
     for (size_t i = 0; i < sub_brackets_.size() && i < subs.size(); ++i) {
       sub_brackets_[i]->restore(subs[i]);
     }
-    shutdowns_ = j["shutdowns"].as_int();
   }
 
  private:
-  // Dispatch to the owning bracket by request-id prefix; a bracket-level
-  // Shutdown only becomes a real Shutdown when every bracket has finished
-  // (tournament.go semantics).
+  // Dispatch to the owning bracket by request-id prefix. Tournament-level
+  // completion (Shutdown once every bracket's trials close) is handled by
+  // the Searcher wrapper's global accounting (tournament.go semantics).
   template <typename Fn>
   std::vector<SearcherOp> route(const std::string& rid, Fn fn) {
     for (size_t i = 0; i < prefixes_.size(); ++i) {
-      if (rid.rfind(prefixes_[i], 0) == 0) {
-        auto ops = fn(*sub_brackets_[i]);
-        std::vector<SearcherOp> out;
-        for (auto& op : ops) {
-          if (op.kind == SearcherOp::Kind::Shutdown) {
-            if (++shutdowns_ == static_cast<int64_t>(sub_brackets_.size())) {
-              out.push_back(op);
-            }
-          } else {
-            out.push_back(op);
-          }
-        }
-        return out;
-      }
+      if (rid.rfind(prefixes_[i], 0) == 0) return fn(*sub_brackets_[i]);
     }
     return {};
   }
 
   std::vector<std::unique_ptr<AshaSearch>> sub_brackets_;
   std::vector<std::string> prefixes_;
-  int64_t shutdowns_ = 0;
 };
 
 }  // namespace
@@ -646,24 +756,45 @@ Searcher::Searcher(const Json& cfg, const Json& hparam_spec, uint64_t seed)
       metric_name_(cfg["metric"].as_string("loss")),
       smaller_is_better_(cfg["smaller_is_better"].as_bool(true)) {}
 
+// Bookkeeping shared by every event path (reference searcher.go:144,198):
+// count Create ops, and emit Shutdown once every requested trial has
+// closed. Methods themselves never emit Shutdown.
+std::vector<SearcherOp> Searcher::account(std::vector<SearcherOp> ops) {
+  for (const auto& op : ops) {
+    if (op.kind == SearcherOp::Kind::Create) ++trials_requested_;
+  }
+  if (trials_requested_ > 0 &&
+      static_cast<int64_t>(trials_closed_.size()) >= trials_requested_ &&
+      !shutdown_emitted_) {
+    shutdown_emitted_ = true;
+    bool all_failed = static_cast<int64_t>(trials_failed_.size()) >=
+                      trials_requested_;
+    ops.push_back(SearcherOp::shutdown(false, all_failed));
+  }
+  return ops;
+}
+
 std::vector<SearcherOp> Searcher::initial_operations() {
-  return method_->initial_operations();
+  return account(method_->initial_operations());
 }
 
 std::vector<SearcherOp> Searcher::validation_completed(
     const std::string& rid, double raw_metric, int64_t length) {
   double metric = smaller_is_better_ ? raw_metric : -raw_metric;
   units_[rid] = std::max(units_[rid], length);
-  return method_->validation_completed(rid, metric, length);
+  return account(method_->validation_completed(rid, metric, length));
 }
 
 std::vector<SearcherOp> Searcher::trial_closed(const std::string& rid) {
-  return method_->trial_closed(rid);
+  trials_closed_.insert(rid);
+  return account(method_->trial_closed(rid));
 }
 
 std::vector<SearcherOp> Searcher::trial_exited_early(
     const std::string& rid, const std::string& reason) {
-  return method_->trial_exited_early(rid, reason);
+  trials_closed_.insert(rid);
+  trials_failed_.insert(rid);
+  return account(method_->trial_exited_early(rid, reason));
 }
 
 void Searcher::record_units(const std::string& rid, int64_t total_units) {
@@ -682,6 +813,14 @@ Json Searcher::snapshot() const {
   Json units = Json::object();
   for (const auto& [rid, u] : units_) units[rid] = u;
   j["units"] = units;
+  j["trials_requested"] = trials_requested_;
+  Json closed = Json::array();
+  for (const auto& rid : trials_closed_) closed.push_back(rid);
+  j["trials_closed"] = closed;
+  Json failed = Json::array();
+  for (const auto& rid : trials_failed_) failed.push_back(rid);
+  j["trials_failed"] = failed;
+  j["shutdown_emitted"] = shutdown_emitted_;
   return j;
 }
 
@@ -691,6 +830,16 @@ void Searcher::restore(const Json& snap) {
   for (const auto& [rid, u] : snap["units"].as_object()) {
     units_[rid] = u.as_int();
   }
+  trials_requested_ = snap["trials_requested"].as_int();
+  trials_closed_.clear();
+  for (const auto& rid : snap["trials_closed"].as_array()) {
+    trials_closed_.insert(rid.as_string());
+  }
+  trials_failed_.clear();
+  for (const auto& rid : snap["trials_failed"].as_array()) {
+    trials_failed_.insert(rid.as_string());
+  }
+  shutdown_emitted_ = snap["shutdown_emitted"].as_bool();
 }
 
 }  // namespace det

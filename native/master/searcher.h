@@ -17,8 +17,10 @@
 #pragma once
 
 #include <cstdint>
+#include <map>
 #include <memory>
 #include <random>
+#include <set>
 #include <string>
 #include <vector>
 
@@ -120,11 +122,17 @@ class Searcher {
   void restore(const Json& snap);
 
  private:
+  std::vector<SearcherOp> account(std::vector<SearcherOp> ops);
+
   std::unique_ptr<SearchMethod> method_;
   std::string metric_name_;
   bool smaller_is_better_ = true;
   // request_id → units completed so far (for progress()).
   std::map<std::string, int64_t> units_;
+  int64_t trials_requested_ = 0;
+  std::set<std::string> trials_closed_;
+  std::set<std::string> trials_failed_;
+  bool shutdown_emitted_ = false;
 };
 
 // Factory (reference search_method.go:73). Config variants: single, random,

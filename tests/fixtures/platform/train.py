@@ -1,0 +1,64 @@
+"""Minimal Core-API trial used by the platform e2e tests.
+
+Mirrors the shape of the reference's e2e fixture trials
+(e2e_tests/tests/fixtures/): trains `op.length` synthetic steps, reports
+metrics, honors preemption by checkpointing and exiting cleanly, and resumes
+from the latest checkpoint.
+"""
+
+import json
+import os
+import sys
+import time
+
+from determined_tpu import core
+
+
+def main() -> int:
+    with core.init(async_checkpointing=False) as ctx:
+        hp = ctx.hparams
+        steps = 0
+        # Resume (reference: info.latest_checkpoint → restore path).
+        if ctx.latest_checkpoint:
+            with ctx.checkpoint.restore_path(ctx.latest_checkpoint) as path:
+                with open(os.path.join(path, "state.json")) as f:
+                    steps = json.load(f)["steps"]
+            print(f"resumed from checkpoint at step {steps}")
+
+        step_sleep = float(os.environ.get("TRIAL_STEP_SLEEP", "0.01"))
+        for op in ctx.searcher.operations():
+            while steps < op.length:
+                steps += 1
+                time.sleep(step_sleep)
+                if steps % 4 == 0 or steps == op.length:
+                    ctx.train.report_training_metrics(steps, {"loss": 1.0 / steps})
+                if ctx.preempt.should_preempt():
+                    with ctx.checkpoint.store_path({"steps_completed": steps}) as (
+                        path,
+                        _sid,
+                    ):
+                        with open(os.path.join(path, "state.json"), "w") as f:
+                            json.dump({"steps": steps}, f)
+                    print(f"preempted at step {steps}")
+                    return 0
+            metric = float(hp.get("lr", 0.1)) / (1.0 + steps)
+            ctx.train.report_validation_metrics(steps, {"val_loss": metric})
+            op.report_completed(metric)
+            # Checkpoint at each rung boundary so an idle-exited (paused)
+            # trial resumes exactly here if promoted later.
+            with ctx.checkpoint.store_path({"steps_completed": steps}) as (
+                path,
+                _sid,
+            ):
+                with open(os.path.join(path, "state.json"), "w") as f:
+                    json.dump({"steps": steps}, f)
+
+        with ctx.checkpoint.store_path({"steps_completed": steps}) as (path, _sid):
+            with open(os.path.join(path, "state.json"), "w") as f:
+                json.dump({"steps": steps}, f)
+        print(f"trial complete at step {steps}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,352 @@
+"""End-to-end platform tests: real master + agent as local processes.
+
+Mirrors the reference's devcluster-based e2e strategy
+(e2e_tests/tests/cluster/managed_cluster.py:27 — db+master+agent as local
+processes, fault injection via kill/restart :50-98). Here the cluster is the
+C++ master + C++ agent with artificial CPU slots; trials are real processes
+running the Core API fixture in tests/fixtures/platform/.
+"""
+
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_BIN = os.path.join(REPO, "native", "bin")
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "platform")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_http(url: str, timeout: float = 20.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=2):
+                return
+        except Exception:
+            time.sleep(0.2)
+    raise TimeoutError(f"server at {url} did not come up")
+
+
+@pytest.fixture(scope="session")
+def native_binaries():
+    subprocess.run(
+        ["make", "-C", os.path.join(REPO, "native")], check=True,
+        capture_output=True,
+    )
+    return NATIVE_BIN
+
+
+class Devcluster:
+    """One master + one agent with N artificial slots."""
+
+    def __init__(self, tmpdir: str, binaries: str, slots: int = 2):
+        self.tmpdir = tmpdir
+        self.binaries = binaries
+        self.slots = slots
+        self.port = _free_port()
+        self.master_url = f"http://127.0.0.1:{self.port}"
+        self.db_path = os.path.join(tmpdir, "master.db")
+        self.master = None
+        self.agent = None
+        self.env = dict(
+            os.environ,
+            PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+            JAX_PLATFORMS="cpu",
+        )
+
+    def start_master(self):
+        self.master = subprocess.Popen(
+            [
+                os.path.join(self.binaries, "determined-master"),
+                "--port", str(self.port),
+                "--host", "127.0.0.1",
+                "--db", self.db_path,
+                "--agent-timeout", "15",
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        _wait_http(self.master_url + "/api/v1/master")
+
+    def start_agent(self, agent_id="agent-0"):
+        self.agent = subprocess.Popen(
+            [
+                os.path.join(self.binaries, "determined-agent"),
+                "--master-url", self.master_url,
+                "--id", agent_id,
+                "--slots", str(self.slots),
+                "--slot-type", "cpu",
+                "--addr", "127.0.0.1",
+                "--work-root", os.path.join(self.tmpdir, "agent-work"),
+            ],
+            env=self.env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            agents = self.api("GET", "/api/v1/agents")["agents"]
+            if any(a["id"] == agent_id and a["alive"] for a in agents):
+                return
+            time.sleep(0.2)
+        raise TimeoutError("agent did not register")
+
+    def kill_master(self):
+        self.master.kill()
+        self.master.wait()
+
+    def stop(self):
+        for proc in (self.agent, self.master):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+    # -- tiny API client -----------------------------------------------
+    def api(self, method: str, path: str, body=None, token=None):
+        req = urllib.request.Request(
+            self.master_url + path,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json",
+                     **({"Authorization": f"Bearer {token}"} if token else {})},
+            method=method,
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            text = resp.read().decode()
+            return json.loads(text) if text else None
+
+    def login(self) -> str:
+        return self.api("POST", "/api/v1/auth/login",
+                        {"username": "determined", "password": ""})["token"]
+
+
+@pytest.fixture()
+def cluster(tmp_path, native_binaries):
+    c = Devcluster(str(tmp_path), native_binaries)
+    c.start_master()
+    c.start_agent()
+    yield c
+    c.stop()
+
+
+def _experiment_config(tmp_path, searcher=None, extra=None):
+    config = {
+        "name": "e2e-fixture",
+        "entrypoint": "python3 train.py",
+        "searcher": searcher
+        or {
+            "name": "single",
+            "metric": "val_loss",
+            "max_length": {"batches": 8},
+        },
+        "hyperparameters": {"lr": 0.5},
+        "checkpoint_storage": {
+            "type": "shared_fs",
+            "host_path": os.path.join(str(tmp_path), "checkpoints"),
+        },
+        "resources": {"slots_per_trial": 1},
+        "max_restarts": 1,
+    }
+    config.update(extra or {})
+    return config
+
+
+def _create_experiment(cluster, config, activate=True):
+    import determined_tpu.cli as cli
+
+    token = cluster.login()
+    model_def = cli._tar_context(FIXTURES)
+    resp = cluster.api(
+        "POST", "/api/v1/experiments",
+        {"config": config, "model_definition": model_def, "activate": activate},
+        token=token,
+    )
+    return resp["id"], token
+
+
+def _wait_experiment(cluster, eid, token, timeout=120.0, want=("COMPLETED",)):
+    deadline = time.time() + timeout
+    state = None
+    while time.time() < deadline:
+        state = cluster.api("GET", f"/api/v1/experiments/{eid}", token=token)[
+            "experiment"]["state"]
+        if state in ("COMPLETED", "CANCELED", "ERROR"):
+            assert state in want, f"experiment finished {state}, wanted {want}"
+            return state
+        time.sleep(0.5)
+    raise TimeoutError(f"experiment {eid} stuck in {state}")
+
+
+# ---------------------------------------------------------------------------
+
+
+def test_master_info_and_agent_registration(cluster):
+    info = cluster.api("GET", "/api/v1/master")
+    assert info["cluster_name"] == "determined-tpu"
+    agents = cluster.api("GET", "/api/v1/agents")["agents"]
+    assert len(agents) == 1
+    assert len(agents[0]["slots"]) == 2
+
+
+def test_single_experiment_end_to_end(cluster, tmp_path):
+    eid, token = _create_experiment(cluster, _experiment_config(tmp_path))
+    _wait_experiment(cluster, eid, token)
+
+    trials = cluster.api("GET", f"/api/v1/experiments/{eid}/trials", token=token)[
+        "trials"]
+    assert len(trials) == 1
+    trial = trials[0]
+    assert trial["state"] == "COMPLETED"
+    assert trial["total_batches"] >= 8
+
+    metrics = cluster.api(
+        "GET", f"/api/v1/trials/{trial['id']}/metrics?group=training", token=token
+    )["metrics"]
+    assert metrics, "training metrics should be reported"
+    val = cluster.api(
+        "GET", f"/api/v1/trials/{trial['id']}/metrics?group=validation", token=token
+    )["metrics"]
+    assert val and "val_loss" in val[-1]["metrics"]
+
+    cps = cluster.api(
+        "GET", f"/api/v1/experiments/{eid}/checkpoints", token=token
+    )["checkpoints"]
+    assert cps, "checkpoint should be reported"
+    ckpt_dir = os.path.join(str(tmp_path), "checkpoints", cps[-1]["uuid"])
+    assert os.path.exists(os.path.join(ckpt_dir, "state.json"))
+
+    logs = cluster.api(
+        "GET", f"/api/v1/tasks/trial-{trial['id']}/logs?offset=0", token=token
+    )["logs"]
+    assert any("trial complete" in line["log"] for line in logs)
+
+
+def test_asha_search_end_to_end(cluster, tmp_path):
+    searcher = {
+        "name": "async_halving",
+        "metric": "val_loss",
+        "max_length": {"batches": 8},
+        "num_rungs": 2,
+        "divisor": 2,
+        "max_trials": 4,
+        "max_concurrent_trials": 2,
+    }
+    config = _experiment_config(
+        tmp_path, searcher=searcher,
+        extra={"hyperparameters": {"lr": {"type": "log", "minval": -2,
+                                          "maxval": 0}}},
+    )
+    eid, token = _create_experiment(cluster, config)
+    _wait_experiment(cluster, eid, token, timeout=180.0)
+    trials = cluster.api("GET", f"/api/v1/experiments/{eid}/trials", token=token)[
+        "trials"]
+    assert len(trials) == 4
+    assert all(t["state"] == "COMPLETED" for t in trials)
+    # rung geometry (cumulative, reference asha.go:62-66): rung0 = 8/2 = 4,
+    # rung1 = 4 + 8 = 12. Everyone reaches 4; promoted trials reach 12.
+    batches = sorted(t["total_batches"] for t in trials)
+    assert batches[0] >= 4
+    assert batches[-1] >= 12
+
+
+def test_pause_resume_preempts_and_resumes_from_checkpoint(cluster, tmp_path):
+    config = _experiment_config(
+        tmp_path,
+        searcher={"name": "single", "metric": "val_loss",
+                  "max_length": {"batches": 200}},
+    )
+    config["environment"] = {"TRIAL_STEP_SLEEP": "0.05"}
+    eid, token = _create_experiment(cluster, config)
+
+    # Let it run a bit, then pause (→ preemption signal → checkpoint+exit).
+    time.sleep(4.0)
+    cluster.api("POST", f"/api/v1/experiments/{eid}/pause", token=token)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        trials = cluster.api(
+            "GET", f"/api/v1/experiments/{eid}/trials", token=token)["trials"]
+        if trials and trials[0].get("latest_checkpoint"):
+            break
+        time.sleep(0.5)
+    trials = cluster.api("GET", f"/api/v1/experiments/{eid}/trials", token=token)[
+        "trials"]
+    assert trials[0]["latest_checkpoint"], "pause should checkpoint the trial"
+
+    cluster.api("POST", f"/api/v1/experiments/{eid}/activate", token=token)
+    _wait_experiment(cluster, eid, token, timeout=180.0)
+    logs = cluster.api(
+        "GET", f"/api/v1/tasks/trial-{trials[0]['id']}/logs?offset=0",
+        token=token)["logs"]
+    assert any("resumed from checkpoint" in line["log"] for line in logs)
+
+
+def test_master_restart_restores_experiment(cluster, tmp_path):
+    config = _experiment_config(
+        tmp_path,
+        searcher={"name": "single", "metric": "val_loss",
+                  "max_length": {"batches": 120}},
+    )
+    config["environment"] = {"TRIAL_STEP_SLEEP": "0.05"}
+    eid, token = _create_experiment(cluster, config)
+    time.sleep(3.0)
+
+    cluster.kill_master()
+    time.sleep(1.0)
+    cluster.start_master()  # same db; snapshot restore (restore.go analogue)
+    token = cluster.login()
+
+    _wait_experiment(cluster, eid, token, timeout=180.0)
+    trials = cluster.api("GET", f"/api/v1/experiments/{eid}/trials", token=token)[
+        "trials"]
+    assert trials[0]["state"] == "COMPLETED"
+
+
+def test_cancel_experiment(cluster, tmp_path):
+    config = _experiment_config(
+        tmp_path,
+        searcher={"name": "single", "metric": "val_loss",
+                  "max_length": {"batches": 10000}},
+    )
+    config["environment"] = {"TRIAL_STEP_SLEEP": "0.05"}
+    eid, token = _create_experiment(cluster, config)
+    time.sleep(3.0)
+    cluster.api("POST", f"/api/v1/experiments/{eid}/cancel", token=token)
+    state = _wait_experiment(cluster, eid, token, timeout=60.0,
+                             want=("CANCELED", "COMPLETED"))
+    assert state in ("CANCELED", "COMPLETED")
+
+
+def test_cli_workflow(cluster, tmp_path, monkeypatch, capsys):
+    """Drive the same flow through the det CLI."""
+    import determined_tpu.cli as cli
+
+    monkeypatch.setattr(cli, "TOKEN_CACHE",
+                        os.path.join(str(tmp_path), "tokens.json"))
+    cfg_path = os.path.join(str(tmp_path), "config.json")
+    with open(cfg_path, "w") as f:
+        json.dump(_experiment_config(tmp_path), f)
+
+    rc = cli.main(["-m", cluster.master_url, "experiment", "create",
+                   cfg_path, FIXTURES, "--follow"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Created experiment" in out
+    assert "COMPLETED" in out
+
+    rc = cli.main(["-m", cluster.master_url, "experiment", "list"])
+    assert rc == 0
+    assert "e2e-fixture" in capsys.readouterr().out
+
+    rc = cli.main(["-m", cluster.master_url, "agent", "list"])
+    assert rc == 0
+    assert "agent-0" in capsys.readouterr().out
