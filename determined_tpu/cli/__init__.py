@@ -261,8 +261,89 @@ def cmd_task_logs(session: Session, args) -> int:
 
 
 # ---------------------------------------------------------------------------
+# NTSC task commands (reference: cli command/notebook/shell/tensorboard)
+# ---------------------------------------------------------------------------
+
+
+def cmd_ntsc(session: Session, args) -> int:
+    kind = args.kind  # commands | notebooks | shells | tensorboards
+    if args.action == "list":
+        tasks = session.get(f"/api/v1/{kind}")[kind]
+        rows = [
+            {
+                "id": t["id"],
+                "state": t.get("allocation_state", t["state"]),
+                "started": t.get("start_time", ""),
+                "address": t.get("proxy_address", ""),
+            }
+            for t in tasks
+        ]
+        _print_table(rows, ["id", "state", "started", "address"])
+        return 0
+    if args.action == "kill":
+        session.post(f"/api/v1/{kind}/{args.task_id}/kill")
+        print(f"killed {args.task_id}")
+        return 0
+    if args.action == "logs":
+        ns = argparse.Namespace(task_id=args.task_id, follow=args.follow)
+        return cmd_task_logs(session, ns)
+    # start / run
+    config: Dict[str, Any] = {}
+    if getattr(args, "config_file", None):
+        config = _load_config_file(args.config_file)
+    if getattr(args, "cmd", None):
+        config["entrypoint"] = args.cmd
+    if getattr(args, "experiment_ids", None):
+        config["experiment_ids"] = args.experiment_ids
+    resp = session.post(f"/api/v1/{kind}", body={"config": config})
+    print(f"Started {resp['id']} (allocation {resp['allocation_id']})")
+    if kind in ("notebooks", "tensorboards"):
+        # Wait briefly for the server address to be reported.
+        for _ in range(60):
+            task = session.get(f"/api/v1/{kind}/{resp['id']}")["task"]
+            if task.get("proxy_address"):
+                print(f"Serving at {task['proxy_address']}")
+                break
+            state = task.get("allocation_state", "")
+            if state == "TERMINATED":
+                print("task exited before serving; check `det task logs`")
+                return 1
+            time.sleep(1.0)
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # admin / registry commands
 # ---------------------------------------------------------------------------
+
+
+def cmd_deploy(session: Session, args) -> int:
+    from determined_tpu import deploy as deploy_mod
+
+    if args.target == "local":
+        if args.action == "up":
+            state = deploy_mod.cluster_up(port=args.port, agents=args.agents,
+                                          slots=args.slots)
+            print(f"cluster up: master pid {state['master_pid']} on port "
+                  f"{state['port']}; logs in {state['logs']}")
+        elif args.action == "down":
+            print("cluster stopped" if deploy_mod.cluster_down()
+                  else "no local cluster running")
+        else:
+            state = deploy_mod.cluster_status()
+            if state is None:
+                print("no local cluster running")
+            else:
+                print(json.dumps(state, indent=2))
+    else:  # gcp
+        from determined_tpu.deploy import gcp
+
+        out = gcp.generate(args.target_dir, project=args.project,
+                           zone=args.zone,
+                           accelerator_type=args.accelerator_type,
+                           num_slices=args.num_slices)
+        print(f"terraform written to {out}; review then `terraform apply`")
+    return 0
 
 
 def cmd_master_info(session: Session, args) -> int:
@@ -421,6 +502,29 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("-f", "--follow", action="store_true")
     t.set_defaults(func=cmd_task_logs)
 
+    for cli_name, kind in (("cmd", "commands"), ("notebook", "notebooks"),
+                           ("shell", "shells"), ("tensorboard", "tensorboards")):
+        nt = sub.add_parser(cli_name).add_subparsers(dest="subcommand",
+                                                     required=True)
+        start = nt.add_parser("run" if cli_name == "cmd" else "start")
+        if cli_name == "cmd":
+            # REMAINDER so flags in the command (`det cmd run ls -la`)
+            # reach the task instead of argparse.
+            start.add_argument("cmd", nargs=argparse.REMAINDER)
+        if cli_name == "tensorboard":
+            start.add_argument("experiment_ids", type=int, nargs="+")
+        start.add_argument("--config-file")
+        start.set_defaults(func=cmd_ntsc, kind=kind, action="start")
+        nt.add_parser("list").set_defaults(func=cmd_ntsc, kind=kind,
+                                           action="list")
+        k = nt.add_parser("kill")
+        k.add_argument("task_id")
+        k.set_defaults(func=cmd_ntsc, kind=kind, action="kill")
+        lg = nt.add_parser("logs")
+        lg.add_argument("task_id")
+        lg.add_argument("-f", "--follow", action="store_true")
+        lg.set_defaults(func=cmd_ntsc, kind=kind, action="logs")
+
     m = sub.add_parser("master").add_subparsers(dest="subcommand", required=True)
     m.add_parser("info").set_defaults(func=cmd_master_info)
 
@@ -467,6 +571,25 @@ def build_parser() -> argparse.ArgumentParser:
     mvs.add_argument("name")
     mvs.set_defaults(func=cmd_model, action="versions")
 
+    dp = sub.add_parser("deploy").add_subparsers(dest="subcommand", required=True)
+    dl = dp.add_parser("local").add_subparsers(dest="subsubcommand", required=True)
+    up = dl.add_parser("up")
+    up.add_argument("--port", type=int, default=8080)
+    up.add_argument("--agents", type=int, default=1)
+    up.add_argument("--slots", type=int, default=None)
+    up.set_defaults(func=cmd_deploy, target="local", action="up")
+    dl.add_parser("down").set_defaults(func=cmd_deploy, target="local",
+                                       action="down")
+    dl.add_parser("status").set_defaults(func=cmd_deploy, target="local",
+                                         action="status")
+    dg = dp.add_parser("gcp")
+    dg.add_argument("target_dir")
+    dg.add_argument("--project", required=True)
+    dg.add_argument("--zone", default="us-east5-b")
+    dg.add_argument("--accelerator-type", default="v5litepod-8")
+    dg.add_argument("--num-slices", type=int, default=1)
+    dg.set_defaults(func=cmd_deploy, target="gcp")
+
     tp = sub.add_parser("template").add_subparsers(dest="subcommand", required=True)
     tp.add_parser("list").set_defaults(func=cmd_template, action="list")
     ts = tp.add_parser("set")
@@ -479,7 +602,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    session = _login(args.master, args.user)
+    # deploy commands manage the cluster itself — no session/login.
+    session = None if args.func is cmd_deploy else _login(args.master, args.user)
     try:
         return args.func(session, args)
     except APIError as e:

@@ -60,8 +60,11 @@ class Context:
 
     def close(self) -> None:
         # Order matters (reference _context.py:79-118): drain checkpoint
-        # writes first, then stop watchers, then tear down distributed.
+        # writes first, final tensorboard sync, then stop watchers, then
+        # tear down distributed.
         self.checkpoint.close()
+        if getattr(self.train, "_tb", None) is not None:
+            self.train._tb.close()
         self.profiler.close()
         self.preempt.close()
         self.distributed.shutdown()
@@ -107,7 +110,28 @@ def init(
 
     storage = storage_from_config(storage_config, default_base=checkpoint_dir)
 
-    train = TrainContext(session, trial_id=trial_id, run_id=run_id, distributed=distributed)
+    # Per-trial tfevents written locally + synced into checkpoint storage
+    # (reference tensorboard/base.py async upload thread); chief only.
+    tb_manager = None
+    if info is not None and info.trial is not None and (
+        distributed is None or distributed.is_chief
+    ):
+        from determined_tpu.tensorboard import TensorboardManager
+
+        try:
+            tb_manager = TensorboardManager(
+                storage, info.trial.experiment_id, info.trial.trial_id
+            )
+        except Exception:
+            logger.debug("tensorboard manager unavailable", exc_info=True)
+
+    train = TrainContext(
+        session,
+        trial_id=trial_id,
+        run_id=run_id,
+        distributed=distributed,
+        tensorboard_manager=tb_manager,
+    )
     searcher = SearcherContext(
         session,
         trial_id=trial_id,

@@ -39,11 +39,13 @@ class TrainContext:
         trial_id: int = 0,
         run_id: int = 0,
         distributed=None,
+        tensorboard_manager=None,
     ):
         self._session = session
         self._trial_id = trial_id
         self._run_id = run_id
         self._dist = distributed
+        self._tb = tensorboard_manager
         # local-mode metric store (inspectable by tests / local callers)
         self.local_training_metrics: List[Dict[str, Any]] = []
         self.local_validation_metrics: List[Dict[str, Any]] = []
@@ -52,6 +54,8 @@ class TrainContext:
         if self._dist is not None and not self._dist.is_chief:
             return
         metrics = _clean_metrics(metrics)
+        if self._tb is not None:
+            self._tb.on_metrics(group, steps_completed, metrics)
         record = {
             "trial_id": self._trial_id,
             "trial_run_id": self._run_id,

@@ -1,0 +1,15 @@
+"""`det deploy` — cluster deployment tooling.
+
+Reference: harness/determined/deploy/ — `local` (docker compose of
+master+db+agent, cluster_utils.py:56), `gcp` (terraform driven from python,
+gcp/gcp.py:35), `aws` (CloudFormation). TPU-native differences:
+
+- `local` runs the native master+agent binaries as host processes (no
+  docker dependency; the binaries are self-contained).
+- `gcp` generates terraform for **TPU-VM pod slices** (google_tpu_v2_vm)
+  with the agent in each VM's startup script, instead of GPU instance
+  groups. Applying it is left to the operator (`terraform apply`) so no
+  cloud credentials are needed here.
+"""
+
+from determined_tpu.deploy.local import cluster_up, cluster_down, cluster_status  # noqa: F401

@@ -1,0 +1,122 @@
+"""`det deploy gcp` — terraform generator for TPU-VM clusters.
+
+Reference: harness/determined/deploy/gcp/gcp.py:35 (terraform plan/apply
+driven from python over templates in deploy/gcp/terraform/). Here the
+deployment target is TPU-native: a master VM and one or more **TPU-VM pod
+slices** (`google_tpu_v2_vm`), each worker host running the native agent
+from its startup script. The generator writes a self-contained terraform
+dir; the operator reviews and applies it (no cloud credentials are touched
+from inside this tool).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+STARTUP_SCRIPT = """#!/bin/bash
+set -ex
+# determined-tpu agent bootstrap (runs on every TPU-VM worker host)
+mkdir -p /opt/determined-tpu
+gsutil cp gs://${artifact_bucket}/determined-agent /opt/determined-tpu/
+gsutil -m cp -r gs://${artifact_bucket}/determined_tpu /opt/determined-tpu/
+chmod +x /opt/determined-tpu/determined-agent
+export PYTHONPATH=/opt/determined-tpu:$PYTHONPATH
+/opt/determined-tpu/determined-agent \\
+  --master-url http://${master_addr}:8080 \\
+  --id "$(hostname)" \\
+  --resource-pool ${resource_pool} \\
+  --addr "$(hostname -I | awk '{print $1}')" \\
+  --work-root /var/determined-tpu/work &
+"""
+
+
+def generate(
+    target_dir: str,
+    project: str,
+    zone: str = "us-east5-b",
+    accelerator_type: str = "v5litepod-8",
+    num_slices: int = 1,
+    artifact_bucket: str = "my-determined-tpu-artifacts",
+    resource_pool: str = "default",
+) -> str:
+    """Write main.tf + terraform.tfvars.json; returns the directory."""
+    os.makedirs(target_dir, exist_ok=True)
+
+    main_tf = """
+terraform {
+  required_providers {
+    google = { source = "hashicorp/google" }
+  }
+}
+
+provider "google" {
+  project = var.project
+  zone    = var.zone
+}
+
+variable "project" { type = string }
+variable "zone" { type = string }
+variable "accelerator_type" { type = string }
+variable "num_slices" { type = number }
+variable "artifact_bucket" { type = string }
+variable "resource_pool" { type = string }
+
+# Master control-plane VM (CPU-only; serves the REST API + scheduler).
+resource "google_compute_instance" "master" {
+  name         = "determined-tpu-master"
+  machine_type = "n2-standard-8"
+  boot_disk {
+    initialize_params { image = "debian-cloud/debian-12" }
+  }
+  network_interface {
+    network = "default"
+    access_config {}
+  }
+  metadata_startup_script = <<-EOT
+    #!/bin/bash
+    set -ex
+    mkdir -p /opt/determined-tpu /var/determined-tpu
+    gsutil cp gs://${var.artifact_bucket}/determined-master /opt/determined-tpu/
+    chmod +x /opt/determined-tpu/determined-master
+    /opt/determined-tpu/determined-master --port 8080 \\
+      --db /var/determined-tpu/master.db &
+  EOT
+}
+
+# TPU pod slices; every worker host runs the agent and owns its local chips.
+resource "google_tpu_v2_vm" "slice" {
+  count            = var.num_slices
+  name             = "determined-tpu-slice-${count.index}"
+  zone             = var.zone
+  runtime_version  = "tpu-ubuntu2204-base"
+  accelerator_type = var.accelerator_type
+  metadata = {
+    startup-script = templatefile("${path.module}/agent-startup.sh.tftpl", {
+      artifact_bucket = var.artifact_bucket
+      master_addr     = google_compute_instance.master.network_interface[0].network_ip
+      resource_pool   = var.resource_pool
+    })
+  }
+}
+
+output "master_ip" {
+  value = google_compute_instance.master.network_interface[0].access_config[0].nat_ip
+}
+"""
+    with open(os.path.join(target_dir, "main.tf"), "w") as f:
+        f.write(main_tf)
+    with open(os.path.join(target_dir, "agent-startup.sh.tftpl"), "w") as f:
+        f.write(STARTUP_SCRIPT)
+    tfvars: Dict[str, Any] = {
+        "project": project,
+        "zone": zone,
+        "accelerator_type": accelerator_type,
+        "num_slices": num_slices,
+        "artifact_bucket": artifact_bucket,
+        "resource_pool": resource_pool,
+    }
+    with open(os.path.join(target_dir, "terraform.tfvars.json"), "w") as f:
+        json.dump(tfvars, f, indent=2)
+    return target_dir

@@ -1,0 +1,179 @@
+"""`det deploy local` — single-box cluster of native master + agent(s).
+
+Reference: deploy/local/cluster_utils.py (docker-based fixture_up/down);
+here the native binaries run as supervised host processes with state in
+``~/.config/determined_tpu/local-cluster.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+from typing import Any, Dict, Optional
+
+STATE_FILE = os.path.expanduser("~/.config/determined_tpu/local-cluster.json")
+
+
+def _find_bin(name: str) -> str:
+    candidates = [
+        os.path.join(os.path.dirname(__file__), "..", "..", "native", "bin", name),
+        os.path.join(os.environ.get("DET_NATIVE_BIN", ""), name),
+    ]
+    for c in candidates:
+        c = os.path.abspath(c)
+        if os.path.isfile(c) and os.access(c, os.X_OK):
+            return c
+    raise FileNotFoundError(
+        f"{name} not found; build it with `make -C native` or set DET_NATIVE_BIN"
+    )
+
+
+def _save_state(state: Dict[str, Any]) -> None:
+    os.makedirs(os.path.dirname(STATE_FILE), exist_ok=True)
+    with open(STATE_FILE, "w") as f:
+        json.dump(state, f)
+
+
+def _load_state() -> Optional[Dict[str, Any]]:
+    try:
+        with open(STATE_FILE) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def cluster_up(
+    port: int = 8080,
+    agents: int = 1,
+    slots: Optional[int] = None,
+    db_path: Optional[str] = None,
+    work_root: Optional[str] = None,
+    wait_s: float = 20.0,
+) -> Dict[str, Any]:
+    if _load_state() is not None:
+        raise RuntimeError("local cluster already running; `det deploy local down` first")
+    base = os.path.expanduser("~/.local/share/determined_tpu")
+    os.makedirs(base, exist_ok=True)
+    db_path = db_path or os.path.join(base, "master.db")
+    work_root = work_root or os.path.join(base, "agent-work")
+    master_log = os.path.join(base, "master.log")
+
+    master = subprocess.Popen(
+        [_find_bin("determined-master"), "--port", str(port), "--db", db_path],
+        stdout=open(master_log, "a"), stderr=subprocess.STDOUT,
+        start_new_session=True,
+    )
+    url = f"http://127.0.0.1:{port}"
+    deadline = time.time() + wait_s
+    while time.time() < deadline:
+        try:
+            urllib.request.urlopen(url + "/api/v1/master", timeout=2)
+            break
+        except Exception:
+            time.sleep(0.3)
+    else:
+        master.kill()
+        raise RuntimeError(f"master did not come up; see {master_log}")
+
+    env = dict(os.environ)
+    repo_root = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    agent_pids = []
+    for i in range(agents):
+        cmd = [
+            _find_bin("determined-agent"), "--master-url", url,
+            "--id", f"agent-{i}", "--addr", "127.0.0.1",
+            "--work-root", work_root,
+        ]
+        if slots is not None:
+            cmd += ["--slots", str(slots), "--slot-type", "cpu"]
+        agent = subprocess.Popen(
+            cmd, env=env,
+            stdout=open(os.path.join(base, f"agent-{i}.log"), "a"),
+            stderr=subprocess.STDOUT, start_new_session=True,
+        )
+        agent_pids.append(agent.pid)
+
+    state = {"master_pid": master.pid, "agent_pids": agent_pids,
+             "port": port, "db_path": db_path, "logs": base}
+    _save_state(state)
+    return state
+
+
+def cluster_down(drain_timeout: float = 20.0) -> bool:
+    state = _load_state()
+    if state is None:
+        return False
+    # Task processes live in their own process groups (the agent detaches
+    # them), so killing the daemons alone would orphan running trials/NTSC
+    # tasks. Ask the master to kill all active work first and let the agents
+    # deliver the kills.
+    url = f"http://127.0.0.1:{state['port']}"
+    try:
+        _kill_all_work(url, drain_timeout)
+    except Exception:
+        pass  # master already dead — nothing to drain
+    for pid in state.get("agent_pids", []) + [state.get("master_pid")]:
+        if pid:
+            try:
+                os.killpg(os.getpgid(pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+    os.unlink(STATE_FILE)
+    return True
+
+
+def _kill_all_work(url: str, drain_timeout: float) -> None:
+    import json as jsonlib
+
+    def api(method: str, path: str, body: Optional[dict] = None,
+            token: Optional[str] = None):
+        req = urllib.request.Request(
+            url + path,
+            data=jsonlib.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json",
+                     **({"Authorization": f"Bearer {token}"} if token else {})},
+            method=method,
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            text = resp.read().decode()
+            return jsonlib.loads(text) if text else None
+
+    token = api("POST", "/api/v1/auth/login",
+                {"username": "determined", "password": ""})["token"]
+    for exp in api("GET", "/api/v1/experiments", token=token)["experiments"]:
+        if exp["state"] not in ("COMPLETED", "CANCELED", "ERROR", "DELETED"):
+            api("POST", f"/api/v1/experiments/{exp['id']}/kill", token=token)
+    for kind in ("commands", "notebooks", "shells", "tensorboards"):
+        for task in api("GET", f"/api/v1/{kind}", token=token)[kind]:
+            if task.get("allocation_state") not in (None, "TERMINATED"):
+                api("POST", f"/api/v1/{kind}/{task['id']}/kill", token=token)
+    # Give agents a moment to deliver SIGTERM/SIGKILL to task groups.
+    deadline = time.time() + drain_timeout
+    while time.time() < deadline:
+        jobs = api("GET", "/api/v1/job-queues", token=token)["jobs"]
+        if not jobs:
+            return
+        time.sleep(0.5)
+
+
+def cluster_status() -> Optional[Dict[str, Any]]:
+    state = _load_state()
+    if state is None:
+        return None
+
+    def alive(pid: int) -> bool:
+        try:
+            os.kill(pid, 0)
+            return True
+        except (ProcessLookupError, PermissionError):
+            return False
+
+    state["master_alive"] = alive(state["master_pid"])
+    state["agents_alive"] = sum(1 for p in state["agent_pids"] if alive(p))
+    return state

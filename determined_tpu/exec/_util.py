@@ -1,0 +1,33 @@
+"""Shared helpers for NTSC task entrypoints."""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+
+from determined_tpu.common.api import Session
+
+logger = logging.getLogger("determined_tpu.exec")
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def report_proxy_address(addr: str) -> None:
+    """Report the serving URL for this allocation to the master
+    (PostAllocationProxyAddress analogue); no-op outside a cluster."""
+    master = os.environ.get("DET_MASTER")
+    allocation_id = os.environ.get("DET_ALLOCATION_ID")
+    if not master or not allocation_id:
+        return
+    try:
+        Session(master, os.environ.get("DET_SESSION_TOKEN")).post(
+            f"/api/v1/allocations/{allocation_id}/proxy_address",
+            body={"rank": 0, "address": addr},
+        )
+    except Exception:
+        logger.warning("failed to report proxy address", exc_info=True)

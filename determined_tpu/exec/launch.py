@@ -36,6 +36,13 @@ def build_command() -> list:
     entrypoint = config.get("entrypoint")
     if entrypoint is None:
         entrypoint = os.environ.get("DET_ENTRYPOINT")
+        # Array entrypoints travel as JSON to keep argument boundaries
+        # exact (a space-joined string would re-split wrongly).
+        if entrypoint and entrypoint.lstrip().startswith("["):
+            try:
+                entrypoint = json.loads(entrypoint)
+            except ValueError:
+                pass
     if entrypoint is None:
         raise RuntimeError("no entrypoint in experiment config")
     if isinstance(entrypoint, list):

@@ -1,0 +1,42 @@
+"""Notebook NTSC task entrypoint (reference: notebook task container
+running jupyter, master/internal/command/). Requires jupyter in the task
+environment; reports the server URL as the allocation proxy address."""
+
+from __future__ import annotations
+
+import logging
+import socket
+import subprocess
+import sys
+
+from determined_tpu.exec._util import free_port, report_proxy_address
+
+logger = logging.getLogger("determined_tpu.exec.notebook")
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    try:
+        import notebook  # noqa: F401
+    except ImportError:
+        print(
+            "jupyter `notebook` is not installed in this task environment; "
+            "install it in the environment image to use notebook tasks",
+            file=sys.stderr,
+        )
+        return 1
+
+    port = free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "notebook", "--ip=0.0.0.0",
+         f"--port={port}", "--no-browser", "--allow-root",
+         "--NotebookApp.token=", "--NotebookApp.password="],
+    )
+    addr = f"http://{socket.gethostname()}:{port}"
+    report_proxy_address(addr)
+    logger.info("notebook at %s", addr)
+    return proc.wait()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,68 @@
+"""TensorBoard NTSC task entrypoint.
+
+Reference: harness/determined/exec/tensorboard.py — fetch per-trial tfevents
+from checkpoint storage, serve them with the tensorboard binary, keep
+re-syncing while experiments are live, and report the serving address to the
+master (PostAllocationProxyAddress analogue).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import subprocess
+import sys
+import time
+
+from determined_tpu.common.api import Session
+from determined_tpu.exec._util import free_port, report_proxy_address
+from determined_tpu.storage import from_config as storage_from_config
+from determined_tpu.tensorboard import fetch_experiment_logs
+
+logger = logging.getLogger("determined_tpu.exec.tensorboard")
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    master = os.environ.get("DET_MASTER")
+    session = Session(master, os.environ.get("DET_SESSION_TOKEN")) if master else None
+    exp_ids = json.loads(os.environ.get("DET_EXPERIMENT_IDS", "[]"))
+    allocation_id = os.environ.get("DET_ALLOCATION_ID")
+    logdir = os.path.abspath("tb_logs")
+    os.makedirs(logdir, exist_ok=True)
+
+    storages = {}
+    if session is not None:
+        for eid in exp_ids:
+            config = session.get(f"/api/v1/experiments/{eid}")["experiment"]["config"]
+            storages[eid] = storage_from_config(config.get("checkpoint_storage"))
+
+    def sync_all() -> None:
+        for eid, storage in storages.items():
+            fetch_experiment_logs(storage, eid, logdir)
+
+    sync_all()
+
+    port = int(os.environ.get("TENSORBOARD_PORT", "0")) or free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tensorboard.main", "--logdir", logdir,
+         "--port", str(port), "--host", "0.0.0.0",
+         "--reload_interval", "15"],
+    )
+    addr = f"http://{socket.gethostname()}:{port}"
+    logger.info("tensorboard serving %s at %s", exp_ids, addr)
+    report_proxy_address(addr)
+
+    try:
+        while proc.poll() is None:
+            time.sleep(30.0)
+            sync_all()
+    except KeyboardInterrupt:
+        proc.terminate()
+    return proc.wait()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

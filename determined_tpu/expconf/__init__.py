@@ -95,9 +95,8 @@ def validate(config: Dict[str, Any]) -> List[str]:
                 errors.append("searcher.metric is required")
             if _length_units(searcher.get("max_length")) in (None, 0):
                 errors.append("searcher.max_length is required (batches)")
-        if name in ("random", "async_halving", "adaptive_asha", "adaptive"):
-            if name == "random" and not searcher.get("max_trials"):
-                errors.append("searcher.max_trials is required for random search")
+        if name == "random" and not searcher.get("max_trials"):
+            errors.append("searcher.max_trials is required for random search")
         if name in ("async_halving", "sync_halving"):
             if not searcher.get("num_rungs"):
                 errors.append("searcher.num_rungs is required for async_halving")

@@ -1,0 +1,13 @@
+"""Python SDK (reference: determined.experimental.client,
+harness/determined/experimental/client.py + common/experimental/*)."""
+
+from determined_tpu.experimental.client import (  # noqa: F401
+    Checkpoint,
+    Determined,
+    Experiment,
+    Model,
+    ModelVersion,
+    Trial,
+    create_experiment,
+    login,
+)

@@ -1,0 +1,2 @@
+"""Framework integrations (reference: harness/determined/transformers/ and
+model_hub/)."""

@@ -1,0 +1,124 @@
+"""DetCallback — HuggingFace Trainer bridge.
+
+Reference: harness/determined/transformers/_hf_callback.py:14 — a
+`transformers.TrainerCallback` that reports train/eval metrics to the Core
+API (:69,:80), drives searcher ops (:31-48,:90), uploads HF checkpoints
+(:111-132) and honors preemption (:97). This is the north-star GPT-2
+workload path (examples/hf_trainer_api).
+
+On TPU the HF Trainer runs via torch-xla when available; the callback is
+backend-agnostic — it only speaks the Core API.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, Optional
+
+import transformers
+
+from determined_tpu import core
+
+logger = logging.getLogger("determined_tpu.integrations.transformers")
+
+
+class DetCallback(transformers.TrainerCallback):
+    def __init__(
+        self,
+        core_context: core.Context,
+        args: Optional[transformers.TrainingArguments] = None,
+        metric_name: Optional[str] = None,
+    ) -> None:
+        self.core = core_context
+        self.metric_name = metric_name or self._searcher_metric()
+        self.last_eval: Dict[str, Any] = {}
+        self.searcher_ops = None
+        self.current_op = None
+
+    def _searcher_metric(self) -> Optional[str]:
+        info = self.core.info
+        if info and info.trial:
+            return info.trial.config.get("searcher", {}).get("metric")
+        return None
+
+    # -- searcher ops (reference :31-48) --------------------------------
+    def _ensure_op(self, state: transformers.TrainerState,
+                   control: transformers.TrainerControl) -> None:
+        if self.searcher_ops is None:
+            self.searcher_ops = self.core.searcher.operations()
+        if self.current_op is None:
+            try:
+                self.current_op = next(self.searcher_ops)
+            except StopIteration:
+                control.should_training_stop = True
+
+    def on_step_end(self, args, state, control, **kwargs):
+        self._ensure_op(state, control)
+        if self.current_op is not None and state.global_step >= self.current_op.length:
+            control.should_evaluate = True
+        # Preemption (reference :97): checkpoint then stop.
+        if self.core.preempt.should_preempt():
+            control.should_save = True
+            control.should_training_stop = True
+        return control
+
+    # -- metrics (reference :69,:80) ------------------------------------
+    def on_log(self, args, state, control, logs=None, **kwargs):
+        if not logs:
+            return
+        metrics = {k: v for k, v in logs.items()
+                   if isinstance(v, (int, float))}
+        if any(k.startswith("eval_") for k in metrics):
+            self.core.train.report_validation_metrics(state.global_step, metrics)
+        else:
+            self.core.train.report_training_metrics(state.global_step, metrics)
+
+    def on_evaluate(self, args, state, control, metrics=None, **kwargs):
+        metrics = metrics or {}
+        self.last_eval = metrics
+        self.core.train.report_validation_metrics(state.global_step, metrics)
+        self._ensure_op(state, control)
+        if self.current_op is not None and state.global_step >= self.current_op.length:
+            name = self.metric_name or "eval_loss"
+            if name not in metrics:
+                if "eval_loss" not in metrics:
+                    raise KeyError(
+                        f"searcher metric {name!r} not in eval metrics "
+                        f"{sorted(metrics)}"
+                    )
+                logger.warning("searcher metric %r missing; using eval_loss", name)
+                name = "eval_loss"
+            self.current_op.report_completed(float(metrics[name]))
+            self.current_op = None
+            self._ensure_op(state, control)
+            if self.current_op is None:
+                control.should_training_stop = True
+        return control
+
+    # -- checkpoints (reference :111-132) -------------------------------
+    def on_save(self, args, state, control, **kwargs):
+        ckpt_dir = transformers.trainer_utils.get_last_checkpoint(args.output_dir)
+        if ckpt_dir is None:
+            return
+        storage_id = self.core.checkpoint.upload(
+            ckpt_dir,
+            metadata={
+                "steps_completed": state.global_step,
+                "framework": "transformers",
+                "hf_checkpoint_name": os.path.basename(ckpt_dir),
+            },
+            shard=self.core.distributed is not None
+            and self.core.distributed.size > 1,
+        )
+        logger.info("uploaded HF checkpoint %s as %s", ckpt_dir, storage_id)
+
+    @staticmethod
+    def resume_checkpoint_dir(core_context: core.Context, local_dir: str) -> Optional[str]:
+        """Download info.latest_checkpoint for Trainer(resume_from_checkpoint=…)."""
+        latest = core_context.latest_checkpoint
+        if not latest:
+            return None
+        dest = os.path.join(local_dir, latest)
+        core_context.checkpoint.download(latest, dest)
+        return dest

@@ -1,0 +1,23 @@
+"""Keras trial API.
+
+Reference: harness/determined/keras/ (~3.1k LoC) — TFKerasTrial
+(_tf_keras_trial.py:975), a class API where the user builds a compiled
+model + data, and the controller (:171) drives fit/evaluate per searcher op
+with a callback reporting to the platform.
+
+TPU stance: the reference's Keras path is TF + Horovod only
+(_tf_keras_trial.py:284-286). Here the trial targets **Keras 3**, whose JAX
+backend runs natively on TPU through the same XLA stack as the rest of this
+framework — set ``KERAS_BACKEND=jax`` in the task environment (the image
+default). TF-backend models keep working unchanged on CPU hosts.
+"""
+
+from determined_tpu.keras._trial import (  # noqa: F401
+    DeterminedCallback,
+    KerasTrial,
+    KerasTrialContext,
+    Trainer,
+)
+
+# Back-compat alias matching the reference class name.
+TFKerasTrial = KerasTrial

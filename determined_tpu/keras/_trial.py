@@ -1,0 +1,178 @@
+"""KerasTrial + controller (reference _tf_keras_trial.py:975, :171)."""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, Optional
+
+from determined_tpu import core
+
+logger = logging.getLogger("determined_tpu.keras")
+
+
+class KerasTrial:
+    """User subclass surface (reference TFKerasTrial):
+
+    - build_model() -> compiled keras.Model
+    - build_training_data() -> (x, y) | tf.data.Dataset | keras Dataset
+    - build_validation_data() -> same
+    """
+
+    def __init__(self, context: "KerasTrialContext"):
+        self.context = context
+
+    def build_model(self):
+        raise NotImplementedError
+
+    def build_training_data(self):
+        raise NotImplementedError
+
+    def build_validation_data(self):
+        raise NotImplementedError
+
+    def batch_size(self) -> int:
+        return int(self.context.get_hparam_or("global_batch_size", 32))
+
+
+class KerasTrialContext:
+    def __init__(self, core_context: Optional[core.Context] = None,
+                 hparams: Optional[Dict[str, Any]] = None):
+        self._core = core_context
+        self.hparams = hparams or (core_context.hparams if core_context else {})
+
+    def get_hparam(self, name: str) -> Any:
+        return self.hparams[name]
+
+    def get_hparam_or(self, name: str, default: Any) -> Any:
+        return self.hparams.get(name, default)
+
+    def wrap_model(self, model):
+        # The reference wraps for Horovod (:483); Keras-3/JAX needs no
+        # wrapper — jax.jit + donated state is built into model.fit.
+        return model
+
+    def wrap_optimizer(self, optimizer):
+        return optimizer
+
+
+class DeterminedCallback:
+    """keras.callbacks.Callback reporting to the Core API (reference
+    keras/callbacks.py). Constructed lazily so importing this module does
+    not import keras."""
+
+    def __new__(cls, core_context: core.Context, initial_step: int = 0):
+        import keras
+
+        class _Callback(keras.callbacks.Callback):
+            def __init__(self) -> None:
+                super().__init__()
+                self.core = core_context
+                self.steps = initial_step
+                self.stopped = False
+
+            def on_train_batch_end(self, batch, logs=None):
+                self.steps += 1
+                if logs and self.steps % 10 == 0:
+                    self.core.train.report_training_metrics(self.steps, dict(logs))
+                if self.core.preempt.should_preempt():
+                    self.model.stop_training = True
+                    self.stopped = True
+
+            def on_epoch_end(self, epoch, logs=None):
+                if logs:
+                    val = {k[4:]: v for k, v in logs.items()
+                           if k.startswith("val_")}
+                    if val:
+                        self.core.train.report_validation_metrics(self.steps, val)
+
+        return _Callback()
+
+
+class Trainer:
+    """Searcher-driven controller for KerasTrial (reference
+    TFKerasTrialController :171)."""
+
+    def __init__(self, trial: KerasTrial,
+                 core_context: Optional[core.Context] = None):
+        self.trial = trial
+        self.core = core_context or trial.context._core or core.init(max_length=1)
+        self.model = trial.build_model()
+
+    def _save(self, steps: int) -> None:
+        with self.core.checkpoint.store_path(
+            {"steps_completed": steps, "framework": "keras"}
+        ) as (path, _sid):
+            self.model.save(os.path.join(path, "model.keras"))
+
+    def _restore(self) -> int:
+        latest = self.core.latest_checkpoint
+        if not latest:
+            return 0
+        import keras
+
+        with self.core.checkpoint.restore_path(latest) as path:
+            self.model = keras.saving.load_model(os.path.join(path, "model.keras"))
+            meta = self.core.checkpoint.load_metadata(latest)
+        steps = int(meta.get("steps_completed", 0))
+        logger.info("restored keras model at step %d", steps)
+        return steps
+
+    def fit(self, searcher_metric: Optional[str] = None) -> int:
+        """Train per searcher op; op length is in BATCHES (scheduling_unit
+        granularity, like the reference's batches-based ops)."""
+        steps = self._restore()
+        x_train = self.trial.build_training_data()
+        x_val = self.trial.build_validation_data()
+        callback = DeterminedCallback(self.core, initial_step=steps)
+
+        for op in self.core.searcher.operations():
+            while steps < op.length and not callback.stopped:
+                take = op.length - steps
+                args: Dict[str, Any] = {
+                    "steps_per_epoch": take,
+                    "epochs": 1,
+                    "callbacks": [callback],
+                    "verbose": 0,
+                }
+                if isinstance(x_train, tuple):
+                    self.model.fit(
+                        x_train[0], x_train[1],
+                        batch_size=self.trial.batch_size(), **args,
+                    )
+                else:
+                    self.model.fit(x_train, **args)
+                steps = callback.steps
+            if callback.stopped:  # preempted
+                self._save(steps)
+                return steps
+            results = self._evaluate(x_val)
+            self.core.train.report_validation_metrics(steps, results)
+            metric_name = searcher_metric or self._configured_metric()
+            if metric_name is not None and metric_name not in results:
+                raise KeyError(
+                    f"searcher metric {metric_name!r} not in evaluate() "
+                    f"results {sorted(results)}; reporting a wrong metric "
+                    "would corrupt the search"
+                )
+            if metric_name is None:
+                metric_name = next(iter(results), None)
+            op.report_completed(float(results.get(metric_name, 0.0)))
+            self._save(steps)
+        return steps
+
+    def _configured_metric(self) -> Optional[str]:
+        info = self.core.info
+        if info and info.trial:
+            return info.trial.config.get("searcher", {}).get("metric")
+        return None
+
+    def _evaluate(self, x_val) -> Dict[str, float]:
+        if isinstance(x_val, tuple):
+            results = self.model.evaluate(
+                x_val[0], x_val[1], batch_size=self.trial.batch_size(),
+                return_dict=True, verbose=0,
+            )
+        else:
+            results = self.model.evaluate(x_val, return_dict=True, verbose=0)
+        return {k: float(v) for k, v in results.items()}

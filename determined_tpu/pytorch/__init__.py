@@ -1,0 +1,23 @@
+"""PyTorchTrial compatibility API.
+
+Reference: harness/determined/pytorch/ (~5k LoC) — class-based trials where
+the user overrides ``__init__ / train_batch / evaluate_batch /
+build_*_data_loader`` (reference _pytorch_trial.py:1391,1471,1531,1544,1568)
+and the controller owns the run loop (:548), driven by searcher operations
+and the Core API.
+
+TPU stance: the native compute path of this framework is JAX
+(determined_tpu.train.JaxTrial); this module exists for API parity and
+migration. It runs on whatever torch device is present — CPU in tests,
+`torch_xla` devices when the task environment ships torch-xla (the
+reference's CUDA/DDP path maps to torch-xla's xla backend; we select it when
+importable).
+"""
+
+from determined_tpu.pytorch._trial import (  # noqa: F401
+    DataLoader,
+    PyTorchTrial,
+    PyTorchTrialContext,
+    Trainer,
+    TorchData,
+)

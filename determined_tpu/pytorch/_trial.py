@@ -1,0 +1,280 @@
+"""PyTorchTrial / PyTorchTrialContext / Trainer.
+
+Reference mapping (harness/determined/pytorch/):
+  - PyTorchTrial user overrides        _pytorch_trial.py:1391-1568
+  - _PyTorchTrialController.run        _pytorch_trial.py:548 (op loop :736,
+    hot loop :681, train step :861, validate :916, checkpoint :384)
+  - PyTorchTrialContext wrap_model/
+    wrap_optimizer/backward/step       _pytorch_context.py:285-297,1054
+  - Trainer.fit                        _trainer.py:70 (backend init :206-228)
+
+Device selection: torch_xla if importable (TPU task env), else cpu/cuda.
+Gradient aggregation/mixed precision hooks are kept minimal — on TPU the
+performant path is the JAX trial; this API is for porting torch codebases
+onto the platform without rewrites.
+"""
+
+from __future__ import annotations
+
+import logging
+import uuid
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+import torch
+
+from determined_tpu import core
+
+logger = logging.getLogger("determined_tpu.pytorch")
+
+TorchData = Union[Dict[str, torch.Tensor], List[torch.Tensor], torch.Tensor]
+
+
+def _default_device() -> torch.device:
+    try:  # torch-xla present in TPU task environments
+        import torch_xla.core.xla_model as xm  # type: ignore
+
+        return xm.xla_device()
+    except ImportError:
+        return torch.device("cuda" if torch.cuda.is_available() else "cpu")
+
+
+class DataLoader:
+    """Thin wrapper mirroring determined.pytorch.DataLoader (pytorch/_data.py):
+    records constructor args so the controller can apply per-worker sharding
+    (reference samplers.py) before building the real torch DataLoader."""
+
+    def __init__(self, dataset, batch_size: int = 1, shuffle: bool = False,
+                 **kwargs: Any):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.kwargs = kwargs
+
+    def get_data_loader(self, num_replicas: int = 1, rank: int = 0):
+        sampler = None
+        shuffle = self.shuffle
+        if num_replicas > 1:
+            sampler = torch.utils.data.distributed.DistributedSampler(
+                self.dataset, num_replicas=num_replicas, rank=rank,
+                shuffle=self.shuffle,
+            )
+            shuffle = False
+        return torch.utils.data.DataLoader(
+            self.dataset, batch_size=self.batch_size, shuffle=shuffle,
+            sampler=sampler, **self.kwargs,
+        )
+
+
+class PyTorchTrialContext:
+    """Services exposed to the user trial (reference _pytorch_context.py)."""
+
+    def __init__(self, core_context: Optional[core.Context] = None,
+                 hparams: Optional[Dict[str, Any]] = None,
+                 device: Optional[torch.device] = None):
+        self._core = core_context
+        self._hparams = hparams or (core_context.hparams if core_context else {})
+        self.device = device or _default_device()
+        self.models: List[torch.nn.Module] = []
+        self.optimizers: List[torch.optim.Optimizer] = []
+        self.lr_schedulers: List[Any] = []
+        self._epoch_len: Optional[int] = None
+
+    # -- user surface --------------------------------------------------
+    def get_hparam(self, name: str) -> Any:
+        if name not in self._hparams:
+            raise KeyError(f"hparam {name!r} not set")
+        return self._hparams[name]
+
+    def get_hparams(self) -> Dict[str, Any]:
+        return dict(self._hparams)
+
+    def wrap_model(self, model: torch.nn.Module) -> torch.nn.Module:
+        """Move to device; DDP-equivalent wrapping happens in torch-xla's
+        runtime (the reference wraps in DistributedDataParallel,
+        _pytorch_context.py:297)."""
+        model = model.to(self.device)
+        self.models.append(model)
+        return model
+
+    def wrap_optimizer(self, optimizer: torch.optim.Optimizer) -> torch.optim.Optimizer:
+        self.optimizers.append(optimizer)
+        return optimizer
+
+    def wrap_lr_scheduler(self, scheduler: Any) -> Any:
+        self.lr_schedulers.append(scheduler)
+        return scheduler
+
+    def backward(self, loss: torch.Tensor) -> None:
+        loss.backward()
+
+    def step_optimizer(self, optimizer: torch.optim.Optimizer) -> None:
+        optimizer.step()
+        optimizer.zero_grad(set_to_none=True)
+        try:
+            import torch_xla.core.xla_model as xm  # type: ignore
+
+            xm.mark_step()
+        except ImportError:
+            pass
+
+    def to_device(self, data: TorchData) -> TorchData:
+        if isinstance(data, dict):
+            return {k: self.to_device(v) for k, v in data.items()}
+        if isinstance(data, (list, tuple)):
+            return type(data)(self.to_device(v) for v in data)
+        if isinstance(data, torch.Tensor):
+            return data.to(self.device)
+        return data
+
+    @property
+    def distributed(self):
+        return self._core.distributed if self._core else None
+
+
+class PyTorchTrial:
+    """User subclass surface (reference _pytorch_trial.py:1391)."""
+
+    def __init__(self, context: PyTorchTrialContext):
+        self.context = context
+
+    def train_batch(self, batch: TorchData, epoch_idx: int,
+                    batch_idx: int) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def evaluate_batch(self, batch: TorchData,
+                       batch_idx: int) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def build_training_data_loader(self) -> DataLoader:
+        raise NotImplementedError
+
+    def build_validation_data_loader(self) -> DataLoader:
+        raise NotImplementedError
+
+    # Optional checkpoint hooks (reference save/load in the controller).
+    def state_dict_extras(self) -> Dict[str, Any]:
+        return {}
+
+    def load_state_dict_extras(self, extras: Dict[str, Any]) -> None:
+        pass
+
+
+class Trainer:
+    """Controller + Trainer.fit (reference _trainer.py:70 +
+    _PyTorchTrialController.run :548)."""
+
+    def __init__(self, trial: PyTorchTrial,
+                 core_context: Optional[core.Context] = None):
+        self.trial = trial
+        self.context = trial.context
+        self.core = core_context or self.context._core or core.init(max_length=100)
+
+    # -- checkpointing -------------------------------------------------
+    def _save(self, steps_completed: int) -> None:
+        with self.core.checkpoint.store_path(
+            {"steps_completed": steps_completed, "framework": "pytorch"}
+        ) as (path, _sid):
+            state = {
+                "models": [m.state_dict() for m in self.context.models],
+                "optimizers": [o.state_dict() for o in self.context.optimizers],
+                "steps_completed": steps_completed,
+                "extras": self.trial.state_dict_extras(),
+            }
+            torch.save(state, f"{path}/state.pt")
+
+    def _restore(self) -> int:
+        latest = self.core.latest_checkpoint
+        if not latest:
+            return 0
+        with self.core.checkpoint.restore_path(latest) as path:
+            state = torch.load(f"{path}/state.pt", map_location=self.context.device,
+                               weights_only=False)
+        for model, sd in zip(self.context.models, state["models"]):
+            model.load_state_dict(sd)
+        for opt, sd in zip(self.context.optimizers, state["optimizers"]):
+            opt.load_state_dict(sd)
+        self.trial.load_state_dict_extras(state.get("extras", {}))
+        logger.info("restored at step %d", state["steps_completed"])
+        return int(state["steps_completed"])
+
+    def _validate(self, steps_completed: int) -> Dict[str, Any]:
+        loader = self.trial.build_validation_data_loader().get_data_loader()
+        for model in self.context.models:
+            model.eval()
+        totals: Dict[str, float] = {}
+        n = 0
+        with torch.no_grad():
+            for batch_idx, batch in enumerate(loader):
+                batch = self.context.to_device(batch)
+                metrics = self.trial.evaluate_batch(batch, batch_idx)
+                for k, v in metrics.items():
+                    totals[k] = totals.get(k, 0.0) + float(v)
+                n += 1
+        for model in self.context.models:
+            model.train()
+        reduced = {k: v / max(n, 1) for k, v in totals.items()}
+        self.core.train.report_validation_metrics(steps_completed, reduced)
+        return reduced
+
+    def fit(
+        self,
+        validation_period: int = 0,  # batches; 0 = only at op boundaries
+        checkpoint_period: int = 0,
+        searcher_metric: Optional[str] = None,
+        report_period: int = 10,
+    ) -> int:
+        """Run the searcher-driven train/validate/checkpoint loop; returns
+        total batches trained."""
+        steps = self._restore()
+        epoch_idx = 0
+        data_iter: Optional[Iterator] = None
+
+        def next_batch():
+            nonlocal data_iter, epoch_idx
+            while True:
+                if data_iter is None:
+                    dl = self.trial.build_training_data_loader().get_data_loader()
+                    data_iter = iter(dl)
+                try:
+                    return next(data_iter)
+                except StopIteration:
+                    data_iter = None
+                    epoch_idx += 1
+
+        window: Dict[str, float] = {}
+        window_n = 0
+        for op in self.core.searcher.operations():
+            while steps < op.length:
+                batch = self.context.to_device(next_batch())
+                metrics = self.trial.train_batch(batch, epoch_idx, steps)
+                steps += 1
+                for k, v in metrics.items():
+                    try:
+                        window[k] = window.get(k, 0.0) + float(v)
+                    except (TypeError, ValueError):
+                        continue
+                window_n += 1
+                if steps % report_period == 0 or steps == op.length:
+                    self.core.train.report_training_metrics(
+                        steps, {k: v / window_n for k, v in window.items()}
+                    )
+                    window, window_n = {}, 0
+                if validation_period and steps % validation_period == 0:
+                    self._validate(steps)
+                if checkpoint_period and steps % checkpoint_period == 0:
+                    self._save(steps)
+                if self.core.preempt.should_preempt():
+                    self._save(steps)
+                    logger.info("preempted at step %d", steps)
+                    return steps
+            val_metrics = self._validate(steps)
+            metric_name = searcher_metric or (
+                self.core.info.trial.config.get("searcher", {}).get("metric")
+                if self.core.info and self.core.info.trial else None
+            )
+            metric_value = val_metrics.get(
+                metric_name or "", next(iter(val_metrics.values()), 0.0)
+            )
+            op.report_completed(float(metric_value))
+            self._save(steps)
+        return steps

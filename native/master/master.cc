@@ -160,6 +160,10 @@ HttpResponse Master::handle(const HttpRequest& req) {
     if (root == "checkpoints") return handle_checkpoints(req, rest);
     if (root == "task") return handle_task_logs(req);
     if (root == "tasks") return handle_tasks(req, rest);
+    if (root == "commands" || root == "notebooks" || root == "shells" ||
+        root == "tensorboards") {
+      return handle_ntsc(req, root, rest);
+    }
     if (root == "workspaces") return handle_workspaces(req, rest);
     if (root == "projects") return handle_projects(req, rest);
     if (root == "models") return handle_models(req, rest);

@@ -101,6 +101,11 @@ struct Allocation {
   std::map<int64_t, Json> allgather;
   int64_t allgather_round = 0;
   std::map<int64_t, std::string> proxy_addresses;
+  // NTSC (generic-task) fields: extra env (includes DET_ENTRYPOINT) and an
+  // idle-kill deadline (reference task/idle/watcher.go).
+  JsonObject extra_env;
+  double idle_timeout_s = 0;
+  double last_activity = 0;
 };
 
 struct TrialState {
@@ -167,6 +172,8 @@ class Master {
   HttpResponse handle_task_logs(const HttpRequest& req);
   HttpResponse handle_tasks(const HttpRequest& req,
                             const std::vector<std::string>& parts);
+  HttpResponse handle_ntsc(const HttpRequest& req, const std::string& kind,
+                           const std::vector<std::string>& parts);
   HttpResponse handle_workspaces(const HttpRequest& req,
                                  const std::vector<std::string>& parts);
   HttpResponse handle_projects(const HttpRequest& req,

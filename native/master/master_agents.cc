@@ -212,6 +212,15 @@ void Master::scheduler_loop() {
 
 void Master::check_agents_locked() {
   double t = now();
+  // Idle NTSC tasks are killed after their idle_timeout
+  // (reference task/idle/watcher.go; activity = shipped log lines).
+  for (auto& [aid, alloc] : allocations_) {
+    if (alloc.idle_timeout_s > 0 && alloc.state == "RUNNING" &&
+        !alloc.killed && t - alloc.last_activity > alloc.idle_timeout_s) {
+      alloc.exit_reason = "idle timeout";
+      kill_allocation_locked(alloc);
+    }
+  }
   for (auto& [id, a] : agents_) {
     if (!a.alive) continue;
     if (t - a.last_heartbeat > cfg_.agent_timeout_s) {
@@ -476,6 +485,8 @@ bool Master::try_fit_locked(Allocation& alloc) {
         env["DET_LATEST_CHECKPOINT"] = trial->latest_checkpoint;
       }
     }
+    // NTSC/generic-task env (DET_ENTRYPOINT, DET_TASK_TYPE overrides, …).
+    for (const auto& [k, v] : alloc.extra_env) env[k] = v;
     // Pre-issued session token (reference: containers get
     // DET_SESSION_TOKEN, tasks/task.go:194-234).
     std::string token = random_hex(24);

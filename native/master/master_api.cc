@@ -190,6 +190,62 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
     return json_resp(200, out);
   }
 
+  // Custom-searcher event queue (reference custom_search.go +
+  // harness/determined/searcher/_remote_search_runner.py):
+  // GET  /api/v1/experiments/{id}/searcher_events   (long-poll)
+  // POST /api/v1/experiments/{id}/searcher_operations
+  //        {operations: [...], triggered_by_event_id, progress?}
+  if (parts.size() == 3 && parts[2] == "searcher_events" &&
+      req.method == "GET") {
+    double timeout = std::stod(req.query_param("timeout_seconds", "30"));
+    std::unique_lock<std::mutex> lock(mu_);
+    auto deadline = Clock::now() + std::chrono::milliseconds(
+                                       static_cast<int>(timeout * 1000));
+    ExperimentState* exp = find_experiment_locked(eid);
+    if (exp == nullptr || exp->searcher->custom() == nullptr) {
+      return json_resp(404, err_body("not a custom-searcher experiment"));
+    }
+    cv_.wait_until(lock, deadline, [&] {
+      ExperimentState* e = find_experiment_locked(eid);
+      return !running_ || e == nullptr ||
+             e->searcher->custom()->has_events() || is_terminal(e->state);
+    });
+    exp = find_experiment_locked(eid);
+    Json out = Json::object();
+    out["events"] = exp != nullptr ? exp->searcher->custom()->pending_events()
+                                   : Json::array();
+    out["experiment_state"] = exp != nullptr ? Json(exp->state) : Json();
+    return json_resp(200, out);
+  }
+  if (parts.size() == 3 && parts[2] == "searcher_operations" &&
+      req.method == "POST") {
+    Json body = Json::parse(req.body);
+    std::lock_guard<std::mutex> lock(mu_);
+    ExperimentState* exp = find_experiment_locked(eid);
+    if (exp == nullptr || exp->searcher->custom() == nullptr) {
+      return json_resp(404, err_body("not a custom-searcher experiment"));
+    }
+    // Parse BEFORE acking: a malformed batch must not destroy the pending
+    // events (the client retries against an intact queue).
+    std::vector<SearcherOp> ops;
+    try {
+      ops = exp->searcher->external_ops(body["operations"]);
+    } catch (const std::exception& e) {
+      return json_resp(400, err_body(e.what()));
+    }
+    if (body["progress"].is_number()) {
+      exp->searcher->custom()->set_progress(body["progress"].as_double());
+      db_.exec("UPDATE experiments SET progress=? WHERE id=?",
+               {body["progress"], Json(eid)});
+    }
+    if (body["triggered_by_event_id"].is_number()) {
+      exp->searcher->custom()->ack_events(
+          body["triggered_by_event_id"].as_int());
+    }
+    process_ops_locked(*exp, ops);
+    return json_resp(200, Json::object());
+  }
+
   // POST /api/v1/experiments/{id}/{activate|pause|cancel|kill|archive|
   // unarchive}
   if (parts.size() == 3 && req.method == "POST") {
@@ -710,6 +766,14 @@ HttpResponse Master::handle_task_logs(const HttpRequest& req) {
              entry["timestamp"]});
       }
     });
+    {
+      // Log traffic counts as activity for idle-watching (task/idle/).
+      std::lock_guard<std::mutex> lock(mu_);
+      for (const auto& entry : logs) {
+        auto it = allocations_.find(entry["allocation_id"].as_string());
+        if (it != allocations_.end()) it->second.last_activity = now();
+      }
+    }
     cv_.notify_all();
     return json_resp(200, Json::object());
   }

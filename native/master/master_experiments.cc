@@ -305,6 +305,11 @@ void Master::on_allocation_exit_locked(Allocation& alloc) {
 
   ExperimentState* exp = find_experiment_locked(alloc.experiment_id);
   if (exp == nullptr) {
+    // Generic/NTSC task: terminal state follows the exit code.
+    db_.exec(
+        "UPDATE tasks SET state=?, end_time=datetime('now') "
+        "WHERE id=? AND end_time IS NULL",
+        {Json(exit_code == 0 ? "COMPLETED" : "ERROR"), Json(alloc.task_id)});
     cv_.notify_all();
     return;
   }

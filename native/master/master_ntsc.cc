@@ -1,0 +1,196 @@
+// master_ntsc.cc — NTSC interactive tasks: Notebooks, Tensorboards, Shells,
+// Commands.
+//
+// Reference: master/internal/command/{command,command_service}.go — the four
+// interactive task types share the trial allocation machinery; idle tasks
+// are killed by task/idle/watcher.go. Here each NTSC task is a generic task
+// row + one allocation whose DET_ENTRYPOINT env carries the command;
+// the agent runs it like any trial process, logs flow through the task-log
+// pipeline, and `proxy_address` reported by the task (e.g. a notebook
+// server's URL) is surfaced on the task object in place of the reference's
+// built-in TCP/WS proxy (proxy/proxy.go).
+
+#include <algorithm>
+
+#include "master.h"
+
+namespace det {
+
+namespace {
+
+Json err_body(const std::string& msg) {
+  Json j = Json::object();
+  j["error"] = msg;
+  return j;
+}
+
+HttpResponse json_resp(int status, const Json& j) {
+  return HttpResponse::json(status, j.dump());
+}
+
+Json row_to_json(const Row& row) {
+  return Json(JsonObject(row.begin(), row.end()));
+}
+
+// kind → (task type string, default entrypoint)
+struct NtscKind {
+  const char* type;
+  const char* default_entrypoint;
+};
+
+NtscKind ntsc_kind(const std::string& kind) {
+  if (kind == "notebooks") {
+    return {"NOTEBOOK",
+            "python3 -m determined_tpu.exec.notebook"};
+  }
+  if (kind == "tensorboards") {
+    return {"TENSORBOARD", "python3 -m determined_tpu.exec.tensorboard"};
+  }
+  if (kind == "shells") {
+    return {"SHELL", "sleep infinity"};
+  }
+  return {"COMMAND", ""};
+}
+
+}  // namespace
+
+HttpResponse Master::handle_ntsc(const HttpRequest& req,
+                                 const std::string& kind,
+                                 const std::vector<std::string>& parts) {
+  NtscKind meta = ntsc_kind(kind);
+
+  // POST /api/v1/{commands|notebooks|shells|tensorboards}
+  //   {config: {entrypoint?, resources?, environment?, idle_timeout_s?,
+  //             experiment_ids?}}
+  if (parts.size() == 1 && req.method == "POST") {
+    Json body = Json::parse(req.body);
+    const Json& config = body["config"];
+    std::lock_guard<std::mutex> lock(mu_);
+    int64_t uid = auth_user_locked(req);
+    if (uid < 0) return json_resp(401, err_body("unauthenticated"));
+
+    std::string task_id =
+        std::string(meta.type) + "-" + random_hex(6);
+    for (auto& c : task_id) c = static_cast<char>(tolower(c));
+    db_.exec(
+        "INSERT INTO tasks (id, type, state, config, owner_id) "
+        "VALUES (?, ?, 'ACTIVE', ?, ?)",
+        {Json(task_id), Json(meta.type), Json(config.dump()), Json(uid)});
+
+    Allocation alloc;
+    alloc.id = "alloc-" + task_id;
+    alloc.task_id = task_id;
+    alloc.resource_pool =
+        config["resources"]["resource_pool"].as_string(cfg_.default_pool);
+    alloc.slots = static_cast<int>(config["resources"]["slots"].as_int(0));
+    alloc.priority = static_cast<int>(config["resources"]["priority"].as_int(42));
+    alloc.submitted_at = now();
+    alloc.idle_timeout_s = config["idle_timeout_s"].as_double(0);
+    alloc.last_activity = now();
+
+    // String entrypoints pass through verbatim (launch.py shlex-splits);
+    // array entrypoints ship as JSON so argument boundaries survive
+    // arguments containing spaces/quotes.
+    std::string entrypoint = meta.default_entrypoint;
+    if (config["entrypoint"].is_string()) {
+      entrypoint = config["entrypoint"].as_string();
+    } else if (config["entrypoint"].is_array()) {
+      entrypoint = config["entrypoint"].dump();
+    }
+    alloc.extra_env["DET_ENTRYPOINT"] = Json(entrypoint);
+    alloc.extra_env["DET_TASK_TYPE"] = Json(meta.type);
+    if (config["experiment_ids"].is_array()) {
+      alloc.extra_env["DET_EXPERIMENT_IDS"] =
+          Json(config["experiment_ids"].dump());
+    }
+    for (const auto& [k, v] : config["environment"].as_object()) {
+      if (v.is_string()) alloc.extra_env[k] = v;
+    }
+
+    db_.exec(
+        "INSERT INTO allocations (id, task_id, resource_pool, slots) "
+        "VALUES (?, ?, ?, ?)",
+        {Json(alloc.id), Json(task_id), Json(alloc.resource_pool),
+         Json(static_cast<int64_t>(alloc.slots))});
+    std::string aid = alloc.id;
+    allocations_[aid] = std::move(alloc);
+    pending_.push_back(aid);
+    cv_.notify_all();
+
+    Json out = Json::object();
+    out["id"] = task_id;
+    out["allocation_id"] = aid;
+    return json_resp(200, out);
+  }
+
+  // GET list
+  if (parts.size() == 1 && req.method == "GET") {
+    auto rows = db_.query(
+        "SELECT id, type, state, config, start_time, end_time FROM tasks "
+        "WHERE type=? ORDER BY start_time DESC",
+        {Json(meta.type)});
+    Json tasks = Json::array();
+    std::lock_guard<std::mutex> lock(mu_);
+    for (auto& row : rows) {
+      Json t = row_to_json(row);
+      t["config"] = Json::parse_or_null(t["config"].as_string());
+      // Surface live allocation state + proxy address.
+      for (const auto& [aid, a] : allocations_) {
+        if (a.task_id == row["id"].as_string()) {
+          t["allocation_state"] = a.state;
+          if (!a.proxy_addresses.empty()) {
+            t["proxy_address"] = a.proxy_addresses.begin()->second;
+          }
+        }
+      }
+      tasks.push_back(std::move(t));
+    }
+    Json out = Json::object();
+    out[kind] = tasks;
+    return json_resp(200, out);
+  }
+
+  if (parts.size() >= 2) {
+    const std::string& task_id = parts[1];
+    // POST /{kind}/{id}/kill
+    if (parts.size() == 3 && parts[2] == "kill" && req.method == "POST") {
+      std::lock_guard<std::mutex> lock(mu_);
+      for (auto& [aid, a] : allocations_) {
+        if (a.task_id == task_id && a.state != "TERMINATED") {
+          if (a.state == "PENDING") {
+            a.state = "TERMINATED";
+            release_resources_locked(a);
+          } else {
+            kill_allocation_locked(a);
+          }
+        }
+      }
+      db_.exec("UPDATE tasks SET state='CANCELED', end_time=datetime('now') "
+               "WHERE id=? AND end_time IS NULL",
+               {Json(task_id)});
+      return json_resp(200, Json::object());
+    }
+    // GET /{kind}/{id}
+    if (parts.size() == 2 && req.method == "GET") {
+      auto rows = db_.query("SELECT * FROM tasks WHERE id=?", {Json(task_id)});
+      if (rows.empty()) return json_resp(404, err_body("no such task"));
+      Json t = row_to_json(rows[0]);
+      t["config"] = Json::parse_or_null(t["config"].as_string());
+      std::lock_guard<std::mutex> lock(mu_);
+      for (const auto& [aid, a] : allocations_) {
+        if (a.task_id == task_id) {
+          t["allocation_state"] = a.state;
+          if (!a.proxy_addresses.empty()) {
+            t["proxy_address"] = a.proxy_addresses.begin()->second;
+          }
+        }
+      }
+      Json out = Json::object();
+      out["task"] = std::move(t);
+      return json_resp(200, out);
+    }
+  }
+  return json_resp(404, err_body("not found"));
+}
+
+}  // namespace det

@@ -66,7 +66,11 @@ SearcherOp SearcherOp::from_json(const Json& j) {
     return validate_after(j["request_id"].as_string(), j["length"].as_int());
   }
   if (t == "Close") return close(j["request_id"].as_string());
-  return shutdown(j["cancel"].as_bool(), j["failure"].as_bool());
+  if (t == "Shutdown") return shutdown(j["cancel"].as_bool(),
+                                       j["failure"].as_bool());
+  // This also parses untrusted client ops (custom searcher POST) — an
+  // unknown type must be rejected, not defaulted to Shutdown.
+  throw std::runtime_error("unknown searcher op type: " + t);
 }
 
 // ---------------------------------------------------------------------------
@@ -748,21 +752,34 @@ std::unique_ptr<SearchMethod> make_search_method(const Json& cfg,
       name == "adaptive_simple") {
     return std::make_unique<AdaptiveAshaSearch>(hparam_spec, seed, cfg);
   }
+  if (name == "custom") return std::make_unique<CustomSearch>();
   throw std::runtime_error("unknown searcher: " + name);
 }
 
 Searcher::Searcher(const Json& cfg, const Json& hparam_spec, uint64_t seed)
     : method_(make_search_method(cfg, hparam_spec, seed)),
       metric_name_(cfg["metric"].as_string("loss")),
-      smaller_is_better_(cfg["smaller_is_better"].as_bool(true)) {}
+      smaller_is_better_(cfg["smaller_is_better"].as_bool(true)) {
+  custom_ = dynamic_cast<CustomSearch*>(method_.get());
+}
+
+std::vector<SearcherOp> Searcher::external_ops(const Json& ops_json) {
+  std::vector<SearcherOp> ops;
+  for (const auto& oj : ops_json.as_array()) {
+    ops.push_back(SearcherOp::from_json(oj));
+  }
+  return account(std::move(ops));
+}
 
 // Bookkeeping shared by every event path (reference searcher.go:144,198):
 // count Create ops, and emit Shutdown once every requested trial has
-// closed. Methods themselves never emit Shutdown.
+// closed. Methods themselves never emit Shutdown — except the custom
+// searcher, where Shutdown comes from the client (searcher.go `!isCustom`).
 std::vector<SearcherOp> Searcher::account(std::vector<SearcherOp> ops) {
   for (const auto& op : ops) {
     if (op.kind == SearcherOp::Kind::Create) ++trials_requested_;
   }
+  if (custom_ != nullptr) return ops;
   if (trials_requested_ > 0 &&
       static_cast<int64_t>(trials_closed_.size()) >= trials_requested_ &&
       !shutdown_emitted_) {

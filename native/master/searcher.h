@@ -99,6 +99,82 @@ class SearchMethod {
   virtual void restore(const Json& snap) = 0;
 };
 
+// Custom search (reference custom_search.go): the method computes nothing —
+// it queues events for an external client (the user's SearchMethod run by
+// RemoteSearchRunner) which answers with operations via the REST API.
+class CustomSearch : public SearchMethod {
+ public:
+  CustomSearch() { push_event("initial_operations", Json::object()); }
+
+  std::vector<SearcherOp> initial_operations() override { return {}; }
+  std::vector<SearcherOp> validation_completed(const std::string& rid,
+                                               double metric,
+                                               int64_t length) override {
+    Json d = Json::object();
+    d["request_id"] = rid;
+    d["metric"] = metric;
+    d["length"] = length;
+    push_event("validation_completed", std::move(d));
+    return {};
+  }
+  std::vector<SearcherOp> trial_closed(const std::string& rid) override {
+    Json d = Json::object();
+    d["request_id"] = rid;
+    push_event("trial_closed", std::move(d));
+    return {};
+  }
+  std::vector<SearcherOp> trial_exited_early(const std::string& rid,
+                                             const std::string& why) override {
+    Json d = Json::object();
+    d["request_id"] = rid;
+    d["reason"] = why;
+    push_event("trial_exited_early", std::move(d));
+    return {};
+  }
+  double progress(int64_t) const override { return progress_; }
+  void set_progress(double p) { progress_ = p; }
+
+  // Events not yet acknowledged by the client.
+  Json pending_events() const {
+    Json arr = Json::array();
+    for (const auto& e : events_) arr.push_back(e);
+    return arr;
+  }
+  void ack_events(int64_t up_to_id) {
+    while (!events_.empty() && events_.front()["id"].as_int() <= up_to_id) {
+      events_.erase(events_.begin());
+    }
+  }
+  bool has_events() const { return !events_.empty(); }
+
+  Json snapshot() const override {
+    Json j = Json::object();
+    j["events"] = pending_events();
+    j["next_id"] = next_id_;
+    j["progress"] = progress_;
+    return j;
+  }
+  void restore(const Json& j) override {
+    events_.clear();
+    for (const auto& e : j["events"].as_array()) events_.push_back(e);
+    next_id_ = j["next_id"].as_int(1);
+    progress_ = j["progress"].as_double();
+  }
+
+ private:
+  void push_event(const std::string& type, Json data) {
+    Json e = Json::object();
+    e["id"] = next_id_++;
+    e["type"] = type;
+    e["data"] = std::move(data);
+    events_.push_back(std::move(e));
+  }
+
+  std::vector<Json> events_;
+  int64_t next_id_ = 1;
+  double progress_ = 0;
+};
+
 // Searcher wraps a method with metric sign handling + bookkeeping
 // (reference searcher.go NewSearcher + searcher_state).
 class Searcher {
@@ -118,6 +194,12 @@ class Searcher {
   const std::string& metric_name() const { return metric_name_; }
   bool smaller_is_better() const { return smaller_is_better_; }
 
+  // Custom-search support: non-null iff searcher name == "custom".
+  CustomSearch* custom() { return custom_; }
+  // Parse client-posted operations (reference custom searcher ops POST);
+  // updates Create accounting so Shutdown bookkeeping stays correct.
+  std::vector<SearcherOp> external_ops(const Json& ops_json);
+
   Json snapshot() const;
   void restore(const Json& snap);
 
@@ -125,6 +207,7 @@ class Searcher {
   std::vector<SearcherOp> account(std::vector<SearcherOp> ops);
 
   std::unique_ptr<SearchMethod> method_;
+  CustomSearch* custom_ = nullptr;  // borrowed from method_ when custom
   std::string metric_name_;
   bool smaller_is_better_ = true;
   // request_id → units completed so far (for progress()).

@@ -326,6 +326,131 @@ def test_cancel_experiment(cluster, tmp_path):
     assert state in ("CANCELED", "COMPLETED")
 
 
+def test_sdk_workflow(cluster, tmp_path):
+    """Drive the flow through the experimental SDK (reference
+    determined.experimental.client)."""
+    from determined_tpu.experimental import Determined
+
+    d = Determined(cluster.master_url)
+    assert d.get_master_info()["cluster_name"] == "determined-tpu"
+    assert len(d.get_agents()) == 1
+
+    exp = d.create_experiment(_experiment_config(tmp_path), FIXTURES)
+    assert exp.wait(timeout=120.0) == "COMPLETED"
+    trials = exp.get_trials()
+    assert len(trials) == 1 and trials[0].state == "COMPLETED"
+    metrics = list(trials[0].iter_metrics("validation"))
+    assert metrics and "val_loss" in metrics[-1]["metrics"]
+
+    ckpt = exp.top_checkpoint()
+    assert ckpt.uuid
+    local = ckpt.download(os.path.join(str(tmp_path), "dl"))
+    assert os.path.exists(os.path.join(local, "state.json"))
+
+    model = d.create_model("sdk-model")
+    version = model.register_version(ckpt.uuid)
+    assert version.version == 1
+    assert d.get_model("sdk-model").get_versions()[0].checkpoint_uuid == ckpt.uuid
+
+
+def test_command_task(cluster):
+    """NTSC command task end to end (reference command/command.go)."""
+    token = cluster.login()
+    resp = cluster.api(
+        "POST", "/api/v1/commands",
+        {"config": {"entrypoint": "echo hello-from-command"}}, token=token,
+    )
+    task_id = resp["id"]
+    deadline = time.time() + 60
+    task = None
+    while time.time() < deadline:
+        task = cluster.api("GET", f"/api/v1/commands/{task_id}", token=token)["task"]
+        if task["state"] in ("COMPLETED", "ERROR", "CANCELED"):
+            break
+        time.sleep(0.5)
+    assert task and task["state"] == "COMPLETED", task
+    logs = cluster.api(
+        "GET", f"/api/v1/tasks/{task_id}/logs?offset=0", token=token)["logs"]
+    assert any("hello-from-command" in line["log"] for line in logs)
+
+    listed = cluster.api("GET", "/api/v1/commands", token=token)["commands"]
+    assert any(t["id"] == task_id for t in listed)
+
+
+def test_tensorboard_metrics_synced_to_storage(cluster, tmp_path):
+    """Trial tfevents must land in checkpoint storage under
+    tensorboard/<exp>/<trial>/ (reference tensorboard/base.py sync)."""
+    eid, token = _create_experiment(cluster, _experiment_config(tmp_path))
+    _wait_experiment(cluster, eid, token)
+    trials = cluster.api("GET", f"/api/v1/experiments/{eid}/trials", token=token)[
+        "trials"]
+    tb_dir = os.path.join(str(tmp_path), "checkpoints", "tensorboard",
+                          str(eid), str(trials[0]["id"]))
+    assert os.path.isdir(tb_dir), f"no synced tfevents dir at {tb_dir}"
+    assert any(name.startswith("events.") for name in os.listdir(tb_dir))
+
+
+def test_custom_searcher(cluster, tmp_path):
+    """User-defined SearchMethod driving trials through the master's
+    custom-searcher event queue (reference custom_search.go +
+    searcher/_remote_search_runner.py)."""
+    from determined_tpu.experimental import Determined
+    from determined_tpu.searcher import (
+        Close, Create, RemoteSearchRunner, SearchMethod, Shutdown,
+        ValidateAfter,
+    )
+
+    class TwoRoundSearch(SearchMethod):
+        """2 trials; the better one trains a second round."""
+
+        def __init__(self):
+            self.results = {}
+            self.closed = 0
+            self.extended = None
+
+        def initial_operations(self):
+            ops = []
+            for lr in (0.1, 0.9):
+                create = Create({"lr": lr})
+                ops += [create, ValidateAfter(create.request_id, 4)]
+            return ops
+
+        def on_validation_completed(self, request_id, metric, train_length):
+            self.results[request_id] = metric
+            if train_length >= 8:
+                return [Close(request_id)]
+            if len(self.results) < 2:
+                return []
+            best = min(self.results, key=self.results.get)
+            if self.extended is None:
+                self.extended = best
+                ops = [ValidateAfter(best, 8)]
+                ops += [Close(r) for r in self.results if r != best]
+                return ops
+            return [Close(request_id)]
+
+        def on_trial_closed(self, request_id):
+            self.closed += 1
+            return [Shutdown()] if self.closed == 2 else []
+
+        def progress(self):
+            return min(1.0, self.closed / 2)
+
+    config = _experiment_config(
+        tmp_path, searcher={"name": "custom", "metric": "val_loss"})
+    runner = RemoteSearchRunner(TwoRoundSearch(),
+                                Determined(cluster.master_url))
+    eid = runner.run(config, FIXTURES, poll_timeout=5.0)
+
+    d = Determined(cluster.master_url)
+    exp = d.get_experiment(eid)
+    assert exp.state == "COMPLETED"
+    trials = exp.get_trials()
+    assert len(trials) == 2
+    batches = sorted(t.total_batches for t in trials)
+    assert batches == [4, 8]
+
+
 def test_cli_workflow(cluster, tmp_path, monkeypatch, capsys):
     """Drive the same flow through the det CLI."""
     import determined_tpu.cli as cli
