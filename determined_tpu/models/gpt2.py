@@ -242,6 +242,7 @@ def apply(
         policies = {
             None: None,
             "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            "dots_saveable": jax.checkpoint_policies.dots_saveable,
             "nothing": jax.checkpoint_policies.nothing_saveable,
             "everything": jax.checkpoint_policies.everything_saveable,
         }

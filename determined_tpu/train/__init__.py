@@ -7,6 +7,10 @@ ops and preemption.
 """
 
 from determined_tpu.train.state import TrainState, create_train_state  # noqa: F401
-from determined_tpu.train.step import make_train_step, make_eval_step  # noqa: F401
+from determined_tpu.train.step import (  # noqa: F401
+    make_eval_step,
+    make_multi_step,
+    make_train_step,
+)
 from determined_tpu.train.trial import JaxTrial  # noqa: F401
 from determined_tpu.train.trainer import Trainer  # noqa: F401
