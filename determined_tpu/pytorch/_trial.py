@@ -272,8 +272,18 @@ class Trainer:
                 self.core.info.trial.config.get("searcher", {}).get("metric")
                 if self.core.info and self.core.info.trial else None
             )
-            metric_value = val_metrics.get(
-                metric_name or "", next(iter(val_metrics.values()), 0.0)
+            if metric_name is not None and metric_name not in val_metrics:
+                # Reporting an arbitrary substitute would corrupt ASHA
+                # promotion ordering; fail loudly like keras/_trial.py and
+                # the reference do.
+                raise KeyError(
+                    f"searcher metric {metric_name!r} not in validation "
+                    f"metrics {sorted(val_metrics)}"
+                )
+            metric_value = (
+                val_metrics[metric_name]
+                if metric_name is not None
+                else next(iter(val_metrics.values()), 0.0)
             )
             op.report_completed(float(metric_value))
             self._save(steps)

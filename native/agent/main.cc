@@ -64,6 +64,59 @@ struct Task {
 std::mutex g_mu;
 std::map<std::string, std::shared_ptr<Task>> g_tasks;  // by container_id
 
+// ---- master session -----------------------------------------------------
+// All master routes require a Bearer token; the agent logs in at startup
+// (username "determined", or a pre-issued DET_AGENT_TOKEN) and re-logins
+// transparently on 401 (e.g. after a master restart wiped sessions).
+
+std::mutex g_token_mu;
+std::string g_token;
+
+std::map<std::string, std::string> auth_headers() {
+  std::lock_guard<std::mutex> lock(g_token_mu);
+  if (g_token.empty()) return {};
+  return {{"Authorization", "Bearer " + g_token}};
+}
+
+bool agent_login(const std::string& master_url, bool use_env_token = true) {
+  // use_env_token=false on the 401-recovery path: re-installing a dead
+  // pre-issued token would brick the agent after a master DB wipe.
+  if (use_env_token) {
+    if (const char* t = getenv("DET_AGENT_TOKEN")) {
+      std::lock_guard<std::mutex> lock(g_token_mu);
+      g_token = t;
+      return true;
+    }
+  }
+  Json body = Json::object();
+  body["username"] = "determined";
+  body["password"] = "";
+  try {
+    auto r = det::http_request("POST", master_url, "/api/v1/auth/login",
+                               body.dump(), 10.0);
+    if (!r.ok()) return false;
+    Json doc = Json::parse_or_null(r.body);
+    std::lock_guard<std::mutex> lock(g_token_mu);
+    g_token = doc["token"].as_string();
+    return !g_token.empty();
+  } catch (const std::exception&) {
+    return false;
+  }
+}
+
+HttpClientResponse master_call(const std::string& master_url,
+                               const std::string& method,
+                               const std::string& path,
+                               const std::string& body, double timeout_s) {
+  auto r = det::http_request(method, master_url, path, body, timeout_s,
+                             auth_headers());
+  if (r.status == 401 && agent_login(master_url, /*use_env_token=*/false)) {
+    r = det::http_request(method, master_url, path, body, timeout_s,
+                          auth_headers());
+  }
+  return r;
+}
+
 // ---- log shipping -------------------------------------------------------
 
 struct LogEntry {
@@ -112,8 +165,8 @@ void shipper_loop(const AgentOptions& opts) {
     body["logs"] = logs;
     for (int attempt = 0; attempt < 3; ++attempt) {
       try {
-        auto r = det::http_request("POST", opts.master_url,
-                                   "/api/v1/task/logs", body.dump(), 10.0);
+        auto r = master_call(opts.master_url, "POST",
+                             "/api/v1/task/logs", body.dump(), 10.0);
         if (r.ok()) break;
       } catch (const std::exception&) {
       }
@@ -186,8 +239,7 @@ void report_state(const AgentOptions& opts, const std::string& alloc_id,
                      "/state";
   for (int attempt = 0; attempt < 5; ++attempt) {
     try {
-      auto r = det::http_request("POST", opts.master_url, path, body.dump(),
-                                 10.0);
+      auto r = master_call(opts.master_url, "POST", path, body.dump(), 10.0);
       if (r.ok() || r.status == 404) return;
     } catch (const std::exception&) {
     }
@@ -313,8 +365,8 @@ bool register_with_master(const AgentOptions& opts, bool reconnect) {
   AgentOptions mut = opts;
   body["slots"] = detect_slots(mut);
   try {
-    auto r = det::http_request("POST", opts.master_url,
-                               "/api/v1/agents/register", body.dump(), 10.0);
+    auto r = master_call(opts.master_url, "POST",
+                         "/api/v1/agents/register", body.dump(), 10.0);
     if (!r.ok()) return false;
     Json resp = Json::parse_or_null(r.body);
     // Kill anything the master no longer recognizes (reattach reconcile).
@@ -350,9 +402,9 @@ void heartbeat_loop(const AgentOptions& opts) {
     }
     body["running"] = running;
     try {
-      auto r = det::http_request("POST", opts.master_url,
-                                 "/api/v1/agents/" + opts.id + "/heartbeat",
-                                 body.dump(), 10.0);
+      auto r = master_call(opts.master_url, "POST",
+                           "/api/v1/agents/" + opts.id + "/heartbeat",
+                           body.dump(), 10.0);
       if (r.status == 404) {
         register_with_master(opts, true);  // master restarted
       } else if (r.ok()) {
@@ -419,8 +471,8 @@ int main(int argc, char** argv) {
                              std::to_string(opts.poll_timeout_s);
   while (g_running) {
     try {
-      auto r = det::http_request("GET", opts.master_url, actions_path, "",
-                                 opts.poll_timeout_s + 10.0);
+      auto r = master_call(opts.master_url, "GET", actions_path, "",
+                           opts.poll_timeout_s + 10.0);
       if (r.status == 404) {
         register_with_master(opts, true);
         continue;

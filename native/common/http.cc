@@ -291,25 +291,67 @@ HttpClientResponse http_request(const std::string& method,
     throw std::runtime_error("send failed");
   }
 
+  // Content-Length framed read: a SO_RCVTIMEO expiry mid-body must surface
+  // as a transport error, never as a silently truncated body (the master
+  // destructively drains agent action queues, so a lost body loses actions).
   std::string resp_buf;
   char chunk[8192];
   ssize_t n;
-  while ((n = ::recv(fd, chunk, sizeof(chunk), 0)) > 0) {
+  size_t head_end = std::string::npos;
+  while ((head_end = resp_buf.find("\r\n\r\n")) == std::string::npos) {
+    n = ::recv(fd, chunk, sizeof(chunk), 0);
+    if (n <= 0) {
+      ::close(fd);
+      throw std::runtime_error("malformed/timeout response head from " + host +
+                               path);
+    }
     resp_buf.append(chunk, static_cast<size_t>(n));
   }
-  ::close(fd);
 
-  auto head_end = resp_buf.find("\r\n\r\n");
-  if (head_end == std::string::npos) {
-    throw std::runtime_error("malformed/timeout response from " + host + path);
-  }
   HttpClientResponse r;
+  long content_len = -1;
   {
     std::istringstream hs(resp_buf.substr(0, head_end));
     std::string version;
     hs >> version >> r.status;
+    std::string line;
+    std::getline(hs, line);  // rest of status line
+    while (std::getline(hs, line)) {
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      auto colon = line.find(':');
+      if (colon == std::string::npos) continue;
+      std::string key = line.substr(0, colon);
+      for (auto& c : key) c = static_cast<char>(tolower(c));
+      if (key == "content-length") {
+        try {
+          content_len = std::stol(line.substr(colon + 1));
+        } catch (...) {
+        }
+      }
+    }
   }
-  r.body = resp_buf.substr(head_end + 4);
+  size_t body_start = head_end + 4;
+  if (content_len >= 0) {
+    while (resp_buf.size() < body_start + static_cast<size_t>(content_len)) {
+      n = ::recv(fd, chunk, sizeof(chunk), 0);
+      if (n <= 0) {
+        ::close(fd);
+        throw std::runtime_error(
+            "truncated response body from " + host + path + " (got " +
+            std::to_string(resp_buf.size() - body_start) + "/" +
+            std::to_string(content_len) + " bytes)");
+      }
+      resp_buf.append(chunk, static_cast<size_t>(n));
+    }
+    r.body = resp_buf.substr(body_start, static_cast<size_t>(content_len));
+  } else {
+    // No Content-Length (Connection: close framing): read to EOF.
+    while ((n = ::recv(fd, chunk, sizeof(chunk), 0)) > 0) {
+      resp_buf.append(chunk, static_cast<size_t>(n));
+    }
+    r.body = resp_buf.substr(body_start);
+  }
+  ::close(fd);
   return r;
 }
 

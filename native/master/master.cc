@@ -151,7 +151,15 @@ HttpResponse Master::handle(const HttpRequest& req) {
 
   try {
     if (root == "auth") return handle_login(req);
-    if (root == "master") return handle_master_info(req);
+    if (root == "master" && req.method == "GET") {
+      return handle_master_info(req);
+    }
+    // Every other /api/v1 route requires a valid session token (the
+    // reference authenticates all routes; tasks/agents use the pre-issued
+    // DET_SESSION_TOKEN / agent login).
+    if (auth_user(req) < 0) {
+      return json_resp(401, err_body("unauthenticated"));
+    }
     if (root == "users" || root == "me") return handle_users(req);
     if (root == "agents") return handle_agents_api(req, rest);
     if (root == "experiments") return handle_experiments(req, rest);
@@ -223,7 +231,9 @@ HttpResponse Master::handle_login(const HttpRequest& req) {
   return not_found();
 }
 
-int64_t Master::auth_user_locked(const HttpRequest& req) {
+// Thread-safe without mu_: touches only the internally-locked Db. Called
+// from the global gate in handle() (no lock) and from handlers (lock held).
+int64_t Master::auth_user(const HttpRequest& req) {
   auto it = req.headers.find("authorization");
   if (it == req.headers.end() || it->second.rfind("Bearer ", 0) != 0) return -1;
   auto rows = db_.query(
@@ -237,7 +247,7 @@ HttpResponse Master::handle_users(const HttpRequest& req) {
   auto parts = split_path(req.path);
   if (parts[2] == "me") {
     std::lock_guard<std::mutex> lock(mu_);
-    int64_t uid = auth_user_locked(req);
+    int64_t uid = auth_user(req);
     if (uid < 0) return json_resp(401, err_body("unauthenticated"));
     auto rows = db_.query("SELECT id, username, admin FROM users WHERE id=?",
                           {Json(uid)});

@@ -216,7 +216,7 @@ class Master {
 
   ExperimentState* find_experiment_locked(int64_t id);
   TrialState* find_trial_locked(int64_t trial_id, ExperimentState** exp_out);
-  int64_t auth_user_locked(const HttpRequest& req);  // -1 if unauthenticated
+  int64_t auth_user(const HttpRequest& req);  // -1 if unauthenticated
 
   MasterConfig cfg_;
   Db db_;

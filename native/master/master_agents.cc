@@ -261,16 +261,34 @@ void Master::schedule_locked() {
     }
     return n;
   };
-  std::vector<std::string> queue(pending_.begin(), pending_.end());
+  std::vector<std::string> queue;
+  for (const auto& aid : pending_) {
+    auto it = allocations_.find(aid);
+    if (it != allocations_.end() && it->second.state == "PENDING") {
+      queue.push_back(aid);
+    }
+  }
+  auto pool_policy = [&](const std::string& pool) -> std::string {
+    auto it = cfg_.pool_policies.find(pool);
+    return it != cfg_.pool_policies.end() ? it->second : "priority";
+  };
   std::stable_sort(queue.begin(), queue.end(), [&](const std::string& x,
                                                    const std::string& y) {
-    const Allocation& ax = allocations_[x];
-    const Allocation& ay = allocations_[y];
-    const std::string policy_x = cfg_.pool_policies.count(ax.resource_pool)
-                                     ? cfg_.pool_policies.at(ax.resource_pool)
-                                     : "priority";
-    if (policy_x == "fair_share") {
-      return running_slots(ax.experiment_id) < running_slots(ay.experiment_id);
+    const Allocation& ax = allocations_.at(x);
+    const Allocation& ay = allocations_.at(y);
+    // Partition by pool first: fits are per-pool independent, and comparing
+    // cross-pool items by pool name keeps this a strict weak ordering even
+    // when pools run different policies (a single per-item policy lookup
+    // would not be).
+    if (ax.resource_pool != ay.resource_pool) {
+      return ax.resource_pool < ay.resource_pool;
+    }
+    const std::string policy = pool_policy(ax.resource_pool);
+    if (policy == "fair_share") {
+      int rx = running_slots(ax.experiment_id);
+      int ry = running_slots(ay.experiment_id);
+      if (rx != ry) return rx < ry;
+      return ax.submitted_at < ay.submitted_at;
     }
     if (ax.priority != ay.priority) return ax.priority < ay.priority;
     return ax.submitted_at < ay.submitted_at;
@@ -387,26 +405,33 @@ bool Master::try_fit_locked(Allocation& alloc) {
       assignment.push_back({best, best_slots});
     } else {
       // Multi-host: whole free hosts only (an ICI mesh spans complete
-      // hosts; fractional hosts can't join the slice).
-      std::vector<Candidate*> whole;
+      // hosts; fractional hosts can't join the slice), and the hosts must
+      // be uniform (every host contributes the same chip count or the mesh
+      // is ragged). Group free hosts by slot count and take the first group
+      // — largest hosts first, fewer hosts per mesh — that divides `need`
+      // exactly and has enough members.
+      std::map<int, std::vector<Candidate*>> whole_by_size;
       for (auto& c : cands) {
         if (!c.agent->slots.empty() &&
             c.free_slots.size() == c.agent->slots.size()) {
-          whole.push_back(&c);
+          whole_by_size[static_cast<int>(c.free_slots.size())].push_back(&c);
         }
       }
-      int got = 0;
-      for (auto* c : whole) {
-        if (got >= need) break;
-        got += static_cast<int>(c->free_slots.size());
+      bool placed = false;
+      for (auto it = whole_by_size.rbegin(); it != whole_by_size.rend();
+           ++it) {
+        int per_host = it->first;
+        std::vector<Candidate*>& group = it->second;
+        if (per_host <= 0 || need % per_host != 0) continue;
+        size_t hosts = static_cast<size_t>(need / per_host);
+        if (group.size() < hosts) continue;
+        for (size_t h = 0; h < hosts; ++h) {
+          assignment.push_back({group[h]->agent, group[h]->free_slots});
+        }
+        placed = true;
+        break;
       }
-      if (got < need || whole.empty()) return false;
-      int per_host = static_cast<int>(whole[0]->free_slots.size());
-      if (per_host == 0 || need % per_host != 0) return false;
-      int hosts = need / per_host;
-      for (int h = 0; h < hosts; ++h) {
-        assignment.push_back({whole[h]->agent, whole[h]->free_slots});
-      }
+      if (!placed) return false;
     }
   }
 

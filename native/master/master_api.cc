@@ -52,7 +52,7 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
   if (parts.size() == 1 && req.method == "POST") {
     Json body = Json::parse(req.body);
     std::lock_guard<std::mutex> lock(mu_);
-    int64_t uid = auth_user_locked(req);
+    int64_t uid = auth_user(req);
     if (uid < 0) return json_resp(401, err_body("unauthenticated"));
     int64_t eid = create_experiment_locked(
         body["config"], body["model_definition"].as_string(), uid,

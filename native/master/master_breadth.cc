@@ -55,7 +55,7 @@ HttpResponse Master::handle_workspaces(const HttpRequest& req,
   if (parts.size() == 1 && req.method == "POST") {
     Json body = Json::parse(req.body);
     std::lock_guard<std::mutex> lock(mu_);
-    int64_t uid = auth_user_locked(req);
+    int64_t uid = auth_user(req);
     if (uid < 0) return json_resp(401, err_body("unauthenticated"));
     db_.exec("INSERT INTO workspaces (name, user_id) VALUES (?, ?)",
              {body["name"], Json(uid)});
@@ -94,7 +94,7 @@ HttpResponse Master::handle_projects(const HttpRequest& req,
   if (parts.size() == 1 && req.method == "POST") {
     Json body = Json::parse(req.body);
     std::lock_guard<std::mutex> lock(mu_);
-    int64_t uid = auth_user_locked(req);
+    int64_t uid = auth_user(req);
     if (uid < 0) return json_resp(401, err_body("unauthenticated"));
     db_.exec(
         "INSERT INTO projects (name, description, workspace_id, user_id) "
@@ -141,7 +141,7 @@ HttpResponse Master::handle_models(const HttpRequest& req,
   if (parts.size() == 1 && req.method == "POST") {
     Json body = Json::parse(req.body);
     std::lock_guard<std::mutex> lock(mu_);
-    int64_t uid = auth_user_locked(req);
+    int64_t uid = auth_user(req);
     if (uid < 0) return json_resp(401, err_body("unauthenticated"));
     db_.exec(
         "INSERT INTO models (name, description, metadata, labels, user_id, "

@@ -66,7 +66,7 @@ HttpResponse Master::handle_ntsc(const HttpRequest& req,
     Json body = Json::parse(req.body);
     const Json& config = body["config"];
     std::lock_guard<std::mutex> lock(mu_);
-    int64_t uid = auth_user_locked(req);
+    int64_t uid = auth_user(req);
     if (uid < 0) return json_resp(401, err_body("unauthenticated"));
 
     std::string task_id =

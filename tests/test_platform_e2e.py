@@ -94,9 +94,10 @@ class Devcluster:
             env=self.env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         )
+        token = self.login()
         deadline = time.time() + 20
         while time.time() < deadline:
-            agents = self.api("GET", "/api/v1/agents")["agents"]
+            agents = self.api("GET", "/api/v1/agents", token=token)["agents"]
             if any(a["id"] == agent_id and a["alive"] for a in agents):
                 return
             time.sleep(0.2)
@@ -193,7 +194,14 @@ def _wait_experiment(cluster, eid, token, timeout=120.0, want=("COMPLETED",)):
 def test_master_info_and_agent_registration(cluster):
     info = cluster.api("GET", "/api/v1/master")
     assert info["cluster_name"] == "determined-tpu"
-    agents = cluster.api("GET", "/api/v1/agents")["agents"]
+    token = cluster.login()
+    # Every route except master-info/login now requires a session token.
+    try:
+        cluster.api("GET", "/api/v1/agents")
+        raise AssertionError("unauthenticated /agents should 401")
+    except urllib.error.HTTPError as e:
+        assert e.code == 401
+    agents = cluster.api("GET", "/api/v1/agents", token=token)["agents"]
     assert len(agents) == 1
     assert len(agents[0]["slots"]) == 2
 
