@@ -43,9 +43,16 @@ class Config:
     # jax.checkpoint policy name: None = full remat; "dots" saves matmul
     # outputs and recomputes only elementwise/softmax (less recompute, more
     # HBM); see jax.checkpoint_policies.
-    remat_policy: Optional[str] = None
-    attention_impl: str = "dot"  # "dot" | "flash" | "ring"
+    remat_policy: Optional[str] = "dots"
+    # "flash" = pallas fused kernel on TPU (falls back to the XLA path on CPU
+    # meshes / unsupported shapes); "dot" = XLA; "ring" = context-parallel.
+    attention_impl: str = "flash"
     layer_norm_eps: float = 1e-5
+    # Unroll factor for the layers scan. 0 = full unroll: removes the
+    # per-layer stacked-param dynamic-slice and scan-carry stacking overhead
+    # (~10% step time on v5e) at the cost of longer compiles; 1 = rolled
+    # (fast compile — the right default for tests and short ASHA trials).
+    scan_unroll: int = 1
 
     @property
     def ff_dim(self) -> int:
@@ -252,7 +259,8 @@ def apply(
     def scan_body(carry, lp):
         return block(carry, lp), None
 
-    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    unroll = cfg.scan_unroll if cfg.scan_unroll > 0 else cfg.n_layer
+    x, _ = jax.lax.scan(scan_body, x, params["blocks"], unroll=unroll)
     x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"], cfg.layer_norm_eps)
     logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(dt))
     return shard_logical(logits, ("batch", "seq", "vocab"), rules)

@@ -45,10 +45,9 @@ def flash_attention(
     causal: bool = True,
 ) -> jax.Array:
     if _pallas_supported(q):
-        try:
-            from determined_tpu.ops.pallas_attention import pallas_flash_attention
+        from determined_tpu.ops.pallas_attention import pallas_flash_attention
 
-            return pallas_flash_attention(q, k, v, causal=causal)
-        except ImportError:
-            pass
+        return pallas_flash_attention(q, k, v, causal=causal)
     return _xla_attention(q, k, v, causal)
+
+
