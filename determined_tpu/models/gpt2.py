@@ -148,17 +148,20 @@ def init(rng: jax.Array, cfg: Config) -> Dict[str, Any]:
 
 
 def param_logical_axes(cfg: Config) -> Dict[str, Any]:
-    """Logical axis names per param dim; leading None is the stacked-layers dim."""
+    """Logical axis names per param dim; the leading "layers" dim of the
+    stacked blocks shards over the `pipeline` mesh axis (replicated when
+    pipeline=1)."""
+    L = "layers"
     return {
         "wte": ("vocab", "embed"),
         "wpe": (None, "embed"),
         "blocks": {
-            "ln1": {"scale": (None, "embed"), "bias": (None, "embed")},
-            "qkv": {"kernel": (None, "embed", "heads"), "bias": (None, "heads")},
-            "attn_out": {"kernel": (None, "heads", "embed"), "bias": (None, "embed")},
-            "ln2": {"scale": (None, "embed"), "bias": (None, "embed")},
-            "mlp_up": {"kernel": (None, "embed", "mlp"), "bias": (None, "mlp")},
-            "mlp_down": {"kernel": (None, "mlp", "embed"), "bias": (None, "embed")},
+            "ln1": {"scale": (L, "embed"), "bias": (L, "embed")},
+            "qkv": {"kernel": (L, "embed", "heads"), "bias": (L, "heads")},
+            "attn_out": {"kernel": (L, "heads", "embed"), "bias": (L, "embed")},
+            "ln2": {"scale": (L, "embed"), "bias": (L, "embed")},
+            "mlp_up": {"kernel": (L, "embed", "mlp"), "bias": (L, "mlp")},
+            "mlp_down": {"kernel": (L, "mlp", "embed"), "bias": (L, "embed")},
         },
         "ln_f": {"scale": ("embed",), "bias": ("embed",)},
     }
@@ -232,6 +235,35 @@ def _block(x, lp, cfg: Config, rules: Optional[LogicalRules]):
     return shard_logical(x, ("batch", "seq", "embed"), rules)
 
 
+def _remat(block, cfg: Config):
+    """Wrap a block fn in jax.checkpoint per cfg.remat_policy."""
+    policies = {
+        None: None,
+        "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        "dots_saveable": jax.checkpoint_policies.dots_saveable,
+        "nothing": jax.checkpoint_policies.nothing_saveable,
+        "everything": jax.checkpoint_policies.everything_saveable,
+    }
+    policy = policies[cfg.remat_policy]
+    return jax.checkpoint(block, policy=policy) if policy else jax.checkpoint(block)
+
+
+def _nll(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean NLL without materialising a full fp32 log-softmax over the
+    vocab: nll = logsumexp(logits) - logits[target]. XLA fuses the f32
+    upcast into the reduction, so the [B,S,V] array stays bf16 in HBM."""
+    lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - tgt.astype(jnp.float32))
+
+
+def _shift(batch: Dict[str, jax.Array]):
+    tokens = batch["tokens"]
+    if "targets" in batch:
+        return tokens, batch["targets"]
+    return tokens[:, :-1], tokens[:, 1:]
+
+
 def apply(
     params: Dict[str, Any],
     tokens: jax.Array,  # [B, S] int32
@@ -246,15 +278,7 @@ def apply(
 
     block = partial(_block, cfg=cfg, rules=rules)
     if cfg.remat:
-        policies = {
-            None: None,
-            "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            "dots_saveable": jax.checkpoint_policies.dots_saveable,
-            "nothing": jax.checkpoint_policies.nothing_saveable,
-            "everything": jax.checkpoint_policies.everything_saveable,
-        }
-        policy = policies[cfg.remat_policy]
-        block = jax.checkpoint(block, policy=policy) if policy else jax.checkpoint(block)
+        block = _remat(block, cfg)
 
     def scan_body(carry, lp):
         return block(carry, lp), None
@@ -264,6 +288,67 @@ def apply(
     x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"], cfg.layer_norm_eps)
     logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(dt))
     return shard_logical(logits, ("batch", "seq", "vocab"), rules)
+
+
+def apply_pipelined(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cfg: Config,
+    mesh,
+    rules: Optional[LogicalRules] = None,
+    num_microbatches: Optional[int] = None,
+) -> jax.Array:
+    """Forward pass with the transformer blocks run as pipeline stages over
+    the mesh's `pipeline` axis (GPipe schedule; parallel/pipeline.py).
+    Embedding and the LM head run outside the pipeline on every stage."""
+    from determined_tpu.parallel.pipeline import (
+        pipeline_apply, pipeline_microbatches_default)
+
+    b, s = tokens.shape
+    # Activation dtype: cfg.dtype (bf16) on TPU — embedding, pipeline body,
+    # and head all match the non-pipelined apply(). On the CPU backend
+    # low-precision activation gradients around a partial-manual shard_map
+    # crash XLA's SPMD partitioner ("Invalid binary instruction opcode
+    # copy"), so everything runs f32 there (weights still cast in _block).
+    compute = (cfg.dtype if jax.default_backend() in ("tpu", "axon")
+               else jnp.float32)
+    x = (params["wte"].astype(compute)[tokens]
+         + params["wpe"].astype(compute)[:s][None])
+    x = shard_logical(x, ("batch", "seq", "embed"), rules)
+
+    def block(xx, lp):
+        return _block(xx.astype(compute), lp, cfg, rules).astype(compute)
+
+    if cfg.remat:
+        block = _remat(block, cfg)
+    m = num_microbatches or pipeline_microbatches_default(mesh, b, rules)
+    x = pipeline_apply(block, params["blocks"], x, mesh=mesh,
+                       num_microbatches=m, rules=rules,
+                       compute_dtype=compute)
+    x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"],
+                    cfg.layer_norm_eps)
+    logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(compute))
+    return shard_logical(logits, ("batch", "seq", "vocab"), rules)
+
+
+def loss_fn_pipelined(
+    params: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    cfg: Config,
+    mesh,
+    rules: Optional[LogicalRules] = None,
+    num_microbatches: Optional[int] = None,
+) -> jax.Array:
+    tokens = batch["tokens"]
+    if "targets" in batch:
+        inputs, targets = tokens, batch["targets"]
+    else:
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = apply_pipelined(params, inputs, cfg, mesh, rules,
+                             num_microbatches)
+    lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - tgt.astype(jnp.float32))
 
 
 def loss_fn(

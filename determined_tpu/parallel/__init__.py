@@ -18,3 +18,8 @@ from determined_tpu.parallel.sharding import (  # noqa: F401
     shard_logical,
     named_sharding,
 )
+from determined_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_apply,
+    pipeline_microbatches_default,
+    pipeline_stage_count,
+)

@@ -4,11 +4,14 @@ An allocation in determined-tpu is "a set of chips with a fixed ICI mesh"
 (SURVEY.md §7).  This module turns a flat device list into a named
 `jax.sharding.Mesh` with the canonical axis names used across the framework:
 
-  data    — pure data parallelism (replicated params); rides DCN across slices
-  fsdp    — fully-sharded data parallelism (ZeRO-3 analogue); intra-slice ICI
-  tensor  — Megatron-style tensor parallelism; innermost, fastest ICI axis
-  context — sequence/context parallelism (ring attention)
-  expert  — MoE expert parallelism
+  data     — pure data parallelism (replicated params); rides DCN across slices
+  pipeline — pipeline (layer-stage) parallelism; stage boundaries exchange
+             activations once per microbatch, so it sits next to `data` on
+             the slower axes
+  fsdp     — fully-sharded data parallelism (ZeRO-3 analogue); intra-slice ICI
+  tensor   — Megatron-style tensor parallelism; innermost, fastest ICI axis
+  context  — sequence/context parallelism (ring attention)
+  expert   — MoE expert parallelism
 
 Axes of size 1 are always present so PartitionSpecs can reference any axis
 unconditionally — XLA treats size-1 mesh axes as free.
@@ -22,7 +25,7 @@ from typing import Any, Mapping, Optional, Sequence
 
 import numpy as np
 
-AXIS_ORDER = ("data", "fsdp", "expert", "context", "tensor")
+AXIS_ORDER = ("data", "pipeline", "fsdp", "expert", "context", "tensor")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -34,6 +37,7 @@ class MeshConfig:
     """
 
     data: int = -1
+    pipeline: int = 1
     fsdp: int = 1
     expert: int = 1
     context: int = 1

@@ -1,0 +1,178 @@
+"""Pipeline parallelism: GPipe-style microbatched execution over a
+`pipeline` mesh axis.
+
+TPU-first design (SURVEY.md §7 step 3 "PP via pipelined shard_map"): layer
+stacks are sharded over the `pipeline` axis, and a `jax.shard_map` that is
+*manual only over the pipeline axis* (``axis_names={'pipeline'}``) moves
+activations between stages with `ppermute` while GSPMD keeps inserting the
+data/fsdp/tensor collectives automatically inside each stage. The reference
+platform has no native PP — it delegates to DeepSpeed topologies
+(reference: harness/determined/pytorch/deepspeed/_mpu.py:9-46); here it is a
+first-class framework primitive.
+
+Schedule: plain GPipe. M microbatches flow through S stages in M+S-1 ticks;
+each tick every stage applies its layer slice to its current microbatch and
+ppermutes the result to the next stage. Bubble fraction = (S-1)/(M+S-1) —
+callers should use M >= 4*S for decent efficiency (warned below).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+from determined_tpu.parallel.sharding import LogicalRules
+
+
+def pipeline_stage_count(mesh: Mesh) -> int:
+    return mesh.shape.get("pipeline", 1)
+
+
+def _batch_shards(mesh: Mesh, rules: Optional[LogicalRules]) -> int:
+    """How many ways the batch dim is sharded under the rules table."""
+    axes = (rules or LogicalRules()).mesh_axes("batch")
+    if axes is None:
+        return 1
+    if isinstance(axes, str):
+        axes = (axes,)
+    n = 1
+    for a in axes:
+        n *= mesh.shape.get(a, 1)
+    return n
+
+
+def pipeline_apply(
+    block_fn: Callable[[jax.Array, Any], jax.Array],
+    stacked_params: Any,  # pytree, leaves [L, ...] (layer-stacked)
+    x: jax.Array,  # [B, ...] activations entering layer 0
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis: str = "pipeline",
+    rules: Optional[LogicalRules] = None,
+    compute_dtype: Any = None,
+) -> jax.Array:
+    """Run L stacked layers as a pipeline over mesh axis `axis`.
+
+    block_fn(x, layer_params) -> x applies ONE layer (layer_params = one
+    [L, ...] slice). L must divide evenly into stages; the batch B must
+    divide num_microbatches. Returns activations after the last layer,
+    replicated over the pipeline axis (other axes keep their GSPMD layout).
+
+    compute_dtype: when set (e.g. bf16 for an f32 input), activations are
+    cast to it INSIDE the shard_map body and cast back before returning, so
+    the boundary dtype matches x. Keep the boundary in the param dtype —
+    low-precision gradient chains crossing a partial-manual shard_map
+    boundary trip an XLA partitioner crash ("Invalid binary instruction
+    opcode copy") on the CPU backend used for mesh tests.
+    """
+    n_stages = mesh.shape.get(axis, 1)
+    if n_stages == 1:
+        # No pipeline axis in this mesh: plain scan.
+        def body(carry, lp):
+            return block_fn(carry, lp), None
+
+        y, _ = jax.lax.scan(body, x, stacked_params)
+        return y
+
+    n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if n_layers % n_stages:
+        raise ValueError(f"{n_layers} layers not divisible by {n_stages} stages")
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(f"batch {b} not divisible by {num_microbatches} microbatches")
+    m = num_microbatches
+    mb = b // m
+    shards = _batch_shards(mesh, rules)
+    if mb % shards:
+        raise ValueError(
+            f"microbatch size {mb} (batch {b} / {m} microbatches) must stay "
+            f"divisible by the {shards}-way batch sharding — use "
+            f"pipeline_microbatches_default() to pick a valid count"
+        )
+
+    # [B, ...] -> [M, mb, ...]; keep the batch sharding on the mb dim (the
+    # microbatch dim is a time axis — replicated) so the partitioner never
+    # has to invent a layout for the split.
+    micro = x.reshape((m, mb) + x.shape[1:])
+    batch_axes = (rules or LogicalRules()).mesh_axes("batch")
+    micro = jax.lax.with_sharding_constraint(
+        micro,
+        PartitionSpec(None, batch_axes, *([None] * (micro.ndim - 2))),
+    )
+
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def stage_apply(params_shard, xin):
+        # params_shard leaves: [L/S, ...] — this stage's layers, run in order.
+        def body(carry, lp):
+            return block_fn(carry, lp), None
+
+        y, _ = jax.lax.scan(body, xin, params_shard)
+        return y
+
+    def pipelined(params_shard, micro_local):
+        out_dtype = micro_local.dtype
+        if compute_dtype is not None:
+            micro_local = micro_local.astype(compute_dtype)
+        stage = jax.lax.axis_index(axis)
+        total = m + n_stages - 1
+
+        def tick(carry, t):
+            x_cur, outputs = carry
+            # Stage 0 injects microbatch t (clamped once the stream is dry —
+            # those ticks' results are masked out downstream).
+            inject = jax.lax.dynamic_index_in_dim(
+                micro_local, jnp.minimum(t, m - 1), axis=0, keepdims=False)
+            x_in = jnp.where(stage == 0, inject, x_cur)
+            y = stage_apply(params_shard, x_in)
+            # Last stage commits finished microbatch t-S+1 (valid when >= 0).
+            out_idx = t - (n_stages - 1)
+            committed = jax.lax.dynamic_update_index_in_dim(
+                outputs, y.astype(outputs.dtype), jnp.maximum(out_idx, 0), 0)
+            outputs = jnp.where(out_idx >= 0, committed, outputs)
+            x_next = jax.lax.ppermute(y, axis, perm)
+            return (x_next, outputs), None
+
+        # pvary: the carries are device-varying over the pipeline axis from
+        # tick 1 on; mark the zero-init the same way so the scan carry type
+        # is stable under varying-manual-axes checking.
+        outputs = jax.lax.pvary(jnp.zeros_like(micro_local), (axis,))
+        x0 = jax.lax.pvary(jnp.zeros_like(micro_local[0]), (axis,))
+        (x_cur, outputs), _ = jax.lax.scan(
+            tick, (x0, outputs), jnp.arange(total))
+        # Only the last stage holds real outputs; replicate over the axis so
+        # the embedding/head (outside the pipeline) see them everywhere.
+        is_last = (stage == n_stages - 1).astype(outputs.dtype)
+        return jax.lax.psum(outputs * is_last, axis).astype(out_dtype)
+
+    n_axes = set(mesh.axis_names) - {axis}
+    y = jax.shard_map(
+        pipelined,
+        mesh=mesh,
+        in_specs=(PartitionSpec(axis), PartitionSpec()),
+        out_specs=PartitionSpec(),
+        axis_names=frozenset({axis}),
+        check_vma=True,
+    )(stacked_params, micro)
+    del n_axes
+    return y.reshape((b,) + y.shape[2:])
+
+
+def pipeline_microbatches_default(
+    mesh: Mesh, batch: int, rules: Optional[LogicalRules] = None
+) -> int:
+    """Pick a microbatch count: toward 4*stages for a small bubble, while
+    each microbatch stays divisible by the batch sharding."""
+    s = pipeline_stage_count(mesh)
+    if s == 1:
+        return 1
+    local = max(batch // _batch_shards(mesh, rules), 1)
+    want = min(local, 4 * s)
+    while local % want:
+        want -= 1
+    return max(want, 1)

@@ -21,6 +21,7 @@ MeshAxes = Union[None, str, Tuple[str, ...]]
 DEFAULT_RULES: Tuple[Tuple[str, MeshAxes], ...] = (
     ("batch", ("data", "fsdp")),
     ("seq", "context"),
+    ("layers", "pipeline"),  # stacked-layer dim → pipeline stages
     ("embed", "fsdp"),
     ("heads", "tensor"),
     ("kv", None),

@@ -1,0 +1,95 @@
+"""Causal-LM pretraining with the HuggingFace Trainer + DetCallback.
+
+The north-star workload path (reference:
+examples/hf_trainer_api/hf_language_modeling/run_clm.py + README.md:1-14):
+the HF Trainer owns the loop; `DetCallback` bridges metrics, searcher ops,
+checkpoint upload, and preemption to the master through the Core API.
+
+Offline-friendly: builds a from-scratch GPT-2 (size set by `model_size`)
+and a synthetic token dataset by default. Set `dataset_path` (a text file)
+plus a local tokenizer dir to pretrain on real data — no hub access needed.
+"""
+
+import os
+
+import numpy as np
+import torch
+import transformers
+from torch.utils.data import Dataset
+
+from determined_tpu import core
+from determined_tpu.integrations.transformers import DetCallback
+
+
+class TokenDataset(Dataset):
+    """Fixed-length token blocks; labels = inputs (causal LM)."""
+
+    def __init__(self, tokens: np.ndarray, seq_len: int):
+        n = (len(tokens) - 1) // seq_len
+        self.blocks = tokens[: n * seq_len].reshape(n, seq_len)
+
+    def __len__(self):
+        return len(self.blocks)
+
+    def __getitem__(self, i):
+        ids = torch.tensor(self.blocks[i], dtype=torch.long)
+        return {"input_ids": ids, "labels": ids.clone()}
+
+
+def build_model(hp) -> transformers.PreTrainedModel:
+    sizes = {
+        "tiny": dict(n_embd=64, n_layer=2, n_head=2, vocab_size=512,
+                     n_positions=128),
+        "small": dict(n_embd=768, n_layer=12, n_head=12, vocab_size=50257,
+                      n_positions=1024),
+    }
+    cfg = transformers.GPT2Config(**sizes[hp.get("model_size", "tiny")])
+    return transformers.GPT2LMHeadModel(cfg)
+
+
+def build_tokens(hp, vocab_size: int) -> np.ndarray:
+    path = hp.get("dataset_path") or os.environ.get("CLM_TOKENS")
+    if path and os.path.exists(path):
+        return np.fromfile(path, dtype=np.int32) % vocab_size
+    return np.random.default_rng(0).integers(
+        0, vocab_size, size=200_000).astype(np.int32)
+
+
+def main() -> None:
+    with core.init() as ctx:
+        hp = ctx.hparams
+        seq_len = int(hp.get("seq_len", 128))
+        model = build_model(hp)
+        tokens = build_tokens(hp, model.config.vocab_size)
+        split = int(len(tokens) * 0.95)
+        train_ds = TokenDataset(tokens[:split], seq_len)
+        eval_ds = TokenDataset(tokens[split:], seq_len)
+
+        out_dir = hp.get("output_dir", "/tmp/hf_clm_out")
+        args = transformers.TrainingArguments(
+            output_dir=out_dir,
+            per_device_train_batch_size=int(hp.get("per_device_batch", 8)),
+            learning_rate=float(hp.get("learning_rate", 3e-4)),
+            max_steps=int(hp.get("max_steps", 100)),
+            logging_steps=10,
+            eval_strategy="steps",
+            eval_steps=int(hp.get("eval_steps", 50)),
+            save_steps=int(hp.get("eval_steps", 50)),
+            save_total_limit=2,
+            report_to=[],
+            use_cpu=not torch.cuda.is_available(),
+        )
+        det_cb = DetCallback(ctx, args)
+        trainer = transformers.Trainer(
+            model=model,
+            args=args,
+            train_dataset=train_ds,
+            eval_dataset=eval_ds,
+            callbacks=[det_cb],
+        )
+        resume = DetCallback.resume_checkpoint_dir(ctx, out_dir)
+        trainer.train(resume_from_checkpoint=resume)
+
+
+if __name__ == "__main__":
+    main()

@@ -32,13 +32,17 @@ class TestMeshConfig:
 
     def test_from_dict_unknown_axis(self):
         with pytest.raises(ValueError):
-            MeshConfig.from_dict({"pipeline": 2})
+            MeshConfig.from_dict({"sequence": 2})  # not a mesh axis name
+
+    def test_from_dict_pipeline_axis(self):
+        assert MeshConfig.from_dict({"pipeline": 2}).pipeline == 2
 
 
 class TestCreateMesh:
     def test_axes_and_shape(self, devices):
         mesh = create_mesh(MeshConfig(data=2, fsdp=2, tensor=2), devices)
-        assert mesh.axis_names == ("data", "fsdp", "expert", "context", "tensor")
+        assert mesh.axis_names == (
+            "data", "pipeline", "fsdp", "expert", "context", "tensor")
         assert mesh.shape["data"] == 2
         assert mesh.shape["tensor"] == 2
         assert mesh.devices.size == 8

@@ -1,0 +1,140 @@
+"""Pipeline parallelism: pipelined execution must match the plain scan.
+
+Reference anchor: the reference is only pipeline-*aware* via DeepSpeed's MPU
+(harness/determined/pytorch/deepspeed/_mpu.py); here PP is first-class
+(determined_tpu/parallel/pipeline.py), so correctness is checked directly
+against single-device execution on the virtual CPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from determined_tpu.models import gpt2
+from determined_tpu.parallel import MeshConfig, create_mesh, pipeline_apply
+
+
+def _tiny_cfg(**kw):
+    kw.setdefault("dtype", jnp.float32)
+    kw.setdefault("remat", False)
+    kw.setdefault("attention_impl", "dot")
+    return gpt2.Config(
+        vocab_size=128, n_positions=64, d_model=32, n_layer=4, n_head=2, **kw
+    )
+
+
+class TestPipelineApply:
+    def test_matches_scan_mlp_stack(self, devices):
+        """A generic 4-layer MLP stack: pipelined == sequential."""
+        mesh = create_mesh(MeshConfig(data=2, pipeline=4), devices)
+        rng = jax.random.PRNGKey(0)
+        L, D, B = 4, 16, 8
+        w = jax.random.normal(rng, (L, D, D)) * 0.3
+
+        def block(x, wl):
+            return jnp.tanh(x @ wl)
+
+        x = jax.random.normal(jax.random.PRNGKey(1), (B, D))
+
+        def ref(w, x):
+            def body(c, wl):
+                return block(c, wl), None
+            y, _ = jax.lax.scan(body, x, w)
+            return y
+
+        want = ref(w, x)
+        with jax.sharding.set_mesh(mesh):
+            got = jax.jit(
+                lambda w, x: pipeline_apply(
+                    block, w, x, mesh=mesh, num_microbatches=4)
+            )(w, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5, rtol=1e-5)
+
+    @pytest.mark.parametrize("microbatches", [2, 4, 8])
+    def test_microbatch_counts(self, devices, microbatches):
+        mesh = create_mesh(MeshConfig(data=1, pipeline=2), devices[:2])
+        L, D, B = 2, 8, 8
+        w = jax.random.normal(jax.random.PRNGKey(2), (L, D, D)) * 0.3
+        x = jax.random.normal(jax.random.PRNGKey(3), (B, D))
+
+        def block(x, wl):
+            return jnp.tanh(x @ wl)
+
+        def ref(w, x):
+            def body(c, wl):
+                return block(c, wl), None
+            return jax.lax.scan(body, x, w)[0]
+
+        want = ref(w, x)
+        with jax.sharding.set_mesh(mesh):
+            got = jax.jit(lambda w, x: pipeline_apply(
+                block, w, x, mesh=mesh, num_microbatches=microbatches))(w, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    def test_no_pipeline_axis_falls_back_to_scan(self, devices):
+        mesh = create_mesh(MeshConfig(data=8), devices)
+        L, D, B = 3, 8, 4
+        w = jax.random.normal(jax.random.PRNGKey(4), (L, D, D)) * 0.3
+        x = jax.random.normal(jax.random.PRNGKey(5), (B, D))
+
+        def block(x, wl):
+            return x @ wl
+
+        with jax.sharding.set_mesh(mesh):
+            got = pipeline_apply(block, w, x, mesh=mesh, num_microbatches=2)
+        want = x
+        for i in range(L):
+            want = want @ w[i]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-4, rtol=1e-4)
+
+
+class TestGPT2Pipelined:
+    def test_forward_matches_single_device(self, devices):
+        cfg = _tiny_cfg()
+        params = gpt2.init(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 128)
+        want = gpt2.apply(params, tokens, cfg)
+
+        mesh = create_mesh(MeshConfig(data=2, pipeline=2, tensor=2), devices)
+        with jax.sharding.set_mesh(mesh):
+            got = jax.jit(
+                lambda p, t: gpt2.apply_pipelined(
+                    p, t, cfg, mesh, num_microbatches=4)
+            )(params, tokens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_train_step_pipelined(self, devices):
+        """Full pp×dp×tp train step: loss finite and params update."""
+        import optax
+
+        from determined_tpu.train import create_train_state, make_train_step
+
+        cfg = _tiny_cfg(remat=True)
+        mesh = create_mesh(MeshConfig(data=2, pipeline=2, tensor=2), devices)
+        tx = optax.adamw(1e-3)
+        batch = {
+            "tokens": np.random.default_rng(0)
+            .integers(0, cfg.vocab_size, size=(8, 33))
+            .astype(np.int32)
+        }
+        with jax.sharding.set_mesh(mesh):
+            state = create_train_state(
+                lambda r: gpt2.init(r, cfg), tx, jax.random.PRNGKey(0),
+                mesh=mesh, param_logical_axes=gpt2.param_logical_axes(cfg))
+            # layer stack must actually be sharded over the pipeline axis
+            qkv = state.params["blocks"]["qkv"]["kernel"]
+            assert "pipeline" in jax.tree_util.tree_leaves(
+                [qkv.sharding.spec])[0:1][0] or qkv.sharding.spec[0] == "pipeline"
+            step = make_train_step(
+                lambda p, b, r: gpt2.loss_fn_pipelined(
+                    p, b, cfg, mesh, num_microbatches=4),
+                tx, mesh=mesh)
+            before = np.asarray(jax.device_get(state.params["wte"]))
+            state2, metrics = step(state, batch, jax.random.PRNGKey(1))
+        assert np.isfinite(float(metrics["loss"]))
+        after = np.asarray(jax.device_get(state2.params["wte"]))
+        assert not np.allclose(before, after)
