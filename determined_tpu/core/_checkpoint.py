@@ -88,6 +88,17 @@ class CheckpointContext:
         # Deterministic id so all hosts agree without a broadcast.
         storage_id = f"trial{self._trial_id}-step{steps_completed}"
         path = self._array_path(storage_id)
+        if (
+            self._needs_staged_copy(path)
+            and self._dist is not None
+            and self._dist.size > 1
+        ):
+            # orbax's atomic finalize needs a filesystem all hosts can see;
+            # host-local staging would silently drop non-primary shards.
+            raise RuntimeError(
+                "staged cloud backends (azure) do not support multi-host "
+                "array checkpoints; use shared_fs or gcs for multi-host trials"
+            )
         state_dir = path + "/" + _STATE_SUBDIR
         if not _is_remote(path):
             os.makedirs(path, exist_ok=True)
@@ -104,8 +115,27 @@ class CheckpointContext:
         if self._is_chief() and not _is_remote(path):
             with open(os.path.join(path, _METADATA_FILE), "w") as f:
                 json.dump(md, f)
+        if self._needs_staged_copy(path):
+            # No tensorstore driver for this backend (azure): the orbax save
+            # landed in local staging — push it to the bucket, then drop the
+            # staging copy so periodic checkpointing doesn't fill /tmp. Every
+            # host uploads its own shard files (reference shard=True
+            # semantics).
+            import shutil
+
+            self.wait()
+            try:
+                self._storage.upload(path, storage_id)
+            finally:
+                shutil.rmtree(path, ignore_errors=True)
         self._report(storage_id, md)
         return storage_id
+
+    def _needs_staged_copy(self, path: str) -> bool:
+        return (
+            not _is_remote(path)
+            and getattr(self._storage, "requires_staging", False)
+        )
 
     def _array_path(self, storage_id: str) -> str:
         """Where orbax reads/writes this checkpoint's arrays.
@@ -116,7 +146,9 @@ class CheckpointContext:
         """
         url_for = getattr(self._storage, "url_for", None)
         if url_for is not None:
-            return url_for(storage_id)
+            url = url_for(storage_id)
+            if url:  # backends without a tensorstore scheme (azure) return None
+                return url
         return os.path.abspath(self._storage.path_for(storage_id))
 
     def restore_state(self, storage_id: str, abstract_state: Any) -> Any:
@@ -129,10 +161,6 @@ class CheckpointContext:
         """
         import jax
 
-        path = self._array_path(storage_id)
-        if not _is_remote(path) and not os.path.isdir(path):
-            raise FileNotFoundError(f"checkpoint {storage_id} not found at {path}")
-        state_dir = path + "/" + _STATE_SUBDIR
         abstract = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
             if hasattr(x, "shape")
@@ -142,14 +170,39 @@ class CheckpointContext:
         import orbax.checkpoint as ocp
 
         restorer = ocp.StandardCheckpointer()
-        return restorer.restore(state_dir, abstract)
+        path = self._array_path(storage_id)
+        if self._needs_staged_copy(path):
+            # restore_path pulls a fresh copy from the bucket into staging
+            # (never trusting this host's own stale/partial staging) and
+            # cleans up afterwards.
+            with self._storage.restore_path(storage_id) as local_path:
+                state_dir = os.path.join(local_path, _STATE_SUBDIR)
+                if not os.path.isdir(state_dir):
+                    raise FileNotFoundError(
+                        f"checkpoint {storage_id} has no array state in cloud storage"
+                    )
+                return restorer.restore(state_dir, abstract)
+        if not _is_remote(path) and not os.path.isdir(path):
+            raise FileNotFoundError(f"checkpoint {storage_id} not found at {path}")
+        return restorer.restore(path + "/" + _STATE_SUBDIR, abstract)
 
     def load_metadata(self, storage_id: str) -> Dict[str, Any]:
-        with self._storage.restore_path(storage_id) as path:
-            md_file = os.path.join(path, _METADATA_FILE)
+        # Fetch only metadata.json — restore_path on a cloud backend would
+        # download every shard of the checkpoint just to read one small file.
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            self._storage.download(
+                storage_id, td, selector=lambda rel: rel == _METADATA_FILE
+            )
+            md_file = os.path.join(td, _METADATA_FILE)
             if os.path.exists(md_file):
                 with open(md_file) as f:
                     return json.load(f)
+        # No metadata file: distinguish "checkpoint without metadata" from a
+        # bad storage id (download() is a no-op for missing ids).
+        if not self._storage.list_files(storage_id):
+            raise FileNotFoundError(f"checkpoint {storage_id} not found")
         return {}
 
     def wait(self) -> None:
@@ -169,14 +222,16 @@ class CheckpointContext:
     @contextlib.contextmanager
     def store_path(self, metadata: Optional[Dict[str, Any]] = None) -> Iterator[tuple]:
         """Chief-only convenience: yield (path, storage_id); report on exit."""
+        md = dict(metadata or {})
         with self._storage.store_path() as (storage_id, path):
             yield path, storage_id
-            md = dict(metadata or {})
             md.setdefault("time", time.time())
             if self._is_chief():
                 with open(os.path.join(path, _METADATA_FILE), "w") as f:
                     json.dump(md, f)
-            self._report(storage_id, md)
+        # Report after the storage context exits — cloud backends upload on
+        # exit, so list_files() inside _report sees the final bucket contents.
+        self._report(storage_id, md)
 
     def upload(
         self,

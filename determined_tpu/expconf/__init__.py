@@ -64,6 +64,61 @@ def _validate_hparam(name: str, spec: Any, errors: List[str]) -> None:
             errors.append(f"hyperparameters.{name}: minval > maxval")
 
 
+def _validate_mesh(mesh: Any, resources: Dict[str, Any], errors: List[str]) -> None:
+    """`hyperparameters.mesh` is THE home of the allocation's mesh request
+    (determined_tpu/parallel/mesh.py MeshConfig): axis name → size, -1 means
+    "absorb the remaining chips" (at most one axis), product must match
+    resources.slots_per_trial when fully specified."""
+    if mesh is None:
+        return
+    from determined_tpu.parallel.mesh import AXIS_ORDER
+
+    if not isinstance(mesh, dict):
+        errors.append("hyperparameters.mesh must be a mapping of axis -> size")
+        return
+    unknown = sorted(set(mesh) - set(AXIS_ORDER))
+    if unknown:
+        errors.append(
+            f"hyperparameters.mesh: unknown axes {unknown}; valid: {list(AXIS_ORDER)}"
+        )
+    sizes = []
+    n_unknown = 0
+    for k, v in mesh.items():
+        if isinstance(v, bool) or not isinstance(v, int) or v == 0 or v < -1:
+            errors.append(
+                f"hyperparameters.mesh.{k}: size must be a positive int or -1"
+            )
+            return
+        if v == -1:
+            n_unknown += 1
+        else:
+            sizes.append(v)
+    # MeshConfig defaults an omitted `data` axis to -1 (absorb remaining
+    # chips) — mirror that here so runtime-valid configs pass validation.
+    if "data" not in mesh:
+        n_unknown += 1
+    if n_unknown > 1:
+        errors.append("hyperparameters.mesh: at most one axis may be -1")
+    # apply_defaults will set slots_per_trial=1 — validate against that same
+    # default so a mesh asking for 8 chips with no resources block fails at
+    # submit time, not at MeshConfig.resolve() mid-launch.
+    slots = resources.get("slots_per_trial", 1)
+    if isinstance(slots, int) and slots > 0 and not unknown:
+        import math
+
+        product = math.prod(sizes)
+        if n_unknown == 0 and product != slots:
+            errors.append(
+                f"hyperparameters.mesh: axis product {product} != "
+                f"resources.slots_per_trial {slots}"
+            )
+        elif n_unknown == 1 and slots % product != 0:
+            errors.append(
+                f"hyperparameters.mesh: slots_per_trial {slots} not divisible "
+                f"by fixed axes product {product}"
+            )
+
+
 def _length_units(v: Any) -> Optional[int]:
     if isinstance(v, (int, float)):
         return int(v)
@@ -112,7 +167,15 @@ def validate(config: Dict[str, Any]) -> List[str]:
         errors.append("hyperparameters must be a mapping")
     else:
         for k, v in hparams.items():
+            if k == "mesh":
+                continue  # the mesh block is not an hparam search space
             _validate_hparam(k, v, errors)
+        _validate_mesh(
+            hparams.get("mesh"),
+            config.get("resources", {}) if isinstance(config.get("resources"), dict)
+            else {},
+            errors,
+        )
         if isinstance(searcher, dict) and searcher.get("name") == "grid":
             def needs_count(spec: Any) -> bool:
                 if not _is_hparam_spec(spec):
@@ -143,6 +206,8 @@ def validate(config: Dict[str, Any]) -> List[str]:
             )
         elif storage["type"] in ("gcs", "s3") and not storage.get("bucket"):
             errors.append("checkpoint_storage.bucket is required for cloud storage")
+        elif storage["type"] == "azure" and not storage.get("container"):
+            errors.append("checkpoint_storage.container is required for azure storage")
 
     mr = config.get("max_restarts")
     if mr is not None and (not isinstance(mr, int) or mr < 0):
@@ -187,8 +252,6 @@ def apply_defaults(config: Dict[str, Any]) -> Dict[str, Any]:
     c.setdefault("reproducibility", {})
     c.setdefault("environment", {})
     c.setdefault("profiling", {"enabled": False})
-    c.setdefault("tpu", {})  # TPU-native block: topology/mesh defaults
-    c["tpu"].setdefault("mesh", {})  # e.g. {"data": -1, "fsdp": 8}
     return c
 
 

@@ -2,8 +2,9 @@
 
 A StorageManager maps (storage config) → concrete paths/upload/download.
 `shared_fs` and `directory` are fully native (GCS buckets are typically
-FUSE-mounted on TPU-VMs, so shared_fs covers gcsfuse too); `gcs`/`s3`/`azure`
-use their cloud SDKs when importable and raise a clear error otherwise.
+FUSE-mounted on TPU-VMs, so shared_fs covers gcsfuse too); `gcs`/`s3` use
+their cloud SDKs when importable and raise a clear error otherwise; `azure`
+speaks the Blob REST protocol directly (storage/azure.py, no SDK needed).
 """
 
 from determined_tpu.storage.base import StorageManager, from_config  # noqa: F401

@@ -5,21 +5,29 @@ On TPU-VMs the canonical checkpoint path is a GCS bucket. Two modes:
   1. tensorstore-native: orbax writes `gs://...` URLs directly (no local
      staging) — used automatically by CheckpointContext when the storage
      manager exposes a `url_for` returning a gs:// path.
-  2. SDK copy mode: upload/download via the cloud SDK, for arbitrary files.
+  2. staged-copy mode: `store_path` yields a local staging dir and uploads it
+     on exit; `restore_path` downloads into staging first. This is how file
+     checkpoints (keras .keras files, torch state dicts) reach the bucket,
+     and how array checkpoints work on backends tensorstore has no driver
+     for (azure).
 SDKs are imported lazily; a missing SDK raises with install guidance.
 """
 
 from __future__ import annotations
 
+import contextlib
 import os
 import tempfile
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from determined_tpu.storage.base import StorageManager
 
 
 class CloudStorageManager(StorageManager):
     scheme = ""
+    # File-style checkpoints stage locally and copy to the bucket; array
+    # checkpoints skip staging iff url_for() returns a tensorstore URL.
+    requires_staging = True
 
     def __init__(self, bucket: str, prefix: str = ""):
         self.bucket = bucket
@@ -27,9 +35,72 @@ class CloudStorageManager(StorageManager):
         # local staging area for upload/download-style use
         super().__init__(os.path.join(tempfile.gettempdir(), "det_tpu_cloud_staging"))
 
-    def url_for(self, storage_id: str) -> str:
+    def url_for(self, storage_id: str) -> Optional[str]:
+        if not self.scheme:
+            return None
         parts = [p for p in (self.bucket, self.prefix, storage_id) if p]
         return f"{self.scheme}://" + "/".join(parts)
+
+    def _key(self, storage_id: str, rel: str) -> str:
+        parts = [p for p in (self.prefix, storage_id, rel) if p]
+        return "/".join(parts)
+
+    def _list_prefix(self, storage_id: str) -> str:
+        key = self._key(storage_id, "")
+        return key + "/" if key and not key.endswith("/") else key
+
+    @staticmethod
+    def _iter_upload_files(src: str, paths: Optional[List[str]]) -> Iterator[Tuple[str, str]]:
+        """Yield (local_path, rel_key) for every file to upload."""
+        names = paths if paths is not None else os.listdir(src)
+        for name in names:
+            full = os.path.join(src, name)
+            if os.path.isdir(full):
+                for root, _, files in os.walk(full):
+                    for f in files:
+                        p = os.path.join(root, f)
+                        yield p, os.path.relpath(p, src)
+            else:
+                yield full, name
+
+    # -- staged file checkpoints --------------------------------------
+
+    @contextlib.contextmanager
+    def store_path(self, storage_id: Optional[str] = None) -> Iterator[tuple]:
+        """Stage locally, upload to the bucket on exit (reference
+        StorageManager.store_path upload-on-close semantics). Staging is
+        removed after the upload so periodic checkpointing doesn't fill /tmp."""
+        import shutil
+
+        storage_id = storage_id or self.new_storage_id()
+        path = self.path_for(storage_id)
+        os.makedirs(path, exist_ok=True)
+        try:
+            yield storage_id, path
+            self.upload(path, storage_id)
+        finally:
+            shutil.rmtree(path, ignore_errors=True)
+
+    @contextlib.contextmanager
+    def restore_path(self, storage_id: str) -> Iterator[str]:
+        """Download into a FRESH staging dir (stale/partial staging from an
+        earlier save on this host must never shadow the bucket), raise
+        FileNotFoundError like the base class when the id doesn't exist, and
+        clean staging up afterwards."""
+        import shutil
+
+        path = self.path_for(storage_id)
+        shutil.rmtree(path, ignore_errors=True)
+        os.makedirs(path, exist_ok=True)
+        try:
+            self.download(storage_id, path)
+            if not os.listdir(path):
+                raise FileNotFoundError(
+                    f"checkpoint {storage_id} not found in {type(self).__name__}"
+                )
+            yield path
+        finally:
+            shutil.rmtree(path, ignore_errors=True)
 
 
 class GCSStorageManager(CloudStorageManager):
@@ -55,17 +126,8 @@ class GCSStorageManager(CloudStorageManager):
 
         client = storage.Client()
         bucket = client.bucket(self.bucket)
-        names = paths if paths is not None else os.listdir(src)
-        for name in names:
-            full = os.path.join(src, name)
-            if os.path.isdir(full):
-                for root, _, files in os.walk(full):
-                    for f in files:
-                        p = os.path.join(root, f)
-                        rel = os.path.relpath(p, src)
-                        bucket.blob(self._key(storage_id, rel)).upload_from_filename(p)
-            else:
-                bucket.blob(self._key(storage_id, name)).upload_from_filename(full)
+        for path, rel in self._iter_upload_files(src, paths):
+            bucket.blob(self._key(storage_id, rel)).upload_from_filename(path)
 
     def download(self, storage_id: str, dst: str, selector=None) -> None:
         if not self._sdk:
@@ -74,18 +136,43 @@ class GCSStorageManager(CloudStorageManager):
 
         client = storage.Client()
         bucket = client.bucket(self.bucket)
-        prefix = self._key(storage_id, "")
+        prefix = self._list_prefix(storage_id)
         for blob in client.list_blobs(bucket, prefix=prefix):
             rel = blob.name[len(prefix):]
             if selector is not None and not selector(rel):
                 continue
             out = os.path.join(dst, rel)
-            os.makedirs(os.path.dirname(out), exist_ok=True)
+            os.makedirs(os.path.dirname(out) or dst, exist_ok=True)
             blob.download_to_filename(out)
 
-    def _key(self, storage_id: str, rel: str) -> str:
-        parts = [p for p in (self.prefix, storage_id, rel) if p]
-        return "/".join(parts)
+    def list_files(self, storage_id: str) -> Dict[str, int]:
+        if not self._sdk:
+            return {}
+        from google.cloud import storage
+
+        client = storage.Client()
+        prefix = self._list_prefix(storage_id)
+        return {
+            b.name[len(prefix):]: b.size or 0
+            for b in client.list_blobs(client.bucket(self.bucket), prefix=prefix)
+        }
+
+    def delete(self, storage_id: str, globs: Optional[List[str]] = None) -> Dict[str, Any]:
+        import fnmatch
+
+        from google.cloud import storage
+
+        client = storage.Client()
+        bucket = client.bucket(self.bucket)
+        prefix = self._list_prefix(storage_id)
+        remaining: Dict[str, int] = {}
+        for blob in client.list_blobs(bucket, prefix=prefix):
+            rel = blob.name[len(prefix):]
+            if globs is not None and not any(fnmatch.fnmatch(rel, g) for g in globs):
+                remaining[rel] = blob.size or 0
+                continue
+            blob.delete()
+        return remaining
 
 
 class S3StorageManager(CloudStorageManager):
@@ -102,23 +189,14 @@ class S3StorageManager(CloudStorageManager):
         import boto3
 
         s3 = boto3.client("s3")
-        names = paths if paths is not None else os.listdir(src)
-        for name in names:
-            full = os.path.join(src, name)
-            if os.path.isdir(full):
-                for root, _, files in os.walk(full):
-                    for f in files:
-                        p = os.path.join(root, f)
-                        rel = os.path.relpath(p, src)
-                        s3.upload_file(p, self.bucket, self._key(storage_id, rel))
-            else:
-                s3.upload_file(full, self.bucket, self._key(storage_id, name))
+        for path, rel in self._iter_upload_files(src, paths):
+            s3.upload_file(path, self.bucket, self._key(storage_id, rel))
 
     def download(self, storage_id: str, dst: str, selector=None) -> None:
         import boto3
 
         s3 = boto3.client("s3")
-        prefix = self._key(storage_id, "")
+        prefix = self._list_prefix(storage_id)
         paginator = s3.get_paginator("list_objects_v2")
         for page in paginator.paginate(Bucket=self.bucket, Prefix=prefix):
             for obj in page.get("Contents", []):
@@ -126,22 +204,86 @@ class S3StorageManager(CloudStorageManager):
                 if selector is not None and not selector(rel):
                     continue
                 out = os.path.join(dst, rel)
-                os.makedirs(os.path.dirname(out), exist_ok=True)
+                os.makedirs(os.path.dirname(out) or dst, exist_ok=True)
                 s3.download_file(self.bucket, obj["Key"], out)
 
-    def _key(self, storage_id: str, rel: str) -> str:
-        parts = [p for p in (self.prefix, storage_id, rel) if p]
-        return "/".join(parts)
+    def list_files(self, storage_id: str) -> Dict[str, int]:
+        import boto3
+
+        s3 = boto3.client("s3")
+        prefix = self._list_prefix(storage_id)
+        out: Dict[str, int] = {}
+        paginator = s3.get_paginator("list_objects_v2")
+        for page in paginator.paginate(Bucket=self.bucket, Prefix=prefix):
+            for obj in page.get("Contents", []):
+                out[obj["Key"][len(prefix):]] = obj["Size"]
+        return out
+
+    def delete(self, storage_id: str, globs: Optional[List[str]] = None) -> Dict[str, Any]:
+        import fnmatch
+
+        import boto3
+
+        s3 = boto3.client("s3")
+        prefix = self._list_prefix(storage_id)
+        remaining: Dict[str, int] = {}
+        for rel, size in self.list_files(storage_id).items():
+            if globs is not None and not any(fnmatch.fnmatch(rel, g) for g in globs):
+                remaining[rel] = size
+                continue
+            s3.delete_object(Bucket=self.bucket, Key=prefix + rel)
+        return remaining
 
 
 class AzureStorageManager(CloudStorageManager):
-    scheme = "az"
+    """Azure Blob backend over the stdlib REST client (storage/azure.py) —
+    no SDK dependency. `bucket` is the container name. tensorstore has no
+    az:// driver, so url_for returns None and CheckpointContext uses the
+    staged save+upload path for array checkpoints too."""
+
+    scheme = ""  # no tensorstore scheme → url_for() → None → staged copies
 
     def __init__(self, container: str, connection_string: str = "", prefix: str = ""):
         super().__init__(container, prefix)
-        raise RuntimeError(
-            "azure-storage-blob not available in this image; use shared_fs/gcs"
-        )
+        from determined_tpu.storage.azure import AzureBlobClient
+
+        self._client = AzureBlobClient(connection_string or None)
+
+    def upload(self, src: str, storage_id: str, paths: Optional[List[str]] = None) -> None:
+        for path, rel in self._iter_upload_files(src, paths):
+            self._client.put_blob_from_file(
+                self.bucket, self._key(storage_id, rel), path
+            )
+
+    def download(self, storage_id: str, dst: str, selector=None) -> None:
+        prefix = self._list_prefix(storage_id)
+        for name, _size in self._client.list_blobs(self.bucket, prefix):
+            rel = name[len(prefix):]
+            if selector is not None and not selector(rel):
+                continue
+            out = os.path.join(dst, rel)
+            os.makedirs(os.path.dirname(out) or dst, exist_ok=True)
+            self._client.get_blob_to_file(self.bucket, name, out)
+
+    def list_files(self, storage_id: str) -> Dict[str, int]:
+        prefix = self._list_prefix(storage_id)
+        return {
+            name[len(prefix):]: size
+            for name, size in self._client.list_blobs(self.bucket, prefix)
+        }
+
+    def delete(self, storage_id: str, globs: Optional[List[str]] = None) -> Dict[str, Any]:
+        import fnmatch
+
+        prefix = self._list_prefix(storage_id)
+        remaining: Dict[str, int] = {}
+        for name, size in self._client.list_blobs(self.bucket, prefix):
+            rel = name[len(prefix):]
+            if globs is not None and not any(fnmatch.fnmatch(rel, g) for g in globs):
+                remaining[rel] = size
+                continue
+            self._client.delete_blob(self.bucket, name)
+        return remaining
 
 
 def cloud_from_config(stype: str, config: Dict[str, Any]) -> StorageManager:
@@ -150,5 +292,13 @@ def cloud_from_config(stype: str, config: Dict[str, Any]) -> StorageManager:
     if stype == "s3":
         return S3StorageManager(config["bucket"], config.get("prefix", ""))
     if stype == "azure":
-        return AzureStorageManager(config.get("container", ""), config.get("connection_string", ""))
+        if not config.get("container"):
+            raise ValueError(
+                "checkpoint_storage.container is required for azure storage"
+            )
+        return AzureStorageManager(
+            config["container"],
+            config.get("connection_string", ""),
+            config.get("prefix", ""),
+        )
     raise ValueError(stype)

@@ -1,0 +1,105 @@
+"""expconf schema tests: searcher/storage/mesh validation and defaults.
+
+Reference discipline: schemas/expconf/v0/*.json validation in the master's
+pkg/schemas/expconf (SURVEY.md §5 "Config/flag system")."""
+
+import pytest
+
+from determined_tpu import expconf
+
+
+def base_config(**over):
+    c = {
+        "entrypoint": "python3 train.py",
+        "searcher": {
+            "name": "single",
+            "metric": "loss",
+            "max_length": {"batches": 10},
+        },
+    }
+    c.update(over)
+    return c
+
+
+class TestValidate:
+    def test_valid_minimal(self):
+        assert expconf.validate(base_config()) == []
+
+    def test_missing_entrypoint(self):
+        c = base_config()
+        del c["entrypoint"]
+        assert any("entrypoint" in e for e in expconf.validate(c))
+
+    def test_azure_requires_container(self):
+        c = base_config(checkpoint_storage={"type": "azure"})
+        assert any("container" in e for e in expconf.validate(c))
+        c = base_config(
+            checkpoint_storage={"type": "azure", "container": "ckpts"}
+        )
+        assert expconf.validate(c) == []
+
+
+class TestMeshValidation:
+    """hyperparameters.mesh is the single validated home of the mesh config."""
+
+    def test_valid_mesh(self):
+        c = base_config(
+            hyperparameters={"mesh": {"data": -1, "fsdp": 4}},
+            resources={"slots_per_trial": 8},
+        )
+        assert expconf.validate(c) == []
+
+    def test_unknown_axis_rejected(self):
+        c = base_config(hyperparameters={"mesh": {"warp": 2}})
+        errs = expconf.validate(c)
+        assert any("unknown axes" in e and "warp" in e for e in errs)
+
+    def test_two_minus_ones_rejected(self):
+        c = base_config(hyperparameters={"mesh": {"data": -1, "fsdp": -1}})
+        assert any("at most one axis may be -1" in e for e in expconf.validate(c))
+
+    def test_zero_size_rejected(self):
+        c = base_config(hyperparameters={"mesh": {"data": 0}})
+        assert any("positive int or -1" in e for e in expconf.validate(c))
+
+    def test_bool_size_rejected(self):
+        # YAML `data: true` must not slip through as int(1)
+        c = base_config(hyperparameters={"mesh": {"data": True}})
+        assert any("positive int or -1" in e for e in expconf.validate(c))
+
+    def test_product_must_match_slots(self):
+        c = base_config(
+            hyperparameters={"mesh": {"data": 2, "tensor": 3}},
+            resources={"slots_per_trial": 8},
+        )
+        assert any("axis product 6" in e for e in expconf.validate(c))
+
+    def test_mesh_without_resources_checks_default_slots(self):
+        # apply_defaults sets slots_per_trial=1; a fixed 8-chip mesh with no
+        # resources block must fail at submit, not at MeshConfig.resolve().
+        c = base_config(hyperparameters={"mesh": {"data": 8}})
+        assert any("axis product 8" in e for e in expconf.validate(c))
+
+    def test_slots_divisibility_with_wildcard(self):
+        c = base_config(
+            hyperparameters={"mesh": {"data": -1, "tensor": 3}},
+            resources={"slots_per_trial": 8},
+        )
+        assert any("not divisible" in e for e in expconf.validate(c))
+
+    def test_check_raises_on_bad_mesh(self):
+        c = base_config(hyperparameters={"mesh": {"bogus": 1}})
+        with pytest.raises(ValueError, match="bogus"):
+            expconf.check(c)
+
+
+class TestDefaults:
+    def test_no_dead_tpu_block(self):
+        # The mesh config has exactly one home: hyperparameters.mesh.
+        out = expconf.apply_defaults(base_config())
+        assert "tpu" not in out
+
+    def test_core_defaults(self):
+        out = expconf.apply_defaults(base_config())
+        assert out["max_restarts"] == 5
+        assert out["resources"]["slots_per_trial"] == 1
