@@ -37,6 +37,14 @@ def _is_remote(path: str) -> bool:
     return "://" in path
 
 
+def _dir_files(src: str, names: Optional[List[str]]) -> Dict[str, int]:
+    """rel path -> size for the files upload() pushed from `src` (same walk
+    as the storage upload implementations, storage/base.py)."""
+    from determined_tpu.storage.base import iter_upload_files
+
+    return {rel: os.path.getsize(p) for p, rel in iter_upload_files(src, names)}
+
+
 class CheckpointContext:
     def __init__(
         self,
@@ -244,23 +252,38 @@ class CheckpointContext:
 
         shard=True: every rank uploads its own files into the same storage id
         (rank-unique filenames are the caller's contract, as in the reference
-        core/_checkpoint.py:282).
+        core/_checkpoint.py:282); each rank's uploaded-file metadata is
+        gathered to the chief over the object control plane and reported
+        merged, so the registry knows the full resource list even on
+        non-shared storage.
         """
-        if shard and self._dist is not None and self._dist.size > 1:
-            # All hosts must agree on the id: chief's timestamp, broadcast as
-            # an int (the control plane only moves numeric payloads).
-            stamp = int(self._dist.broadcast(int(time.time() * 1000)))
-            storage_id = f"trial{self._trial_id}-upload{stamp}"
+        sharded = shard and self._dist is not None and self._dist.size > 1
+        if sharded:
+            # All hosts must agree on the id: chief generates, broadcast as a
+            # python string over the object control plane.
+            storage_id = self._dist.broadcast(self._storage.new_storage_id())
         else:
             storage_id = self._storage.new_storage_id()
         names = None
         if selector is not None:
             names = [n for n in os.listdir(ckpt_dir) if selector(n)]
+        local_files: Dict[str, int] = {}
         if shard or self._is_chief():
             self._storage.upload(ckpt_dir, storage_id, names)
+            local_files = _dir_files(ckpt_dir, names)
         md = dict(metadata or {})
         md.setdefault("time", time.time())
-        self._report(storage_id, md)
+        resources: Optional[Dict[str, int]] = None
+        if sharded:
+            # gather doubles as the all-uploads-finished barrier before the
+            # chief registers the checkpoint (reference metadata merge,
+            # core/_checkpoint.py:282).
+            gathered = self._dist.gather(local_files)
+            if gathered is not None:
+                resources = {}
+                for files in gathered:
+                    resources.update(files)
+        self._report(storage_id, md, resources=resources)
         return storage_id
 
     def download(self, storage_id: str, ckpt_dir: str, selector=None) -> None:
@@ -277,7 +300,12 @@ class CheckpointContext:
 
     # -- master reporting ---------------------------------------------
 
-    def _report(self, storage_id: str, metadata: Dict[str, Any]) -> None:
+    def _report(
+        self,
+        storage_id: str,
+        metadata: Dict[str, Any],
+        resources: Optional[Dict[str, int]] = None,
+    ) -> None:
         if not self._is_chief():
             return
         record = {
@@ -286,13 +314,14 @@ class CheckpointContext:
             "allocation_id": self._allocation_id,
             "metadata": metadata,
             "steps_completed": metadata.get("steps_completed", 0),
-            "resources": {},
+            "resources": resources or {},
         }
         if self._session is None:
             self.local_reported.append(record)
             return
-        try:
-            record["resources"] = self._storage.list_files(storage_id)
-        except Exception:
-            pass
+        if resources is None:
+            try:
+                record["resources"] = self._storage.list_files(storage_id)
+            except Exception:
+                pass
         self._session.post("/api/v1/checkpoints", body=record)

@@ -2,12 +2,20 @@
 
 The reference coordinates non-gradient data (sharded-checkpoint metadata,
 metric gathering) chief↔workers over ZMQ (harness/determined/ipc.py:34,
-core/_distributed.py:12). On TPU the data plane is XLA collectives over ICI,
-and for the *control* plane we ride the same transport: small host-level
-gather/broadcast are implemented with
-`jax.experimental.multihost_utils` (which uses the jax.distributed client) —
-no extra socket layer needed. A single-process context is the default for
-1-host allocations and local mode.
+core/_distributed.py:12), and its collectives move arbitrary pickled python
+objects. On TPU the data plane is XLA collectives over ICI; for the
+*control* plane we ride the same transport jax already maintains:
+byte-level allgather/broadcast are built from
+`jax.experimental.multihost_utils` (length-prefixed uint8 buffers, padded to
+the max length so every host contributes the same shape), and
+gather/allgather/broadcast pickle arbitrary objects on top — dicts, strings,
+file-metadata lists, whatever the checkpoint layer needs.
+
+Transports:
+  - `_JaxTransport`   — production multi-host path over jax.distributed.
+  - `_ThreadTransport`— threads-as-hosts, for tests and local simulation
+    (the TPU analogue of the reference's harness/tests/parallel.py
+    `parallel.Execution` ZMQ-over-localhost harness).
 
 Topology model (one process per TPU-VM host, owning all local chips — unlike
 the reference's process-per-GPU):
@@ -19,7 +27,91 @@ the reference's process-per-GPU):
 from __future__ import annotations
 
 import dataclasses
+import pickle
+import threading
 from typing import Any, List, Optional
+
+
+class _JaxTransport:
+    """Byte collectives over multihost_utils (jax.distributed client)."""
+
+    def allgather_bytes(self, payload: bytes) -> List[bytes]:
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        n = np.asarray(len(payload), np.int64)
+        lengths = np.asarray(multihost_utils.process_allgather(n)).reshape(-1)
+        maxlen = max(1, int(lengths.max()))
+        buf = np.zeros(maxlen, np.uint8)
+        buf[: len(payload)] = np.frombuffer(payload, np.uint8)
+        gathered = np.asarray(multihost_utils.process_allgather(buf))
+        return [
+            gathered[i, : int(lengths[i])].tobytes() for i in range(len(lengths))
+        ]
+
+    def broadcast_bytes(self, payload: bytes, is_source: bool) -> bytes:
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        n = multihost_utils.broadcast_one_to_all(
+            np.asarray(len(payload) if is_source else 0, np.int64)
+        )
+        n = int(n)
+        buf = np.zeros(max(1, n), np.uint8)
+        if is_source:
+            buf[:n] = np.frombuffer(payload, np.uint8)
+        out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+        return out[:n].tobytes()
+
+    def barrier(self, name: str) -> None:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
+class _ThreadSharedState:
+    def __init__(self, size: int):
+        self.size = size
+        self.barrier = threading.Barrier(size)
+        self.slots: List[Optional[bytes]] = [None] * size
+        self.bcast: Optional[bytes] = None
+
+
+class _ThreadTransport:
+    """Threads-as-hosts transport: N threads share one state object.
+
+    Build one per rank via `make_thread_transports(n)`. Double barrier =
+    publish / read-before-reuse."""
+
+    def __init__(self, shared: _ThreadSharedState, rank: int):
+        self._shared = shared
+        self._rank = rank
+
+    def allgather_bytes(self, payload: bytes) -> List[bytes]:
+        s = self._shared
+        s.slots[self._rank] = payload
+        s.barrier.wait()
+        out = list(s.slots)  # type: ignore[arg-type]
+        s.barrier.wait()
+        return out  # type: ignore[return-value]
+
+    def broadcast_bytes(self, payload: bytes, is_source: bool) -> bytes:
+        s = self._shared
+        if is_source:
+            s.bcast = payload
+        s.barrier.wait()
+        out = s.bcast
+        s.barrier.wait()
+        assert out is not None
+        return out
+
+    def barrier(self, name: str) -> None:
+        self._shared.barrier.wait()
+
+
+def make_thread_transports(size: int) -> List[_ThreadTransport]:
+    shared = _ThreadSharedState(size)
+    return [_ThreadTransport(shared, r) for r in range(size)]
 
 
 @dataclasses.dataclass
@@ -27,6 +119,7 @@ class DistributedContext:
     rank: int = 0
     size: int = 1
     initialized_jax_distributed: bool = False
+    transport: Optional[Any] = None  # byte-level collectives (size>1 only)
 
     @property
     def is_chief(self) -> bool:
@@ -58,9 +151,21 @@ class DistributedContext:
             num_processes=num_processes,
             process_id=process_id,
         )
-        return cls(rank=process_id, size=num_processes, initialized_jax_distributed=True)
+        return cls(
+            rank=process_id,
+            size=num_processes,
+            initialized_jax_distributed=True,
+            transport=_JaxTransport(),
+        )
+
+    @classmethod
+    def for_test(cls, rank: int, size: int, transport: Any) -> "DistributedContext":
+        """Threads-as-hosts context (pair with make_thread_transports)."""
+        return cls(rank=rank, size=size, transport=transport)
 
     # -- control-plane collectives ------------------------------------
+    # Arbitrary pickleable objects, like the reference's ZMQ plane
+    # (harness/determined/ipc.py:34): dicts, strings, numpy arrays, ...
 
     def gather(self, obj: Any) -> Optional[List[Any]]:
         """Gather python objects to the chief (None on non-chief ranks)."""
@@ -72,37 +177,29 @@ class DistributedContext:
     def allgather(self, obj: Any) -> List[Any]:
         if self.size == 1:
             return [obj]
-        from jax.experimental import multihost_utils
-
-        return list(multihost_utils.process_allgather(_encode(obj)))  # type: ignore
+        payloads = self._t().allgather_bytes(pickle.dumps(obj))
+        return [pickle.loads(p) for p in payloads]
 
     def broadcast(self, obj: Any) -> Any:
         if self.size == 1:
             return obj
-        from jax.experimental import multihost_utils
-
-        return multihost_utils.broadcast_one_to_all(_encode(obj))
+        payload = pickle.dumps(obj) if self.is_chief else b""
+        return pickle.loads(self._t().broadcast_bytes(payload, self.is_chief))
 
     def barrier(self, name: str = "barrier") -> None:
         if self.size == 1:
             return
-        from jax.experimental import multihost_utils
+        self._t().barrier(name)
 
-        multihost_utils.sync_global_devices(name)
+    def _t(self) -> Any:
+        if self.transport is None:
+            # Multi-host contexts built by from_allocation always carry one;
+            # hand-rolled ones default to the jax plane.
+            self.transport = _JaxTransport()
+        return self.transport
 
     def shutdown(self) -> None:
         if self.initialized_jax_distributed:
             import jax
 
             jax.distributed.shutdown()
-
-
-def _encode(obj: Any) -> Any:
-    # multihost_utils handles arrays/pytrees of arrays; plain python scalars
-    # pass through np.asarray. Strings/dicts must be pre-encoded by callers
-    # that need them; the framework only gathers numeric payloads here.
-    import numpy as np
-
-    if isinstance(obj, (int, float)):
-        return np.asarray(obj)
-    return obj

@@ -95,14 +95,17 @@ class AzureBlobClient:
         ).decode()
         return f"SharedKey {self.account}:{sig}"
 
-    def _request(
+    def _open(
         self,
         verb: str,
         path: str,
         query: Optional[Dict[str, str]] = None,
         body: Optional[bytes] = None,
         headers: Optional[Dict[str, str]] = None,
-    ) -> Tuple[int, bytes]:
+        timeout: int = 60,
+    ):
+        """Signed request returning the open response (caller closes).
+        Raises urllib.error.HTTPError on non-2xx."""
         query = dict(query or {})
         headers = dict(headers or {})
         headers["x-ms-date"] = email.utils.formatdate(usegmt=True)
@@ -118,8 +121,18 @@ class AzureBlobClient:
         qs = urllib.parse.urlencode(query)
         url = self.endpoint + qpath + ("?" + qs if qs else "")
         req = urllib.request.Request(url, data=body, method=verb, headers=headers)
+        return urllib.request.urlopen(req, timeout=timeout)
+
+    def _request(
+        self,
+        verb: str,
+        path: str,
+        query: Optional[Dict[str, str]] = None,
+        body: Optional[bytes] = None,
+        headers: Optional[Dict[str, str]] = None,
+    ) -> Tuple[int, bytes]:
         try:
-            with urllib.request.urlopen(req, timeout=60) as resp:
+            with self._open(verb, path, query, body, headers) as resp:
                 return resp.status, resp.read()
         except urllib.error.HTTPError as e:
             return e.code, e.read()
@@ -176,19 +189,10 @@ class AzureBlobClient:
 
     def get_blob_to_file(self, container: str, name: str, out_path: str) -> None:
         """Download a blob, streaming to disk in 1 MiB chunks."""
-        qpath = urllib.parse.quote(f"/{container}/{name}")
-        headers = {
-            "x-ms-date": email.utils.formatdate(usegmt=True),
-            "x-ms-version": _API_VERSION,
-        }
-        if self.key:
-            headers["Authorization"] = self._sign("GET", qpath, {}, headers, 0)
-        url = self.endpoint + qpath
-        req = urllib.request.Request(url, method="GET", headers=headers)
         try:
-            with urllib.request.urlopen(req, timeout=300) as resp, open(
-                out_path, "wb"
-            ) as fh:
+            with self._open(
+                "GET", f"/{container}/{name}", timeout=300
+            ) as resp, open(out_path, "wb") as fh:
                 while True:
                     chunk = resp.read(1024 * 1024)
                     if not chunk:

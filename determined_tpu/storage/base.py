@@ -13,6 +13,24 @@ import uuid
 from typing import Any, Dict, Iterator, List, Optional
 
 
+def iter_upload_files(src: str, paths: Optional[List[str]] = None):
+    """Yield (local_path, rel_key) for every file upload() pushes from src.
+
+    Shared by the cloud upload walks and the sharded-checkpoint resource
+    reporting so the registry's file list always matches what was uploaded.
+    """
+    names = paths if paths is not None else os.listdir(src)
+    for name in names:
+        full = os.path.join(src, name)
+        if os.path.isdir(full):
+            for root, _, files in os.walk(full):
+                for f in files:
+                    p = os.path.join(root, f)
+                    yield p, os.path.relpath(p, src)
+        else:
+            yield full, name
+
+
 class StorageManager:
     """Checkpoints are directories keyed by UUID under a storage root."""
 

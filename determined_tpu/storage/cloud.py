@@ -51,17 +51,9 @@ class CloudStorageManager(StorageManager):
 
     @staticmethod
     def _iter_upload_files(src: str, paths: Optional[List[str]]) -> Iterator[Tuple[str, str]]:
-        """Yield (local_path, rel_key) for every file to upload."""
-        names = paths if paths is not None else os.listdir(src)
-        for name in names:
-            full = os.path.join(src, name)
-            if os.path.isdir(full):
-                for root, _, files in os.walk(full):
-                    for f in files:
-                        p = os.path.join(root, f)
-                        yield p, os.path.relpath(p, src)
-            else:
-                yield full, name
+        from determined_tpu.storage.base import iter_upload_files
+
+        return iter_upload_files(src, paths)
 
     # -- staged file checkpoints --------------------------------------
 
@@ -158,6 +150,8 @@ class GCSStorageManager(CloudStorageManager):
         }
 
     def delete(self, storage_id: str, globs: Optional[List[str]] = None) -> Dict[str, Any]:
+        if not self._sdk:
+            raise RuntimeError("google-cloud-storage not installed")
         import fnmatch
 
         from google.cloud import storage
@@ -227,11 +221,19 @@ class S3StorageManager(CloudStorageManager):
         s3 = boto3.client("s3")
         prefix = self._list_prefix(storage_id)
         remaining: Dict[str, int] = {}
+        doomed: List[str] = []
         for rel, size in self.list_files(storage_id).items():
             if globs is not None and not any(fnmatch.fnmatch(rel, g) for g in globs):
                 remaining[rel] = size
                 continue
-            s3.delete_object(Bucket=self.bucket, Key=prefix + rel)
+            doomed.append(prefix + rel)
+        # Sharded checkpoints hold thousands of tensorstore chunks — batch
+        # deletes (1000 keys/request is the S3 API limit).
+        for i in range(0, len(doomed), 1000):
+            s3.delete_objects(
+                Bucket=self.bucket,
+                Delete={"Objects": [{"Key": k} for k in doomed[i : i + 1000]]},
+            )
         return remaining
 
 
