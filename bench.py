@@ -90,5 +90,79 @@ def _peak_flops() -> float:
     return 197e12
 
 
+def pp_compile_check() -> None:
+    """AOT-compile the bf16 pipeline-parallel train step against a v5e 2x2
+    TPU topology (deviceless — works with the single bench chip).
+
+    Why: on the CPU backend the bf16 partial-manual shard_map gradient trips
+    an XLA partitioner crash, so CPU tests run the PP path in f32
+    (models/gpt2.py apply_pipelined). This check runs the REAL TPU
+    partitioner over the bf16 graph, closing that blind spot without
+    needing 8 physical chips.
+    """
+    import jax
+    import numpy as np
+    import optax
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from determined_tpu.models import gpt2
+    from determined_tpu.parallel.mesh import AXIS_ORDER, MeshConfig
+    from determined_tpu.train import create_train_state, make_train_step
+
+    topo = topologies.get_topology_desc(platform="tpu", topology_name="v5e:2x2")
+    shape = MeshConfig(data=2, pipeline=2).resolve(len(topo.devices)).sizes()
+    mesh = Mesh(np.asarray(topo.devices).reshape(shape), AXIS_ORDER)
+
+    cfg = gpt2.Config.tiny()
+    assert cfg.dtype == jax.numpy.bfloat16
+    # apply_pipelined picks its compute dtype from the DEFAULT backend — on
+    # a cpu default it would compile the f32 graph and this check would be
+    # a false green (the whole point is bf16 on the TPU partitioner).
+    assert jax.default_backend() in ("tpu", "axon"), (
+        f"pp-compile-check needs a TPU default backend, got "
+        f"{jax.default_backend()}"
+    )
+    tx = optax.adamw(3e-4)
+
+    def loss(p, b, r):
+        return gpt2.loss_fn_pipelined(p, b, cfg, mesh, num_microbatches=4)
+
+    step = make_train_step(loss, tx, mesh=mesh)
+    key = jax.random.PRNGKey(0)
+    # Ambient mesh must be the ABSTRACT one: a concrete topology mesh would
+    # route eager ops at devices this host doesn't have.
+    with jax.sharding.use_abstract_mesh(mesh.abstract_mesh):
+        state = jax.eval_shape(
+            lambda r: create_train_state(lambda rr: gpt2.init(rr, cfg), tx, r),
+            key,
+        )
+    repl = NamedSharding(mesh, PartitionSpec())
+    state = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=repl)
+        if hasattr(x, "shape") else x,
+        state,
+    )
+    batch = {
+        "tokens": jax.ShapeDtypeStruct(
+            (8, 17), np.int32,
+            sharding=NamedSharding(mesh, PartitionSpec(("data", "fsdp"))),
+        )
+    }
+    rng = jax.ShapeDtypeStruct((2,), np.uint32, sharding=repl)
+    with jax.sharding.use_abstract_mesh(mesh.abstract_mesh):
+        compiled = jax.jit(step).lower(state, batch, rng).compile()
+    print(json.dumps({
+        "check": "pp_bf16_tpu_compile",
+        "ok": True,
+        "topology": "v5e:2x2",
+        "mesh": dict(zip(AXIS_ORDER, shape)),
+        "flops": compiled.cost_analysis().get("flops", 0),
+    }))
+
+
 if __name__ == "__main__":
+    if "--pp-compile-check" in sys.argv:
+        pp_compile_check()
+        sys.exit(0)
     sys.exit(main())

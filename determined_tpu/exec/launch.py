@@ -50,7 +50,50 @@ def build_command() -> list:
     return shlex.split(str(entrypoint))
 
 
+def apply_task_environment(env: dict, config: dict) -> dict:
+    """Render the expconf `environment:` block into the process env
+    (reference: task-spec env/image rendering, master/pkg/tasks/task.go:194-234
+    — on TPU-VMs there are no containers, so "environment management" means
+    interpreter selection + import paths + env vars):
+
+      environment_variables: ["K=V", ...]   (also applied master-side; done
+                                             here too so local mode matches)
+      venv: /path/to/venv                    activation-equivalent: VIRTUAL_ENV
+                                             + venv/bin first on PATH, so a
+                                             `python3 ...` entrypoint resolves
+                                             to the task's interpreter
+      python_path: [dir, ...]                appended to PYTHONPATH (extra
+                                             package roots shipped with the
+                                             context or mounted on the host)
+    """
+    envcfg = config.get("environment") or {}
+    # Flat "K": "V" entries are env vars too (master-side rendering does the
+    # same; applying here keeps local mode identical).
+    for k, v in envcfg.items():
+        if k in ("environment_variables", "venv", "python_path"):
+            continue
+        if isinstance(v, str):
+            env[k] = v
+    for kv in envcfg.get("environment_variables", []) or []:
+        k, sep, v = str(kv).partition("=")
+        if sep:
+            env[k] = v
+    venv = envcfg.get("venv")
+    if venv:
+        venv = os.path.expanduser(str(venv))
+        env["VIRTUAL_ENV"] = venv
+        env["PATH"] = os.path.join(venv, "bin") + os.pathsep + env.get("PATH", "")
+        env.pop("PYTHONHOME", None)
+    for p in envcfg.get("python_path", []) or []:
+        env["PYTHONPATH"] = (
+            env.get("PYTHONPATH", "") + os.pathsep + os.path.expanduser(str(p))
+        ).strip(os.pathsep)
+    return env
+
+
 def main() -> int:
+    import json
+
     logging.basicConfig(level=logging.INFO, format="%(name)s: %(message)s")
 
     info = prep_mod.prep()
@@ -61,6 +104,9 @@ def main() -> int:
     workdir = env.get("DET_WORKDIR", os.getcwd())
     env["PYTHONPATH"] = workdir + os.pathsep + env.get("PYTHONPATH", "")
     env.setdefault("PYTHONUNBUFFERED", "1")
+    apply_task_environment(
+        env, json.loads(os.environ.get("DET_EXPERIMENT_CONFIG", "{}"))
+    )
 
     cmd = build_command()
     logger.info("launching entrypoint: %s", cmd)

@@ -213,7 +213,48 @@ def validate(config: Dict[str, Any]) -> List[str]:
     if mr is not None and (not isinstance(mr, int) or mr < 0):
         errors.append("max_restarts must be a non-negative int")
 
+    _validate_environment(config.get("environment"), errors)
+
     return errors
+
+
+def _validate_environment(envcfg: Any, errors: List[str]) -> None:
+    """`environment:` block (reference task-spec env rendering,
+    master/pkg/tasks/task.go:194-234): flat "K": "V" pairs and/or
+    environment_variables ["K=V", ...], plus TPU-native `venv` (interpreter
+    activation) and `python_path` (extra package roots)."""
+    if envcfg is None:
+        return
+    if not isinstance(envcfg, dict):
+        errors.append("environment must be a mapping")
+        return
+    ev = envcfg.get("environment_variables")
+    if ev is not None:
+        if not isinstance(ev, list):
+            errors.append("environment.environment_variables must be a list")
+        else:
+            for kv in ev:
+                if not isinstance(kv, str) or "=" not in kv:
+                    errors.append(
+                        f"environment.environment_variables entry {kv!r} "
+                        "must be a 'KEY=value' string"
+                    )
+    venv = envcfg.get("venv")
+    if venv is not None and not isinstance(venv, str):
+        errors.append("environment.venv must be a path string")
+    pp = envcfg.get("python_path")
+    if pp is not None and (
+        not isinstance(pp, list) or not all(isinstance(p, str) for p in pp)
+    ):
+        errors.append("environment.python_path must be a list of path strings")
+    for k, v in envcfg.items():
+        if k in ("environment_variables", "venv", "python_path"):
+            continue
+        if not isinstance(v, str):
+            errors.append(
+                f"environment.{k}: flat entries are env vars and must be "
+                "strings"
+            )
 
 
 def apply_defaults(config: Dict[str, Any]) -> Dict[str, Any]:

@@ -69,6 +69,19 @@ class JaxTrial(abc.ABC):
         """Stateless: (params, batch, rng) -> loss | (loss, metrics).
         Stateful: (params, extra, batch, rng) -> (loss, metrics, new_extra)."""
 
+    def loss_pipelined(self, params, batch, rng, mesh):
+        """Pipeline-parallel loss, used by the Trainer whenever the mesh has
+        `pipeline > 1`. Implementations run the model's layer stack through
+        `parallel.pipeline.pipeline_apply` over `mesh` (see
+        models/gpt2.loss_fn_pipelined). Trials that do not implement this
+        cannot run with a pipeline axis — the Trainer rejects the mesh
+        loudly instead of silently degrading to a gathered non-pipelined
+        step."""
+        raise NotImplementedError
+
+    def supports_pipeline(self) -> bool:
+        return type(self).loss_pipelined is not JaxTrial.loss_pipelined
+
     def init_extra(self) -> Any:
         """Initial non-gradient state (stateful trials only)."""
         return None
@@ -106,6 +119,18 @@ class JaxTrial(abc.ABC):
         Stateful trials receive (params, extra, batch)."""
         raise NotImplementedError(
             "implement evaluate() or leave build_validation_data() empty"
+        )
+
+    def evaluate_pipelined(self, params, batch, mesh) -> Dict[str, Any]:
+        """Pipeline-parallel evaluate, selected by the Trainer when
+        mesh.pipeline > 1 (mirrors loss/loss_pipelined). Without it, the
+        plain evaluate() runs under the pipeline mesh — correct but slow
+        (GSPMD gathers each stage's params every eval); the Trainer warns."""
+        raise NotImplementedError
+
+    def supports_pipelined_eval(self) -> bool:
+        return (
+            type(self).evaluate_pipelined is not JaxTrial.evaluate_pipelined
         )
 
     # -- knobs ----------------------------------------------------------

@@ -53,6 +53,13 @@ class GPT2Trial(JaxTrial):
     def loss(self, params, batch, rng):
         return gpt2.loss_fn(params, batch, self.cfg, self.sharding_rules())
 
+    def loss_pipelined(self, params, batch, rng, mesh):
+        # Selected by the Trainer whenever the config mesh has pipeline > 1
+        # (GPipe over the `pipeline` axis, parallel/pipeline.py).
+        return gpt2.loss_fn_pipelined(
+            params, batch, self.cfg, mesh, self.sharding_rules()
+        )
+
     def param_logical_axes(self):
         return gpt2.param_logical_axes(self.cfg)
 
@@ -94,6 +101,12 @@ class GPT2Trial(JaxTrial):
 
     def evaluate(self, params, batch):
         loss = gpt2.loss_fn(params, batch, self.cfg, self.sharding_rules())
+        return {"validation_loss": loss}
+
+    def evaluate_pipelined(self, params, batch, mesh):
+        loss = gpt2.loss_fn_pipelined(
+            params, batch, self.cfg, mesh, self.sharding_rules()
+        )
         return {"validation_loss": loss}
 
 

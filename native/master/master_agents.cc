@@ -483,10 +483,13 @@ bool Master::try_fit_locked(Allocation& alloc) {
     if (exp != nullptr) {
       // Experiment-config environment variables (expconf environment
       // block): either {"K": "V", ...} or
-      // {"environment_variables": ["K=V", ...]}.
+      // {"environment_variables": ["K=V", ...]}. Schema keys with their
+      // own semantics (venv/python_path, applied by exec/launch.py) are
+      // not env vars.
       const Json& env_cfg = exp->config["environment"];
       for (const auto& [k, v] : env_cfg.as_object()) {
-        if (k == "environment_variables") continue;
+        if (k == "environment_variables" || k == "venv" || k == "python_path")
+          continue;
         if (v.is_string()) env[k] = v;
       }
       for (const auto& kv : env_cfg["environment_variables"].as_array()) {

@@ -1,0 +1,45 @@
+"""Fixture trial asserting the task environment was applied before exec:
+config env vars (flat + list form), python_path package roots, and venv
+interpreter activation (reference task-spec rendering,
+master/pkg/tasks/task.go:194-234)."""
+
+import os
+import shutil
+import sys
+
+
+def main() -> int:
+    # Env vars from the config's environment block — flat form rendered by
+    # the master, list form by master + launch layer.
+    assert os.environ.get("MY_TASK_FLAG") == "from-config", os.environ.get(
+        "MY_TASK_FLAG"
+    )
+    assert os.environ.get("MY_TASK_FLAG2") == "listed", os.environ.get(
+        "MY_TASK_FLAG2"
+    )
+
+    # Extra package root from environment.python_path.
+    import extra_pkg
+
+    assert extra_pkg.VALUE == 42
+
+    # venv activation: VIRTUAL_ENV exported and its bin/ first on PATH, so
+    # `python3` resolves inside the venv.
+    venv = os.environ.get("VIRTUAL_ENV", "")
+    assert venv.endswith("fake-venv"), venv
+    resolved = shutil.which("python3") or ""
+    assert resolved.startswith(venv), f"python3 -> {resolved}, venv {venv}"
+
+    from determined_tpu import core
+
+    with core.init(async_checkpointing=False) as ctx:
+        for op in ctx.searcher.operations():
+            ctx.train.report_training_metrics(op.length, {"loss": 0.5})
+            ctx.train.report_validation_metrics(op.length, {"val_loss": 0.1})
+            op.report_completed(0.1)
+    print("task environment verified")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,6 +44,7 @@ def _cli(cluster, *args, timeout=300):
         JAX_PLATFORMS="cpu",
         HOME=cluster.tmpdir,  # isolate the CLI token cache
     )
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # keep the CLI off the axon plugin
     return subprocess.run(
         [sys.executable, "-m", "determined_tpu.cli",
          "-m", cluster.master_url, *args],
@@ -88,6 +89,29 @@ def test_mnist_example_quickstart(cluster, tmp_path):
 def test_gpt2_example(cluster, tmp_path):
     cfg = _patch_storage(tmp_path, os.path.join(EXAMPLES, "gpt2", "config.yaml"))
     r = _cli(cluster, "experiment", "create", cfg,
+             os.path.join(EXAMPLES, "gpt2"), "--follow", timeout=600)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "COMPLETED" in r.stdout, r.stdout[-2000:]
+
+
+def test_gpt2_pipeline_example(cluster, tmp_path):
+    """pipeline.yaml runs the GPipe path: mesh.pipeline=2 makes the Trainer
+    select loss_pipelined inside the spawned trial (8-device CPU mesh via the
+    conftest XLA_FLAGS the agent inherits), shrunk to test size."""
+    import yaml
+
+    with open(os.path.join(EXAMPLES, "gpt2", "pipeline.yaml")) as f:
+        cfg = yaml.safe_load(f)
+    cfg["checkpoint_storage"]["host_path"] = os.path.join(str(tmp_path), "ckpts")
+    cfg["searcher"]["max_length"] = {"batches": 2}
+    cfg["hyperparameters"].update(
+        model_size="tiny", seq_len=16, global_batch_size=8,
+        mesh={"pipeline": 2, "data": -1})
+    cfg["resources"]["slots_per_trial"] = 2
+    out = os.path.join(str(tmp_path), "pipeline.yaml")
+    with open(out, "w") as f:
+        yaml.safe_dump(cfg, f)
+    r = _cli(cluster, "experiment", "create", out,
              os.path.join(EXAMPLES, "gpt2"), "--follow", timeout=600)
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     assert "COMPLETED" in r.stdout, r.stdout[-2000:]

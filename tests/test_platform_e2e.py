@@ -66,6 +66,10 @@ class Devcluster:
             PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
             JAX_PLATFORMS="cpu",
         )
+        # The axon TPU plugin's sitecustomize re-forces JAX_PLATFORMS=axon
+        # when this is set — e2e trials must stay on the virtual CPU mesh
+        # (and off the single real chip).
+        self.env.pop("PALLAS_AXON_POOL_IPS", None)
 
     def start_master(self):
         self.master = subprocess.Popen(

@@ -1,0 +1,147 @@
+"""Task environment management e2e: env vars, python_path, venv activation.
+
+Reference: the task-spec builder renders env images/mounts/env vars into the
+container spec (master/pkg/tasks/task.go:194-234). The TPU equivalent is
+process-level: the master injects config env vars into the task env, and the
+launch layer (determined_tpu/exec/launch.py apply_task_environment) performs
+venv activation + PYTHONPATH extension before exec'ing the entrypoint."""
+
+import os
+import sys
+
+import pytest
+
+from determined_tpu.exec.launch import apply_task_environment
+from tests.test_platform_e2e import Devcluster, _wait_experiment, native_binaries  # noqa: F401
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TASKENV_FIXTURES = os.path.join(REPO, "tests", "fixtures", "taskenv")
+
+
+class TestApplyTaskEnvironment:
+    def test_env_vars_list_form(self):
+        env = apply_task_environment(
+            {}, {"environment": {"environment_variables": ["A=1", "B=x=y"]}}
+        )
+        assert env["A"] == "1"
+        assert env["B"] == "x=y"  # split on first '=' only
+
+    def test_venv_activation(self):
+        env = apply_task_environment(
+            {"PATH": "/usr/bin", "PYTHONHOME": "/opt/py"},
+            {"environment": {"venv": "/opt/task-venv"}},
+        )
+        assert env["VIRTUAL_ENV"] == "/opt/task-venv"
+        assert env["PATH"].startswith("/opt/task-venv/bin" + os.pathsep)
+        assert "PYTHONHOME" not in env
+
+    def test_python_path_appended(self):
+        env = apply_task_environment(
+            {"PYTHONPATH": "/ctx"},
+            {"environment": {"python_path": ["/pkgs/a", "/pkgs/b"]}},
+        )
+        assert env["PYTHONPATH"] == os.pathsep.join(["/ctx", "/pkgs/a", "/pkgs/b"])
+
+    def test_no_environment_block(self):
+        assert apply_task_environment({"X": "1"}, {}) == {"X": "1"}
+
+
+class TestExpconfEnvironmentValidation:
+    def test_valid(self):
+        from determined_tpu import expconf
+
+        c = {
+            "entrypoint": "python3 t.py",
+            "searcher": {"name": "single", "metric": "m",
+                         "max_length": {"batches": 1}},
+            "environment": {
+                "FOO": "bar",
+                "environment_variables": ["K=V"],
+                "venv": "/opt/venv",
+                "python_path": ["/pkgs"],
+            },
+        }
+        assert expconf.validate(c) == []
+
+    def test_bad_entries(self):
+        from determined_tpu import expconf
+
+        c = {
+            "entrypoint": "python3 t.py",
+            "searcher": {"name": "single", "metric": "m",
+                         "max_length": {"batches": 1}},
+            "environment": {
+                "environment_variables": ["NOEQUALS"],
+                "venv": 7,
+                "python_path": "notalist",
+                "NUM": 3,
+            },
+        }
+        errs = expconf.validate(c)
+        assert any("NOEQUALS" in e for e in errs)
+        assert any("venv" in e for e in errs)
+        assert any("python_path" in e for e in errs)
+        assert any("environment.NUM" in e for e in errs)
+
+
+@pytest.fixture()
+def cluster(tmp_path, native_binaries):  # noqa: F811
+    c = Devcluster(str(tmp_path), native_binaries)
+    c.start_master()
+    c.start_agent()
+    yield c
+    c.stop()
+
+
+def test_task_environment_e2e(cluster, tmp_path):
+    """A trial sees its configured env vars, imports from an extra package
+    root, and runs under the task venv's interpreter."""
+    # Extra package root (outside the context dir).
+    extra = tmp_path / "extra-pkgs"
+    extra.mkdir()
+    (extra / "extra_pkg.py").write_text("VALUE = 42\n")
+    # Fake venv whose bin/python3 is the real interpreter.
+    venv = tmp_path / "fake-venv"
+    (venv / "bin").mkdir(parents=True)
+    os.symlink(sys.executable, venv / "bin" / "python3")
+
+    import determined_tpu.cli as cli
+
+    config = {
+        "name": "taskenv-e2e",
+        "entrypoint": "python3 train_env.py",
+        "searcher": {
+            "name": "single",
+            "metric": "val_loss",
+            "max_length": {"batches": 2},
+        },
+        "checkpoint_storage": {
+            "type": "shared_fs",
+            "host_path": os.path.join(str(tmp_path), "ckpts"),
+        },
+        "environment": {
+            "MY_TASK_FLAG": "from-config",
+            "environment_variables": ["MY_TASK_FLAG2=listed"],
+            "venv": str(venv),
+            "python_path": [str(extra)],
+        },
+        "resources": {"slots_per_trial": 1},
+        "max_restarts": 0,
+    }
+    token = cluster.login()
+    resp = cluster.api(
+        "POST", "/api/v1/experiments",
+        {
+            "config": config,
+            "model_definition": cli._tar_context(TASKENV_FIXTURES),
+            "activate": True,
+        },
+        token=token,
+    )
+    _wait_experiment(cluster, resp["id"], token, timeout=120)
+    # The fixture asserts the environment before reporting; reaching
+    # COMPLETED proves env vars + python_path + venv all applied.
+    logs = cluster.api(
+        "GET", f"/api/v1/experiments/{resp['id']}/trials", token=token
+    )["trials"]
+    assert logs[0]["state"] == "COMPLETED"
