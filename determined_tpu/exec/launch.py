@@ -28,11 +28,12 @@ from determined_tpu.exec import prep as prep_mod
 logger = logging.getLogger("determined_tpu.exec")
 
 
-def build_command() -> list:
+def build_command(config: Optional[dict] = None) -> list:
     """Resolve the experiment entrypoint into an argv list."""
     import json
 
-    config = json.loads(os.environ.get("DET_EXPERIMENT_CONFIG", "{}"))
+    if config is None:
+        config = json.loads(os.environ.get("DET_EXPERIMENT_CONFIG", "{}"))
     entrypoint = config.get("entrypoint")
     if entrypoint is None:
         entrypoint = os.environ.get("DET_ENTRYPOINT")
@@ -104,11 +105,10 @@ def main() -> int:
     workdir = env.get("DET_WORKDIR", os.getcwd())
     env["PYTHONPATH"] = workdir + os.pathsep + env.get("PYTHONPATH", "")
     env.setdefault("PYTHONUNBUFFERED", "1")
-    apply_task_environment(
-        env, json.loads(os.environ.get("DET_EXPERIMENT_CONFIG", "{}"))
-    )
+    config = json.loads(os.environ.get("DET_EXPERIMENT_CONFIG", "{}"))
+    apply_task_environment(env, config)
 
-    cmd = build_command()
+    cmd = build_command(config)
     logger.info("launching entrypoint: %s", cmd)
     proc = subprocess.Popen(cmd, env=env, cwd=workdir)
 

@@ -17,6 +17,13 @@ class KerasTrial:
     - build_model() -> compiled keras.Model
     - build_training_data() -> (x, y) | tf.data.Dataset | keras Dataset
     - build_validation_data() -> same
+
+    Distribution: the reference's TFKerasTrial is distributed only via
+    Horovod (_tf_keras_trial.py:183-186); here Keras 3 on the JAX backend
+    distributes over the allocation's chips natively — `mesh_config()`
+    (read from the `mesh` hparam block, same home as JaxTrial) selects
+    DataParallel, or ModelParallel when fsdp/tensor axes are > 1 (then
+    `layout_map()` must describe the weight shardings).
     """
 
     def __init__(self, context: "KerasTrialContext"):
@@ -33,6 +40,20 @@ class KerasTrial:
 
     def batch_size(self) -> int:
         return int(self.context.get_hparam_or("global_batch_size", 32))
+
+    def mesh_config(self):
+        from determined_tpu.parallel.mesh import MeshConfig
+
+        mc = self.context.hparams.get("mesh")
+        return MeshConfig.from_dict(mc) if mc else MeshConfig()
+
+    def layout_map(self, device_mesh):
+        """For ModelParallel (fsdp/tensor > 1): return a
+        keras.distribution.LayoutMap over `device_mesh` mapping weight-path
+        regexes to shardings along the "model" mesh dim. Required when the
+        mesh requests model axes — the Trainer rejects the mesh otherwise
+        (no silent replication)."""
+        return None
 
 
 class KerasTrialContext:
@@ -89,14 +110,62 @@ class DeterminedCallback:
         return _Callback()
 
 
+def build_distribution(trial: KerasTrial):
+    """Map the trial's MeshConfig onto a keras.distribution strategy.
+
+    data-only mesh    -> DataParallel over all devices
+    fsdp/tensor > 1   -> ModelParallel on a ("batch", "model") DeviceMesh
+                         with the trial's layout_map (required)
+    single device     -> None
+    """
+    import keras
+
+    devices = keras.distribution.list_devices()
+    cfg = trial.mesh_config().resolve(len(devices))
+    if cfg.pipeline > 1 or cfg.context > 1 or cfg.expert > 1:
+        raise ValueError(
+            "KerasTrial supports data/fsdp/tensor mesh axes only "
+            f"(got {cfg}); use the JaxTrial API for pipeline/context/expert"
+        )
+    model_par = cfg.fsdp * cfg.tensor
+    if model_par > 1:
+        mesh = keras.distribution.DeviceMesh(
+            shape=(cfg.data, model_par),
+            axis_names=("batch", "model"),
+            devices=devices,
+        )
+        lm = trial.layout_map(mesh)
+        if lm is None:
+            raise ValueError(
+                f"mesh requests {model_par}-way model parallelism but "
+                f"{type(trial).__name__}.layout_map() returned None; "
+                "return a keras.distribution.LayoutMap describing the "
+                "weight shardings (or use a data-only mesh)"
+            )
+        return keras.distribution.ModelParallel(
+            layout_map=lm, batch_dim_name="batch"
+        )
+    if len(devices) > 1:
+        return keras.distribution.DataParallel(devices=devices)
+    return None
+
+
 class Trainer:
     """Searcher-driven controller for KerasTrial (reference
-    TFKerasTrialController :171)."""
+    TFKerasTrialController :171). Distribution is installed BEFORE
+    build_model so variables are created already sharded."""
 
     def __init__(self, trial: KerasTrial,
                  core_context: Optional[core.Context] = None):
         self.trial = trial
         self.core = core_context or trial.context._core or core.init(max_length=1)
+        self.distribution = build_distribution(trial)
+        if self.distribution is not None:
+            import keras
+
+            keras.distribution.set_distribution(self.distribution)
+            logger.info("keras distribution: %s",
+                        type(self.distribution).__name__)
         self.model = trial.build_model()
 
     def _save(self, steps: int) -> None:

@@ -1,0 +1,8 @@
+"""Launch layers — distributed process fan-out run as the task entrypoint
+(reference harness/determined/launch/: torch_distributed.py, horovod.py,
+deepspeed.py).
+
+On TPU the native JAX path needs no fan-out (one process per host owns all
+local chips), so the only launcher is for the PyTorch compat trial API:
+`python -m determined_tpu.launch.torch_distributed -- python3 train.py`.
+"""

@@ -1,0 +1,173 @@
+"""torch.distributed launch layer for PyTorchTrial.
+
+Reference: harness/determined/launch/torch_distributed.py:74 — wraps the
+user script in `torch.distributed.run --nnodes ... --node_rank ...
+--master_addr <chief>`. The TPU-native rewrite spawns the worker processes
+directly (no torchrun dependency) and wires the rendezvous from the
+master-provided env:
+
+  nnodes     = DET_NUM_NODES      (hosts in the allocation)
+  node_rank  = DET_NODE_RANK
+  chief addr = DET_CHIEF_IP       (master rendezvous)
+  nproc      = --nproc-per-node | auto:
+                 torch-xla present  -> 1 process per host (a torch-xla
+                   process owns all local chips via xla:// — unlike GPU's
+                   process-per-device)
+                 else               -> DET_NPROC_PER_NODE or 1
+
+Each worker gets the standard torch.distributed env contract (RANK,
+WORLD_SIZE, LOCAL_RANK, LOCAL_WORLD_SIZE, MASTER_ADDR, MASTER_PORT) plus
+DET_TORCH_BACKEND (xla|gloo|nccl) so PyTorchTrial's Trainer knows how to
+init the process group. stdout/stderr are prefixed with the global rank
+(reference launch/wrap_rank.py).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+from typing import List, Optional
+
+
+def _has_torch_xla() -> bool:
+    import importlib.util
+
+    return importlib.util.find_spec("torch_xla") is not None
+
+
+def pick_backend() -> str:
+    if _has_torch_xla():
+        return "xla"
+    import torch
+
+    return "nccl" if torch.cuda.is_available() else "gloo"
+
+
+def worker_env(
+    base_env: dict,
+    *,
+    node_rank: int,
+    nnodes: int,
+    local_rank: int,
+    nproc_per_node: int,
+    master_addr: str,
+    master_port: int,
+    backend: str,
+) -> dict:
+    env = dict(base_env)
+    env.update(
+        RANK=str(node_rank * nproc_per_node + local_rank),
+        WORLD_SIZE=str(nnodes * nproc_per_node),
+        LOCAL_RANK=str(local_rank),
+        LOCAL_WORLD_SIZE=str(nproc_per_node),
+        MASTER_ADDR=master_addr,
+        MASTER_PORT=str(master_port),
+        DET_TORCH_BACKEND=backend,
+    )
+    return env
+
+
+def _stream_prefixed(pipe, rank: int, out) -> None:
+    # reference launch/wrap_rank.py — prefix each line with the global rank
+    for line in iter(pipe.readline, b""):
+        out.write(f"[rank={rank}] ".encode() + line)
+        out.flush()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    nproc = 0
+    if argv and argv[0] == "--nproc-per-node":
+        nproc = int(argv[1])
+        argv = argv[2:]
+    if argv and argv[0] == "--":
+        argv = argv[1:]
+    if not argv:
+        print("usage: torch_distributed [--nproc-per-node N] -- cmd ...",
+              file=sys.stderr)
+        return 2
+
+    backend = pick_backend()
+    if nproc <= 0:
+        if backend == "xla":
+            nproc = 1  # one torch-xla process per host owns all local chips
+        else:
+            nproc = int(os.environ.get("DET_NPROC_PER_NODE", "1"))
+
+    node_rank = int(os.environ.get("DET_NODE_RANK", "0"))
+    nnodes = int(os.environ.get("DET_NUM_NODES", "1"))
+    chief = os.environ.get("DET_CHIEF_IP", "127.0.0.1")
+    port = int(os.environ.get("DET_TORCH_MASTER_PORT", "29400"))
+
+    procs: List[subprocess.Popen] = []
+    streams: List[threading.Thread] = []
+    for local_rank in range(nproc):
+        env = worker_env(
+            os.environ.copy(),
+            node_rank=node_rank,
+            nnodes=nnodes,
+            local_rank=local_rank,
+            nproc_per_node=nproc,
+            master_addr=chief,
+            master_port=port,
+            backend=backend,
+        )
+        p = subprocess.Popen(
+            argv, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT
+        )
+        rank = node_rank * nproc + local_rank
+        t = threading.Thread(
+            target=_stream_prefixed, args=(p.stdout, rank, sys.stdout.buffer),
+            daemon=True,
+        )
+        t.start()
+        procs.append(p)
+        streams.append(t)
+
+    def forward(signum, frame):
+        for p in procs:
+            try:
+                p.send_signal(signum)
+            except ProcessLookupError:
+                pass
+
+    signal.signal(signal.SIGTERM, forward)
+    signal.signal(signal.SIGINT, forward)
+
+    # Monitor-and-kill (torchrun semantics): the first worker to die with a
+    # non-zero status takes the rest down — survivors would otherwise hang
+    # in collectives (gloo barrier default timeout is 30 min).
+    import time
+
+    rc = 0
+    alive = list(procs)
+    while alive:
+        for p in list(alive):
+            code = p.poll()
+            if code is None:
+                continue
+            alive.remove(p)
+            if code != 0 and rc == 0:
+                rc = code
+                print(
+                    f"worker pid={p.pid} exited {code}; terminating "
+                    f"{len(alive)} remaining worker(s)",
+                    file=sys.stderr,
+                )
+                for q in alive:
+                    try:
+                        q.terminate()
+                    except ProcessLookupError:
+                        pass
+        if alive:
+            time.sleep(0.2)
+    for t in streams:
+        t.join(timeout=5)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

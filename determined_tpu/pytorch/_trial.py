@@ -17,12 +17,14 @@ onto the platform without rewrites.
 from __future__ import annotations
 
 import logging
+import os
 import uuid
 from typing import Any, Dict, Iterator, List, Optional, Union
 
 import torch
 
 from determined_tpu import core
+from determined_tpu.core._distributed import DistributedContext
 
 logger = logging.getLogger("determined_tpu.pytorch")
 
@@ -36,6 +38,77 @@ def _default_device() -> torch.device:
         return xm.xla_device()
     except ImportError:
         return torch.device("cuda" if torch.cuda.is_available() else "cpu")
+
+
+class TorchDistTransport:
+    """Byte-level control-plane collectives over torch.distributed — the
+    torch compat trials' analogue of the jax multihost transport
+    (core/_distributed.py), so one DistributedContext implementation serves
+    both runtimes."""
+
+    def allgather_bytes(self, payload: bytes) -> List[bytes]:
+        import torch.distributed as dist
+
+        out: List[Optional[bytes]] = [None] * dist.get_world_size()
+        dist.all_gather_object(out, payload)
+        return out  # type: ignore[return-value]
+
+    def broadcast_bytes(self, payload: bytes, is_source: bool) -> bytes:
+        import torch.distributed as dist
+
+        box: List[Optional[bytes]] = [payload if is_source else None]
+        dist.broadcast_object_list(box, src=0)
+        assert box[0] is not None
+        return box[0]
+
+    def barrier(self, name: str) -> None:
+        import torch.distributed as dist
+
+        dist.barrier()
+
+
+def init_torch_distributed() -> Optional[DistributedContext]:
+    """Bring up torch.distributed from the launch layer's env contract
+    (determined_tpu/launch/torch_distributed.py): RANK/WORLD_SIZE/
+    MASTER_ADDR(+PORT)/DET_TORCH_BACKEND. Returns None when not launched
+    distributed. Reference: pytorch/_trainer.py:206-228 backend init.
+
+    Backends: `xla` (torch-xla on TPU task environments, xla:// init —
+    one process per host owning all local chips), `gloo` (CPU), `nccl`.
+    """
+    world = int(os.environ.get("WORLD_SIZE", "1"))
+    if world <= 1:
+        return None
+    import torch.distributed as dist
+
+    backend = os.environ.get("DET_TORCH_BACKEND", "")
+    if not backend:
+        backend = "nccl" if torch.cuda.is_available() else "gloo"
+    if not dist.is_initialized():
+        if backend == "xla":
+            dist.init_process_group("xla", init_method="xla://")
+        else:
+            dist.init_process_group(backend, init_method="env://")
+    return DistributedContext(
+        rank=dist.get_rank(),
+        size=dist.get_world_size(),
+        transport=TorchDistTransport(),
+    )
+
+
+def _is_fsdp(model: torch.nn.Module) -> bool:
+    # torch-xla's XlaFullyShardedDataParallel / torch's FSDP — matched by
+    # name so the check works without torch_xla installed.
+    return any(
+        "FullyShardedDataParallel" in type(m).__name__ for m in
+        (model, getattr(model, "module", model))
+    )
+
+
+def _unwrap(model: torch.nn.Module) -> torch.nn.Module:
+    if isinstance(model, torch.nn.parallel.DistributedDataParallel):
+        return model.module
+    return model
 
 
 class DataLoader:
@@ -71,6 +144,9 @@ class PyTorchTrialContext:
     def __init__(self, core_context: Optional[core.Context] = None,
                  hparams: Optional[Dict[str, Any]] = None,
                  device: Optional[torch.device] = None):
+        # Process group FIRST (before any wrap_model): construction order is
+        # context → trial(__init__ wraps models) → Trainer.
+        self.dist = init_torch_distributed()
         self._core = core_context
         self._hparams = hparams or (core_context.hparams if core_context else {})
         self.device = device or _default_device()
@@ -89,10 +165,19 @@ class PyTorchTrialContext:
         return dict(self._hparams)
 
     def wrap_model(self, model: torch.nn.Module) -> torch.nn.Module:
-        """Move to device; DDP-equivalent wrapping happens in torch-xla's
-        runtime (the reference wraps in DistributedDataParallel,
-        _pytorch_context.py:297)."""
+        """Move to device; wrap in DistributedDataParallel when launched
+        distributed (reference _pytorch_context.py:297). torch-xla supports
+        DDP over the xla backend, so the wrap is uniform."""
         model = model.to(self.device)
+        if self.dist is not None and self.dist.size > 1 and not _is_fsdp(model):
+            # FSDP-wrapped models already own their gradient comms — DDP on
+            # top would all-reduce reduce-scattered shards (wrong grads).
+            device_ids = (
+                [self.device] if self.device.type == "cuda" else None
+            )
+            model = torch.nn.parallel.DistributedDataParallel(
+                model, device_ids=device_ids
+            )
         self.models.append(model)
         return model
 
@@ -167,30 +252,88 @@ class Trainer:
                  core_context: Optional[core.Context] = None):
         self.trial = trial
         self.context = trial.context
-        self.core = core_context or self.context._core or core.init(max_length=100)
+        self.dist = self.context.dist
+        self.core = core_context or self.context._core or core.init(
+            max_length=100, distributed=self.dist
+        )
+        if (
+            self.dist is not None
+            and self.core.distributed.size != self.dist.size
+        ):
+            # A core context that doesn't know the torch process group would
+            # make every rank act as chief (N-fold op completions/reports).
+            raise ValueError(
+                f"core context distributed size "
+                f"{self.core.distributed.size} != torch world size "
+                f"{self.dist.size}; build it with "
+                "core.init(distributed=trial.context.dist)"
+            )
+
+    @property
+    def _world(self) -> int:
+        return self.dist.size if self.dist is not None else 1
+
+    @property
+    def _rank(self) -> int:
+        return self.dist.rank if self.dist is not None else 0
 
     # -- checkpointing -------------------------------------------------
+    def _sharded_models(self) -> bool:
+        return any(_is_fsdp(m) for m in self.context.models)
+
+    def _state(self, steps_completed: int) -> Dict[str, Any]:
+        return {
+            "models": [_unwrap(m).state_dict() for m in self.context.models],
+            "optimizers": [o.state_dict() for o in self.context.optimizers],
+            "steps_completed": steps_completed,
+            "extras": self.trial.state_dict_extras(),
+        }
+
     def _save(self, steps_completed: int) -> None:
+        if self._sharded_models() and self._world > 1:
+            # FSDP: each rank's state_dict holds only ITS shard — every rank
+            # uploads state-rank{r}.pt into one storage id (sharded upload,
+            # reference core/_checkpoint.py:282 semantics).
+            import tempfile
+
+            with tempfile.TemporaryDirectory() as td:
+                torch.save(self._state(steps_completed),
+                           os.path.join(td, f"state-rank{self._rank}.pt"))
+                self.core.checkpoint.upload(
+                    td,
+                    metadata={"steps_completed": steps_completed,
+                              "framework": "pytorch", "sharded": True,
+                              "world_size": self._world},
+                    shard=True,
+                )
+            return
+        if self.dist is not None and not self.dist.is_chief:
+            self.dist.barrier("ckpt")  # chief writes; workers wait
+            return
         with self.core.checkpoint.store_path(
             {"steps_completed": steps_completed, "framework": "pytorch"}
         ) as (path, _sid):
-            state = {
-                "models": [m.state_dict() for m in self.context.models],
-                "optimizers": [o.state_dict() for o in self.context.optimizers],
-                "steps_completed": steps_completed,
-                "extras": self.trial.state_dict_extras(),
-            }
-            torch.save(state, f"{path}/state.pt")
+            torch.save(self._state(steps_completed), f"{path}/state.pt")
+        if self.dist is not None:
+            self.dist.barrier("ckpt")
 
     def _restore(self) -> int:
         latest = self.core.latest_checkpoint
         if not latest:
             return 0
         with self.core.checkpoint.restore_path(latest) as path:
-            state = torch.load(f"{path}/state.pt", map_location=self.context.device,
+            sharded = os.path.join(path, f"state-rank{self._rank}.pt")
+            fname = sharded if os.path.exists(sharded) else f"{path}/state.pt"
+            if not os.path.exists(fname):
+                raise FileNotFoundError(
+                    f"checkpoint {latest}: no {os.path.basename(sharded)} or "
+                    "state.pt — resuming a sharded checkpoint needs the same "
+                    "world size it was saved with"
+                )
+            state = torch.load(fname, map_location=self.context.device,
                                weights_only=False)
         for model, sd in zip(self.context.models, state["models"]):
-            model.load_state_dict(sd)
+            _unwrap(model).load_state_dict(sd)
         for opt, sd in zip(self.context.optimizers, state["optimizers"]):
             opt.load_state_dict(sd)
         self.trial.load_state_dict_extras(state.get("extras", {}))
@@ -198,7 +341,11 @@ class Trainer:
         return int(state["steps_completed"])
 
     def _validate(self, steps_completed: int) -> Dict[str, Any]:
-        loader = self.trial.build_validation_data_loader().get_data_loader()
+        # Each rank evaluates its shard; sums are reduced over the control
+        # plane (reference: distributed metric reducers, pytorch/_reducer.py).
+        loader = self.trial.build_validation_data_loader().get_data_loader(
+            num_replicas=self._world, rank=self._rank
+        )
         for model in self.context.models:
             model.eval()
         totals: Dict[str, float] = {}
@@ -212,6 +359,13 @@ class Trainer:
                 n += 1
         for model in self.context.models:
             model.train()
+        if self.dist is not None and self.dist.size > 1:
+            parts = self.dist.allgather((totals, n))
+            totals, n = {}, 0
+            for t, c in parts:
+                n += c
+                for k, v in t.items():
+                    totals[k] = totals.get(k, 0.0) + v
         reduced = {k: v / max(n, 1) for k, v in totals.items()}
         self.core.train.report_validation_metrics(steps_completed, reduced)
         return reduced
@@ -233,7 +387,9 @@ class Trainer:
             nonlocal data_iter, epoch_idx
             while True:
                 if data_iter is None:
-                    dl = self.trial.build_training_data_loader().get_data_loader()
+                    dl = self.trial.build_training_data_loader().get_data_loader(
+                        num_replicas=self._world, rank=self._rank
+                    )
                     data_iter = iter(dl)
                 try:
                     return next(data_iter)

@@ -1,0 +1,91 @@
+"""CIFAR-10 ResNet — KerasTrial distributed over the allocation mesh.
+
+The BASELINE.md end-to-end workload "CIFAR-10 ResNet (TFKerasTrial,
+v5e-8)": Keras 3 on the JAX backend, distributed by the framework via
+keras.distribution (DataParallel over the `mesh` hparam block — the
+reference's TFKerasTrial could only do this through Horovod,
+_tf_keras_trial.py:183-186).
+
+Data: real CIFAR-10 via keras.datasets when its cache is present; falls
+back to deterministic synthetic CIFAR-shaped data so the example runs
+air-gapped.
+"""
+
+import numpy as np
+
+from determined_tpu import core
+from determined_tpu.keras import KerasTrial, KerasTrialContext, Trainer
+
+
+def _load_data(n_train=2048, n_val=512):
+    try:
+        import keras
+
+        (x, y), (xv, yv) = keras.datasets.cifar10.load_data()
+        x, xv = x.astype("float32") / 255.0, xv.astype("float32") / 255.0
+        return (x, y), (xv, yv)
+    except Exception:
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(n_train, 32, 32, 3)).astype("float32")
+        y = rng.integers(0, 10, size=(n_train, 1))
+        xv = rng.normal(size=(n_val, 32, 32, 3)).astype("float32")
+        yv = rng.integers(0, 10, size=(n_val, 1))
+        return (x, y), (xv, yv)
+
+
+def _resnet_block(keras, x, filters, stride=1):
+    shortcut = x
+    y = keras.layers.Conv2D(filters, 3, stride, "same", use_bias=False)(x)
+    y = keras.layers.BatchNormalization()(y)
+    y = keras.layers.ReLU()(y)
+    y = keras.layers.Conv2D(filters, 3, 1, "same", use_bias=False)(y)
+    y = keras.layers.BatchNormalization()(y)
+    if stride != 1 or shortcut.shape[-1] != filters:
+        shortcut = keras.layers.Conv2D(filters, 1, stride, use_bias=False)(x)
+        shortcut = keras.layers.BatchNormalization()(shortcut)
+    return keras.layers.ReLU()(y + shortcut)
+
+
+class CIFARTrial(KerasTrial):
+    def __init__(self, context):
+        super().__init__(context)
+        self.train_data, self.val_data = _load_data()
+
+    def build_model(self):
+        import keras
+
+        hp = self.context.hparams
+        width = int(hp.get("width", 16))
+        n_blocks = int(hp.get("blocks_per_stage", 2))
+        inputs = keras.Input((32, 32, 3))
+        x = keras.layers.Conv2D(width, 3, 1, "same", use_bias=False)(inputs)
+        x = keras.layers.BatchNormalization()(x)
+        x = keras.layers.ReLU()(x)
+        for stage, filters in enumerate((width, width * 2, width * 4)):
+            for b in range(n_blocks):
+                x = _resnet_block(
+                    keras, x, filters, stride=2 if (stage > 0 and b == 0) else 1
+                )
+        x = keras.layers.GlobalAveragePooling2D()(x)
+        outputs = keras.layers.Dense(10)(x)
+        model = keras.Model(inputs, outputs)
+        model.compile(
+            optimizer=keras.optimizers.SGD(
+                float(hp.get("learning_rate", 0.1)), momentum=0.9
+            ),
+            loss=keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+            metrics=["accuracy"],
+        )
+        return model
+
+    def build_training_data(self):
+        return self.train_data
+
+    def build_validation_data(self):
+        return self.val_data
+
+
+if __name__ == "__main__":
+    with core.init() as ctx:
+        trial = CIFARTrial(KerasTrialContext(ctx, hparams=ctx.hparams))
+        Trainer(trial, core_context=ctx).fit(searcher_metric="loss")
