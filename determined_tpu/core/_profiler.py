@@ -60,12 +60,42 @@ def collect_system_metrics() -> Dict[str, Any]:
     return metrics
 
 
+# bf16 peak FLOP/s per chip by jax device_kind — used for the
+# device-utilization (MFU) series. SURVEY §5 asks for TPU duty-cycle/MXU
+# utilization in the profiler pipeline; on TPU the sound training-time
+# utilization measure is model-FLOPs utilization (achieved/peak), which
+# needs no hardware counters.
+PEAK_BF16_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def peak_flops_per_device() -> Optional[float]:
+    try:
+        import jax
+
+        kind = jax.local_devices()[0].device_kind
+    except Exception:
+        return None
+    for name, peak in PEAK_BF16_FLOPS.items():
+        if kind.startswith(name):
+            return peak
+    return None
+
+
 class _Collector(threading.Thread):
-    def __init__(self, train_context, interval: float, get_step):
+    def __init__(self, train_context, interval: float, get_step, profiler):
         super().__init__(daemon=True, name="profiler-collector")
         self._train = train_context
         self._interval = interval
         self._get_step = get_step
+        self._profiler = profiler
         self._stop = threading.Event()
 
     def run(self) -> None:
@@ -81,6 +111,7 @@ class _Collector(threading.Thread):
                 prev = (total, idle)
             except Exception:
                 pass
+            m.update(self._profiler._utilization_window())
             try:
                 self._train.report_metrics("profiling", self._get_step(), m)
             except Exception:
@@ -98,13 +129,51 @@ class ProfilerContext:
         self.tensorboard_dir = tensorboard_dir or os.environ.get(
             "DET_TENSORBOARD_PATH", "/tmp/determined_tpu/tb"
         )
+        # device-utilization series (MFU): the Trainer feeds step counts +
+        # wall time; the trial declares its FLOPs per optimizer step.
+        self._lock = threading.Lock()
+        self._flops_per_step: Optional[float] = None
+        self._window_steps = 0
+        self._window_seconds = 0.0
+        self._n_devices = 1
+        self._peak = peak_flops_per_device()
 
     def set_step(self, step: int) -> None:
         self._step = step
 
+    def set_flops_per_step(self, flops: Optional[float],
+                           n_devices: int = 1) -> None:
+        """Model FLOPs per (global) optimizer step; enables the
+        device_flops_util series (achieved / bf16-peak per chip)."""
+        self._flops_per_step = flops
+        self._n_devices = max(1, n_devices)
+
+    def observe_steps(self, n_steps: int, seconds: float) -> None:
+        """Called by the Trainer each metric flush with the window's step
+        count and wall time."""
+        with self._lock:
+            self._window_steps += n_steps
+            self._window_seconds += seconds
+
+    def _utilization_window(self) -> Dict[str, Any]:
+        with self._lock:
+            steps, secs = self._window_steps, self._window_seconds
+            self._window_steps, self._window_seconds = 0, 0.0
+        out: Dict[str, Any] = {}
+        if steps and secs > 0:
+            sps = steps / secs
+            out["steps_per_second"] = sps
+            if self._flops_per_step and self._peak:
+                out["device_flops_util"] = (
+                    self._flops_per_step * sps / (self._peak * self._n_devices)
+                )
+        return out
+
     def on(self, sampling_interval: float = 5.0) -> None:
         if self._collector is None:
-            self._collector = _Collector(self._train, sampling_interval, lambda: self._step)
+            self._collector = _Collector(
+                self._train, sampling_interval, lambda: self._step, self
+            )
             self._collector.start()
 
     def off(self) -> None:

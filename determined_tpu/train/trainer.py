@@ -165,6 +165,9 @@ class Trainer:
         if resume_from:
             self._restore(resume_from)
         if profile:
+            core.profiler.set_flops_per_step(
+                self.trial.flops_per_step(), n_devices=self.mesh.size
+            )
             core.profiler.on()
 
         data_iter = _repeat(self.trial.build_training_data)
@@ -238,6 +241,7 @@ class Trainer:
         dt = time.time() - t_start
         if n_steps and dt > 0:
             host["steps_per_second"] = n_steps / dt
+            core.profiler.observe_steps(n_steps, dt)
         core.train.report_training_metrics(last_step, host)
 
     def _validate(self, core, step: int) -> Dict[str, Any]:

@@ -135,6 +135,13 @@ class JaxTrial(abc.ABC):
 
     # -- knobs ----------------------------------------------------------
 
+    def flops_per_step(self) -> Optional[float]:
+        """Model FLOPs per global optimizer step (fwd+bwd). When provided,
+        the profiler reports a `device_flops_util` series — achieved FLOPs
+        over the chips' bf16 peak (the TPU utilization measure SURVEY §5
+        asks the profiler pipeline for)."""
+        return None
+
     def searcher_metric(self, val_metrics: Dict[str, Any]) -> float:
         """Scalar the HP searcher optimises; default: validation loss."""
         for k in ("validation_loss", "loss"):

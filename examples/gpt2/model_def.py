@@ -50,6 +50,11 @@ class GPT2Trial(JaxTrial):
     def init_params(self, rng):
         return gpt2.init(rng, self.cfg)
 
+    def flops_per_step(self):
+        # fwd+bwd FLOPs per optimizer step → profiler device_flops_util
+        return (gpt2.flops_per_token(self.cfg, self.seq_len)
+                * self.context.global_batch_size * self.seq_len)
+
     def loss(self, params, batch, rng):
         return gpt2.loss_fn(params, batch, self.cfg, self.sharding_rules())
 

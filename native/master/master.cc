@@ -138,11 +138,34 @@ void Master::stop() {
 // ---------------------------------------------------------------------------
 
 HttpResponse Master::handle(const HttpRequest& req) {
+  auto t0 = Clock::now();
+  HttpResponse resp = route(req);
+  {
+    std::lock_guard<std::mutex> lock(api_stats_.mu);
+    api_stats_.requests_by_status[resp.status]++;
+    api_stats_.seconds_sum +=
+        std::chrono::duration<double>(Clock::now() - t0).count();
+    api_stats_.seconds_count++;
+  }
+  return resp;
+}
+
+HttpResponse Master::route(const HttpRequest& req) {
   auto parts = split_path(req.path);
   // All routes live under /api/v1/.
   if (parts.size() < 3 || parts[0] != "api" || parts[1] != "v1") {
     if (req.path == "/" || req.path == "/health") {
       return HttpResponse::json(200, "{\"status\":\"ok\"}");
+    }
+    if (req.path == "/metrics" && req.method == "GET") {
+      // Prometheus scrape endpoint (reference internal/prom/
+      // det_state_metrics.go + echo-prometheus in core.go:28).
+      // Authenticated like every API route — scrapers send
+      // `Authorization: Bearer <token>`.
+      if (auth_user(req) < 0) {
+        return json_resp(401, err_body("unauthenticated"));
+      }
+      return handle_prometheus_metrics();
     }
     return not_found();
   }
@@ -279,6 +302,61 @@ HttpResponse Master::handle_users(const HttpRequest& req) {
     return json_resp(200, out);
   }
   return not_found();
+}
+
+HttpResponse Master::handle_prometheus_metrics() {
+  // Prometheus text exposition format. Gauges over the in-memory cluster
+  // state + API counters (reference det_state_metrics.go gauges).
+  std::ostringstream out;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    int agents_alive = 0, slots_total = 0, slots_free = 0;
+    for (const auto& [id, a] : agents_) {
+      if (!a.alive) continue;
+      ++agents_alive;
+      for (const auto& s : a.slots) {
+        ++slots_total;
+        if (s.enabled && s.allocation_id.empty()) ++slots_free;
+      }
+    }
+    std::map<std::string, int> allocs_by_state;
+    for (const auto& [id, a] : allocations_) allocs_by_state[a.state]++;
+    std::map<std::string, int> exps_by_state;
+    for (const auto& [id, e] : experiments_) exps_by_state[e.state]++;
+
+    out << "# TYPE det_agents_alive gauge\n"
+        << "det_agents_alive " << agents_alive << "\n"
+        << "# TYPE det_slots_total gauge\n"
+        << "det_slots_total " << slots_total << "\n"
+        << "# TYPE det_slots_free gauge\n"
+        << "det_slots_free " << slots_free << "\n"
+        << "# TYPE det_scheduler_queue_depth gauge\n"
+        << "det_scheduler_queue_depth " << pending_.size() << "\n";
+    out << "# TYPE det_allocations gauge\n";
+    for (const auto& [state, n] : allocs_by_state) {
+      out << "det_allocations{state=\"" << state << "\"} " << n << "\n";
+    }
+    out << "# TYPE det_experiments gauge\n";
+    for (const auto& [state, n] : exps_by_state) {
+      out << "det_experiments{state=\"" << state << "\"} " << n << "\n";
+    }
+  }
+  {
+    std::lock_guard<std::mutex> lock(api_stats_.mu);
+    out << "# TYPE det_api_requests_total counter\n";
+    for (const auto& [code, n] : api_stats_.requests_by_status) {
+      out << "det_api_requests_total{code=\"" << code << "\"} " << n << "\n";
+    }
+    out << "# TYPE det_api_request_seconds summary\n"
+        << "det_api_request_seconds_sum " << api_stats_.seconds_sum << "\n"
+        << "det_api_request_seconds_count " << api_stats_.seconds_count
+        << "\n";
+  }
+  HttpResponse r;
+  r.status = 200;
+  r.content_type = "text/plain; version=0.0.4";
+  r.body = out.str();
+  return r;
 }
 
 HttpResponse Master::handle_master_info(const HttpRequest& req) {

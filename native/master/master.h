@@ -19,6 +19,7 @@
 
 #pragma once
 
+#include <chrono>
 #include <condition_variable>
 #include <deque>
 #include <map>
@@ -150,6 +151,7 @@ class Master {
   void stop();
 
   HttpResponse handle(const HttpRequest& req);
+  HttpResponse route(const HttpRequest& req);
 
  private:
   using Clock = std::chrono::steady_clock;
@@ -185,6 +187,7 @@ class Master {
   HttpResponse handle_webhooks(const HttpRequest& req,
                                const std::vector<std::string>& parts);
   HttpResponse handle_job_queue(const HttpRequest& req);
+  HttpResponse handle_prometheus_metrics();
 
   // --- experiment/trial/searcher machinery (mu_ held) ---
   int64_t create_experiment_locked(const Json& config,
@@ -221,6 +224,15 @@ class Master {
   MasterConfig cfg_;
   Db db_;
   HttpServer server_;
+
+  // --- observability (reference internal/prom/det_state_metrics.go) ---
+  struct ApiStats {
+    std::mutex mu;
+    std::map<int, int64_t> requests_by_status;
+    double seconds_sum = 0;
+    int64_t seconds_count = 0;
+  };
+  ApiStats api_stats_;
 
   std::mutex mu_;
   std::condition_variable cv_;

@@ -12,6 +12,7 @@
 #include <thread>
 
 #include "master.h"
+#include "scheduler_fit.h"
 
 namespace det {
 
@@ -340,99 +341,28 @@ void Master::schedule_locked() {
 }
 
 bool Master::try_fit_locked(Allocation& alloc) {
-  // Collect alive agents in the pool with their free slot runs.
-  struct Candidate {
-    AgentState* agent;
-    std::vector<int> free_slots;  // sorted ids
-  };
-  std::vector<Candidate> cands;
+  // Collect alive agents in the pool with their free slots, then delegate
+  // the pure fitting decision to find_fit (scheduler_fit.cc — unit-tested
+  // standalone, reference fitting_test.go discipline).
+  std::vector<AgentState*> pool_agents;
+  std::vector<HostFreeView> views;
   for (auto& [id, a] : agents_) {
     if (!a.alive || a.resource_pool != alloc.resource_pool) continue;
-    Candidate c{&a, {}};
+    HostFreeView v;
+    v.id = a.id;
+    v.total_slots = static_cast<int>(a.slots.size());
     for (const auto& s : a.slots) {
-      if (s.enabled && s.allocation_id.empty()) c.free_slots.push_back(s.id);
+      if (s.enabled && s.allocation_id.empty()) v.free_slots.push_back(s.id);
     }
-    std::sort(c.free_slots.begin(), c.free_slots.end());
-    cands.push_back(std::move(c));
+    pool_agents.push_back(&a);
+    views.push_back(std::move(v));
   }
-  if (cands.empty()) return false;
-  std::sort(cands.begin(), cands.end(), [](const Candidate& x,
-                                           const Candidate& y) {
-    return x.agent->id < y.agent->id;
-  });
+  auto picks = find_fit(alloc.slots, views);
+  if (picks.empty()) return false;  // no fit (or no alive agents at all)
 
   std::vector<std::pair<AgentState*, std::vector<int>>> assignment;
-  int need = alloc.slots;
-
-  if (need == 0) {
-    // Zero-slot aux task: any alive agent.
-    assignment.push_back({cands[0].agent, {}});
-  } else {
-    // Single-host fit first: best-fit (fitting_methods.go:41) with a
-    // topology preference for a contiguous chip run whose start is aligned
-    // to the sub-slice size — those map onto ICI sub-slices.
-    AgentState* best = nullptr;
-    std::vector<int> best_slots;
-    int best_score = -1;
-    for (auto& c : cands) {
-      if (static_cast<int>(c.free_slots.size()) < need) continue;
-      // Find the best contiguous aligned run of `need` slots.
-      std::vector<int> pick;
-      for (size_t i = 0; i + need <= c.free_slots.size() && pick.empty(); ++i) {
-        if (c.free_slots[i] % need != 0) continue;
-        bool contiguous = true;
-        for (int k = 1; k < need; ++k) {
-          contiguous &= c.free_slots[i + k] == c.free_slots[i] + k;
-        }
-        if (contiguous) {
-          pick.assign(c.free_slots.begin() + i, c.free_slots.begin() + i + need);
-        }
-      }
-      int score = 0;  // higher is better
-      if (!pick.empty()) score += 1000;  // aligned contiguous sub-slice
-      if (pick.empty()) {
-        pick.assign(c.free_slots.begin(), c.free_slots.begin() + need);
-      }
-      // Best-fit: prefer the agent with the least leftover.
-      score += 500 - static_cast<int>(c.free_slots.size() - pick.size());
-      if (score > best_score) {
-        best_score = score;
-        best = c.agent;
-        best_slots = pick;
-      }
-    }
-    if (best != nullptr) {
-      assignment.push_back({best, best_slots});
-    } else {
-      // Multi-host: whole free hosts only (an ICI mesh spans complete
-      // hosts; fractional hosts can't join the slice), and the hosts must
-      // be uniform (every host contributes the same chip count or the mesh
-      // is ragged). Group free hosts by slot count and take the first group
-      // — largest hosts first, fewer hosts per mesh — that divides `need`
-      // exactly and has enough members.
-      std::map<int, std::vector<Candidate*>> whole_by_size;
-      for (auto& c : cands) {
-        if (!c.agent->slots.empty() &&
-            c.free_slots.size() == c.agent->slots.size()) {
-          whole_by_size[static_cast<int>(c.free_slots.size())].push_back(&c);
-        }
-      }
-      bool placed = false;
-      for (auto it = whole_by_size.rbegin(); it != whole_by_size.rend();
-           ++it) {
-        int per_host = it->first;
-        std::vector<Candidate*>& group = it->second;
-        if (per_host <= 0 || need % per_host != 0) continue;
-        size_t hosts = static_cast<size_t>(need / per_host);
-        if (group.size() < hosts) continue;
-        for (size_t h = 0; h < hosts; ++h) {
-          assignment.push_back({group[h]->agent, group[h]->free_slots});
-        }
-        placed = true;
-        break;
-      }
-      if (!placed) return false;
-    }
+  for (auto& [idx, slot_ids] : picks) {
+    assignment.push_back({pool_agents[idx], slot_ids});
   }
 
   // Commit the assignment: mark slots, build resources, enqueue start

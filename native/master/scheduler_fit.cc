@@ -1,0 +1,96 @@
+// Pure fitting logic for the agent RM (see scheduler_fit.h). Reference:
+// rm/agentrm/fitting.go findFits + fitting_methods.go:41 BestFit, re-shaped
+// for ICI topology (contiguous aligned sub-slices, whole uniform hosts).
+
+#include "scheduler_fit.h"
+
+#include <algorithm>
+#include <map>
+
+namespace det {
+
+std::vector<std::pair<size_t, std::vector<int>>> find_fit(
+    int need, std::vector<HostFreeView> views) {
+  std::vector<std::pair<size_t, std::vector<int>>> assignment;
+  if (views.empty()) return assignment;
+
+  // Deterministic host order; keep the original index for the caller.
+  std::vector<size_t> order(views.size());
+  for (size_t i = 0; i < order.size(); ++i) order[i] = i;
+  std::stable_sort(order.begin(), order.end(), [&](size_t x, size_t y) {
+    return views[x].id < views[y].id;
+  });
+  for (auto& v : views) {
+    std::sort(v.free_slots.begin(), v.free_slots.end());
+  }
+
+  if (need == 0) {
+    // Zero-slot aux task: any alive host.
+    assignment.push_back({order[0], {}});
+    return assignment;
+  }
+
+  // Single-host fit first: best-fit with a topology preference for a
+  // contiguous chip run whose start is aligned to the sub-slice size —
+  // those map onto ICI sub-slices.
+  int best_score = -1;
+  size_t best_idx = 0;
+  std::vector<int> best_slots;
+  for (size_t oi : order) {
+    const HostFreeView& c = views[oi];
+    if (static_cast<int>(c.free_slots.size()) < need) continue;
+    std::vector<int> pick;
+    for (size_t i = 0; i + need <= c.free_slots.size() && pick.empty(); ++i) {
+      if (c.free_slots[i] % need != 0) continue;
+      bool contiguous = true;
+      for (int k = 1; k < need; ++k) {
+        contiguous &= c.free_slots[i + k] == c.free_slots[i] + k;
+      }
+      if (contiguous) {
+        pick.assign(c.free_slots.begin() + i, c.free_slots.begin() + i + need);
+      }
+    }
+    int score = 0;  // higher is better
+    if (!pick.empty()) score += 1000;  // aligned contiguous sub-slice
+    if (pick.empty()) {
+      pick.assign(c.free_slots.begin(), c.free_slots.begin() + need);
+    }
+    // Best-fit: prefer the host with the least leftover.
+    score += 500 - static_cast<int>(c.free_slots.size() - pick.size());
+    if (score > best_score) {
+      best_score = score;
+      best_idx = oi;
+      best_slots = pick;
+    }
+  }
+  if (best_score >= 0) {
+    assignment.push_back({best_idx, best_slots});
+    return assignment;
+  }
+
+  // Multi-host: whole free hosts only (an ICI mesh spans complete hosts),
+  // uniform slot counts (a ragged mesh is not a mesh). Largest hosts first —
+  // fewer hosts per mesh.
+  std::map<int, std::vector<size_t>> whole_by_size;
+  for (size_t oi : order) {
+    const HostFreeView& c = views[oi];
+    if (c.total_slots > 0 &&
+        static_cast<int>(c.free_slots.size()) == c.total_slots) {
+      whole_by_size[c.total_slots].push_back(oi);
+    }
+  }
+  for (auto it = whole_by_size.rbegin(); it != whole_by_size.rend(); ++it) {
+    int per_host = it->first;
+    const std::vector<size_t>& group = it->second;
+    if (per_host <= 0 || need % per_host != 0) continue;
+    size_t hosts = static_cast<size_t>(need / per_host);
+    if (group.size() < hosts) continue;
+    for (size_t h = 0; h < hosts; ++h) {
+      assignment.push_back({group[h], views[group[h]].free_slots});
+    }
+    return assignment;
+  }
+  return {};
+}
+
+}  // namespace det

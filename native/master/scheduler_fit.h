@@ -1,0 +1,30 @@
+// scheduler_fit.h — pure slot-fitting logic, extracted from the agent RM so
+// it can be unit-tested without a running master (reference discipline:
+// rm/agentrm/fitting_test.go tests findFits standalone).
+//
+// Topology model (SURVEY.md §7): a slot is a TPU chip, an agent is a
+// TPU-VM host, an allocation is an ICI mesh. Single-host fits prefer a
+// contiguous chip run whose start is aligned to the sub-slice size;
+// multi-host fits take whole, uniform hosts only.
+
+#pragma once
+
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace det {
+
+struct HostFreeView {
+  std::string id;       // agent id (used for deterministic ordering)
+  int total_slots = 0;  // all slots on the host (free or not)
+  std::vector<int> free_slots;  // free+enabled slot ids, any order
+};
+
+// Pick hosts+slots for `need` chips over candidate hosts. Returns
+// {host_index_in_views, slot_ids} per chosen host; empty if no fit.
+// need == 0 (aux task): first host, no slots.
+std::vector<std::pair<size_t, std::vector<int>>> find_fit(
+    int need, std::vector<HostFreeView> views);
+
+}  // namespace det

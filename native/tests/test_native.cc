@@ -1,0 +1,351 @@
+// Unit tests for the native master's pure logic: JSON, hparam sampling,
+// searcher state machines (ASHA promote semantics, snapshot/restore), and
+// the scheduler's fitting function.
+//
+// Reference discipline: master/pkg/searcher/*_test.go +
+// rm/agentrm/fitting_test.go run under `go test -race`; here the same
+// binary is built plain and under -fsanitize=thread / address
+// (`make -C native test tsan asan`), driven from pytest
+// (tests/test_native_unit.py).
+
+#include <cstdio>
+#include <cstdlib>
+#include <functional>
+#include <map>
+#include <random>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "../common/json.h"
+#include "../master/scheduler_fit.h"
+#include "../master/searcher.h"
+
+using det::Json;
+using det::SearcherOp;
+
+static int g_failures = 0;
+static int g_checks = 0;
+
+#define CHECK(cond)                                                         \
+  do {                                                                      \
+    ++g_checks;                                                             \
+    if (!(cond)) {                                                          \
+      ++g_failures;                                                         \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);  \
+    }                                                                       \
+  } while (0)
+
+#define CHECK_EQ(a, b)                                                      \
+  do {                                                                      \
+    ++g_checks;                                                             \
+    if (!((a) == (b))) {                                                    \
+      ++g_failures;                                                         \
+      std::fprintf(stderr, "FAIL %s:%d: %s == %s\n", __FILE__, __LINE__,    \
+                   #a, #b);                                                 \
+    }                                                                       \
+  } while (0)
+
+// ---------------------------------------------------------------- JSON
+
+static void test_json_roundtrip() {
+  const char* src =
+      "{\"a\": 1, \"b\": -2.5e3, \"c\": [true, false, null], "
+      "\"d\": {\"nested\": \"va\\\"lue\\n\"}, \"e\": \"\\u0041\"}";
+  Json j = Json::parse(src);
+  CHECK_EQ(j["a"].as_int(), 1);
+  CHECK(j["b"].as_double() == -2500.0);
+  CHECK_EQ(j["c"].as_array().size(), static_cast<size_t>(3));
+  CHECK(j["c"].as_array()[0].as_bool());
+  CHECK_EQ(j["d"]["nested"].as_string(), "va\"lue\n");
+  CHECK_EQ(j["e"].as_string(), "A");
+  // dump → parse → dump is stable
+  std::string d1 = j.dump();
+  Json j2 = Json::parse(d1);
+  CHECK_EQ(d1, j2.dump());
+}
+
+static void test_json_malformed() {
+  bool threw = false;
+  try {
+    Json::parse("{\"unterminated\": ");
+  } catch (const std::exception&) {
+    threw = true;
+  }
+  CHECK(threw);
+}
+
+static void test_json_defaults() {
+  Json j = Json::parse("{}");
+  CHECK_EQ(j["missing"].as_int(7), 7);
+  CHECK_EQ(j["missing"].as_string("x"), "x");
+  CHECK(j["missing"].is_null());
+}
+
+// ------------------------------------------------------------- hparams
+
+static Json hp_spec() {
+  // log hparams: minval/maxval are EXPONENTS of base (reference
+  // schemas/expconf/v0/hyperparameter.json semantics).
+  return Json::parse(R"({
+    "lr": {"type": "log", "minval": -4, "maxval": -1, "base": 10},
+    "units": {"type": "int", "minval": 8, "maxval": 64},
+    "act": {"type": "categorical", "vals": ["relu", "gelu"]},
+    "depth": {"type": "const", "val": 3},
+    "bare": 42
+  })");
+}
+
+static void test_sample_hparams() {
+  std::mt19937_64 rng(1234);
+  Json s = det::sample_hparams(hp_spec(), rng);
+  double lr = s["lr"].as_double();
+  CHECK(lr >= 1e-4 && lr <= 1e-1);
+  int64_t units = s["units"].as_int();
+  CHECK(units >= 8 && units <= 64);
+  std::string act = s["act"].as_string();
+  CHECK(act == "relu" || act == "gelu");
+  CHECK_EQ(s["depth"].as_int(), 3);
+  CHECK_EQ(s["bare"].as_int(), 42);
+  // determinism: same seed, same sample
+  std::mt19937_64 rng2(1234);
+  CHECK_EQ(det::sample_hparams(hp_spec(), rng2).dump(), s.dump());
+}
+
+static void test_grid_points() {
+  Json spec = Json::parse(R"({
+    "lr": {"type": "double", "minval": 0.0, "maxval": 1.0, "count": 3},
+    "act": {"type": "categorical", "vals": ["a", "b"]}
+  })");
+  auto pts = det::grid_points(spec);
+  CHECK_EQ(pts.size(), static_cast<size_t>(6));
+  std::set<std::string> seen;
+  for (const auto& p : pts) seen.insert(p.dump());
+  CHECK_EQ(seen.size(), static_cast<size_t>(6));
+}
+
+// ------------------------------------------------------------ searcher
+
+static Json searcher_cfg(const char* extra) {
+  std::string base = std::string(
+      "{\"name\": \"async_halving\", \"metric\": \"loss\", "
+      "\"smaller_is_better\": true, \"max_length\": {\"batches\": 16}, "
+      "\"num_rungs\": 2, \"divisor\": 4, \"max_trials\": 8") + extra + "}";
+  return Json::parse(base);
+}
+
+static void test_single_searcher() {
+  Json cfg = Json::parse(
+      "{\"name\": \"single\", \"metric\": \"loss\", "
+      "\"max_length\": {\"batches\": 10}}");
+  det::Searcher s(cfg, hp_spec(), 7);
+  auto ops = s.initial_operations();
+  // one Create + one ValidateAfter(10)
+  CHECK_EQ(ops.size(), static_cast<size_t>(2));
+  CHECK(ops[0].kind == SearcherOp::Kind::Create);
+  CHECK(ops[1].kind == SearcherOp::Kind::ValidateAfter);
+  CHECK_EQ(ops[1].length, 10);
+  auto done = s.validation_completed(ops[0].request_id, 0.5, 10);
+  bool saw_close = false;
+  for (const auto& op : done) {
+    saw_close |= op.kind == SearcherOp::Kind::Close;
+  }
+  CHECK(saw_close);
+}
+
+static void test_asha_promote_semantics() {
+  det::Searcher s(searcher_cfg(""), hp_spec(), 7);
+  auto ops = s.initial_operations();
+  // Collect created trials + their first ValidateAfter (rung 0 = 16/4 = 4).
+  std::vector<std::string> rids;
+  int64_t rung0 = 0;
+  for (const auto& op : ops) {
+    if (op.kind == SearcherOp::Kind::Create) rids.push_back(op.request_id);
+    if (op.kind == SearcherOp::Kind::ValidateAfter) rung0 = op.length;
+  }
+  CHECK(!rids.empty());
+  CHECK_EQ(rung0, 4);
+
+  // Report rung-0 metrics: trial i gets metric i (smaller better). The
+  // best 1/divisor (=1/4) get promoted to the top rung — lengths are
+  // CUMULATIVE (continuation-style: rung0 4 + 16 more = 20), keeping
+  // promotions warm-slice continuations instead of kill+respawn.
+  int promotions = 0, closes = 0;
+  std::set<std::string> promoted;
+  for (size_t i = 0; i < rids.size(); ++i) {
+    auto out = s.validation_completed(rids[i], static_cast<double>(i), 4);
+    for (const auto& op : out) {
+      if (op.kind == SearcherOp::Kind::ValidateAfter) {
+        CHECK_EQ(op.length, 20);
+        ++promotions;
+        promoted.insert(op.request_id);
+      }
+      if (op.kind == SearcherOp::Kind::Close) ++closes;
+      // new trials may also be created (async) — allowed
+    }
+  }
+  CHECK(promotions >= 1);
+  // The FIRST reported (best metric 0) must be among the promoted.
+  CHECK(promoted.count(rids[0]) == 1);
+  CHECK(closes >= 1);
+}
+
+static void test_asha_snapshot_restore_determinism() {
+  det::Searcher a(searcher_cfg(""), hp_spec(), 99);
+  auto ops = a.initial_operations();
+  std::vector<std::string> rids;
+  for (const auto& op : ops) {
+    if (op.kind == SearcherOp::Kind::Create) rids.push_back(op.request_id);
+  }
+  // half-way: report two metrics, snapshot, then diverge-check
+  a.validation_completed(rids[0], 0.3, 4);
+  Json snap = a.snapshot();
+
+  det::Searcher b(searcher_cfg(""), hp_spec(), 99);
+  b.restore(snap);
+  auto out_a = a.validation_completed(rids[1], 0.1, 4);
+  auto out_b = b.validation_completed(rids[1], 0.1, 4);
+  CHECK_EQ(out_a.size(), out_b.size());
+  for (size_t i = 0; i < out_a.size() && i < out_b.size(); ++i) {
+    CHECK_EQ(out_a[i].to_json().dump(), out_b[i].to_json().dump());
+  }
+}
+
+static void test_adaptive_asha_brackets() {
+  Json cfg = Json::parse(
+      "{\"name\": \"adaptive_asha\", \"metric\": \"loss\", "
+      "\"smaller_is_better\": true, \"max_length\": {\"batches\": 64}, "
+      "\"max_trials\": 8, \"max_rungs\": 3, \"divisor\": 4, "
+      "\"mode\": \"standard\", \"max_concurrent_trials\": 8}");
+  det::Searcher s(cfg, hp_spec(), 5);
+  auto ops = s.initial_operations();
+  int creates = 0;
+  std::set<int64_t> first_lengths;
+  std::map<std::string, int64_t> first_len;
+  for (const auto& op : ops) {
+    if (op.kind == SearcherOp::Kind::Create) ++creates;
+    if (op.kind == SearcherOp::Kind::ValidateAfter &&
+        !first_len.count(op.request_id)) {
+      first_len[op.request_id] = op.length;
+      first_lengths.insert(op.length);
+    }
+  }
+  CHECK(creates >= 2);
+  // multiple brackets → different rung-0 lengths
+  CHECK(first_lengths.size() >= 2);
+}
+
+static void test_grid_searcher_runs_all_points() {
+  Json cfg = Json::parse(
+      "{\"name\": \"grid\", \"metric\": \"loss\", "
+      "\"max_length\": {\"batches\": 4}}");
+  Json spec = Json::parse(R"({
+    "lr": {"type": "double", "minval": 0.0, "maxval": 1.0, "count": 2},
+    "act": {"type": "categorical", "vals": ["a", "b"]}
+  })");
+  det::Searcher s(cfg, spec, 3);
+  auto ops = s.initial_operations();
+  int creates = 0;
+  for (const auto& op : ops) {
+    if (op.kind == SearcherOp::Kind::Create) ++creates;
+  }
+  CHECK_EQ(creates, 4);
+}
+
+// ----------------------------------------------------------- scheduler
+
+static det::HostFreeView host(const std::string& id, int total,
+                              std::vector<int> free) {
+  det::HostFreeView v;
+  v.id = id;
+  v.total_slots = total;
+  v.free_slots = std::move(free);
+  return v;
+}
+
+static void test_fit_prefers_aligned_contiguous() {
+  // host-a has a fragmented set; host-b has an aligned contiguous run.
+  auto picks = det::find_fit(
+      2, {host("a", 4, {1, 3}), host("b", 4, {2, 3})});
+  CHECK_EQ(picks.size(), static_cast<size_t>(1));
+  CHECK_EQ(picks[0].first, static_cast<size_t>(1));
+  CHECK((picks[0].second == std::vector<int>{2, 3}));
+}
+
+static void test_fit_best_fit_least_leftover() {
+  // both have aligned runs; prefer the fuller host (least leftover).
+  auto picks = det::find_fit(
+      2, {host("a", 8, {0, 1, 2, 3, 4, 5}), host("b", 4, {0, 1})});
+  CHECK_EQ(picks.size(), static_cast<size_t>(1));
+  CHECK_EQ(picks[0].first, static_cast<size_t>(1));
+}
+
+static void test_fit_multihost_uniform() {
+  // need 8 over whole hosts: two free 4-slot hosts win; the fragmented
+  // 8-slot host (not fully free) cannot join.
+  auto picks = det::find_fit(
+      8, {host("big", 8, {0, 1, 2, 3, 4, 5, 6}),  // one slot busy
+          host("w1", 4, {0, 1, 2, 3}), host("w2", 4, {0, 1, 2, 3})});
+  CHECK_EQ(picks.size(), static_cast<size_t>(2));
+  CHECK_EQ(picks[0].first, static_cast<size_t>(1));
+  CHECK_EQ(picks[1].first, static_cast<size_t>(2));
+}
+
+static void test_fit_multihost_heterogeneous_groups() {
+  // r2 hardening case: hosts of different sizes — group by size; the
+  // 8-slot pair divides 16 exactly, the lone 4-slot host is skipped.
+  auto picks = det::find_fit(
+      16, {host("s4", 4, {0, 1, 2, 3}), host("b1", 8, {0, 1, 2, 3, 4, 5, 6, 7}),
+           host("b2", 8, {0, 1, 2, 3, 4, 5, 6, 7})});
+  CHECK_EQ(picks.size(), static_cast<size_t>(2));
+  std::set<size_t> idx{picks[0].first, picks[1].first};
+  CHECK(idx == (std::set<size_t>{1, 2}));
+}
+
+static void test_fit_no_fit() {
+  CHECK(det::find_fit(4, {host("a", 2, {0, 1})}).empty());
+  CHECK(det::find_fit(1, {}).empty());
+  // 3 doesn't divide into 2-slot whole hosts
+  CHECK(det::find_fit(3, {host("a", 2, {0, 1}), host("b", 2, {0, 1})}).empty());
+}
+
+static void test_fit_zero_slot_aux() {
+  auto picks = det::find_fit(0, {host("z", 2, {})});
+  CHECK_EQ(picks.size(), static_cast<size_t>(1));
+  CHECK(picks[0].second.empty());
+}
+
+// -------------------------------------------------------------- driver
+
+int main() {
+  struct Test {
+    const char* name;
+    std::function<void()> fn;
+  };
+  std::vector<Test> tests = {
+      {"json_roundtrip", test_json_roundtrip},
+      {"json_malformed", test_json_malformed},
+      {"json_defaults", test_json_defaults},
+      {"sample_hparams", test_sample_hparams},
+      {"grid_points", test_grid_points},
+      {"single_searcher", test_single_searcher},
+      {"asha_promote_semantics", test_asha_promote_semantics},
+      {"asha_snapshot_restore", test_asha_snapshot_restore_determinism},
+      {"adaptive_asha_brackets", test_adaptive_asha_brackets},
+      {"grid_searcher_all_points", test_grid_searcher_runs_all_points},
+      {"fit_aligned_contiguous", test_fit_prefers_aligned_contiguous},
+      {"fit_best_fit", test_fit_best_fit_least_leftover},
+      {"fit_multihost_uniform", test_fit_multihost_uniform},
+      {"fit_multihost_heterogeneous", test_fit_multihost_heterogeneous_groups},
+      {"fit_no_fit", test_fit_no_fit},
+      {"fit_zero_slot_aux", test_fit_zero_slot_aux},
+  };
+  for (auto& t : tests) {
+    int before = g_failures;
+    t.fn();
+    std::printf("%-32s %s\n", t.name,
+                g_failures == before ? "ok" : "FAILED");
+  }
+  std::printf("%d checks, %d failures\n", g_checks, g_failures);
+  return g_failures == 0 ? 0 : 1;
+}

@@ -1,0 +1,26 @@
+"""Drive the native C++ unit tests (plain + sanitizers) from pytest.
+
+Reference discipline: the Go master runs `go test -race -short`
+(master/Makefile:187); here `make -C native test / asan / tsan` build and
+run the same binary under ThreadSanitizer and AddressSanitizer+UBSan."""
+
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _make(target: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        ["make", "-C", os.path.join(REPO, "native"), target],
+        capture_output=True, text=True, timeout=600,
+    )
+
+
+@pytest.mark.parametrize("target", ["test", "asan", "tsan"])
+def test_native_units(target):
+    r = _make(target)
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-3000:]
+    assert "0 failures" in r.stdout

@@ -1,0 +1,96 @@
+"""Observability: master /metrics endpoint + profiler utilization series.
+
+Reference: internal/prom/det_state_metrics.go (master gauges) and the
+profiler-metrics pipeline (SURVEY §5 asks for TPU utilization in it)."""
+
+import urllib.error
+import urllib.request
+
+import pytest
+
+from determined_tpu.core._profiler import PEAK_BF16_FLOPS, ProfilerContext
+from determined_tpu.core._train import TrainContext
+from tests.test_platform_e2e import Devcluster, native_binaries  # noqa: F401
+
+
+class TestProfilerUtilization:
+    def test_device_flops_util_math(self):
+        train = TrainContext(None)
+        p = ProfilerContext(train)
+        p._peak = 197e12  # v5e chip peak (CPU test host detects none)
+        p.set_flops_per_step(197e12 * 0.5, n_devices=1)  # half-peak model
+        p.observe_steps(20, 10.0)  # 2 steps/sec
+        m = p._utilization_window()
+        assert m["steps_per_second"] == pytest.approx(2.0)
+        assert m["device_flops_util"] == pytest.approx(1.0)  # 2 × half = peak
+        # window resets after read
+        assert p._utilization_window() == {}
+
+    def test_multi_device_normalization(self):
+        p = ProfilerContext(TrainContext(None))
+        p._peak = 100.0
+        p.set_flops_per_step(400.0, n_devices=8)  # global-step flops
+        p.observe_steps(10, 10.0)  # 1 step/sec
+        m = p._utilization_window()
+        assert m["device_flops_util"] == pytest.approx(0.5)
+
+    def test_no_flops_no_series(self):
+        p = ProfilerContext(TrainContext(None))
+        p._peak = 100.0
+        p.observe_steps(5, 1.0)
+        m = p._utilization_window()
+        assert "device_flops_util" not in m
+        assert m["steps_per_second"] == pytest.approx(5.0)
+
+    def test_peak_table_covers_v5e(self):
+        assert PEAK_BF16_FLOPS["TPU v5 lite"] == 197e12
+
+    def test_trainer_feeds_profiler(self, tmp_path):
+        """Trainer.fit(profile=True) reports a profiling metric series."""
+        from determined_tpu import core
+        from determined_tpu.train import Trainer
+        from determined_tpu.train.trial import TrialContext
+        from tests.test_trainer import TinyGPT2Trial
+
+        class FlopsTrial(TinyGPT2Trial):
+            def flops_per_step(self):
+                return 1e9
+
+        ctx = core.init(max_length=4, checkpoint_dir=str(tmp_path),
+                        async_checkpointing=False)
+        trainer = Trainer(FlopsTrial(TrialContext()), core_context=ctx)
+        # make the collector tick fast enough for a short run
+        trainer.fit(report_period=1, profile=True)
+        ctx.profiler._collector is None or ctx.profiler.off()
+        # observe_steps was fed; utilisation window accumulates between
+        # collector ticks — read it directly
+        assert ctx.profiler._flops_per_step == 1e9
+        ctx.close()
+
+
+def test_master_metrics_endpoint(tmp_path, native_binaries):  # noqa: F811
+    c = Devcluster(str(tmp_path), native_binaries)
+    c.start_master()
+    try:
+        c.start_agent()
+        token = c.login()
+        # generate some API traffic
+        c.api("GET", "/api/v1/agents", token=token)
+        # unauthenticated scrape is rejected like every API route
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(c.master_url + "/metrics", timeout=10)
+        assert ei.value.code == 401
+        req = urllib.request.Request(
+            c.master_url + "/metrics",
+            headers={"Authorization": f"Bearer {token}"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            body = r.read().decode()
+        assert "det_agents_alive 1" in body
+        assert "det_slots_total 2" in body
+        assert "det_slots_free 2" in body
+        assert "det_scheduler_queue_depth 0" in body
+        assert 'det_api_requests_total{code="200"}' in body
+        assert "det_api_request_seconds_count" in body
+    finally:
+        c.stop()
