@@ -46,6 +46,7 @@ int main(int argc, char** argv) {
   // 2. env
   if (const char* p = getenv("DET_MASTER_PORT")) cfg.port = atoi(p);
   if (const char* p = getenv("DET_MASTER_DB")) cfg.db_path = p;
+  if (const char* p = getenv("DET_MASTER_WEBUI_DIR")) cfg.webui_dir = p;
 
   // 3. flags
   for (int i = 1; i < argc; ++i) {
@@ -58,12 +59,21 @@ int main(int argc, char** argv) {
     else if (a == "--db") cfg.db_path = next();
     else if (a == "--cluster-name") cfg.cluster_name = next();
     else if (a == "--agent-timeout") cfg.agent_timeout_s = atof(next().c_str());
+    else if (a == "--webui-dir") cfg.webui_dir = next();
     else if (a == "--config") next();
     else if (a == "--help" || a == "-h") {
       std::cout << "determined-master [--port N] [--host H] [--db PATH] "
                    "[--config file.json]\n";
       return 0;
     }
+  }
+
+  // Default WebUI dir: <exe dir>/../../webui (bin/ lives in native/).
+  if (cfg.webui_dir.empty()) {
+    std::string exe = argv[0];
+    auto slash = exe.rfind('/');
+    std::string dir = slash == std::string::npos ? "." : exe.substr(0, slash);
+    cfg.webui_dir = dir + "/../../webui";
   }
 
   try {

@@ -82,6 +82,7 @@ MasterConfig MasterConfig::from_json(const Json& j) {
   if (j["agent_timeout_s"].is_number()) {
     c.agent_timeout_s = j["agent_timeout_s"].as_double();
   }
+  if (j["webui_dir"].is_string()) c.webui_dir = j["webui_dir"].as_string();
   for (const auto& [pool, policy] : j["resource_pools"].as_object()) {
     c.pool_policies[pool] = policy["scheduler"].as_string("priority");
   }
@@ -154,7 +155,20 @@ HttpResponse Master::route(const HttpRequest& req) {
   auto parts = split_path(req.path);
   // All routes live under /api/v1/.
   if (parts.size() < 3 || parts[0] != "api" || parts[1] != "v1") {
-    if (req.path == "/" || req.path == "/health") {
+    if (req.path == "/health") {
+      return HttpResponse::json(200, "{\"status\":\"ok\"}");
+    }
+    // Static WebUI (reference: webui/react served by the master): `/` is
+    // the SPA shell, assets under /ui/. Auth happens in the app (the API
+    // it calls is token-gated); the shell itself is public like any SPA.
+    if (req.method == "GET" &&
+        (req.path == "/" || req.path.rfind("/ui/", 0) == 0)) {
+      HttpResponse r = serve_webui(req.path);
+      if (r.status != 404 || req.path != "/") return r;
+      return HttpResponse::json(200, "{\"status\":\"ok\"}");  // no webui dir
+    }
+    if (req.path == "/") {
+      // Non-GET probes (HEAD from load balancers) keep the health answer.
       return HttpResponse::json(200, "{\"status\":\"ok\"}");
     }
     if (req.path == "/metrics" && req.method == "GET") {
@@ -302,6 +316,32 @@ HttpResponse Master::handle_users(const HttpRequest& req) {
     return json_resp(200, out);
   }
   return not_found();
+}
+
+HttpResponse Master::serve_webui(const std::string& path) {
+  std::string rel = path == "/" ? "index.html" : path.substr(4);  // strip /ui/
+  // Flat directory only — reject any traversal or nesting.
+  if (rel.empty() || rel.find('/') != std::string::npos ||
+      rel.find("..") != std::string::npos) {
+    return not_found();
+  }
+  std::ifstream f(cfg_.webui_dir + "/" + rel, std::ios::binary);
+  if (!f) return not_found();
+  std::stringstream ss;
+  ss << f.rdbuf();
+  HttpResponse r;
+  r.status = 200;
+  if (rel.size() > 5 && rel.rfind(".html") == rel.size() - 5) {
+    r.content_type = "text/html; charset=utf-8";
+  } else if (rel.size() > 3 && rel.rfind(".js") == rel.size() - 3) {
+    r.content_type = "application/javascript";
+  } else if (rel.size() > 4 && rel.rfind(".css") == rel.size() - 4) {
+    r.content_type = "text/css";
+  } else {
+    r.content_type = "application/octet-stream";
+  }
+  r.body = ss.str();
+  return r;
 }
 
 HttpResponse Master::handle_prometheus_metrics() {

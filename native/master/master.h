@@ -50,6 +50,9 @@ struct MasterConfig {
   std::map<std::string, std::string> pool_policies;
   std::string default_pool = "default";
   double agent_timeout_s = 60.0;  // heartbeat grace before marking dead
+  // Directory with the static WebUI (index.html, app.js, style.css);
+  // resolved at startup (flag --webui-dir > env > <exe>/../../webui).
+  std::string webui_dir;
 
   static MasterConfig from_json(const Json& j);
 };
@@ -188,6 +191,7 @@ class Master {
                                const std::vector<std::string>& parts);
   HttpResponse handle_job_queue(const HttpRequest& req);
   HttpResponse handle_prometheus_metrics();
+  HttpResponse serve_webui(const std::string& path);
 
   // --- experiment/trial/searcher machinery (mu_ held) ---
   int64_t create_experiment_locked(const Json& config,

@@ -1,0 +1,407 @@
+/* determined-tpu WebUI — dependency-free SPA over the master REST API.
+   Pages: experiments list/detail (metric charts), cluster, job queue.
+   Charting follows the dataviz method: fixed-order categorical slots,
+   2px lines, recessive grid, crosshair+tooltip hover, legend for >=2
+   series + direct labels, table view toggle. */
+
+"use strict";
+
+const view = document.getElementById("view");
+
+// ---------------------------------------------------------------- api
+
+function token() { return localStorage.getItem("det_token") || ""; }
+
+async function api(method, path, body) {
+  const resp = await fetch(path, {
+    method,
+    headers: {
+      "Content-Type": "application/json",
+      ...(token() ? { Authorization: `Bearer ${token()}` } : {}),
+    },
+    body: body === undefined ? undefined : JSON.stringify(body),
+  });
+  if (resp.status === 401) { renderLogin(); throw new Error("unauthenticated"); }
+  if (!resp.ok) throw new Error(`${method} ${path}: HTTP ${resp.status}`);
+  const text = await resp.text();
+  return text ? JSON.parse(text) : null;
+}
+
+// ---------------------------------------------------------------- util
+
+function el(tag, attrs = {}, ...children) {
+  const node = document.createElement(tag);
+  for (const [k, v] of Object.entries(attrs)) {
+    if (k === "class") node.className = v;
+    else if (k.startsWith("on")) node.addEventListener(k.slice(2), v);
+    else node.setAttribute(k, v);
+  }
+  for (const c of children.flat()) {
+    node.append(c instanceof Node ? c : document.createTextNode(String(c)));
+  }
+  return node;
+}
+
+function stateBadge(s) { return el("span", { class: `state ${s}` }, s); }
+
+function fmt(v) {
+  if (typeof v !== "number") return String(v);
+  if (Number.isInteger(v)) return String(v);
+  const a = Math.abs(v);
+  if (a !== 0 && (a < 1e-3 || a >= 1e5)) return v.toExponential(3);
+  return v.toPrecision(4);
+}
+
+// ---------------------------------------------------------------- chart
+
+const SERIES_VARS = ["--series-1", "--series-2", "--series-3", "--series-4"];
+
+function seriesColor(i) {
+  const css = getComputedStyle(document.body);
+  return css.getPropertyValue(SERIES_VARS[i % SERIES_VARS.length]).trim();
+}
+
+// series: [{name, points: [{x, y}]}]. The SVG plots at most 4 (fixed-order
+// categorical slots, never cycled); the table view keeps ALL series so
+// nothing is silently dropped, and the legend notes any fold.
+function lineChart(title, series, xLabel) {
+  const allSeries = series.filter((s) => s.points.length > 0);
+  series = allSeries.slice(0, 4);
+  const folded = allSeries.length - series.length;
+  const W = 720, H = 240, M = { l: 56, r: 110, t: 12, b: 28 };
+  const block = el("div", { class: "chart-block" });
+  const head = el("div", { class: "chart-head" },
+    el("span", { class: "chart-title" }, title));
+  if (series.length >= 2 || folded > 0) {
+    const legend = el("span", { class: "legend" },
+      series.map((s, i) => el("span", {},
+        el("span", { class: "swatch",
+                     style: `background:${seriesColor(i)}` }), s.name)));
+    if (folded > 0) {
+      legend.append(el("span", { class: "muted" },
+        `+${folded} more in table view`));
+    }
+    head.append(legend);
+  }
+  const tableBtn = el("button", { class: "table-toggle" }, "table view");
+  head.append(tableBtn);
+  block.append(head);
+  if (series.length === 0) {
+    block.append(el("div", { class: "muted" }, "no data"));
+    return block;
+  }
+
+  const xs = series.flatMap((s) => s.points.map((p) => p.x));
+  const ys = series.flatMap((s) => s.points.map((p) => p.y));
+  const xmin = Math.min(...xs), xmax = Math.max(...xs);
+  const ymin = Math.min(...ys), ymax = Math.max(...ys);
+  const xpad = xmax === xmin ? 1 : 0;
+  const ypad = (ymax - ymin || Math.abs(ymax) || 1) * 0.08;
+  const sx = (x) => M.l + ((x - xmin) / (xmax - xmin + xpad)) * (W - M.l - M.r);
+  const sy = (y) => H - M.b -
+    ((y - (ymin - ypad)) / ((ymax + ypad) - (ymin - ypad))) * (H - M.t - M.b);
+
+  const NS = "http://www.w3.org/2000/svg";
+  const svg = document.createElementNS(NS, "svg");
+  svg.setAttribute("class", "chart");
+  svg.setAttribute("viewBox", `0 0 ${W} ${H}`);
+
+  // recessive grid: 4 horizontal lines + y labels
+  for (let i = 0; i <= 3; i++) {
+    const y = ymin - ypad + ((ymax + ypad) - (ymin - ypad)) * (i / 3);
+    const line = document.createElementNS(NS, "line");
+    line.setAttribute("class", "gridline");
+    line.setAttribute("x1", M.l); line.setAttribute("x2", W - M.r);
+    line.setAttribute("y1", sy(y)); line.setAttribute("y2", sy(y));
+    svg.append(line);
+    const label = document.createElementNS(NS, "text");
+    label.setAttribute("class", "axis-label");
+    label.setAttribute("x", M.l - 6); label.setAttribute("y", sy(y) + 4);
+    label.setAttribute("text-anchor", "end");
+    label.textContent = fmt(y);
+    svg.append(label);
+  }
+  const xl = document.createElementNS(NS, "text");
+  xl.setAttribute("class", "axis-label");
+  xl.setAttribute("x", (M.l + W - M.r) / 2); xl.setAttribute("y", H - 8);
+  xl.setAttribute("text-anchor", "middle");
+  xl.textContent = xLabel || "batches";
+  svg.append(xl);
+
+  series.forEach((s, i) => {
+    const path = document.createElementNS(NS, "path");
+    path.setAttribute("class", "series-line");
+    path.setAttribute("stroke", seriesColor(i));
+    path.setAttribute("d", s.points.map((p, j) =>
+      `${j ? "L" : "M"}${sx(p.x).toFixed(1)},${sy(p.y).toFixed(1)}`).join(""));
+    svg.append(path);
+    // direct label at line end (text wears text tokens, swatch carries hue)
+    const last = s.points[s.points.length - 1];
+    const lbl = document.createElementNS(NS, "text");
+    lbl.setAttribute("class", "direct-label axis-label");
+    lbl.setAttribute("x", sx(last.x) + 6);
+    lbl.setAttribute("y", sy(last.y) + 4);
+    lbl.textContent = `${s.name} ${fmt(last.y)}`;
+    svg.append(lbl);
+  });
+
+  // hover layer: crosshair + nearest-x tooltip
+  const cross = document.createElementNS(NS, "line");
+  cross.setAttribute("class", "crosshair");
+  cross.setAttribute("y1", M.t); cross.setAttribute("y2", H - M.b);
+  cross.style.display = "none";
+  svg.append(cross);
+  const dots = series.map((s, i) => {
+    const d = document.createElementNS(NS, "circle");
+    d.setAttribute("class", "hover-dot");
+    d.setAttribute("r", 4);
+    d.setAttribute("fill", seriesColor(i));
+    d.style.display = "none";
+    svg.append(d);
+    return d;
+  });
+  const tooltip = el("div", { class: "tooltip" });
+  const wrap = el("div", { class: "chart-wrap" }, svg, tooltip);
+  svg.addEventListener("mousemove", (ev) => {
+    const rect = svg.getBoundingClientRect();
+    const px = ((ev.clientX - rect.left) / rect.width) * W;
+    if (px < M.l || px > W - M.r) { return; }
+    let bestX = null, bestD = Infinity;
+    for (const x of new Set(xs)) {
+      const d = Math.abs(sx(x) - px);
+      if (d < bestD) { bestD = d; bestX = x; }
+    }
+    cross.setAttribute("x1", sx(bestX)); cross.setAttribute("x2", sx(bestX));
+    cross.style.display = "";
+    const lines = [`${xLabel || "batches"} ${fmt(bestX)}`];
+    series.forEach((s, i) => {
+      const p = s.points.find((q) => q.x === bestX);
+      if (p) {
+        dots[i].setAttribute("cx", sx(p.x));
+        dots[i].setAttribute("cy", sy(p.y));
+        dots[i].style.display = "";
+        lines.push(`${s.name}: ${fmt(p.y)}`);
+      } else {
+        dots[i].style.display = "none";
+      }
+    });
+    tooltip.style.display = "block";
+    tooltip.textContent = "";
+    lines.forEach((l) => tooltip.append(el("div", {}, l)));
+    const tx = (sx(bestX) / W) * rect.width;
+    tooltip.style.left = `${Math.min(tx + 12, rect.width - 150)}px`;
+    tooltip.style.top = "10px";
+  });
+  svg.addEventListener("mouseleave", () => {
+    cross.style.display = "none";
+    tooltip.style.display = "none";
+    dots.forEach((d) => (d.style.display = "none"));
+  });
+  block.append(wrap);
+
+  // accessible table view — ALL series, including any folded past slot 4
+  const txs = [...new Set(allSeries.flatMap((s) => s.points.map((p) => p.x)))]
+    .sort((a, b) => a - b);
+  const table = el("table", { class: "datatable" },
+    el("tr", {}, el("th", {}, xLabel || "batches"),
+      allSeries.map((s) => el("th", {}, s.name))),
+    txs.map((x) =>
+      el("tr", {}, el("td", {}, fmt(x)),
+        allSeries.map((s) => {
+          const p = s.points.find((q) => q.x === x);
+          return el("td", {}, p ? fmt(p.y) : "");
+        }))));
+  table.style.display = "none";
+  block.append(table);
+  tableBtn.addEventListener("click", () => {
+    const show = table.style.display === "none";
+    table.style.display = show ? "block" : "none";
+    wrap.style.display = show ? "none" : "block";
+    tableBtn.textContent = show ? "chart view" : "table view";
+  });
+  return block;
+}
+
+// ---------------------------------------------------------------- pages
+
+function renderLogin(err) {
+  view.textContent = "";
+  const user = el("input", { placeholder: "username", value: "determined" });
+  const pass = el("input", { placeholder: "password", type: "password" });
+  const msg = el("div", { class: "error" }, err || "");
+  const form = el("div", { id: "login" },
+    el("h1", {}, "Sign in"), user, pass,
+    el("button", {
+      onclick: async () => {
+        try {
+          const r = await fetch("/api/v1/auth/login", {
+            method: "POST",
+            headers: { "Content-Type": "application/json" },
+            body: JSON.stringify({ username: user.value, password: pass.value }),
+          });
+          if (!r.ok) throw new Error(`HTTP ${r.status}`);
+          const j = await r.json();
+          localStorage.setItem("det_token", j.token);
+          localStorage.setItem("det_user", user.value);
+          route();
+        } catch (e) { msg.textContent = `login failed: ${e.message}`; }
+      },
+    }, "Log in"), msg);
+  view.append(form);
+}
+
+async function pageExperiments() {
+  const { experiments } = await api("GET", "/api/v1/experiments");
+  view.textContent = "";
+  view.append(el("h1", {}, "Experiments"));
+  const rows = experiments.map((e) => el("tr", {
+    class: "rowlink",
+    onclick: () => { location.hash = `#/experiments/${e.id}`; },
+  },
+    el("td", {}, e.id),
+    el("td", {}, e.name ?? ""),
+    el("td", {}, stateBadge(e.state)),
+    el("td", {}, `${Math.round((e.progress ?? 0) * 100)}%`),
+    el("td", {}, e.config?.searcher?.name ?? ""),
+    el("td", { class: "muted" }, e.config?.resources?.slots_per_trial ?? 1)));
+  view.append(el("table", {},
+    el("tr", {}, ["ID", "Name", "State", "Progress", "Searcher", "Slots"]
+      .map((h) => el("th", {}, h))), rows));
+  if (!experiments.length) view.append(el("p", { class: "muted" }, "no experiments"));
+}
+
+async function pageExperiment(id) {
+  const [{ experiment }, { trials }] = await Promise.all([
+    api("GET", `/api/v1/experiments/${id}`),
+    api("GET", `/api/v1/experiments/${id}/trials`),
+  ]);
+  view.textContent = "";
+  view.append(el("h1", {}, `Experiment ${id} `, stateBadge(experiment.state),
+    el("span", { class: "muted" }, `  ${experiment.name ?? ""}`)));
+
+  const actions = el("div", { class: "actions" });
+  const actErr = el("span", { class: "error" });
+  const act = (label, method, path) => el("button", {
+    onclick: async () => {
+      try {
+        await api(method, path);
+        pageExperiment(id);
+      } catch (e) { actErr.textContent = `${label} failed: ${e.message}`; }
+    },
+  }, label);
+  if (experiment.state === "ACTIVE" || experiment.state === "RUNNING") {
+    actions.append(act("Pause", "POST", `/api/v1/experiments/${id}/pause`));
+  }
+  if (experiment.state === "PAUSED") {
+    actions.append(act("Activate", "POST", `/api/v1/experiments/${id}/activate`));
+  }
+  if (!["COMPLETED", "CANCELED", "ERROR", "DELETED"].includes(experiment.state)) {
+    actions.append(act("Kill", "POST", `/api/v1/experiments/${id}/kill`));
+  }
+  actions.append(actErr);
+  view.append(actions);
+
+  view.append(el("h2", {}, "Trials"));
+  view.append(el("table", {},
+    el("tr", {}, ["ID", "State", "Restarts"].map((h) => el("th", {}, h))),
+    trials.map((t) => el("tr", {},
+      el("td", {}, t.id), el("td", {}, stateBadge(t.state)),
+      el("td", {}, t.restarts ?? 0)))));
+
+  // metric charts from the first trial (single/first-trial view; the data
+  // is per-trial at /api/v1/trials/{id}/metrics)
+  if (trials.length) {
+    const { metrics } = await api("GET", `/api/v1/trials/${trials[0].id}/metrics`);
+    const groups = {};
+    for (const m of metrics) {
+      for (const [k, v] of Object.entries(m.metrics || {})) {
+        if (typeof v !== "number" || !isFinite(v)) continue;
+        const key = `${m.group_name}:${k}`;
+        (groups[key] ??= []).push({ x: m.total_batches, y: v });
+      }
+    }
+    view.append(el("h2", {}, `Metrics (trial ${trials[0].id})`));
+    const lossSeries = [];
+    for (const name of ["training:loss", "validation:validation_loss",
+                        "validation:val_loss", "validation:loss"]) {
+      if (groups[name]) {
+        lossSeries.push({ name: name.replace(":", " "), points: groups[name] });
+        delete groups[name];
+      }
+    }
+    if (lossSeries.length) view.append(lineChart("loss", lossSeries));
+    // remaining numeric series, one small chart each (single series → no
+    // legend; the title names it)
+    for (const [name, points] of Object.entries(groups).slice(0, 6)) {
+      view.append(lineChart(name.replace(":", " "), [{ name, points }]));
+    }
+  }
+
+  view.append(el("h2", {}, "Config"));
+  view.append(el("pre", { class: "config" },
+    JSON.stringify(experiment.config, null, 2)));
+}
+
+async function pageCluster() {
+  const { agents } = await api("GET", "/api/v1/agents");
+  view.textContent = "";
+  view.append(el("h1", {}, "Cluster"));
+  view.append(el("table", {},
+    el("tr", {}, ["Agent", "Pool", "Address", "Alive", "Slots (chips)"]
+      .map((h) => el("th", {}, h))),
+    agents.map((a) => el("tr", {},
+      el("td", {}, a.id),
+      el("td", {}, a.resource_pool),
+      el("td", { class: "muted" }, a.addr),
+      el("td", {}, a.alive ? "yes" : "no"),
+      el("td", {}, el("span", { class: "slots" },
+        a.slots.map((s) => el("span", {
+          class: `slot ${s.allocation_id ? "busy" : ""} ${s.enabled ? "" : "disabled"}`,
+          title: `slot ${s.id}${s.allocation_id ? " → " + s.allocation_id : " (free)"}`,
+        }))))))));
+  if (!agents.length) view.append(el("p", { class: "muted" }, "no agents connected"));
+}
+
+async function pageJobs() {
+  const { jobs } = await api("GET", "/api/v1/job-queues");
+  view.textContent = "";
+  view.append(el("h1", {}, "Job queue"));
+  view.append(el("table", {},
+    el("tr", {}, ["Allocation", "Experiment", "Pool", "Slots", "Priority",
+                  "State", "Queue pos"].map((h) => el("th", {}, h))),
+    jobs.map((j) => el("tr", {},
+      el("td", { class: "muted" }, j.allocation_id),
+      el("td", {}, j.experiment_id ?? ""),
+      el("td", {}, j.resource_pool),
+      el("td", {}, j.slots),
+      el("td", {}, j.priority),
+      el("td", {}, stateBadge(j.state)),
+      el("td", {}, j.queue_position ?? "")))));
+  if (!jobs.length) view.append(el("p", { class: "muted" }, "queue is empty"));
+}
+
+// --------------------------------------------------------------- router
+
+async function route() {
+  document.getElementById("whoami").textContent =
+    localStorage.getItem("det_user") || "";
+  const hash = location.hash || "#/experiments";
+  document.querySelectorAll("#topbar a").forEach((a) =>
+    a.classList.toggle("active", hash.startsWith(a.getAttribute("href"))));
+  try {
+    const m = hash.match(/^#\/experiments\/(\d+)/);
+    if (m) return await pageExperiment(m[1]);
+    if (hash.startsWith("#/cluster")) return await pageCluster();
+    if (hash.startsWith("#/jobs")) return await pageJobs();
+    return await pageExperiments();
+  } catch (e) {
+    if (e.message !== "unauthenticated") {
+      view.textContent = "";
+      view.append(el("p", { class: "error" }, String(e)));
+    }
+  }
+}
+
+window.addEventListener("hashchange", route);
+if (!token()) renderLogin();
+else route();
