@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""ASHA scheduler throughput: trials/hour through the real master+agent
+(BASELINE.md: "ASHA trials/hour — track & report ... adaptive_asha HP
+search scheduling concurrent trials across pod sub-slices").
+
+Prints ONE JSON line. Measures platform overhead (scheduling, allocation,
+process launch, searcher round-trips, checkpoint/metric reporting) with an
+adaptive_asha search of near-instant trials on a devcluster with artificial
+slots — the master/agent cost per trial, not model compute. Run with
+JAX_PLATFORMS=cpu; BENCH_ASHA_DEBUG=1 prints progress."""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    subprocess.run(["make", "-C", os.path.join(REPO, "native")],
+                   check=True, capture_output=True)
+    # Reuse the e2e harness's devcluster (readiness checks, env
+    # sanitization for the axon sitecustomize, teardown).
+    from tests.test_platform_e2e import Devcluster
+
+    import determined_tpu.cli as cli
+
+    tmp = tempfile.mkdtemp(prefix="bench_asha_")
+    cluster = Devcluster(tmp, os.path.join(REPO, "native", "bin"), slots=8)
+    try:
+        cluster.start_master()
+        cluster.start_agent()
+        token = cluster.login()
+
+        n_trials = 16
+        config = {
+            "name": "bench-asha",
+            "entrypoint": "python3 train.py",
+            "searcher": {
+                "name": "adaptive_asha",
+                "metric": "val_loss",
+                "smaller_is_better": True,
+                "max_length": {"batches": 8},
+                "max_trials": n_trials,
+                "max_rungs": 3,
+                "divisor": 4,
+                "max_concurrent_trials": 8,
+            },
+            "hyperparameters": {
+                "lr": {"type": "log", "minval": -4, "maxval": -1},
+            },
+            "environment": {"TRIAL_STEP_SLEEP": "0.0"},
+            "checkpoint_storage": {"type": "shared_fs",
+                                   "host_path": os.path.join(tmp, "ckpts")},
+            "resources": {"slots_per_trial": 1},
+            "max_restarts": 0,
+        }
+        model_def = cli._tar_context(
+            os.path.join(REPO, "tests", "fixtures", "platform"))
+        t0 = time.time()
+        eid = cluster.api(
+            "POST", "/api/v1/experiments",
+            {"config": config, "model_definition": model_def,
+             "activate": True}, token=token)["id"]
+        deadline = time.time() + 900
+        state = None
+        while time.time() < deadline:
+            e = cluster.api("GET", f"/api/v1/experiments/{eid}",
+                            token=token)["experiment"]
+            state = e["state"]
+            if state in ("COMPLETED", "ERROR", "CANCELED"):
+                break
+            if os.environ.get("BENCH_ASHA_DEBUG"):
+                print(f"  state={state} progress={e.get('progress')}",
+                      file=sys.stderr)
+            time.sleep(1.0)
+        elapsed = time.time() - t0
+        if state != "COMPLETED":
+            raise RuntimeError(f"asha experiment finished {state}")
+        trials = cluster.api("GET", f"/api/v1/experiments/{eid}/trials",
+                             token=token)["trials"]
+        trials_per_hour = len(trials) / elapsed * 3600
+        print(json.dumps({
+            "metric": "asha_trials_per_hour",
+            "value": round(trials_per_hour, 1),
+            "unit": "trials/hour (adaptive_asha, 8 artificial slots)",
+            "vs_baseline": 1.0,  # no reference number exists (BASELINE.md)
+            "detail": {
+                "trials": len(trials),
+                "wall_seconds": round(elapsed, 1),
+                "max_concurrent": 8,
+            },
+        }))
+    finally:
+        cluster.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""ResNet-50 training throughput on one TPU chip (BASELINE.md:
+"samples/sec/chip — track & report ... GPT-2 & ResNet-50").
+
+Prints ONE JSON line like bench.py. ResNet-50, ImageNet shapes
+(224x224x3), bf16 compute, BatchNorm stats carried through a scanned
+multi-step (same dispatch-amortized structure as the production loop).
+vs_baseline is MFU over the 40% target for cross-bench comparability."""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from determined_tpu.models import resnet
+
+    cfg = resnet.Config.resnet50()
+    B, HW = 256, 224
+    STEPS_PER_CALL = 5
+    # ResNet-50 fwd ≈ 4.1 GFLOP/image at 224²; train ≈ 3× fwd.
+    train_flops_per_image = 3 * 4.1e9
+    peak = 197e12  # v5e bf16
+
+    tx = optax.sgd(0.1, momentum=0.9)
+    params, stats = resnet.init(jax.random.PRNGKey(0), cfg)
+    opt_state = tx.init(params)
+
+    def one_step(carry, batch):
+        params, stats, opt_state = carry
+
+        def lfn(p):
+            loss, metrics, new_stats = resnet.loss_fn(
+                p, stats, batch, cfg=cfg, train=True)
+            return loss.astype(jnp.float32), (metrics, new_stats)
+
+        (loss, (metrics, new_stats)), grads = jax.value_and_grad(
+            lfn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, new_stats, opt_state), loss
+
+    @jax.jit
+    def multi_step(params, stats, opt_state, batches):
+        (params, stats, opt_state), losses = jax.lax.scan(
+            one_step, (params, stats, opt_state), batches)
+        return params, stats, opt_state, losses.mean()
+
+    rng = np.random.default_rng(0)
+    # Device-resident batch (transferred once, before timing): this bench
+    # measures the chip's training throughput; input-pipeline cost is a
+    # host/IO concern and would be hidden by double-buffering in the real
+    # loop anyway (and the remote-tunnel PJRT link would otherwise dominate).
+    batches = jax.device_put({
+        "images": rng.normal(size=(STEPS_PER_CALL, B, HW, HW, 3)).astype(
+            jnp.bfloat16),
+        "labels": rng.integers(0, cfg.n_classes,
+                               size=(STEPS_PER_CALL, B)).astype(np.int32),
+    })
+
+    params, stats, opt_state, loss = multi_step(params, stats, opt_state, batches)
+    float(loss)  # compile + sync
+
+    n_calls = 3
+    t0 = time.time()
+    for _ in range(n_calls):
+        params, stats, opt_state, loss = multi_step(
+            params, stats, opt_state, batches)
+    float(loss)
+    dt = (time.time() - t0) / (n_calls * STEPS_PER_CALL)
+
+    samples_per_sec = B / dt
+    mfu = train_flops_per_image * samples_per_sec / peak
+    print(json.dumps({
+        "metric": "resnet50_samples_per_sec_per_chip",
+        "value": round(samples_per_sec, 1),
+        "unit": "samples/sec/chip (224x224)",
+        "vs_baseline": round(mfu / 0.40, 3),
+        "detail": {
+            "step_ms": round(dt * 1000, 1),
+            "mfu": round(mfu, 4),
+            "batch": B,
+            "device": str(jax.devices()[0]),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,15 @@ class Session:
         self.max_retries = max_retries
         self.timeout = timeout
 
+    @classmethod
+    def login(cls, master_url: str, user: str = "determined",
+              password: str = "") -> "Session":
+        s = cls(master_url)
+        resp = s.post("/api/v1/auth/login",
+                      body={"username": user, "password": password})
+        s.token = resp["token"]
+        return s
+
     def _request(
         self,
         method: str,

@@ -1,0 +1,66 @@
+"""Checkpoint GC task (reference harness/determined/exec/gc_checkpoints.py,
+spawned by master/internal/checkpoint_gc.go:76).
+
+Runs as a zero-slot task on an agent: DET_GC_SPEC (JSON env injected by the
+master) names the storage config and the checkpoint uuids outside the
+experiment's retention policy. Files are deleted task-side — this is where
+the storage credentials live — and each deletion is PATCHed into the
+master's checkpoint registry as state DELETED."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+
+logger = logging.getLogger("determined_tpu.exec.gc")
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO, format="gc: %(message)s")
+    spec = json.loads(os.environ.get("DET_GC_SPEC", "{}"))
+    uuids = spec.get("uuids", [])
+    if not uuids:
+        logger.info("nothing to delete")
+        return 0
+
+    from determined_tpu.common.api import Session
+    from determined_tpu.storage import from_config
+
+    storage = from_config(spec.get("checkpoint_storage"))
+    session = None
+    master = os.environ.get("DET_MASTER")
+    token = os.environ.get("DET_SESSION_TOKEN")
+    if master and token:
+        session = Session(master, token)
+
+    deleted, failed = [], []
+    for uuid in uuids:
+        try:
+            storage.delete(uuid)
+            deleted.append(uuid)
+            logger.info("deleted %s", uuid)
+        except Exception:
+            logger.warning("failed to delete %s", uuid, exc_info=True)
+            failed.append(uuid)
+            continue
+        # Report each deletion as it happens: a crash/restart mid-GC must
+        # not leave already-deleted files registered as COMPLETED (the GC
+        # task is one-shot — there is no retry for lost bookkeeping).
+        if session is not None:
+            try:
+                session.patch(
+                    "/api/v1/checkpoints",
+                    body={"checkpoints": [{"uuid": uuid, "state": "DELETED"}]},
+                )
+            except Exception:
+                logger.warning("failed to report deletion of %s", uuid,
+                               exc_info=True)
+                failed.append(uuid)
+    logger.info("done: %d deleted, %d failed", len(deleted), len(failed))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

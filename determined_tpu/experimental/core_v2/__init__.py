@@ -1,0 +1,25 @@
+"""Core API v2 — "det as a library" (unmanaged experiments).
+
+Reference: harness/determined/experimental/core_v2/_core_v2.py (singleton
+init/train.report_metrics) + _unmanaged.py (creates unmanaged experiments
+via the API). The training process runs ANYWHERE (laptop, bare TPU-VM, a
+different scheduler); the master only tracks it: experiment + trial rows,
+metrics, checkpoints. No scheduling, no entrypoint, no agent involved.
+
+    from determined_tpu.experimental import core_v2
+
+    core_v2.init(config={"name": "my-run"}, master="http://master:8080")
+    for step in range(100):
+        ...
+        core_v2.train.report_training_metrics(step, {"loss": loss})
+    core_v2.close()
+"""
+
+from determined_tpu.experimental.core_v2._core_v2 import (  # noqa: F401
+    Context,
+    close,
+    checkpoint,
+    init,
+    searcher,
+    train,
+)

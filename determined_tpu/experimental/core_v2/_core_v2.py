@@ -1,0 +1,122 @@
+"""Core API v2 implementation (reference experimental/core_v2/_core_v2.py:
+module-level singleton + unmanaged experiment creation _unmanaged.py)."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from determined_tpu.common.api import Session
+from determined_tpu.core._checkpoint import CheckpointContext
+from determined_tpu.core._distributed import DistributedContext
+from determined_tpu.core._searcher import SearcherContext
+from determined_tpu.core._train import TrainContext
+from determined_tpu.storage import from_config as storage_from_config
+
+
+class Context:
+    """An unmanaged run bound to a master-tracked experiment + trial."""
+
+    def __init__(
+        self,
+        session: Session,
+        experiment_id: int,
+        trial_id: int,
+        storage,
+        distributed: Optional[DistributedContext] = None,
+        max_length: Optional[int] = None,
+    ):
+        self.experiment_id = experiment_id
+        self.trial_id = trial_id
+        self._session = session
+        dist = distributed or DistributedContext.local()
+        self.distributed = dist
+        self.train = TrainContext(session, trial_id=trial_id, distributed=dist)
+        # Unmanaged runs own their training loop — the searcher context is
+        # local (one op of max_length), like reference unmanaged mode.
+        self.searcher = SearcherContext(
+            None, trial_id=trial_id, distributed=dist,
+            local_max_length=max_length,
+        )
+        self.checkpoint = CheckpointContext(
+            session, storage, trial_id=trial_id, distributed=dist,
+        )
+
+    def close(self, state: str = "COMPLETED") -> None:
+        self.checkpoint.close()
+        try:
+            self._session.post(
+                f"/api/v1/experiments/{self.experiment_id}/complete",
+                body={"state": state},
+            )
+        except Exception:
+            pass
+
+
+_ctx: Optional[Context] = None
+
+
+def init(
+    *,
+    config: Optional[Dict[str, Any]] = None,
+    master: Optional[str] = None,
+    user: str = "determined",
+    password: str = "",
+    hparams: Optional[Dict[str, Any]] = None,
+    checkpoint_storage: Optional[Dict[str, Any]] = None,
+    max_length: Optional[int] = None,
+    distributed: Optional[DistributedContext] = None,
+) -> Context:
+    """Register an unmanaged experiment + trial with the master and bind the
+    module-level train/checkpoint/searcher handles to it."""
+    global _ctx
+    config = dict(config or {})
+    config.setdefault("name", "unmanaged-run")
+    config.setdefault(
+        "searcher",
+        {"name": "single", "metric": config.get("metric", "loss"),
+         "max_length": {"batches": max_length or 0}},
+    )
+    if hparams:
+        config.setdefault("hyperparameters", hparams)
+    master = master or os.environ.get("DET_MASTER", "http://127.0.0.1:8080")
+    session = Session.login(master, user, password)
+    exp = session.post(
+        "/api/v1/experiments", body={"config": config, "unmanaged": True}
+    )
+    eid = exp["id"]
+    trial = session.post(
+        f"/api/v1/experiments/{eid}/trials", body={"hparams": hparams or {}}
+    )
+    storage = storage_from_config(
+        checkpoint_storage or config.get("checkpoint_storage"))
+    _ctx = Context(
+        session, eid, trial["id"], storage,
+        distributed=distributed, max_length=max_length,
+    )
+    return _ctx
+
+
+def close(state: str = "COMPLETED") -> None:
+    global _ctx
+    if _ctx is not None:
+        _ctx.close(state)
+        _ctx = None
+
+
+class _Proxy:
+    """Module-level handles resolving to the active context (reference
+    core_v2 module globals train/checkpoint/searcher)."""
+
+    def __init__(self, attr: str):
+        self._attr = attr
+
+    def __getattr__(self, name: str) -> Any:
+        if _ctx is None:
+            raise RuntimeError("core_v2.init() has not been called")
+        return getattr(getattr(_ctx, self._attr), name)
+
+
+train = _Proxy("train")
+checkpoint = _Proxy("checkpoint")
+searcher = _Proxy("searcher")

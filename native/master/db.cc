@@ -336,6 +336,9 @@ CREATE TABLE searcher_events (
   processed INTEGER NOT NULL DEFAULT 0
 );
 )sql"},
+      {9, R"sql(
+CREATE INDEX idx_task_logs_time ON task_logs(timestamp);
+)sql"},
   };
   return kMigrations;
 }

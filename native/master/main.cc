@@ -3,6 +3,8 @@
 // Config precedence flags > env (DET_MASTER_*) > JSON config file, the same
 // viper-style layering as the reference (cmd/determined-master/init.go:13).
 
+#include <unistd.h>
+
 #include <csignal>
 #include <cstdlib>
 #include <cstring>
@@ -60,6 +62,8 @@ int main(int argc, char** argv) {
     else if (a == "--cluster-name") cfg.cluster_name = next();
     else if (a == "--agent-timeout") cfg.agent_timeout_s = atof(next().c_str());
     else if (a == "--webui-dir") cfg.webui_dir = next();
+    else if (a == "--log-retention-days")
+      cfg.log_retention_days = atoi(next().c_str());
     else if (a == "--config") next();
     else if (a == "--help" || a == "-h") {
       std::cout << "determined-master [--port N] [--host H] [--db PATH] "
@@ -69,8 +73,12 @@ int main(int argc, char** argv) {
   }
 
   // Default WebUI dir: <exe dir>/../../webui (bin/ lives in native/).
+  // /proc/self/exe, not argv[0] — a PATH-resolved launch would otherwise
+  // anchor the default to the cwd.
   if (cfg.webui_dir.empty()) {
-    std::string exe = argv[0];
+    char exe_buf[4096];
+    ssize_t n = readlink("/proc/self/exe", exe_buf, sizeof(exe_buf) - 1);
+    std::string exe = n > 0 ? std::string(exe_buf, n) : std::string(argv[0]);
     auto slash = exe.rfind('/');
     std::string dir = slash == std::string::npos ? "." : exe.substr(0, slash);
     cfg.webui_dir = dir + "/../../webui";

@@ -83,6 +83,9 @@ MasterConfig MasterConfig::from_json(const Json& j) {
     c.agent_timeout_s = j["agent_timeout_s"].as_double();
   }
   if (j["webui_dir"].is_string()) c.webui_dir = j["webui_dir"].as_string();
+  if (j["log_retention_days"].is_number()) {
+    c.log_retention_days = static_cast<int>(j["log_retention_days"].as_int());
+  }
   for (const auto& [pool, policy] : j["resource_pools"].as_object()) {
     c.pool_policies[pool] = policy["scheduler"].as_string("priority");
   }
@@ -196,6 +199,16 @@ HttpResponse Master::route(const HttpRequest& req) {
     // DET_SESSION_TOKEN / agent login).
     if (auth_user(req) < 0) {
       return json_resp(401, err_body("unauthenticated"));
+    }
+    if (root == "master" && rest.size() == 2 && rest[1] == "cleanup_logs" &&
+        req.method == "POST") {
+      // Manual log-retention sweep (reference internal/logretention/).
+      Json body = req.body.empty() ? Json::object() : Json::parse(req.body);
+      int days = static_cast<int>(body["days"].as_int(cfg_.log_retention_days));
+      if (days <= 0) return json_resp(400, err_body("days must be > 0"));
+      Json out = Json::object();
+      out["deleted"] = sweep_task_logs(days);
+      return json_resp(200, out);
     }
     if (root == "users" || root == "me") return handle_users(req);
     if (root == "agents") return handle_agents_api(req, rest);

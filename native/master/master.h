@@ -53,6 +53,9 @@ struct MasterConfig {
   // Directory with the static WebUI (index.html, app.js, style.css);
   // resolved at startup (flag --webui-dir > env > <exe>/../../webui).
   std::string webui_dir;
+  // Task-log retention sweep (reference internal/logretention/):
+  // logs older than this many days are deleted hourly; <= 0 keeps forever.
+  int log_retention_days = 0;
 
   static MasterConfig from_json(const Json& j);
 };
@@ -192,6 +195,7 @@ class Master {
   HttpResponse handle_job_queue(const HttpRequest& req);
   HttpResponse handle_prometheus_metrics();
   HttpResponse serve_webui(const std::string& path);
+  int64_t sweep_task_logs(int days);  // returns rows deleted
 
   // --- experiment/trial/searcher machinery (mu_ held) ---
   int64_t create_experiment_locked(const Json& config,
@@ -208,6 +212,7 @@ class Master {
   void set_experiment_state_locked(ExperimentState& exp,
                                    const std::string& state);
   void snapshot_experiment_locked(ExperimentState& exp);
+  void launch_checkpoint_gc_locked(ExperimentState& exp);
   void restore_experiments();  // on boot
   void preempt_allocation_locked(Allocation& alloc, const std::string& why);
   void kill_allocation_locked(Allocation& alloc);

@@ -9,6 +9,7 @@
 
 #include <algorithm>
 #include <chrono>
+#include <iostream>
 #include <thread>
 
 #include "master.h"
@@ -202,12 +203,41 @@ HttpResponse Master::handle_agents_api(const HttpRequest& req,
 // ---------------------------------------------------------------------------
 
 void Master::scheduler_loop() {
+  double last_log_sweep = now();
   while (true) {
     std::unique_lock<std::mutex> lock(mu_);
     cv_.wait_for(lock, std::chrono::milliseconds(200));
     if (!running_) return;
     check_agents_locked();
     schedule_locked();
+    // Hourly task-log retention sweep (reference internal/logretention/).
+    // Runs with mu_ RELEASED — a big DELETE must not stall the scheduler
+    // or API handlers (the db has its own lock).
+    if (cfg_.log_retention_days > 0 && now() - last_log_sweep > 3600) {
+      last_log_sweep = now();
+      lock.unlock();
+      int64_t n = sweep_task_logs(cfg_.log_retention_days);
+      if (n > 0) {
+        std::cerr << "master: log retention deleted " << n << " rows"
+                  << std::endl;
+      }
+      lock.lock();
+    }
+  }
+}
+
+int64_t Master::sweep_task_logs(int days) {
+  // Bounded batches: the db mutex is shared with every API handler, so one
+  // giant DELETE would stall log shipping/metrics for its whole duration.
+  const std::string cutoff = "-" + std::to_string(days) + " days";
+  int64_t total = 0;
+  while (true) {
+    int64_t n = db_.exec(
+        "DELETE FROM task_logs WHERE id IN (SELECT id FROM task_logs "
+        "WHERE timestamp < datetime('now', ?) LIMIT 10000)",
+        {Json(cutoff)});
+    total += n;
+    if (n < 10000) return total;
   }
 }
 

@@ -49,11 +49,33 @@ Json row_to_json(const Row& row) { return Json(JsonObject(row.begin(), row.end()
 HttpResponse Master::handle_experiments(const HttpRequest& req,
                                         const std::vector<std::string>& parts) {
   // POST /api/v1/experiments — CreateExperiment (api_experiment.go:1627).
+  // With {unmanaged: true}: "det as a library" (reference Core API v2,
+  // experimental/core_v2/_unmanaged.py) — the experiment is registered for
+  // tracking only; the caller runs training anywhere and reports in. No
+  // scheduling, no entrypoint required.
   if (parts.size() == 1 && req.method == "POST") {
     Json body = Json::parse(req.body);
     std::lock_guard<std::mutex> lock(mu_);
     int64_t uid = auth_user(req);
     if (uid < 0) return json_resp(401, err_body("unauthenticated"));
+    if (body["unmanaged"].as_bool(false)) {
+      const Json& config = body["config"];
+      std::string job_id = "job-unmanaged-" + random_hex(6);
+      db_.exec("INSERT INTO jobs (id, type) VALUES (?, 'EXPERIMENT')",
+               {Json(job_id)});
+      db_.exec(
+          "INSERT INTO experiments (state, config, original_config, "
+          "model_def, owner_id, project_id, job_id, unmanaged) "
+          "VALUES ('ACTIVE', ?, ?, '', ?, ?, ?, 1)",
+          {Json(config.dump()), Json(config.dump()), Json(uid),
+           Json(body["project_id"].as_int(1)), Json(job_id)});
+      int64_t eid = db_.last_insert_id();
+      Json out = Json::object();
+      out["experiment"] = Json(JsonObject{
+          {"id", Json(eid)}, {"state", Json(std::string("ACTIVE"))}});
+      out["id"] = eid;
+      return json_resp(200, out);
+    }
     int64_t eid = create_experiment_locked(
         body["config"], body["model_definition"].as_string(), uid,
         body["project_id"].as_int(1), body["activate"].as_bool(true));
@@ -159,6 +181,60 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
     Json out = Json::object();
     out["trials"] = trials;
     return json_resp(200, out);
+  }
+
+  // POST /api/v1/experiments/{id}/trials {hparams?} — unmanaged trials
+  // (reference unmanaged path: trials created by the library caller, not
+  // the searcher).
+  if (parts.size() == 3 && parts[2] == "trials" && req.method == "POST") {
+    auto erows = db_.query("SELECT unmanaged FROM experiments WHERE id=?",
+                           {Json(eid)});
+    if (erows.empty()) return json_resp(404, err_body("no such experiment"));
+    if (erows[0]["unmanaged"].as_int(0) == 0) {
+      return json_resp(400,
+                       err_body("trials of managed experiments are created "
+                                "by the searcher"));
+    }
+    Json body = req.body.empty() ? Json::object() : Json::parse(req.body);
+    int64_t seed = body["seed"].as_int(static_cast<int64_t>(now()));
+    db_.exec(
+        "INSERT INTO trials (experiment_id, request_id, state, hparams, "
+        "seed) VALUES (?, ?, 'RUNNING', ?, ?)",
+        {Json(eid), Json("unmanaged-" + random_hex(4)),
+         Json(body["hparams"].dump()), Json(seed)});
+    Json out = Json::object();
+    out["id"] = db_.last_insert_id();
+    out["seed"] = seed;
+    return json_resp(200, out);
+  }
+
+  // POST /api/v1/experiments/{id}/complete {state?} — unmanaged close-out.
+  if (parts.size() == 3 && parts[2] == "complete" && req.method == "POST") {
+    auto erows = db_.query(
+        "SELECT unmanaged, state FROM experiments WHERE id=?", {Json(eid)});
+    if (erows.empty()) return json_resp(404, err_body("no such experiment"));
+    if (erows[0]["unmanaged"].as_int(0) == 0) {
+      return json_resp(400, err_body("managed experiments complete via "
+                                     "their searcher"));
+    }
+    if (is_terminal(erows[0]["state"].as_string())) {
+      return json_resp(400, err_body("experiment already terminal"));
+    }
+    Json body = req.body.empty() ? Json::object() : Json::parse(req.body);
+    std::string state = body["state"].as_string("COMPLETED");
+    if (state != "COMPLETED" && state != "CANCELED" && state != "ERROR") {
+      return json_resp(400,
+                       err_body("state must be COMPLETED|CANCELED|ERROR"));
+    }
+    db_.exec(
+        "UPDATE experiments SET state=?, progress=1.0, "
+        "end_time=datetime('now') WHERE id=?",
+        {Json(state), Json(eid)});
+    db_.exec(
+        "UPDATE trials SET state=?, end_time=datetime('now') "
+        "WHERE experiment_id=? AND state='RUNNING'",
+        {Json(state), Json(eid)});
+    return json_resp(200, Json::object());
   }
 
   // GET /api/v1/experiments/{id}/checkpoints

@@ -280,6 +280,59 @@ HttpResponse Master::handle_webhooks(const HttpRequest& req,
 // rm/tasklist/): queued/scheduled jobs per pool with queue positions.
 HttpResponse Master::handle_job_queue(const HttpRequest& req) {
   std::lock_guard<std::mutex> lock(mu_);
+  // POST /api/v1/job-queues/reorder {allocation_id, ahead_of|behind}
+  // (reference job queue UpdateJobQueue ahead-of/behind ops): reposition a
+  // QUEUED allocation relative to another by adopting the target's
+  // priority and nudging submit time — the scheduler's (priority,
+  // submitted_at) order then places it deterministically.
+  if (req.method == "POST" && req.path.find("/reorder") != std::string::npos) {
+    Json body = Json::parse(req.body);
+    auto it = allocations_.find(body["allocation_id"].as_string());
+    if (it == allocations_.end() || it->second.state != "PENDING") {
+      return json_resp(404, err_body("no such queued allocation"));
+    }
+    bool ahead = body["ahead_of"].is_string();
+    const std::string target_id =
+        ahead ? body["ahead_of"].as_string() : body["behind"].as_string();
+    auto tgt = allocations_.find(target_id);
+    if (tgt == allocations_.end() || tgt->second.state != "PENDING") {
+      return json_resp(404, err_body("no such queued target"));
+    }
+    if (it->second.resource_pool != tgt->second.resource_pool) {
+      return json_resp(400, err_body("cross-pool reorder not allowed"));
+    }
+    it->second.priority = tgt->second.priority;
+    it->second.submitted_at =
+        tgt->second.submitted_at + (ahead ? -0.001 : 0.001);
+    // Persist onto the owning experiment so the position survives
+    // re-allocation (rung promotions, restarts) — the trial's next
+    // allocation takes exp.priority.
+    if (it->second.experiment_id > 0) {
+      ExperimentState* exp = find_experiment_locked(it->second.experiment_id);
+      if (exp != nullptr) exp->priority = it->second.priority;
+    }
+    // Re-sort pending_ NOW with the scheduler's queue order so the new
+    // position is observable immediately (GET right after the POST), not
+    // only after the next scheduler tick.
+    std::stable_sort(
+        pending_.begin(), pending_.end(),
+        [&](const std::string& x, const std::string& y) {
+          auto ix = allocations_.find(x);
+          auto iy = allocations_.find(y);
+          if (ix == allocations_.end() || iy == allocations_.end()) {
+            return false;
+          }
+          const Allocation& ax = ix->second;
+          const Allocation& ay = iy->second;
+          if (ax.resource_pool != ay.resource_pool) {
+            return ax.resource_pool < ay.resource_pool;
+          }
+          if (ax.priority != ay.priority) return ax.priority < ay.priority;
+          return ax.submitted_at < ay.submitted_at;
+        });
+    cv_.notify_all();
+    return json_resp(200, Json::object());
+  }
   Json jobs = Json::array();
   int64_t pos = 0;
   for (const auto& aid : pending_) {

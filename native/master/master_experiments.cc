@@ -10,6 +10,7 @@
 
 #include <algorithm>
 #include <cmath>
+#include <iostream>
 
 #include "master.h"
 
@@ -141,8 +142,137 @@ void Master::set_experiment_state_locked(ExperimentState& exp,
                           "end_time=datetime('now') WHERE id=?"
                         : "UPDATE experiments SET state=? WHERE id=?";
   db_.exec(sql, {Json(state), Json(exp.id)});
-  if (is_terminal(state)) fire_webhooks_locked(exp);
+  if (is_terminal(state)) {
+    fire_webhooks_locked(exp);
+    launch_checkpoint_gc_locked(exp);
+  }
   cv_.notify_all();
+}
+
+// Checkpoint GC (reference checkpoint_gc.go:76 + exec/gc_checkpoints.py):
+// on experiment termination, compute the checkpoints falling outside the
+// retention policy (checkpoint_storage.save_experiment_best /
+// save_trial_best / save_trial_latest) and spawn a zero-slot GC task that
+// deletes the files and PATCHes the registry — deletion runs task-side
+// because that is where the storage credentials live.
+void Master::launch_checkpoint_gc_locked(ExperimentState& exp) {
+  const Json& storage = exp.config["checkpoint_storage"];
+  if (!storage.is_object()) return;
+  int64_t keep_exp_best = storage["save_experiment_best"].as_int(0);
+  int64_t keep_trial_best = storage["save_trial_best"].as_int(1);
+  int64_t keep_trial_latest = storage["save_trial_latest"].as_int(1);
+  if (keep_exp_best < 0 || keep_trial_best < 0 || keep_trial_latest < 0) {
+    return;  // negative = keep everything
+  }
+  std::string metric_name = exp.config["searcher"]["metric"].as_string("");
+  bool smaller = exp.config["searcher"]["smaller_is_better"].as_bool(true);
+
+  struct Ck {
+    std::string uuid;
+    int64_t trial_id = 0;
+    int64_t steps = 0;
+    double metric = 0;
+    bool has_metric = false;
+  };
+  std::vector<Ck> cks;
+  // Single pass (no N+1 under mu_): each checkpoint joined to its latest
+  // validation row at the same step.
+  auto rows = db_.query(
+      "SELECT c.uuid, c.trial_id, c.steps_completed, "
+      "(SELECT m.metrics FROM raw_metrics m WHERE m.trial_id=c.trial_id "
+      " AND m.group_name='validation' AND m.total_batches=c.steps_completed "
+      " ORDER BY m.id DESC LIMIT 1) AS vmetrics "
+      "FROM checkpoints c JOIN trials t ON c.trial_id = t.id "
+      "WHERE t.experiment_id=? AND c.state='COMPLETED'",
+      {Json(exp.id)});
+  for (auto& row : rows) {
+    Ck ck;
+    ck.uuid = row["uuid"].as_string();
+    ck.trial_id = row["trial_id"].as_int();
+    ck.steps = row["steps_completed"].as_int();
+    if (row["vmetrics"].is_string() && !metric_name.empty()) {
+      Json m = Json::parse_or_null(row["vmetrics"].as_string());
+      if (m[metric_name].is_number()) {
+        double v = m[metric_name].as_double();
+        ck.metric = smaller ? v : -v;  // normalize: smaller is better
+        ck.has_metric = true;
+      }
+    }
+    cks.push_back(std::move(ck));
+  }
+  if (cks.empty()) return;
+
+  std::set<std::string> keep;
+  std::map<int64_t, std::vector<const Ck*>> by_trial;
+  for (const auto& ck : cks) by_trial[ck.trial_id].push_back(&ck);
+  for (auto& [tid, list] : by_trial) {
+    // latest k by steps
+    std::sort(list.begin(), list.end(),
+              [](const Ck* a, const Ck* b) { return a->steps > b->steps; });
+    for (int64_t i = 0; i < keep_trial_latest &&
+                        i < static_cast<int64_t>(list.size()); ++i) {
+      keep.insert(list[i]->uuid);
+    }
+    // best k by metric
+    std::sort(list.begin(), list.end(), [](const Ck* a, const Ck* b) {
+      if (a->has_metric != b->has_metric) return a->has_metric;
+      return a->metric < b->metric;
+    });
+    for (int64_t i = 0; i < keep_trial_best &&
+                        i < static_cast<int64_t>(list.size()); ++i) {
+      if (list[i]->has_metric) keep.insert(list[i]->uuid);
+    }
+  }
+  {
+    // experiment best k across all trials
+    std::vector<const Ck*> all;
+    for (const auto& ck : cks) {
+      if (ck.has_metric) all.push_back(&ck);
+    }
+    std::sort(all.begin(), all.end(),
+              [](const Ck* a, const Ck* b) { return a->metric < b->metric; });
+    for (int64_t i = 0; i < keep_exp_best &&
+                        i < static_cast<int64_t>(all.size()); ++i) {
+      keep.insert(all[i]->uuid);
+    }
+  }
+  Json doomed = Json::array();
+  for (const auto& ck : cks) {
+    if (!keep.count(ck.uuid)) doomed.push_back(Json(ck.uuid));
+  }
+  if (doomed.as_array().empty()) return;
+
+  std::string task_id = "gc-exp" + std::to_string(exp.id) + "-" +
+                        random_hex(4);
+  db_.exec(
+      "INSERT INTO tasks (id, type, state, config, owner_id) "
+      "VALUES (?, 'GC', 'ACTIVE', ?, 1)",
+      {Json(task_id), Json(storage.dump())});
+  Allocation alloc;
+  alloc.id = "alloc-" + task_id;
+  alloc.task_id = task_id;
+  alloc.resource_pool = exp.resource_pool.empty() ? cfg_.default_pool
+                                                  : exp.resource_pool;
+  alloc.slots = 0;  // zero-slot aux task
+  alloc.priority = 99;  // GC never preempts real work
+  alloc.submitted_at = now();
+  alloc.extra_env["DET_ENTRYPOINT"] =
+      Json("python3 -m determined_tpu.exec.gc_checkpoints");
+  alloc.extra_env["DET_TASK_TYPE"] = Json("GC");
+  Json spec = Json::object();
+  spec["checkpoint_storage"] = storage;
+  spec["uuids"] = doomed;
+  alloc.extra_env["DET_GC_SPEC"] = Json(spec.dump());
+  db_.exec(
+      "INSERT INTO allocations (id, task_id, resource_pool, slots) "
+      "VALUES (?, ?, ?, 0)",
+      {Json(alloc.id), Json(task_id), Json(alloc.resource_pool)});
+  std::string aid = alloc.id;
+  allocations_[aid] = std::move(alloc);
+  pending_.push_back(aid);
+  std::cerr << "master: checkpoint GC " << task_id << " for experiment "
+            << exp.id << ": " << doomed.as_array().size()
+            << " checkpoint(s) outside retention" << std::endl;
 }
 
 void Master::process_ops_locked(ExperimentState& exp,
@@ -418,8 +548,8 @@ void Master::restore_experiments() {
   auto rows = db_.query(
       "SELECT e.id, e.state, e.config, s.content FROM experiments e "
       "LEFT JOIN experiment_snapshots s ON s.experiment_id = e.id "
-      "WHERE e.state IN ('ACTIVE','PAUSED','STOPPING_CANCELED',"
-      "'STOPPING_KILLED','STOPPING_COMPLETED')");
+      "WHERE e.unmanaged=0 AND e.state IN ('ACTIVE','PAUSED',"
+      "'STOPPING_CANCELED','STOPPING_KILLED','STOPPING_COMPLETED')");
   for (auto& row : rows) {
     int64_t eid = row["id"].as_int();
     Json config = Json::parse_or_null(row["config"].as_string());

@@ -1,0 +1,34 @@
+"""Fixture trial for the checkpoint-GC e2e: checkpoints + validates every
+2 steps with a non-monotonic metric (best at mid-training), so the
+retention policy has distinct best/latest/doomed checkpoints to act on."""
+
+import json
+import os
+import sys
+
+from determined_tpu import core
+
+
+def main() -> int:
+    with core.init(async_checkpointing=False) as ctx:
+        steps = 0
+        for op in ctx.searcher.operations():
+            while steps < op.length:
+                steps += 1
+                if steps % 2 == 0:
+                    # best at steps==4: val = (steps-4)^2
+                    val = float((steps - 4) ** 2)
+                    ctx.train.report_validation_metrics(
+                        steps, {"val_loss": val})
+                    with ctx.checkpoint.store_path(
+                        {"steps_completed": steps}
+                    ) as (path, _sid):
+                        with open(os.path.join(path, "state.json"), "w") as f:
+                            json.dump({"steps": steps}, f)
+            op.report_completed(0.0)
+        print(f"gc fixture trained {steps} steps")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -129,12 +129,22 @@ function lineChart(title, series, xLabel) {
   svg.append(xl);
 
   series.forEach((s, i) => {
-    const path = document.createElementNS(NS, "path");
-    path.setAttribute("class", "series-line");
-    path.setAttribute("stroke", seriesColor(i));
-    path.setAttribute("d", s.points.map((p, j) =>
-      `${j ? "L" : "M"}${sx(p.x).toFixed(1)},${sy(p.y).toFixed(1)}`).join(""));
-    svg.append(path);
+    if (s.points.length === 1) {
+      // a lone M command paints nothing — draw a marker instead
+      const dot = document.createElementNS(NS, "circle");
+      dot.setAttribute("cx", sx(s.points[0].x));
+      dot.setAttribute("cy", sy(s.points[0].y));
+      dot.setAttribute("r", 4);
+      dot.setAttribute("fill", seriesColor(i));
+      svg.append(dot);
+    } else {
+      const path = document.createElementNS(NS, "path");
+      path.setAttribute("class", "series-line");
+      path.setAttribute("stroke", seriesColor(i));
+      path.setAttribute("d", s.points.map((p, j) =>
+        `${j ? "L" : "M"}${sx(p.x).toFixed(1)},${sy(p.y).toFixed(1)}`).join(""));
+      svg.append(path);
+    }
     // direct label at line end (text wears text tokens, swatch carries hue)
     const last = s.points[s.points.length - 1];
     const lbl = document.createElementNS(NS, "text");
@@ -331,9 +341,15 @@ async function pageExperiment(id) {
     }
     if (lossSeries.length) view.append(lineChart("loss", lossSeries));
     // remaining numeric series, one small chart each (single series → no
-    // legend; the title names it)
-    for (const [name, points] of Object.entries(groups).slice(0, 6)) {
+    // legend; the title names it); cap at 6 charts and SAY so
+    const entries = Object.entries(groups);
+    for (const [name, points] of entries.slice(0, 6)) {
       view.append(lineChart(name.replace(":", " "), [{ name, points }]));
+    }
+    if (entries.length > 6) {
+      view.append(el("p", { class: "muted" },
+        `+${entries.length - 6} more metric series: ` +
+        entries.slice(6).map(([n]) => n.replace(":", " ")).join(", ")));
     }
   }
 
