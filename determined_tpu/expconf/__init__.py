@@ -214,8 +214,47 @@ def validate(config: Dict[str, Any]) -> List[str]:
         errors.append("max_restarts must be a non-negative int")
 
     _validate_environment(config.get("environment"), errors)
+    _validate_log_policies(config.get("log_policies"), errors)
 
     return errors
+
+
+def _validate_log_policies(policies: Any, errors: List[str]) -> None:
+    """`log_policies:` — regex actions on task logs (reference
+    logpattern.go + schemas/expconf/v0/log-policy.json):
+    [{pattern: regex, action: {type: cancel_retries|exclude_node}}]."""
+    if policies is None:
+        return
+    if not isinstance(policies, list):
+        errors.append("log_policies must be a list")
+        return
+    import re as _re
+
+    for i, p in enumerate(policies):
+        if not isinstance(p, dict) or not isinstance(p.get("pattern"), str):
+            errors.append(f"log_policies[{i}]: requires a `pattern` string")
+            continue
+        try:
+            _re.compile(p["pattern"])
+        except _re.error as e:
+            errors.append(f"log_policies[{i}].pattern: invalid regex: {e}")
+        else:
+            # The master matches with ECMAScript std::regex: python-only
+            # constructs (named groups, inline flags) would be silently
+            # inert there — reject them at submit time. (?: (?= (?! are
+            # fine in both dialects.
+            if _re.search(r"\(\?(?![:=!])", p["pattern"]):
+                errors.append(
+                    f"log_policies[{i}].pattern: named groups / inline "
+                    "flags are not supported by the master's regex engine"
+                )
+        action = p.get("action")
+        atype = action.get("type") if isinstance(action, dict) else action
+        if atype not in ("cancel_retries", "exclude_node"):
+            errors.append(
+                f"log_policies[{i}].action.type must be cancel_retries or "
+                "exclude_node"
+            )
 
 
 def _validate_environment(envcfg: Any, errors: List[str]) -> None:

@@ -339,6 +339,9 @@ CREATE TABLE searcher_events (
       {9, R"sql(
 CREATE INDEX idx_task_logs_time ON task_logs(timestamp);
 )sql"},
+      {10, R"sql(
+ALTER TABLE tasks ADD COLUMN parent_id TEXT;
+)sql"},
   };
   return kMigrations;
 }

@@ -219,9 +219,10 @@ HttpResponse Master::route(const HttpRequest& req) {
     if (root == "task") return handle_task_logs(req);
     if (root == "tasks") return handle_tasks(req, rest);
     if (root == "commands" || root == "notebooks" || root == "shells" ||
-        root == "tensorboards") {
+        root == "tensorboards" || root == "generic-tasks") {
       return handle_ntsc(req, root, rest);
     }
+    if (root == "runs") return handle_runs(req, rest);
     if (root == "workspaces") return handle_workspaces(req, rest);
     if (root == "projects") return handle_projects(req, rest);
     if (root == "models") return handle_models(req, rest);

@@ -25,6 +25,7 @@
 #include <map>
 #include <memory>
 #include <mutex>
+#include <regex>
 #include <set>
 #include <string>
 #include <vector>
@@ -113,6 +114,8 @@ struct Allocation {
   JsonObject extra_env;
   double idle_timeout_s = 0;
   double last_activity = 0;
+  // Hosts this allocation must avoid (exclude_node log policies).
+  std::set<std::string> excluded_agents;
 };
 
 struct TrialState {
@@ -130,6 +133,19 @@ struct TrialState {
   int64_t steps_completed = 0;
   std::string latest_checkpoint;
   std::string allocation_id;  // current, "" when none
+  // Log-pattern policy outcomes (reference logpattern/logpattern.go:232):
+  bool cancel_retries = false;          // matched a cancel_retries policy
+  std::set<std::string> excluded_agents;  // matched exclude_node policies
+};
+
+// Compiled expconf log_policies entry (reference logpattern.go +
+// schemas/expconf/v0/log-policy.json): regex over shipped task-log lines;
+// action "cancel_retries" (fail the trial for good) or "exclude_node"
+// (restart lands on a different host).
+struct LogPolicy {
+  std::string pattern;
+  std::string action;
+  std::regex re;
 };
 
 struct ExperimentState {
@@ -144,6 +160,7 @@ struct ExperimentState {
   std::string resource_pool;
   int64_t max_restarts = 5;
   bool searcher_shutdown = false;
+  std::vector<LogPolicy> log_policies;
 };
 
 class Master {
@@ -193,6 +210,9 @@ class Master {
   HttpResponse handle_webhooks(const HttpRequest& req,
                                const std::vector<std::string>& parts);
   HttpResponse handle_job_queue(const HttpRequest& req);
+  HttpResponse handle_runs(const HttpRequest& req,
+                           const std::vector<std::string>& parts);
+  void kill_task_tree_locked(const std::string& task_id);
   HttpResponse handle_prometheus_metrics();
   HttpResponse serve_webui(const std::string& path);
   int64_t sweep_task_logs(int days);  // returns rows deleted

@@ -378,6 +378,7 @@ bool Master::try_fit_locked(Allocation& alloc) {
   std::vector<HostFreeView> views;
   for (auto& [id, a] : agents_) {
     if (!a.alive || a.resource_pool != alloc.resource_pool) continue;
+    if (alloc.excluded_agents.count(id)) continue;  // exclude_node policy
     HostFreeView v;
     v.id = a.id;
     v.total_slots = static_cast<int>(a.slots.size());

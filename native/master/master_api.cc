@@ -8,6 +8,7 @@
 
 #include <algorithm>
 #include <chrono>
+#include <iostream>
 
 #include "master.h"
 
@@ -843,11 +844,44 @@ HttpResponse Master::handle_task_logs(const HttpRequest& req) {
       }
     });
     {
-      // Log traffic counts as activity for idle-watching (task/idle/).
+      // Log traffic counts as activity for idle-watching (task/idle/),
+      // and runs through the experiment's log-pattern policies
+      // (reference logpattern/logpattern.go:232).
       std::lock_guard<std::mutex> lock(mu_);
       for (const auto& entry : logs) {
         auto it = allocations_.find(entry["allocation_id"].as_string());
-        if (it != allocations_.end()) it->second.last_activity = now();
+        if (it == allocations_.end()) continue;
+        Allocation& alloc = it->second;
+        alloc.last_activity = now();
+        if (alloc.trial_id < 0) continue;
+        ExperimentState* exp = find_experiment_locked(alloc.experiment_id);
+        if (exp == nullptr || exp->log_policies.empty()) continue;
+        TrialState* trial = nullptr;
+        for (auto& [rid, t] : exp->trials) {
+          if (t.id == alloc.trial_id) {
+            trial = &t;
+            break;
+          }
+        }
+        if (trial == nullptr) continue;
+        const std::string& line = entry["log"].as_string();
+        for (const auto& policy : exp->log_policies) {
+          if (!std::regex_search(line, policy.re)) continue;
+          if (policy.action == "cancel_retries" && !trial->cancel_retries) {
+            trial->cancel_retries = true;
+            std::cerr << "master: log policy /" << policy.pattern
+                      << "/ matched trial " << trial->id
+                      << ": retries canceled" << std::endl;
+          } else if (policy.action == "exclude_node") {
+            const std::string agent = entry["agent_id"].as_string();
+            if (!agent.empty() &&
+                trial->excluded_agents.insert(agent).second) {
+              std::cerr << "master: log policy /" << policy.pattern
+                        << "/ matched trial " << trial->id
+                        << ": excluding node " << agent << std::endl;
+            }
+          }
+        }
       }
     }
     cv_.notify_all();
@@ -884,8 +918,8 @@ HttpResponse Master::handle_tasks(const HttpRequest& req,
     double timeout = std::stod(req.query_param("timeout_seconds", "30"));
     auto fetch = [&] {
       return db_.query(
-          "SELECT id, rank_id, level, stdtype, log, timestamp FROM task_logs "
-          "WHERE task_id=? AND id>? ORDER BY id LIMIT 1000",
+          "SELECT id, agent_id, rank_id, level, stdtype, log, timestamp "
+          "FROM task_logs WHERE task_id=? AND id>? ORDER BY id LIMIT 1000",
           {Json(task_id), Json(offset)});
     };
     auto rows = fetch();

@@ -69,6 +69,36 @@ TrialState* Master::find_trial_locked(int64_t trial_id,
 // Experiment lifecycle.
 // ---------------------------------------------------------------------------
 
+namespace {
+
+// Compile expconf log_policies (reference logpattern.go; schema
+// schemas/expconf/v0/log-policy.json): [{pattern, action: {type} | "type"}].
+std::vector<LogPolicy> compile_log_policies(const Json& config) {
+  std::vector<LogPolicy> out;
+  for (const auto& p : config["log_policies"].as_array()) {
+    LogPolicy lp;
+    lp.pattern = p["pattern"].as_string();
+    if (lp.pattern.empty()) continue;
+    lp.action = p["action"].is_string()
+                    ? p["action"].as_string()
+                    : p["action"]["type"].as_string("cancel_retries");
+    try {
+      lp.re = std::regex(lp.pattern);
+    } catch (const std::regex_error& e) {
+      // Validated python-side; never crash the master — but never drop
+      // a policy silently either.
+      std::cerr << "master: log policy pattern /" << lp.pattern
+                << "/ rejected by regex engine (" << e.what()
+                << "); policy inert" << std::endl;
+      continue;
+    }
+    out.push_back(std::move(lp));
+  }
+  return out;
+}
+
+}  // namespace
+
 int64_t Master::create_experiment_locked(const Json& config,
                                          const std::string& model_def_b64,
                                          int64_t user_id, int64_t project_id,
@@ -105,6 +135,7 @@ int64_t Master::create_experiment_locked(const Json& config,
   exp.resource_pool = res["resource_pool"].as_string(cfg_.default_pool);
   exp.priority = static_cast<int>(res["priority"].as_int(42));
   exp.max_restarts = config["max_restarts"].as_int(5);
+  exp.log_policies = compile_log_policies(config);
   uint64_t seed = static_cast<uint64_t>(
       config["reproducibility"]["experiment_seed"].as_int(eid * 2654435761));
   exp.searcher = std::make_unique<Searcher>(config["searcher"],
@@ -343,6 +374,7 @@ void Master::request_allocation_locked(ExperimentState& exp,
   alloc.slots = exp.slots_per_trial;
   alloc.priority = exp.priority;
   alloc.submitted_at = now();
+  alloc.excluded_agents = trial.excluded_agents;  // exclude_node policies
   trial.allocation_id = alloc.id;
   db_.exec(
       "INSERT INTO allocations (id, task_id, trial_id, resource_pool, slots) "
@@ -495,13 +527,15 @@ void Master::on_allocation_exit_locked(Allocation& alloc) {
       trial.run_id += 1;
       db_.exec("UPDATE trials SET run_id=? WHERE id=?",
                {Json(trial.run_id), Json(trial.id)});
-    } else if (trial.restarts < exp->max_restarts && exp->state == "ACTIVE") {
+    } else if (trial.restarts < exp->max_restarts &&
+               !trial.cancel_retries && exp->state == "ACTIVE") {
       trial.restarts += 1;
       trial.run_id += 1;
       db_.exec("UPDATE trials SET restarts=?, run_id=? WHERE id=?",
                {Json(trial.restarts), Json(trial.run_id), Json(trial.id)});
       request_allocation_locked(*exp, trial);
     } else {
+      // cancel_retries log policy or max_restarts exhausted.
       finish_trial_locked(*exp, trial, "ERROR");
     }
   }
@@ -533,6 +567,10 @@ void Master::snapshot_experiment_locked(ExperimentState& exp) {
     tj["run_id"] = t.run_id;
     tj["steps_completed"] = t.steps_completed;
     tj["latest_checkpoint"] = t.latest_checkpoint;
+    tj["cancel_retries"] = t.cancel_retries;
+    Json excluded = Json::array();
+    for (const auto& a : t.excluded_agents) excluded.push_back(Json(a));
+    tj["excluded_agents"] = excluded;
     trials[rid] = std::move(tj);
   }
   snap["trials"] = trials;
@@ -562,6 +600,7 @@ void Master::restore_experiments() {
     exp.resource_pool = res["resource_pool"].as_string(cfg_.default_pool);
     exp.priority = static_cast<int>(res["priority"].as_int(42));
     exp.max_restarts = config["max_restarts"].as_int(5);
+    exp.log_policies = compile_log_policies(config);
     uint64_t seed = static_cast<uint64_t>(
         config["reproducibility"]["experiment_seed"].as_int(
             eid * 2654435761));
@@ -592,6 +631,10 @@ void Master::restore_experiments() {
         t.run_id = tj["run_id"].as_int() + 1;
         t.steps_completed = tj["steps_completed"].as_int();
         t.latest_checkpoint = tj["latest_checkpoint"].as_string();
+        t.cancel_retries = tj["cancel_retries"].as_bool();
+        for (const auto& a : tj["excluded_agents"].as_array()) {
+          t.excluded_agents.insert(a.as_string());
+        }
         exp.trials[rid] = std::move(t);
       }
     }

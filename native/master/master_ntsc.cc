@@ -49,10 +49,142 @@ NtscKind ntsc_kind(const std::string& kind) {
   if (kind == "shells") {
     return {"SHELL", "sleep infinity"};
   }
+  if (kind == "generic-tasks") {
+    // Reference api_generic_tasks.go:207 CreateGenericTask — user-launched
+    // task trees with state propagation.
+    return {"GENERIC", ""};
+  }
   return {"COMMAND", ""};
 }
 
 }  // namespace
+
+void Master::kill_task_tree_locked(const std::string& task_id) {
+  for (auto& [aid, a] : allocations_) {
+    if (a.task_id == task_id && a.state != "TERMINATED") {
+      if (a.state == "PENDING") {
+        a.state = "TERMINATED";
+        release_resources_locked(a);
+      } else {
+        kill_allocation_locked(a);
+      }
+    }
+  }
+  db_.exec("UPDATE tasks SET state='CANCELED', end_time=datetime('now') "
+           "WHERE id=? AND end_time IS NULL",
+           {Json(task_id)});
+  // Recurse into children (task trees, api_generic_tasks.go:432).
+  auto children = db_.query(
+      "SELECT id FROM tasks WHERE parent_id=? AND end_time IS NULL",
+      {Json(task_id)});
+  for (auto& row : children) {
+    kill_task_tree_locked(row["id"].as_string());
+  }
+}
+
+HttpResponse Master::handle_runs(const HttpRequest& req,
+                                 const std::vector<std::string>& parts) {
+  // GET /api/v1/runs — SearchRuns (reference api_runs.go:70): the flat
+  // runs view over trials across experiments.
+  if (parts.size() == 1 && req.method == "GET") {
+    // Validate numeric params up front (400, not a stoll-500); clamp limit.
+    auto parse_id = [&](const std::string& name, int64_t* out_v) -> bool {
+      const std::string v = req.query_param(name);
+      if (v.empty()) return true;
+      try {
+        *out_v = std::stoll(v);
+        return true;
+      } catch (...) {
+        return false;
+      }
+    };
+    int64_t exp_filter = -1, project_filter = -1, limit = 200;
+    if (!parse_id("experiment_id", &exp_filter) ||
+        !parse_id("project_id", &project_filter) ||
+        !parse_id("limit", &limit)) {
+      return json_resp(400, err_body("invalid numeric query parameter"));
+    }
+    limit = std::max<int64_t>(1, std::min<int64_t>(limit, 1000));
+
+    std::string sql =
+        "SELECT t.id, t.experiment_id, t.state, t.hparams, t.restarts, "
+        "t.summary_metrics, t.start_time, t.end_time, e.config, "
+        "e.project_id FROM trials t JOIN experiments e ON "
+        "t.experiment_id = e.id WHERE e.archived=0";
+    std::vector<Json> params;
+    if (!req.query_param("experiment_id").empty()) {
+      sql += " AND t.experiment_id=?";
+      params.push_back(Json(exp_filter));
+    }
+    if (!req.query_param("project_id").empty()) {
+      sql += " AND e.project_id=?";
+      params.push_back(Json(project_filter));
+    }
+    sql += " ORDER BY t.id DESC LIMIT " + std::to_string(limit);
+    // Query OUTSIDE mu_ (db has its own lock); take mu_ only for the
+    // live-state overlay. The ?state= filter applies AFTER the overlay —
+    // trials.state in the DB is only persisted at terminal transitions.
+    auto rows = db_.query(sql, params);
+    Json runs = Json::array();
+    const std::string want_state = req.query_param("state");
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      for (auto& row : rows) {
+        Json r = row_to_json(row);
+        Json cfg = Json::parse_or_null(r["config"].as_string());
+        r["experiment_name"] = cfg["name"];
+        r["config"] = Json();
+        r["hparams"] = Json::parse_or_null(r["hparams"].as_string());
+        r["summary_metrics"] =
+            Json::parse_or_null(r["summary_metrics"].as_string());
+        ExperimentState* exp =
+            find_experiment_locked(row["experiment_id"].as_int());
+        if (exp != nullptr) {
+          for (const auto& [rid, trial] : exp->trials) {
+            if (trial.id == row["id"].as_int()) {
+              r["state"] = trial.state;
+              break;
+            }
+          }
+        }
+        if (!want_state.empty() && r["state"].as_string() != want_state) {
+          continue;
+        }
+        runs.push_back(std::move(r));
+      }
+    }
+    Json out = Json::object();
+    out["runs"] = runs;
+    return json_resp(200, out);
+  }
+
+  // POST /api/v1/runs/move {run_ids: [...], project_id} — MoveRuns
+  // (reference api_runs.go:262): moves the runs' parent experiments.
+  if (parts.size() == 2 && parts[1] == "move" && req.method == "POST") {
+    Json body = Json::parse(req.body);
+    int64_t project = body["project_id"].as_int(1);
+    auto prows = db_.query("SELECT id FROM projects WHERE id=?",
+                           {Json(project)});
+    if (prows.empty()) return json_resp(404, err_body("no such project"));
+    // Dedupe to parent experiments first — several runs may share one.
+    std::set<int64_t> exp_ids;
+    for (const auto& rid : body["run_ids"].as_array()) {
+      auto trows = db_.query("SELECT experiment_id FROM trials WHERE id=?",
+                             {rid});
+      if (!trows.empty()) exp_ids.insert(trows[0]["experiment_id"].as_int());
+    }
+    int64_t moved = 0;
+    for (int64_t eid2 : exp_ids) {
+      moved += db_.exec(
+          "UPDATE experiments SET project_id=? WHERE id=? AND project_id<>?",
+          {Json(project), Json(eid2), Json(project)});
+    }
+    Json out = Json::object();
+    out["moved"] = moved;
+    return json_resp(200, out);
+  }
+  return json_resp(404, err_body("not found"));
+}
 
 HttpResponse Master::handle_ntsc(const HttpRequest& req,
                                  const std::string& kind,
@@ -72,10 +204,21 @@ HttpResponse Master::handle_ntsc(const HttpRequest& req,
     std::string task_id =
         std::string(meta.type) + "-" + random_hex(6);
     for (auto& c : task_id) c = static_cast<char>(tolower(c));
+    // Generic task trees (reference api_generic_tasks.go:207): a child
+    // carries its parent's id; kill/error propagates down the tree.
+    std::string parent = body["parent_task_id"].as_string();
+    if (!parent.empty()) {
+      auto prows = db_.query("SELECT id FROM tasks WHERE id=?",
+                             {Json(parent)});
+      if (prows.empty()) {
+        return json_resp(404, err_body("no such parent task"));
+      }
+    }
     db_.exec(
-        "INSERT INTO tasks (id, type, state, config, owner_id) "
-        "VALUES (?, ?, 'ACTIVE', ?, ?)",
-        {Json(task_id), Json(meta.type), Json(config.dump()), Json(uid)});
+        "INSERT INTO tasks (id, type, state, config, owner_id, parent_id) "
+        "VALUES (?, ?, 'ACTIVE', ?, ?, ?)",
+        {Json(task_id), Json(meta.type), Json(config.dump()), Json(uid),
+         parent.empty() ? Json() : Json(parent)});
 
     Allocation alloc;
     alloc.id = "alloc-" + task_id;
@@ -152,22 +295,11 @@ HttpResponse Master::handle_ntsc(const HttpRequest& req,
 
   if (parts.size() >= 2) {
     const std::string& task_id = parts[1];
-    // POST /{kind}/{id}/kill
+    // POST /{kind}/{id}/kill — propagates down the task tree (reference
+    // api_generic_tasks.go:432 PropagateTaskState).
     if (parts.size() == 3 && parts[2] == "kill" && req.method == "POST") {
       std::lock_guard<std::mutex> lock(mu_);
-      for (auto& [aid, a] : allocations_) {
-        if (a.task_id == task_id && a.state != "TERMINATED") {
-          if (a.state == "PENDING") {
-            a.state = "TERMINATED";
-            release_resources_locked(a);
-          } else {
-            kill_allocation_locked(a);
-          }
-        }
-      }
-      db_.exec("UPDATE tasks SET state='CANCELED', end_time=datetime('now') "
-               "WHERE id=? AND end_time IS NULL",
-               {Json(task_id)});
+      kill_task_tree_locked(task_id);
       return json_resp(200, Json::object());
     }
     // GET /{kind}/{id}

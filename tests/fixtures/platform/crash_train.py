@@ -1,0 +1,24 @@
+"""Fixture for log-pattern policy e2e: prints a recognizable fatal line,
+then exits non-zero — the master's policies decide whether it retries and
+where."""
+
+import os
+import sys
+import time
+
+from determined_tpu import core
+
+
+def main() -> int:
+    with core.init(async_checkpointing=False) as ctx:
+        ctx.train.report_training_metrics(1, {"loss": 1.0})
+        print(f"run on agent {os.environ.get('DET_AGENT_ID')}")
+        sys.stdout.flush()
+        print("UNRECOVERABLE_CONDITION: device melted")
+        sys.stdout.flush()
+        time.sleep(1.0)  # let the log batch ship before dying
+    return 17
+
+
+if __name__ == "__main__":
+    sys.exit(main())
