@@ -1,0 +1,19 @@
+"""Autotune — the TPU analogue of DeepSpeed Autotune (dsat).
+
+Reference: harness/determined/pytorch/dsat/_dsat_search_method.py — a
+custom-searcher workflow that profiles a model then searches deployment
+knobs (ZeRO stage, micro-batch size) for throughput. On TPU the knobs that
+matter are the per-chip batch size and rematerialisation: bigger batches
+amortize HBM bandwidth until they OOM; remat trades FLOPs for memory and
+changes where that cliff sits.
+
+`BatchSizeSearchMethod` drives trials through the custom-searcher API:
+doubling the global batch size until a trial fails (the OOM cliff), then
+narrowing with a binary search between the last good and first bad size,
+ranking survivors by reported throughput (searcher metric
+`samples_per_second`, larger is better).
+"""
+
+from determined_tpu.autotune._batch_size import (  # noqa: F401
+    BatchSizeSearchMethod,
+)

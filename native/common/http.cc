@@ -233,6 +233,23 @@ void HttpServer::handle_connection(int fd, const std::string& remote) {
   ::close(fd);
 }
 
+std::string url_encode(const std::string& s, bool keep_slash) {
+  static const char* hex = "0123456789ABCDEF";
+  std::string out;
+  out.reserve(s.size());
+  for (unsigned char c : s) {
+    if (isalnum(c) || c == '-' || c == '_' || c == '.' || c == '~' ||
+        (keep_slash && c == '/')) {
+      out += static_cast<char>(c);
+    } else {
+      out += '%';
+      out += hex[c >> 4];
+      out += hex[c & 0xf];
+    }
+  }
+  return out;
+}
+
 HttpClientResponse http_request(const std::string& method,
                                 const std::string& url, const std::string& path,
                                 const std::string& body, double timeout_s,
@@ -283,7 +300,10 @@ HttpClientResponse http_request(const std::string& method,
   std::ostringstream out;
   out << method << ' ' << path << " HTTP/1.1\r\nHost: " << host
       << "\r\nContent-Length: " << body.size()
-      << "\r\nContent-Type: application/json\r\nConnection: close\r\n";
+      << "\r\nConnection: close\r\n";
+  if (headers.find("Content-Type") == headers.end()) {
+    out << "Content-Type: application/json\r\n";
+  }
   for (const auto& [k, v] : headers) out << k << ": " << v << "\r\n";
   out << "\r\n" << body;
   if (!write_all(fd, out.str())) {
@@ -310,6 +330,7 @@ HttpClientResponse http_request(const std::string& method,
 
   HttpClientResponse r;
   long content_len = -1;
+  bool chunked = false;
   {
     std::istringstream hs(resp_buf.substr(0, head_end));
     std::string version;
@@ -322,15 +343,62 @@ HttpClientResponse http_request(const std::string& method,
       if (colon == std::string::npos) continue;
       std::string key = line.substr(0, colon);
       for (auto& c : key) c = static_cast<char>(tolower(c));
+      std::string value = line.substr(colon + 1);
+      while (!value.empty() && value.front() == ' ') value.erase(0, 1);
+      r.headers[key] = value;
       if (key == "content-length") {
         try {
-          content_len = std::stol(line.substr(colon + 1));
+          content_len = std::stol(value);
         } catch (...) {
         }
+      }
+      if (key == "transfer-encoding" &&
+          value.find("chunked") != std::string::npos) {
+        chunked = true;
       }
     }
   }
   size_t body_start = head_end + 4;
+  if (chunked) {
+    // Minimal chunked decoding (proxied upstreams — tensorboard, jupyter —
+    // commonly chunk): read to EOF (we sent Connection: close), then
+    // de-frame. The same invariant as below applies: a timeout mid-body
+    // must be an error, never a silently partial 200.
+    while ((n = ::recv(fd, chunk, sizeof(chunk), 0)) > 0) {
+      resp_buf.append(chunk, static_cast<size_t>(n));
+    }
+    ::close(fd);
+    if (n < 0) {
+      throw std::runtime_error("timeout reading chunked body from " + host);
+    }
+    std::string framed = resp_buf.substr(body_start);
+    size_t pos = 0;
+    bool terminated = false;
+    while (pos < framed.size()) {
+      size_t eol = framed.find("\r\n", pos);
+      if (eol == std::string::npos) break;
+      long sz = 0;
+      try {
+        sz = std::stol(framed.substr(pos, eol - pos), nullptr, 16);
+      } catch (...) {
+        break;
+      }
+      if (sz == 0) {
+        terminated = true;
+        break;
+      }
+      if (sz < 0 || eol + 2 + static_cast<size_t>(sz) > framed.size()) {
+        throw std::runtime_error("truncated chunked body from " + host);
+      }
+      r.body.append(framed, eol + 2, static_cast<size_t>(sz));
+      pos = eol + 2 + static_cast<size_t>(sz) + 2;  // skip trailing CRLF
+    }
+    if (!terminated) {
+      throw std::runtime_error("chunked body missing terminal chunk from " +
+                               host);
+    }
+    return r;
+  }
   if (content_len >= 0) {
     while (resp_buf.size() < body_start + static_cast<size_t>(content_len)) {
       n = ::recv(fd, chunk, sizeof(chunk), 0);

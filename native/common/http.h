@@ -81,6 +81,7 @@ class HttpServer {
 struct HttpClientResponse {
   int status = 0;
   std::string body;
+  std::map<std::string, std::string> headers;  // lower-cased keys
   bool ok() const { return status >= 200 && status < 300; }
 };
 
@@ -96,5 +97,8 @@ HttpClientResponse http_request(const std::string& method,
                                     headers = {});
 
 std::string url_decode(const std::string& s);
+// Percent-encodes everything outside RFC3986 unreserved + '/' (for paths);
+// set keep_slash=false for query keys/values.
+std::string url_encode(const std::string& s, bool keep_slash = true);
 
 }  // namespace det

@@ -174,6 +174,19 @@ HttpResponse Master::route(const HttpRequest& req) {
       // Non-GET probes (HEAD from load balancers) keep the health answer.
       return HttpResponse::json(200, "{\"status\":\"ok\"}");
     }
+    // /proxy/{task_id}/... — HTTP proxy to NTSC task servers (reference
+    // internal/proxy/proxy.go + tcp.go; HTTP-only here — notebooks and
+    // tensorboards serve HTTP).
+    if (parts.size() >= 2 && parts[0] == "proxy") {
+      if (auth_user(req) < 0) {
+        return json_resp(401, err_body("unauthenticated"));
+      }
+      try {
+        return handle_proxy(req, parts);
+      } catch (const std::exception& e) {
+        return json_resp(502, err_body(std::string("proxy: ") + e.what()));
+      }
+    }
     if (req.path == "/metrics" && req.method == "GET") {
       // Prometheus scrape endpoint (reference internal/prom/
       // det_state_metrics.go + echo-prometheus in core.go:28).

@@ -212,6 +212,8 @@ class Master {
   HttpResponse handle_job_queue(const HttpRequest& req);
   HttpResponse handle_runs(const HttpRequest& req,
                            const std::vector<std::string>& parts);
+  HttpResponse handle_proxy(const HttpRequest& req,
+                            const std::vector<std::string>& parts);
   void kill_task_tree_locked(const std::string& task_id);
   HttpResponse handle_prometheus_metrics();
   HttpResponse serve_webui(const std::string& path);

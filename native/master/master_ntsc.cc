@@ -186,6 +186,79 @@ HttpResponse Master::handle_runs(const HttpRequest& req,
   return json_resp(404, err_body("not found"));
 }
 
+HttpResponse Master::handle_proxy(const HttpRequest& req,
+                                  const std::vector<std::string>& parts) {
+  // /proxy/{task_id}/{rest...} → forward to the task's registered proxy
+  // address (PostAllocationProxyAddress). The reference runs a generic
+  // TCP/WS proxy (proxy/tcp.go, ws.go); here HTTP request/response
+  // forwarding, which covers the HTTP-serving NTSC types.
+  const std::string& task_id = parts[1];
+  std::string target;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    for (auto& [aid, a] : allocations_) {
+      if (a.task_id == task_id && !a.proxy_addresses.empty() &&
+          a.state != "TERMINATED") {
+        target = a.proxy_addresses.begin()->second;
+        a.last_activity = now();  // proxy traffic keeps the task non-idle
+      }
+    }
+  }
+  if (target.empty()) {
+    return json_resp(502, err_body("task has no proxy address (yet)"));
+  }
+  // Split "http://host:port[/base]" into origin + base path.
+  std::string base_path;
+  auto scheme_end = target.find("://");
+  if (scheme_end != std::string::npos) {
+    auto path_start = target.find('/', scheme_end + 3);
+    if (path_start != std::string::npos) {
+      base_path = target.substr(path_start);
+      if (base_path == "/") base_path.clear();
+      target = target.substr(0, path_start);
+    }
+  }
+  // Re-encode: req.path/query arrive URL-decoded (http.cc read_request);
+  // raw spaces etc. would corrupt the upstream request line.
+  std::string fwd_path = base_path;
+  for (size_t i = 2; i < parts.size(); ++i) {
+    fwd_path += "/" + url_encode(parts[i], /*keep_slash=*/false);
+  }
+  if (fwd_path.empty()) fwd_path = "/";
+  if (!req.query.empty()) {
+    std::string qs;
+    for (const auto& [k, v] : req.query) {
+      qs += (qs.empty() ? "?" : "&") + url_encode(k, false) + "=" +
+            url_encode(v, false);
+    }
+    fwd_path += qs;
+  }
+  std::map<std::string, std::string> fwd_headers;
+  auto it = req.headers.find("content-type");
+  if (it != req.headers.end()) fwd_headers["Content-Type"] = it->second;
+  // Session cookies must survive both directions (jupyter login flow).
+  auto cookie = req.headers.find("cookie");
+  if (cookie != req.headers.end()) fwd_headers["Cookie"] = cookie->second;
+  HttpClientResponse pr =
+      http_request(req.method, target, fwd_path, req.body, 60.0, fwd_headers);
+  HttpResponse out;
+  out.status = pr.status;
+  out.body = pr.body;
+  auto ct = pr.headers.find("content-type");
+  out.content_type =
+      ct != pr.headers.end() ? ct->second : "application/octet-stream";
+  auto sc = pr.headers.find("set-cookie");
+  if (sc != pr.headers.end()) out.headers["Set-Cookie"] = sc->second;
+  auto loc = pr.headers.find("location");
+  if (loc != pr.headers.end()) {
+    // Keep redirects inside the proxy prefix when they are origin-relative.
+    std::string l = loc->second;
+    if (!l.empty() && l[0] == '/') l = "/proxy/" + task_id + l;
+    out.headers["Location"] = l;
+  }
+  return out;
+}
+
 HttpResponse Master::handle_ntsc(const HttpRequest& req,
                                  const std::string& kind,
                                  const std::vector<std::string>& parts) {

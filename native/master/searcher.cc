@@ -797,7 +797,12 @@ std::vector<SearcherOp> Searcher::initial_operations() {
 
 std::vector<SearcherOp> Searcher::validation_completed(
     const std::string& rid, double raw_metric, int64_t length) {
-  double metric = smaller_is_better_ ? raw_metric : -raw_metric;
+  // Built-in methods get the sign-normalized metric (smaller always
+  // better); the CUSTOM event queue forwards the RAW metric — the client's
+  // SearchMethod owns the semantics (reference custom_search.go passes the
+  // user metric through unchanged).
+  double metric = (custom_ != nullptr || smaller_is_better_) ? raw_metric
+                                                             : -raw_metric;
   units_[rid] = std::max(units_[rid], length);
   return account(method_->validation_completed(rid, metric, length));
 }

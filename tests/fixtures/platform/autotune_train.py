@@ -1,0 +1,29 @@
+"""Fixture trial for the autotune e2e: pretends sizes above
+FAKE_MEMORY_LIMIT OOM, otherwise reports a throughput that grows with
+batch size (bigger batches amortize overhead), so the autotuner's best
+should be the largest fitting size."""
+
+import os
+import sys
+
+from determined_tpu import core
+
+
+def main() -> int:
+    with core.init(async_checkpointing=False) as ctx:
+        size = int(ctx.hparams["global_batch_size"])
+        limit = int(os.environ.get("FAKE_MEMORY_LIMIT", "64"))
+        if size > limit:
+            print(f"RESOURCE_EXHAUSTED: fake OOM at batch {size}")
+            return 1
+        sps = size * 10.0 / (1.0 + size / 100.0)
+        for op in ctx.searcher.operations():
+            ctx.train.report_validation_metrics(
+                op.length, {"samples_per_second": sps})
+            op.report_completed(sps)
+        print(f"profiled batch {size}: {sps:.1f} samples/s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

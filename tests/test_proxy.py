@@ -1,0 +1,128 @@
+"""NTSC proxy e2e (reference internal/proxy/proxy.go + tcp.go): the master
+forwards /proxy/{task_id}/... to the task's registered proxy address."""
+
+import textwrap
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tests.test_platform_e2e import Devcluster, native_binaries  # noqa: F401
+
+
+@pytest.fixture()
+def cluster(tmp_path, native_binaries):  # noqa: F811
+    c = Devcluster(str(tmp_path), native_binaries)
+    c.start_master()
+    c.start_agent()
+    yield c
+    c.stop()
+
+
+SERVER = textwrap.dedent("""
+    import http.server, threading, sys
+    from determined_tpu.exec._util import report_proxy_address
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+        def do_GET(self):
+            if self.path.startswith("/hello"):
+                body = f"hi from task: {self.path}".encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path == "/jump":
+                self.send_response(302)
+                self.send_header("Location", "/hello-after-jump")
+                self.end_headers()
+            else:
+                self.send_response(404)
+                self.end_headers()
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = b"echo:" + self.rfile.read(n)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    report_proxy_address(f"http://127.0.0.1:{srv.server_address[1]}")
+    print("serving", srv.server_address[1])
+    sys.stdout.flush()
+    srv.serve_forever()
+""")
+
+
+def test_proxy_forwards_to_task(cluster, tmp_path):
+    token = cluster.login()
+    script = tmp_path / "srv.py"
+    script.write_text(SERVER)
+    task = cluster.api(
+        "POST", "/api/v1/commands",
+        {"config": {"entrypoint": f"python3 {script}"}}, token=token)
+    tid = task["id"]
+
+    # wait for the proxy address to register
+    deadline = time.time() + 30
+    addr = None
+    while time.time() < deadline:
+        t = cluster.api("GET", f"/api/v1/commands/{tid}", token=token)["task"]
+        addr = t.get("proxy_address")
+        if addr:
+            break
+        time.sleep(0.3)
+    assert addr, "task never registered a proxy address"
+
+    def proxied(method, path, data=None):
+        req = urllib.request.Request(
+            cluster.master_url + f"/proxy/{tid}{path}",
+            data=data, method=method,
+            headers={"Authorization": f"Bearer {token}"})
+        return urllib.request.urlopen(req, timeout=20)
+
+    # GET with query string
+    with proxied("GET", "/hello?x=1") as r:
+        assert r.headers.get_content_type() == "text/plain"
+        body = r.read().decode()
+    assert body.startswith("hi from task: /hello")
+    assert "x=1" in body
+
+    # POST body round-trips
+    with proxied("POST", "/hello-post") as r:
+        pass  # 404 from server is fine — exercise POST on /hello instead
+    with proxied("POST", "/hello", data=b"payload-bytes") as r:
+        assert r.read() == b"echo:payload-bytes"
+
+    # origin-relative redirects are rewritten into the proxy prefix
+    class NoRedirect(urllib.request.HTTPRedirectHandler):
+        def redirect_request(self, *a, **k):
+            return None
+
+    opener = urllib.request.build_opener(NoRedirect)
+    req = urllib.request.Request(
+        cluster.master_url + f"/proxy/{tid}/jump",
+        headers={"Authorization": f"Bearer {token}"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        opener.open(req, timeout=20)
+    assert ei.value.code == 302
+    assert ei.value.headers["Location"] == f"/proxy/{tid}/hello-after-jump"
+
+    # unauthenticated proxying rejected; unknown task 502
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            cluster.master_url + f"/proxy/{tid}/hello", timeout=10)
+    assert ei.value.code == 401
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            urllib.request.Request(
+                cluster.master_url + "/proxy/no-such-task/x",
+                headers={"Authorization": f"Bearer {token}"}), timeout=10)
+    assert ei.value.code == 502
+
+    cluster.api("POST", f"/api/v1/commands/{tid}/kill", token=token)
