@@ -1,0 +1,48 @@
+"""Streaming updates client (reference
+harness/determined/common/streams/_client.py over the master's websocket
+publisher; here a long-poll generator over GET /api/v1/stream)."""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence
+
+from determined_tpu.common.api import Session
+
+
+class StreamClient:
+    """Iterate entity-change events from the master.
+
+        for event in StreamClient(session).subscribe(["experiments"]):
+            ...  # {"seq": N, "entity": "experiments", "payload": {...}}
+
+    `dropped=True` responses mean the server's ring overflowed past our
+    cursor — the caller should re-list the entities it mirrors, then keep
+    streaming (reference subscribers resync from the DB on overflow).
+    """
+
+    def __init__(self, session: Session, since: int = 0):
+        self._session = session
+        self.since = since
+        self.dropped = False
+
+    def poll(self, entities: Optional[Sequence[str]] = None,
+             timeout_seconds: float = 30.0) -> list:
+        params = {
+            "since": str(self.since),
+            "timeout_seconds": str(timeout_seconds),
+        }
+        if entities:
+            params["entities"] = ",".join(entities)
+        out = self._session.get("/api/v1/stream", params=params)
+        self.dropped = self.dropped or bool(out.get("dropped"))
+        events = out.get("events", [])
+        if events:
+            self.since = events[-1]["seq"]
+        return events
+
+    def subscribe(self, entities: Optional[Sequence[str]] = None,
+                  timeout_seconds: float = 30.0) -> Iterator[dict]:
+        """Infinite generator; blocks in long-polls between event batches."""
+        while True:
+            for event in self.poll(entities, timeout_seconds):
+                yield event

@@ -1,0 +1,16 @@
+"""model_hub — prebuilt trial adapters for external model libraries.
+
+Reference: model_hub/ (HuggingFace Transformers adapters
+model_hub/huggingface/, MMDetection model_hub/mmdetection/_trial.py).
+Here the HuggingFace adapters: generic PyTorchTrial wrappers around
+AutoModelFor* so a config file + a model name (or config) is a runnable
+experiment — no trial code to write. On TPU task images they run under
+torch-xla via the torch_distributed launch layer; the native JAX path for
+transformers remains determined_tpu.models + integrations.transformers
+(DetCallback).
+"""
+
+from determined_tpu.model_hub.huggingface import (  # noqa: F401
+    CausalLMTrial,
+    SequenceClassificationTrial,
+)

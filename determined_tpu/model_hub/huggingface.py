@@ -1,0 +1,168 @@
+"""HuggingFace trial adapters (reference model_hub/model_hub/huggingface/:
+_trial.py BaseTransformerTrial — re-shaped onto this platform's
+PyTorchTrial).
+
+Hyperparameters understood by both adapters:
+  model_name          HF hub id or local path (from_pretrained), OR
+  model_config        dict of config overrides built offline via
+                      AutoConfig/from_config — no network needed
+  learning_rate, per_device_batch_size, seq_len
+CausalLMTrial extra:  tokens_path (int32 memmap) else synthetic tokens
+SequenceClassificationTrial extra: num_labels; synthetic features
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+import torch
+
+from determined_tpu.pytorch import DataLoader, PyTorchTrial, PyTorchTrialContext
+
+
+def build_model(hp: Dict[str, Any], auto_cls, config_cls_default: str):
+    """model_name → from_pretrained; model_config → offline from_config."""
+    import transformers
+
+    if hp.get("model_name"):
+        return auto_cls.from_pretrained(hp["model_name"])
+    overrides = dict(hp.get("model_config") or {})
+    cfg_type = overrides.pop("config_type", config_cls_default)
+    cfg_cls = getattr(transformers, cfg_type)
+    return auto_cls.from_config(cfg_cls(**overrides))
+
+
+class _SyntheticTokens(torch.utils.data.Dataset):
+    def __init__(self, vocab, seq_len, n=1024, path=None, seed=0):
+        self.seq_len = seq_len
+        if path:
+            self.tokens = np.memmap(path, dtype=np.int32, mode="r")
+            self.n = (len(self.tokens) - 1) // seq_len
+        else:
+            rng = np.random.default_rng(seed)
+            self.tokens = rng.integers(
+                0, vocab, size=(n * seq_len + 1,)).astype(np.int64)
+            self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        chunk = np.asarray(
+            self.tokens[i * self.seq_len:(i + 1) * self.seq_len + 1],
+            dtype=np.int64)
+        return {"input_ids": torch.from_numpy(chunk[:-1]),
+                "labels": torch.from_numpy(chunk[1:])}
+
+
+class CausalLMTrial(PyTorchTrial):
+    """Any AutoModelForCausalLM as a runnable trial (reference
+    hf_language_modeling adapter)."""
+
+    def __init__(self, context: PyTorchTrialContext):
+        super().__init__(context)
+        import transformers
+
+        hp = context.get_hparams()
+        model = build_model(hp, transformers.AutoModelForCausalLM,
+                            "GPT2Config")
+        self.vocab = model.config.vocab_size
+        self.seq_len = int(hp.get("seq_len", 128))
+        self.batch_size = int(hp.get("per_device_batch_size", 8))
+        self.tokens_path = hp.get("tokens_path")
+        self.n_examples = int(hp.get("synthetic_examples", 1024))
+        self.model = context.wrap_model(model)
+        self.opt = context.wrap_optimizer(
+            torch.optim.AdamW(self.model.parameters(),
+                              lr=float(hp.get("learning_rate", 5e-5))))
+
+    def build_training_data_loader(self):
+        return DataLoader(
+            _SyntheticTokens(self.vocab, self.seq_len, n=self.n_examples,
+                             path=self.tokens_path),
+            batch_size=self.batch_size)
+
+    def build_validation_data_loader(self):
+        return DataLoader(
+            _SyntheticTokens(self.vocab, self.seq_len, n=64, seed=7,
+                             path=self.tokens_path),
+            batch_size=self.batch_size)
+
+    def train_batch(self, batch, epoch_idx, batch_idx):
+        out = self.model(input_ids=batch["input_ids"], labels=batch["labels"])
+        self.context.backward(out.loss)
+        self.context.step_optimizer(self.opt)
+        return {"loss": out.loss.item()}
+
+    def evaluate_batch(self, batch, batch_idx):
+        with torch.no_grad():
+            out = self.model(input_ids=batch["input_ids"],
+                             labels=batch["labels"])
+        return {"val_loss": out.loss.item()}
+
+
+class _SyntheticClassification(torch.utils.data.Dataset):
+    def __init__(self, vocab, seq_len, num_labels, n=512, seed=0):
+        rng = np.random.default_rng(seed)
+        self.x = rng.integers(0, vocab, size=(n, seq_len)).astype(np.int64)
+        # learnable rule: label = first token mod num_labels
+        self.y = (self.x[:, 0] % num_labels).astype(np.int64)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return {"input_ids": torch.from_numpy(self.x[i]),
+                "labels": torch.tensor(self.y[i])}
+
+
+class SequenceClassificationTrial(PyTorchTrial):
+    """Any AutoModelForSequenceClassification as a runnable trial
+    (reference hf text-classification adapter)."""
+
+    def __init__(self, context: PyTorchTrialContext):
+        super().__init__(context)
+        import transformers
+
+        hp = context.get_hparams()
+        self.num_labels = int(hp.get("num_labels", 2))
+        mc = dict(hp.get("model_config") or {})
+        mc["num_labels"] = self.num_labels
+        hp2 = dict(hp)
+        hp2["model_config"] = mc
+        model = build_model(
+            hp2, transformers.AutoModelForSequenceClassification,
+            "BertConfig")
+        self.vocab = model.config.vocab_size
+        self.seq_len = int(hp.get("seq_len", 32))
+        self.batch_size = int(hp.get("per_device_batch_size", 16))
+        self.model = context.wrap_model(model)
+        self.opt = context.wrap_optimizer(
+            torch.optim.AdamW(self.model.parameters(),
+                              lr=float(hp.get("learning_rate", 5e-5))))
+
+    def build_training_data_loader(self):
+        return DataLoader(
+            _SyntheticClassification(self.vocab, self.seq_len,
+                                     self.num_labels),
+            batch_size=self.batch_size)
+
+    def build_validation_data_loader(self):
+        return DataLoader(
+            _SyntheticClassification(self.vocab, self.seq_len,
+                                     self.num_labels, n=128, seed=7),
+            batch_size=self.batch_size)
+
+    def train_batch(self, batch, epoch_idx, batch_idx):
+        out = self.model(input_ids=batch["input_ids"], labels=batch["labels"])
+        self.context.backward(out.loss)
+        self.context.step_optimizer(self.opt)
+        return {"loss": out.loss.item()}
+
+    def evaluate_batch(self, batch, batch_idx):
+        with torch.no_grad():
+            out = self.model(input_ids=batch["input_ids"],
+                             labels=batch["labels"])
+            acc = (out.logits.argmax(-1) == batch["labels"]).float().mean()
+        return {"val_loss": out.loss.item(), "accuracy": acc.item()}

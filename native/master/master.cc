@@ -223,6 +223,7 @@ HttpResponse Master::route(const HttpRequest& req) {
       out["deleted"] = sweep_task_logs(days);
       return json_resp(200, out);
     }
+    if (root == "stream" && req.method == "GET") return handle_stream(req);
     if (root == "users" || root == "me") return handle_users(req);
     if (root == "agents") return handle_agents_api(req, rest);
     if (root == "experiments") return handle_experiments(req, rest);
@@ -369,6 +370,92 @@ HttpResponse Master::serve_webui(const std::string& path) {
   }
   r.body = ss.str();
   return r;
+}
+
+void Master::publish_locked(const std::string& entity, Json payload) {
+  StreamEvent ev;
+  ev.seq = ++stream_seq_;
+  ev.entity = entity;
+  ev.payload = std::move(payload);
+  stream_events_.push_back(std::move(ev));
+  // Bounded ring: clients that fall further behind than this must
+  // re-list; the response's `dropped` flag tells them (reference stream
+  // subscribers resync from the DB on overflow).
+  while (stream_events_.size() > 4096) stream_events_.pop_front();
+  cv_.notify_all();
+}
+
+HttpResponse Master::handle_stream(const HttpRequest& req) {
+  // GET /api/v1/stream?since=SEQ&entities=a,b&timeout_seconds=N — long-poll
+  // for entity-change events after SEQ (reference stream/publisher.go over
+  // websocket; long-poll here, same contract as the other master signals).
+  int64_t since = 0;
+  try {
+    since = std::stoll(req.query_param("since", "0"));
+  } catch (...) {
+    return json_resp(400, err_body("invalid since"));
+  }
+  double timeout = 30.0;
+  try {
+    timeout = std::stod(req.query_param("timeout_seconds", "30"));
+  } catch (...) {
+  }
+  if (std::isnan(timeout)) timeout = 30.0;
+  timeout = std::max(0.0, std::min(timeout, 60.0));
+  std::set<std::string> want;
+  {
+    const std::string ents = req.query_param("entities");
+    size_t start = 0;
+    while (start < ents.size()) {
+      auto comma = ents.find(',', start);
+      if (comma == std::string::npos) comma = ents.size();
+      if (comma > start) want.insert(ents.substr(start, comma - start));
+      start = comma + 1;
+    }
+  }
+  auto collect = [&](Json* out_events, bool* dropped) {
+    Json events = Json::array();
+    *dropped =
+        since != 0 &&
+        ((!stream_events_.empty() && stream_events_.front().seq > since + 1) ||
+         // A cursor ahead of the counter = the master restarted (seq reset):
+         // the client must re-list, not wait for the counter to catch up.
+         since > stream_seq_);
+    for (const auto& ev : stream_events_) {
+      if (ev.seq <= since) continue;
+      if (!want.empty() && !want.count(ev.entity)) continue;
+      Json e = Json::object();
+      e["seq"] = ev.seq;
+      e["entity"] = ev.entity;
+      e["payload"] = ev.payload;
+      events.push_back(std::move(e));
+    }
+    *out_events = std::move(events);
+  };
+  Json events;
+  bool dropped = false;
+  {
+    // Predicated deadline wait like the other long-polls: unrelated cv_
+    // wakeups (every publish/metric/schedule notifies) must not end the
+    // poll early with an empty batch.
+    auto deadline = Clock::now() + std::chrono::milliseconds(
+                                       static_cast<int64_t>(timeout * 1000));
+    std::unique_lock<std::mutex> lock(mu_);
+    collect(&events, &dropped);
+    while (events.as_array().empty() && !dropped &&
+           Clock::now() < deadline) {
+      if (cv_.wait_until(lock, deadline) == std::cv_status::timeout) break;
+      collect(&events, &dropped);
+    }
+  }
+  Json out = Json::object();
+  out["events"] = events;
+  out["dropped"] = dropped;
+  out["latest_seq"] =
+      events.as_array().empty()
+          ? since
+          : events.as_array().back()["seq"].as_int();
+  return json_resp(200, out);
 }
 
 HttpResponse Master::handle_prometheus_metrics() {

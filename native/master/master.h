@@ -256,6 +256,19 @@ class Master {
   Db db_;
   HttpServer server_;
 
+  // --- streaming updates (reference internal/stream/publisher.go) ---
+  // In-memory ring of entity-change events served by the long-poll
+  // GET /api/v1/stream (the websocket publisher's TPU-native stand-in).
+  struct StreamEvent {
+    int64_t seq = 0;
+    std::string entity;  // experiments | trials | metrics | checkpoints
+    Json payload;
+  };
+  void publish_locked(const std::string& entity, Json payload);
+  HttpResponse handle_stream(const HttpRequest& req);
+  std::deque<StreamEvent> stream_events_;
+  int64_t stream_seq_ = 0;
+
   // --- observability (reference internal/prom/det_state_metrics.go) ---
   struct ApiStats {
     std::mutex mu;

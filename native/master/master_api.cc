@@ -512,7 +512,11 @@ HttpResponse Master::handle_trials(const HttpRequest& req,
       if (trial != nullptr) {
         trial->steps_completed = std::max(trial->steps_completed, batches);
       }
-      cv_.notify_all();  // wake log/metric followers
+      // publish_locked notifies cv_ — wakes log/metric/stream followers.
+      publish_locked("metrics", Json(JsonObject{
+          {"trial_id", Json(tid)},
+          {"group", Json(group)},
+          {"steps_completed", Json(batches)}}));
     }
     return json_resp(200, Json::object());
   }
@@ -777,6 +781,8 @@ HttpResponse Master::handle_checkpoints(const HttpRequest& req,
         trial->latest_checkpoint = uuid;
         snapshot_experiment_locked(*exp);
       }
+      publish_locked("checkpoints", Json(JsonObject{
+          {"uuid", Json(uuid)}, {"trial_id", Json(trial_id)}}));
     }
     return json_resp(200, Json::object());
   }

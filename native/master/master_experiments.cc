@@ -173,6 +173,8 @@ void Master::set_experiment_state_locked(ExperimentState& exp,
                           "end_time=datetime('now') WHERE id=?"
                         : "UPDATE experiments SET state=? WHERE id=?";
   db_.exec(sql, {Json(state), Json(exp.id)});
+  publish_locked("experiments", Json(JsonObject{
+      {"id", Json(exp.id)}, {"state", Json(state)}}));
   if (is_terminal(state)) {
     fire_webhooks_locked(exp);
     launch_checkpoint_gc_locked(exp);
@@ -394,6 +396,10 @@ void Master::finish_trial_locked(ExperimentState& exp, TrialState& trial,
   db_.exec(
       "UPDATE trials SET state=?, end_time=datetime('now') WHERE id=?",
       {Json(state), Json(trial.id)});
+  publish_locked("trials", Json(JsonObject{
+      {"id", Json(trial.id)},
+      {"experiment_id", Json(exp.id)},
+      {"state", Json(state)}}));
   db_.exec("UPDATE tasks SET state=?, end_time=datetime('now') WHERE id=?",
            {Json(state), Json(trial_task_id(trial.id))});
   if (!trial.searcher_done) {

@@ -1,0 +1,83 @@
+"""Streaming updates e2e (reference internal/stream/publisher.go +
+common/streams/_client.py): entity-change events long-polled while an
+experiment runs."""
+
+import threading
+
+import pytest
+
+from determined_tpu.common.api import Session
+from determined_tpu.common.streams import StreamClient
+from tests.test_platform_e2e import (  # noqa: F401
+    Devcluster,
+    _create_experiment,
+    _experiment_config,
+    _wait_experiment,
+    native_binaries,
+)
+
+
+@pytest.fixture()
+def cluster(tmp_path, native_binaries):  # noqa: F811
+    c = Devcluster(str(tmp_path), native_binaries)
+    c.start_master()
+    c.start_agent()
+    yield c
+    c.stop()
+
+
+def test_stream_events_during_experiment(cluster, tmp_path):
+    token = cluster.login()
+    session = Session(cluster.master_url, token)
+    client = StreamClient(session)
+
+    events = []
+    stop = threading.Event()
+
+    def consume():
+        while not stop.is_set():
+            events.extend(client.poll(timeout_seconds=2))
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    eid, _ = _create_experiment(
+        cluster, _experiment_config(tmp_path), activate=True)
+    _wait_experiment(cluster, eid, token)
+    stop.set()
+    t.join(timeout=10)
+
+    entities = {e["entity"] for e in events}
+    assert {"experiments", "trials", "metrics", "checkpoints"} <= entities, (
+        entities)
+    # ordered, gapless sequence numbers
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs)
+    assert len(set(seqs)) == len(seqs)
+    # lifecycle visible: ACTIVE before COMPLETED for our experiment
+    states = [e["payload"]["state"] for e in events
+              if e["entity"] == "experiments" and e["payload"]["id"] == eid]
+    assert "ACTIVE" in states and states[-1] == "COMPLETED", states
+    # trial completion observed
+    tstates = [e["payload"]["state"] for e in events
+               if e["entity"] == "trials"]
+    assert "COMPLETED" in tstates
+    assert not client.dropped
+
+
+def test_stream_entity_filter_and_since(cluster, tmp_path):
+    token = cluster.login()
+    session = Session(cluster.master_url, token)
+    eid, _ = _create_experiment(
+        cluster, _experiment_config(tmp_path), activate=True)
+    _wait_experiment(cluster, eid, token)
+
+    only_exp = StreamClient(session).poll(
+        entities=["experiments"], timeout_seconds=1)
+    assert only_exp and all(e["entity"] == "experiments" for e in only_exp)
+
+    # since-cursor: polling from the last seq returns nothing new
+    c2 = StreamClient(session)
+    first = c2.poll(timeout_seconds=1)
+    assert first
+    again = c2.poll(timeout_seconds=1)
+    assert again == []
