@@ -94,6 +94,47 @@ def test_gpt2_example(cluster, tmp_path):
     assert "COMPLETED" in r.stdout, r.stdout[-2000:]
 
 
+def test_mnist_adaptive_example(cluster, tmp_path):
+    """The shipped adaptive_asha config runs a real multi-trial search
+    (shrunk trial count/length)."""
+    import yaml
+
+    with open(os.path.join(EXAMPLES, "mnist", "adaptive.yaml")) as f:
+        cfg = yaml.safe_load(f)
+    cfg["checkpoint_storage"]["host_path"] = os.path.join(str(tmp_path), "ckpts")
+    cfg["searcher"].update(max_trials=4, max_length={"batches": 8})
+    cfg["hyperparameters"]["global_batch_size"] = 32
+    out = os.path.join(str(tmp_path), "adaptive.yaml")
+    with open(out, "w") as f:
+        yaml.safe_dump(cfg, f)
+    r = _cli(cluster, "experiment", "create", out,
+             os.path.join(EXAMPLES, "mnist"), "--follow", timeout=900)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "COMPLETED" in r.stdout, r.stdout[-2000:]
+    token = cluster.login()
+    trials = cluster.api("GET", "/api/v1/experiments/1/trials",
+                         token=token)["trials"]
+    assert len(trials) == 4  # the search really ran multiple trials
+
+
+def test_hf_trainer_example(cluster, tmp_path):
+    """The shipped HF-Trainer DetCallback example, shrunk."""
+    import yaml
+
+    with open(os.path.join(EXAMPLES, "hf_trainer", "config.yaml")) as f:
+        cfg = yaml.safe_load(f)
+    cfg["checkpoint_storage"]["host_path"] = os.path.join(str(tmp_path), "ckpts")
+    cfg["searcher"]["max_length"] = {"batches": 4}
+    cfg["hyperparameters"].update(max_steps=4, eval_steps=4, seq_len=32)
+    out = os.path.join(str(tmp_path), "hf.yaml")
+    with open(out, "w") as f:
+        yaml.safe_dump(cfg, f)
+    r = _cli(cluster, "experiment", "create", out,
+             os.path.join(EXAMPLES, "hf_trainer"), "--follow", timeout=600)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "COMPLETED" in r.stdout, r.stdout[-2000:]
+
+
 def test_cifar10_keras_distributed_example(cluster, tmp_path):
     """The BASELINE CIFAR-10 KerasTrial workload, shrunk: DataParallel over
     the trial's 8-device CPU mesh through the full platform."""
