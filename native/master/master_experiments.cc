@@ -204,6 +204,7 @@ void Master::launch_checkpoint_gc_locked(ExperimentState& exp) {
     std::string uuid;
     int64_t trial_id = 0;
     int64_t steps = 0;
+    int64_t order = 0;  // report order: tie-break for "latest" at equal steps
     double metric = 0;
     bool has_metric = false;
   };
@@ -216,13 +217,16 @@ void Master::launch_checkpoint_gc_locked(ExperimentState& exp) {
       " AND m.group_name='validation' AND m.total_batches=c.steps_completed "
       " ORDER BY m.id DESC LIMIT 1) AS vmetrics "
       "FROM checkpoints c JOIN trials t ON c.trial_id = t.id "
-      "WHERE t.experiment_id=? AND c.state='COMPLETED'",
+      "WHERE t.experiment_id=? AND c.state='COMPLETED' "
+      "ORDER BY c.report_time, c.rowid",
       {Json(exp.id)});
+  int64_t order = 0;
   for (auto& row : rows) {
     Ck ck;
     ck.uuid = row["uuid"].as_string();
     ck.trial_id = row["trial_id"].as_int();
     ck.steps = row["steps_completed"].as_int();
+    ck.order = order++;
     if (row["vmetrics"].is_string() && !metric_name.empty()) {
       Json m = Json::parse_or_null(row["vmetrics"].as_string());
       if (m[metric_name].is_number()) {
@@ -239,9 +243,13 @@ void Master::launch_checkpoint_gc_locked(ExperimentState& exp) {
   std::map<int64_t, std::vector<const Ck*>> by_trial;
   for (const auto& ck : cks) by_trial[ck.trial_id].push_back(&ck);
   for (auto& [tid, list] : by_trial) {
-    // latest k by steps
-    std::sort(list.begin(), list.end(),
-              [](const Ck* a, const Ck* b) { return a->steps > b->steps; });
+    // latest k by steps, most-recently-reported first on ties — the
+    // trial's latest_checkpoint (its resume pointer) must never be the
+    // one deleted.
+    std::sort(list.begin(), list.end(), [](const Ck* a, const Ck* b) {
+      if (a->steps != b->steps) return a->steps > b->steps;
+      return a->order > b->order;
+    });
     for (int64_t i = 0; i < keep_trial_latest &&
                         i < static_cast<int64_t>(list.size()); ++i) {
       keep.insert(list[i]->uuid);
@@ -268,6 +276,16 @@ void Master::launch_checkpoint_gc_locked(ExperimentState& exp) {
                         i < static_cast<int64_t>(all.size()); ++i) {
       keep.insert(all[i]->uuid);
     }
+  }
+  // Enforce the resume-pointer invariant directly: whatever retention
+  // decides, a trial's latest_checkpoint (the uuid restarts resume from)
+  // is never deleted — the tie-break above is a nicety, this is the law.
+  {
+    auto lrows = db_.query(
+        "SELECT latest_checkpoint FROM trials WHERE experiment_id=? AND "
+        "latest_checkpoint IS NOT NULL AND latest_checkpoint <> ''",
+        {Json(exp.id)});
+    for (auto& row : lrows) keep.insert(row["latest_checkpoint"].as_string());
   }
   Json doomed = Json::array();
   for (const auto& ck : cks) {

@@ -52,13 +52,16 @@ def _cli(cluster, *args, timeout=300):
     )
 
 
-def _patch_storage(tmp_path, config_path):
-    """Point the example's checkpoint_storage at the test tmpdir."""
+def _patch_storage(tmp_path, config_path, mutate=None):
+    """Point the example's checkpoint_storage at the test tmpdir; `mutate`
+    may shrink the config further (test-size lengths/models)."""
     import yaml
 
     with open(config_path) as f:
         cfg = yaml.safe_load(f)
     cfg["checkpoint_storage"]["host_path"] = os.path.join(str(tmp_path), "ckpts")
+    if mutate is not None:
+        mutate(cfg)
     out = os.path.join(str(tmp_path), os.path.basename(config_path))
     with open(out, "w") as f:
         yaml.safe_dump(cfg, f)
@@ -97,16 +100,12 @@ def test_gpt2_example(cluster, tmp_path):
 def test_mnist_adaptive_example(cluster, tmp_path):
     """The shipped adaptive_asha config runs a real multi-trial search
     (shrunk trial count/length)."""
-    import yaml
+    def shrink(cfg):
+        cfg["searcher"].update(max_trials=4, max_length={"batches": 8})
+        cfg["hyperparameters"]["global_batch_size"] = 32
 
-    with open(os.path.join(EXAMPLES, "mnist", "adaptive.yaml")) as f:
-        cfg = yaml.safe_load(f)
-    cfg["checkpoint_storage"]["host_path"] = os.path.join(str(tmp_path), "ckpts")
-    cfg["searcher"].update(max_trials=4, max_length={"batches": 8})
-    cfg["hyperparameters"]["global_batch_size"] = 32
-    out = os.path.join(str(tmp_path), "adaptive.yaml")
-    with open(out, "w") as f:
-        yaml.safe_dump(cfg, f)
+    out = _patch_storage(
+        tmp_path, os.path.join(EXAMPLES, "mnist", "adaptive.yaml"), shrink)
     r = _cli(cluster, "experiment", "create", out,
              os.path.join(EXAMPLES, "mnist"), "--follow", timeout=900)
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
@@ -119,16 +118,12 @@ def test_mnist_adaptive_example(cluster, tmp_path):
 
 def test_hf_trainer_example(cluster, tmp_path):
     """The shipped HF-Trainer DetCallback example, shrunk."""
-    import yaml
+    def shrink(cfg):
+        cfg["searcher"]["max_length"] = {"batches": 4}
+        cfg["hyperparameters"].update(max_steps=4, eval_steps=4, seq_len=32)
 
-    with open(os.path.join(EXAMPLES, "hf_trainer", "config.yaml")) as f:
-        cfg = yaml.safe_load(f)
-    cfg["checkpoint_storage"]["host_path"] = os.path.join(str(tmp_path), "ckpts")
-    cfg["searcher"]["max_length"] = {"batches": 4}
-    cfg["hyperparameters"].update(max_steps=4, eval_steps=4, seq_len=32)
-    out = os.path.join(str(tmp_path), "hf.yaml")
-    with open(out, "w") as f:
-        yaml.safe_dump(cfg, f)
+    out = _patch_storage(
+        tmp_path, os.path.join(EXAMPLES, "hf_trainer", "config.yaml"), shrink)
     r = _cli(cluster, "experiment", "create", out,
              os.path.join(EXAMPLES, "hf_trainer"), "--follow", timeout=600)
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
