@@ -37,7 +37,7 @@ def _load_config_file(path: str) -> Dict[str, Any]:
     return yaml.safe_load(text)
 
 
-def _login(master: str, user: str) -> Session:
+def _login(master: str, user: str, password: Optional[str] = None) -> Session:
     """Session with token cache (reference: authentication.login_with_cache)."""
     cache: Dict[str, str] = {}
     try:
@@ -53,8 +53,13 @@ def _login(master: str, user: str) -> Session:
             return session
         except APIError:
             pass
+    if password is None:
+        password = os.environ.get("DET_PASSWORD", "")
+    from determined_tpu.common.api import salted_hash
+
     resp = Session(master).post(
-        "/api/v1/auth/login", body={"username": user, "password": ""}
+        "/api/v1/auth/login",
+        body={"username": user, "password": salted_hash(user, password)},
     )
     token = resp["token"]
     cache[key] = token
@@ -375,13 +380,107 @@ def cmd_job_list(session: Session, args) -> int:
 
 def cmd_user_list(session: Session, args) -> int:
     users = session.get("/api/v1/users")["users"]
-    _print_table(users, ["id", "username", "admin", "active"])
+    _print_table(users, ["id", "username", "role", "active"])
     return 0
 
 
 def cmd_user_create(session: Session, args) -> int:
-    session.post("/api/v1/users", body={"username": args.username})
-    print(f"created user {args.username}")
+    from determined_tpu.common.api import salted_hash
+
+    role = "admin" if getattr(args, "admin", False) else args.role
+    session.post(
+        "/api/v1/users",
+        body={"username": args.username, "role": role,
+              "password": salted_hash(args.username, args.password or "")},
+    )
+    print(f"created user {args.username} (role {role})")
+    return 0
+
+
+def _user_id_by_name(session: Session, name_or_id: str) -> int:
+    if name_or_id.isdigit():
+        return int(name_or_id)
+    for u in session.get("/api/v1/users")["users"]:
+        if u["username"] == name_or_id:
+            return u["id"]
+    raise SystemExit(f"no such user: {name_or_id}")
+
+
+def cmd_user_patch(session: Session, args) -> int:
+    uid = _user_id_by_name(session, args.target_user)
+    body: Dict[str, Any] = {}
+    if args.action == "activate":
+        body["active"] = True
+    elif args.action == "deactivate":
+        body["active"] = False
+    elif args.action == "change-role":
+        body["role"] = args.role
+    elif args.action == "change-password":
+        from determined_tpu.common.api import salted_hash
+
+        body["password"] = salted_hash(args.target_user, args.password)
+    session.patch(f"/api/v1/users/{uid}", body=body)
+    print(f"{args.action} user {args.target_user}")
+    return 0
+
+
+def cmd_user_whoami(session: Session, args) -> int:
+    me = session.get("/api/v1/me")["user"]
+    print(f"{me['username']} (id {me['id']}, role {me.get('role', 'user')})")
+    return 0
+
+
+def cmd_rbac(session: Session, args) -> int:
+    if args.action == "list":
+        params = {}
+        if getattr(args, "workspace_id", None) is not None:
+            params["workspace_id"] = args.workspace_id
+        rows = session.get("/api/v1/rbac/assignments", params=params)["assignments"]
+        _print_table(rows, ["id", "role", "username", "group_name", "workspace_id"])
+        return 0
+    if args.action == "unassign":
+        session.delete(f"/api/v1/rbac/assignments/{args.id}")
+        print(f"removed assignment {args.id}")
+        return 0
+    body: Dict[str, Any] = {"role": args.role}
+    if args.target_user:
+        body["user_id"] = _user_id_by_name(session, args.target_user)
+    if args.group_id is not None:
+        body["group_id"] = args.group_id
+    if args.workspace_id is not None:
+        body["workspace_id"] = args.workspace_id
+    resp = session.post("/api/v1/rbac/assignments", body=body)
+    print(f"assigned {args.role} (assignment {resp['id']})")
+    return 0
+
+
+def cmd_group(session: Session, args) -> int:
+    if args.action == "list":
+        groups = session.get("/api/v1/groups")["groups"]
+        rows = [
+            {"id": g["id"], "name": g["name"],
+             "members": ",".join(m["username"] for m in g["members"])}
+            for g in groups
+        ]
+        _print_table(rows, ["id", "name", "members"])
+    elif args.action == "create":
+        resp = session.post("/api/v1/groups", body={"name": args.name})
+        print(f"created group {args.name} (id {resp['id']})")
+    elif args.action == "add-member":
+        uid = _user_id_by_name(session, args.target_user)
+        session.post(f"/api/v1/groups/{args.group_id}/members",
+                     body={"user_id": uid})
+        print(f"added {args.target_user} to group {args.group_id}")
+    elif args.action == "remove-member":
+        uid = _user_id_by_name(session, args.target_user)
+        session.delete(f"/api/v1/groups/{args.group_id}/members/{uid}")
+        print(f"removed {args.target_user} from group {args.group_id}")
+    return 0
+
+
+def cmd_agent_admin(session: Session, args) -> int:
+    session.post(f"/api/v1/agents/{args.agent_id}/{args.action}")
+    print(f"{args.action}d agent {args.agent_id}")
     return 0
 
 
@@ -530,15 +629,60 @@ def build_parser() -> argparse.ArgumentParser:
 
     a = sub.add_parser("agent").add_subparsers(dest="subcommand", required=True)
     a.add_parser("list").set_defaults(func=cmd_agent_list)
+    for action in ("enable", "disable"):
+        av = a.add_parser(action)
+        av.add_argument("agent_id")
+        av.set_defaults(func=cmd_agent_admin, action=action)
 
     j = sub.add_parser("job").add_subparsers(dest="subcommand", required=True)
     j.add_parser("list").set_defaults(func=cmd_job_list)
 
     u = sub.add_parser("user").add_subparsers(dest="subcommand", required=True)
     u.add_parser("list").set_defaults(func=cmd_user_list)
+    u.add_parser("whoami").set_defaults(func=cmd_user_whoami)
     uc = u.add_parser("create")
     uc.add_argument("username")
+    uc.add_argument("--role", choices=["admin", "user", "viewer"], default="user")
+    uc.add_argument("--admin", action="store_true")
+    uc.add_argument("--password", default="")
     uc.set_defaults(func=cmd_user_create)
+    for action in ("activate", "deactivate"):
+        ua = u.add_parser(action)
+        ua.add_argument("target_user", metavar="user")
+        ua.set_defaults(func=cmd_user_patch, action=action)
+    ur = u.add_parser("change-role")
+    ur.add_argument("target_user", metavar="user")
+    ur.add_argument("role", choices=["admin", "user", "viewer"])
+    ur.set_defaults(func=cmd_user_patch, action="change-role")
+    up2 = u.add_parser("change-password")
+    up2.add_argument("target_user", metavar="user")
+    up2.add_argument("password")
+    up2.set_defaults(func=cmd_user_patch, action="change-password")
+
+    rb = sub.add_parser("rbac").add_subparsers(dest="subcommand", required=True)
+    rl = rb.add_parser("list")
+    rl.add_argument("--workspace-id", type=int, default=None)
+    rl.set_defaults(func=cmd_rbac, action="list")
+    ra = rb.add_parser("assign")
+    ra.add_argument("role", choices=["viewer", "editor", "admin"])
+    ra.add_argument("--user", dest="target_user", default=None)
+    ra.add_argument("--group-id", type=int, default=None)
+    ra.add_argument("--workspace-id", type=int, default=None)
+    ra.set_defaults(func=cmd_rbac, action="assign")
+    ru = rb.add_parser("unassign")
+    ru.add_argument("id", type=int)
+    ru.set_defaults(func=cmd_rbac, action="unassign")
+
+    gr = sub.add_parser("group").add_subparsers(dest="subcommand", required=True)
+    gr.add_parser("list").set_defaults(func=cmd_group, action="list")
+    gc = gr.add_parser("create")
+    gc.add_argument("name")
+    gc.set_defaults(func=cmd_group, action="create")
+    for action in ("add-member", "remove-member"):
+        ga = gr.add_parser(action)
+        ga.add_argument("group_id", type=int)
+        ga.add_argument("target_user", metavar="user")
+        ga.set_defaults(func=cmd_group, action=action)
 
     ws = sub.add_parser("workspace").add_subparsers(dest="subcommand", required=True)
     ws.add_parser("list").set_defaults(func=cmd_workspace, action="list")

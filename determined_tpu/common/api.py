@@ -7,6 +7,7 @@ endpoints a trial container actually uses (SURVEY.md Appendix A).
 
 from __future__ import annotations
 
+import hashlib
 import json
 import socket
 import time
@@ -14,6 +15,20 @@ import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Any, Dict, Optional
+
+
+def salted_hash(username: str, password: str) -> str:
+    """Client-side salted password hash.
+
+    The master stores and compares this opaque string verbatim (reference:
+    the CLI sends the already-salted hash, common/api/authentication.py) —
+    raw passwords never reach the wire or the DB. Empty password maps to
+    empty string (the bootstrap-user posture).
+    """
+    if not password:
+        return ""
+    salted = f"determined-tpu${username}${password}".encode()
+    return hashlib.sha256(salted).hexdigest()
 
 
 class APIError(Exception):
@@ -44,7 +59,8 @@ class Session:
               password: str = "") -> "Session":
         s = cls(master_url)
         resp = s.post("/api/v1/auth/login",
-                      body={"username": user, "password": password})
+                      body={"username": user,
+                            "password": salted_hash(user, password)})
         s.token = resp["token"]
         return s
 

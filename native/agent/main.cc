@@ -26,6 +26,7 @@
 #include <condition_variable>
 #include <cstring>
 #include <deque>
+#include <fstream>
 #include <iostream>
 #include <map>
 #include <mutex>
@@ -48,6 +49,9 @@ struct AgentOptions {
   std::string resource_pool = "default";
   std::string addr;  // host address peers can reach (rendezvous)
   std::string work_root = "/tmp/determined-agent";
+  // Path to the master-minted bootstrap token (<db>.agent_token). The
+  // service account is token-only; there is no password fallback.
+  std::string token_file;
   int slots_override = -1;  // DET_AGENT_SLOTS / --slots ("artificial")
   std::string slot_type = "auto";
   double poll_timeout_s = 20.0;
@@ -66,8 +70,9 @@ std::map<std::string, std::shared_ptr<Task>> g_tasks;  // by container_id
 
 // ---- master session -----------------------------------------------------
 // All master routes require a Bearer token; the agent logs in at startup
-// (username "determined", or a pre-issued DET_AGENT_TOKEN) and re-logins
-// transparently on 401 (e.g. after a master restart wiped sessions).
+// (service account "determined-agent", or a pre-issued DET_AGENT_TOKEN) and
+// re-logins transparently on 401 (e.g. after a master restart wiped
+// sessions).
 
 std::mutex g_token_mu;
 std::string g_token;
@@ -78,9 +83,16 @@ std::map<std::string, std::string> auth_headers() {
   return {{"Authorization", "Bearer " + g_token}};
 }
 
+std::string g_token_file;  // set from options at startup
+
 bool agent_login(const std::string& master_url, bool use_env_token = true) {
-  // use_env_token=false on the 401-recovery path: re-installing a dead
-  // pre-issued token would brick the agent after a master DB wipe.
+  // The service account is token-only: DET_AGENT_TOKEN env, or the
+  // master-minted token file (<db>.agent_token, shared via the node's
+  // provisioning / deploy tooling). On the 401-recovery path
+  // (use_env_token=false, e.g. after a master DB wipe) the token FILE is
+  // re-read — the master rewrites it at boot — while a stale env token is
+  // not re-installed.
+  (void)master_url;
   if (use_env_token) {
     if (const char* t = getenv("DET_AGENT_TOKEN")) {
       std::lock_guard<std::mutex> lock(g_token_mu);
@@ -88,20 +100,17 @@ bool agent_login(const std::string& master_url, bool use_env_token = true) {
       return true;
     }
   }
-  Json body = Json::object();
-  body["username"] = "determined";
-  body["password"] = "";
-  try {
-    auto r = det::http_request("POST", master_url, "/api/v1/auth/login",
-                               body.dump(), 10.0);
-    if (!r.ok()) return false;
-    Json doc = Json::parse_or_null(r.body);
-    std::lock_guard<std::mutex> lock(g_token_mu);
-    g_token = doc["token"].as_string();
-    return !g_token.empty();
-  } catch (const std::exception&) {
-    return false;
+  if (!g_token_file.empty()) {
+    std::ifstream f(g_token_file);
+    std::string tok;
+    if (f && std::getline(f, tok) && !tok.empty()) {
+      std::lock_guard<std::mutex> lock(g_token_mu);
+      if (g_token == tok && !use_env_token) return false;  // already stale
+      g_token = tok;
+      return true;
+    }
   }
+  return false;
 }
 
 HttpClientResponse master_call(const std::string& master_url,
@@ -367,7 +376,17 @@ bool register_with_master(const AgentOptions& opts, bool reconnect) {
   try {
     auto r = master_call(opts.master_url, "POST",
                          "/api/v1/agents/register", body.dump(), 10.0);
-    if (!r.ok()) return false;
+    if (!r.ok()) {
+      // 401/403 means a credential problem, not a down master — say so,
+      // or an unprovisioned agent spins forever with zero diagnostics.
+      std::cerr << "agent: register failed (HTTP " << r.status << ")";
+      if (r.status == 401 || r.status == 403) {
+        std::cerr << " — agent token missing/invalid; set DET_AGENT_TOKEN "
+                     "or --token-file to the master's <db>.agent_token";
+      }
+      std::cerr << std::endl;
+      return false;
+    }
     Json resp = Json::parse_or_null(r.body);
     // Kill anything the master no longer recognizes (reattach reconcile).
     std::vector<std::string> keep;
@@ -432,6 +451,7 @@ int main(int argc, char** argv) {
   if (const char* p = getenv("DET_AGENT_SLOTS")) {
     opts.slots_override = atoi(p);
   }
+  if (const char* p = getenv("DET_AGENT_TOKEN_FILE")) opts.token_file = p;
 
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
@@ -445,18 +465,25 @@ int main(int argc, char** argv) {
     else if (a == "--slots") opts.slots_override = atoi(next().c_str());
     else if (a == "--slot-type") opts.slot_type = next();
     else if (a == "--work-root") opts.work_root = next();
+    else if (a == "--token-file") opts.token_file = next();
     else if (a == "--help" || a == "-h") {
       std::cout << "determined-agent --master-url URL [--id ID] "
                    "[--resource-pool P] [--addr A] [--slots N] "
-                   "[--slot-type tpu|cpu] [--work-root DIR]\n";
+                   "[--slot-type tpu|cpu] [--work-root DIR] "
+                   "[--token-file PATH]\n";
       return 0;
     }
   }
+  g_token_file = opts.token_file;
 
   signal(SIGPIPE, SIG_IGN);
 
-  // Register (retry until master is up).
+  // Install the bootstrap credential (env first, then token file), then
+  // register (retry until master is up — the file may not exist until the
+  // master has booted and minted it).
+  agent_login(opts.master_url, /*use_env_token=*/true);
   while (!register_with_master(opts, false)) {
+    agent_login(opts.master_url, /*use_env_token=*/true);
     std::this_thread::sleep_for(std::chrono::seconds(2));
   }
   std::cout << "agent " << opts.id << " registered with " << opts.master_url

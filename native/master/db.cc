@@ -342,6 +342,39 @@ CREATE INDEX idx_task_logs_time ON task_logs(timestamp);
       {10, R"sql(
 ALTER TABLE tasks ADD COLUMN parent_id TEXT;
 )sql"},
+      // RBAC (reference master/internal/rbac/rbac.go, usergroup/): lean
+      // role model — base role per user (admin|user|viewer) plus
+      // workspace-scoped grants to users or groups. role_assignments with
+      // workspace_id NULL are global-scope grants.
+      {11, R"sql(
+ALTER TABLE users ADD COLUMN role TEXT NOT NULL DEFAULT 'user';
+UPDATE users SET role='admin' WHERE admin=1;
+CREATE TABLE user_groups (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  name TEXT NOT NULL UNIQUE,
+  created_at TEXT NOT NULL DEFAULT (datetime('now'))
+);
+CREATE TABLE user_group_members (
+  group_id INTEGER NOT NULL REFERENCES user_groups(id),
+  user_id INTEGER NOT NULL REFERENCES users(id),
+  PRIMARY KEY (group_id, user_id)
+);
+CREATE TABLE role_assignments (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  role TEXT NOT NULL,
+  user_id INTEGER REFERENCES users(id),
+  group_id INTEGER REFERENCES user_groups(id),
+  workspace_id INTEGER REFERENCES workspaces(id),
+  created_at TEXT NOT NULL DEFAULT (datetime('now'))
+);
+CREATE INDEX idx_role_assignments_user ON role_assignments(user_id);
+CREATE INDEX idx_role_assignments_group ON role_assignments(group_id);
+)sql"},
+      // Tasks carry the workspace they were launched in so authz on
+      // kill/log routes can use the real scope instead of a default.
+      {12, R"sql(
+ALTER TABLE tasks ADD COLUMN workspace_id INTEGER NOT NULL DEFAULT 1;
+)sql"},
   };
   return kMigrations;
 }

@@ -4,9 +4,15 @@
 
 #include "master.h"
 
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cerrno>
 #include <chrono>
 #include <cstring>
 #include <fstream>
+#include <iostream>
 #include <random>
 #include <sstream>
 #include <thread>
@@ -94,13 +100,81 @@ MasterConfig MasterConfig::from_json(const Json& j) {
 
 Master::Master(MasterConfig cfg) : cfg_(std::move(cfg)), db_(cfg_.db_path) {
   db_.migrate();
-  // Default users, as in the reference bootstrap (user "determined" and
-  // "admin" with empty passwords).
-  for (const char* name : {"determined", "admin"}) {
-    auto rows = db_.query("SELECT id FROM users WHERE username=?", {Json(name)});
+  // Default users, as in the reference bootstrap — plus the agent service
+  // account: node daemons authenticate as "determined-agent" (role
+  // "agent"), the only role allowed on the agent-protocol routes. Those
+  // routes hand out task environments including per-owner session tokens,
+  // so an ordinary user must NOT be able to register a fake agent.
+  struct BootUser { const char* name; const char* role; };
+  for (BootUser u : {BootUser{"determined", "user"},
+                     BootUser{"admin", "admin"},
+                     BootUser{"determined-agent", "agent"}}) {
+    auto rows =
+        db_.query("SELECT id FROM users WHERE username=?", {Json(u.name)});
     if (rows.empty()) {
-      db_.exec("INSERT INTO users (username, admin) VALUES (?, ?)",
-               {Json(name), Json(std::string(name) == "admin" ? 1 : 0)});
+      db_.exec("INSERT INTO users (username, admin, role) VALUES (?, ?, ?)",
+               {Json(u.name), Json(std::string(u.role) == "admin" ? 1 : 0),
+                Json(u.role)});
+    } else {
+      // Upgrades: ensure the service account's role is correct.
+      if (std::string(u.name) == "determined-agent") {
+        db_.exec("UPDATE users SET role='agent' WHERE username=?",
+                 {Json(u.name)});
+      }
+    }
+  }
+  // Agent bootstrap credential: the service account is TOKEN-ONLY (no
+  // password login — see handle_login). Mint one persistent session and
+  // write it to <db>.agent_token (0600) for node daemons / deploy tooling
+  // to pick up (DET_AGENT_TOKEN / --token-file). Persisted in the DB, so
+  // it survives master restarts; a fresh DB mints a fresh secret.
+  {
+    auto rows = db_.query(
+        "SELECT s.token FROM user_sessions s JOIN users u ON u.id=s.user_id "
+        "WHERE u.username='determined-agent' AND s.expires_at IS NULL "
+        "ORDER BY s.id LIMIT 1");
+    std::string token;
+    if (rows.empty()) {
+      token = random_hex(24);
+      auto urows = db_.query(
+          "SELECT id FROM users WHERE username='determined-agent'");
+      db_.exec(
+          "INSERT INTO user_sessions (user_id, token, expires_at) "
+          "VALUES (?, ?, NULL)",
+          {urows[0]["id"], Json(token)});
+    } else {
+      token = rows[0]["token"].as_string();
+    }
+    agent_token_ = token;
+    // 0600 from birth (no umask window where another local user could
+    // read the secret), and loudly report write failures — an unwritable
+    // token file would strand every agent with no diagnostic.
+    std::string path = cfg_.db_path + ".agent_token";
+    int fd = open(path.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0600);
+    bool ok = fd >= 0;
+    if (ok) {
+      std::string line = token + "\n";
+      ok = write(fd, line.data(), line.size()) ==
+           static_cast<ssize_t>(line.size());
+      close(fd);
+    }
+    if (!ok) {
+      std::cerr << "master: FAILED to write agent token file " << path
+                << ": " << strerror(errno)
+                << " — agents cannot authenticate" << std::endl;
+    }
+  }
+  // Reference-parity default posture: bootstrap users have no password
+  // until an admin sets one. Make the exposure explicit in the logs.
+  {
+    auto blank = db_.query(
+        "SELECT username FROM users WHERE password_hash='' AND "
+        "role IN ('admin','user') AND active=1");
+    for (auto& row : blank) {
+      std::cerr << "master: WARNING user '" << row["username"].as_string()
+                << "' has no password — set one with `det user "
+                   "change-password` before exposing this master"
+                << std::endl;
     }
   }
   restore_experiments();
@@ -216,6 +290,10 @@ HttpResponse Master::route(const HttpRequest& req) {
     if (root == "master" && rest.size() == 2 && rest[1] == "cleanup_logs" &&
         req.method == "POST") {
       // Manual log-retention sweep (reference internal/logretention/).
+      // Destroys data cluster-wide → admin only.
+      if (!auth_ctx(req).admin) {
+        return json_resp(403, err_body("admin role required"));
+      }
       Json body = req.body.empty() ? Json::object() : Json::parse(req.body);
       int days = static_cast<int>(body["days"].as_int(cfg_.log_retention_days));
       if (days <= 0) return json_resp(400, err_body("days must be > 0"));
@@ -225,6 +303,8 @@ HttpResponse Master::route(const HttpRequest& req) {
     }
     if (root == "stream" && req.method == "GET") return handle_stream(req);
     if (root == "users" || root == "me") return handle_users(req);
+    if (root == "groups") return handle_groups(req, rest);
+    if (root == "rbac") return handle_rbac(req, rest);
     if (root == "agents") return handle_agents_api(req, rest);
     if (root == "experiments") return handle_experiments(req, rest);
     if (root == "trials") return handle_trials(req, rest);
@@ -261,10 +341,17 @@ HttpResponse Master::handle_login(const HttpRequest& req) {
     Json body = Json::parse_or_null(req.body);
     std::string username = body["username"].as_string("determined");
     auto rows = db_.query(
-        "SELECT id, password_hash, active FROM users WHERE username=?",
+        "SELECT id, password_hash, active, role, admin FROM users "
+        "WHERE username=?",
         {Json(username)});
     if (rows.empty() || rows[0]["active"].as_int() == 0) {
       return json_resp(403, err_body("invalid credentials"));
+    }
+    if (rows[0]["role"].as_string() == "agent") {
+      // Agent service accounts are token-only (bootstrap token minted at
+      // startup into <db>.agent_token) — a passwordless privileged login
+      // would let anyone register a fake agent and harvest task tokens.
+      return json_resp(403, err_body("agent accounts are token-only"));
     }
     // Empty-password default users; hashed passwords compared verbatim
     // (the CLI sends the already-salted hash, as the reference does).
@@ -282,6 +369,8 @@ HttpResponse Master::handle_login(const HttpRequest& req) {
     Json user = Json::object();
     user["username"] = username;
     user["id"] = rows[0]["id"];
+    user["role"] = rows[0]["role"];
+    user["admin"] = rows[0]["admin"].as_int() != 0;
     out["user"] = user;
     return json_resp(200, out);
   }
@@ -310,38 +399,106 @@ int64_t Master::auth_user(const HttpRequest& req) {
 
 HttpResponse Master::handle_users(const HttpRequest& req) {
   auto parts = split_path(req.path);
+  AuthCtx ctx = auth_ctx(req);
+  if (!ctx.ok()) return json_resp(401, err_body("unauthenticated"));
   if (parts[2] == "me") {
-    std::lock_guard<std::mutex> lock(mu_);
-    int64_t uid = auth_user(req);
-    if (uid < 0) return json_resp(401, err_body("unauthenticated"));
-    auto rows = db_.query("SELECT id, username, admin FROM users WHERE id=?",
-                          {Json(uid)});
+    auto rows = db_.query(
+        "SELECT id, username, admin, role FROM users WHERE id=?",
+        {Json(ctx.uid)});
     Json out = Json::object();
     out["user"] = Json(JsonObject{{"id", rows[0]["id"]},
                                   {"username", rows[0]["username"]},
-                                  {"admin", rows[0]["admin"]}});
+                                  {"admin", rows[0]["admin"].as_int() != 0},
+                                  {"role", rows[0]["role"]}});
     return json_resp(200, out);
   }
+  // GET /api/v1/users[/{id}]
   if (req.method == "GET") {
+    if (parts.size() >= 4) {
+      auto rows = db_.query(
+          "SELECT id, username, admin, role, active, created_at FROM users "
+          "WHERE id=?",
+          {Json(to_id(parts[3]))});
+      if (rows.empty()) return json_resp(404, err_body("no such user"));
+      Json out = Json::object();
+      out["user"] = Json(JsonObject(rows[0].begin(), rows[0].end()));
+      return json_resp(200, out);
+    }
     Json users = Json::array();
     for (auto& row : db_.query(
-             "SELECT id, username, admin, active, created_at FROM users")) {
+             "SELECT id, username, admin, role, active, created_at "
+             "FROM users")) {
       users.push_back(Json(JsonObject(row.begin(), row.end())));
     }
     Json out = Json::object();
     out["users"] = users;
     return json_resp(200, out);
   }
-  if (req.method == "POST") {
+  // POST /api/v1/users — create. Admin only (reference: user management is
+  // a permission, api_user.go; the "any user can mint admins" hole was
+  // round 3's biggest authz bug).
+  if (req.method == "POST" && parts.size() == 3) {
+    if (!ctx.admin) return json_resp(403, err_body("admin role required"));
     Json body = Json::parse_or_null(req.body);
     const std::string& name = body["username"].as_string();
     if (name.empty()) return json_resp(400, err_body("username required"));
+    std::string role = body["role"].as_string(
+        body["admin"].as_bool() ? "admin" : "user");
+    if (role != "admin" && role != "user" && role != "viewer" &&
+        role != "agent") {
+      return json_resp(400, err_body("role must be admin|user|viewer|agent"));
+    }
     db_.exec(
-        "INSERT INTO users (username, password_hash, admin) VALUES (?, ?, ?)",
-        {Json(name), body["password"], Json(body["admin"].as_bool() ? 1 : 0)});
+        "INSERT INTO users (username, password_hash, admin, role) "
+        "VALUES (?, ?, ?, ?)",
+        {Json(name), body["password"], Json(role == "admin" ? 1 : 0),
+         Json(role)});
     Json out = Json::object();
     out["id"] = db_.last_insert_id();
     return json_resp(200, out);
+  }
+  // PATCH /api/v1/users/{id} {active?, role?, password?, display_name?}.
+  // Admins patch anyone; users may change their own password/display_name.
+  if (req.method == "PATCH" && parts.size() >= 4) {
+    int64_t target = to_id(parts[3]);
+    auto rows = db_.query("SELECT id FROM users WHERE id=?", {Json(target)});
+    if (rows.empty()) return json_resp(404, err_body("no such user"));
+    Json body = Json::parse_or_null(req.body);
+    bool self = target == ctx.uid;
+    bool wants_privileged = body["active"].is_bool() ||
+                            body["role"].is_string() ||
+                            body["admin"].is_bool();
+    if (!ctx.admin && (!self || wants_privileged)) {
+      return json_resp(403, err_body("admin role required"));
+    }
+    if (body["role"].is_string() || body["admin"].is_bool()) {
+      std::string role = body["role"].as_string(
+          body["admin"].as_bool() ? "admin" : "user");
+      if (role != "admin" && role != "user" && role != "viewer" &&
+          role != "agent") {
+        return json_resp(400,
+                         err_body("role must be admin|user|viewer|agent"));
+      }
+      db_.exec("UPDATE users SET role=?, admin=? WHERE id=?",
+               {Json(role), Json(role == "admin" ? 1 : 0), Json(target)});
+    }
+    if (body["active"].is_bool()) {
+      db_.exec("UPDATE users SET active=? WHERE id=?",
+               {Json(body["active"].as_bool() ? 1 : 0), Json(target)});
+      if (!body["active"].as_bool()) {
+        // Deactivation revokes sessions immediately.
+        db_.exec("DELETE FROM user_sessions WHERE user_id=?", {Json(target)});
+      }
+    }
+    if (body["password"].is_string()) {
+      db_.exec("UPDATE users SET password_hash=? WHERE id=?",
+               {body["password"], Json(target)});
+    }
+    if (body["display_name"].is_string()) {
+      db_.exec("UPDATE users SET display_name=? WHERE id=?",
+               {body["display_name"], Json(target)});
+    }
+    return json_resp(200, Json::object());
   }
   return not_found();
 }

@@ -40,6 +40,17 @@ namespace det {
 // Shared helpers (defined in master.cc).
 std::string random_hex(size_t nbytes);
 
+// Resolved per-request identity + authorization context (reference
+// master/internal/rbac/rbac.go + user/): base role ladder
+// viewer < user < admin, refined per workspace by role_assignments.
+struct AuthCtx {
+  int64_t uid = -1;      // -1 = unauthenticated
+  std::string username;
+  std::string role;      // base role: "admin" | "user" | "viewer"
+  bool admin = false;    // base role == admin
+  bool ok() const { return uid >= 0; }
+};
+
 struct MasterConfig {
   std::string host = "0.0.0.0";
   int port = 8080;
@@ -109,6 +120,10 @@ struct Allocation {
   std::map<int64_t, Json> allgather;
   int64_t allgather_round = 0;
   std::map<int64_t, std::string> proxy_addresses;
+  // Owner of the work this allocation runs; task containers get a session
+  // token pre-issued for this user (reference tasks/task.go:194-234 —
+  // containers act as the submitting user, not a service account).
+  int64_t owner_id = 1;
   // NTSC (generic-task) fields: extra env (includes DET_ENTRYPOINT) and an
   // idle-kill deadline (reference task/idle/watcher.go).
   JsonObject extra_env;
@@ -150,6 +165,9 @@ struct LogPolicy {
 
 struct ExperimentState {
   int64_t id = 0;
+  int64_t owner_id = 1;
+  int64_t project_id = 1;
+  int64_t workspace_id = 1;  // workspace of project_id (authz scope)
   Json config;
   std::string state = "ACTIVE";
   std::unique_ptr<Searcher> searcher;
@@ -252,9 +270,30 @@ class Master {
   TrialState* find_trial_locked(int64_t trial_id, ExperimentState** exp_out);
   int64_t auth_user(const HttpRequest& req);  // -1 if unauthenticated
 
+  // --- authorization (master_authz.cc; reference internal/rbac/,
+  // usergroup/, authz plumbing in api_experiment.go). All thread-safe
+  // without mu_ — they only touch the internally-locked Db.
+  AuthCtx auth_ctx(const HttpRequest& req);
+  // Strongest role the user holds on a workspace ("", "viewer", "editor",
+  // "admin") from base role + direct/group grants (global or ws-scoped).
+  std::string workspace_role(const AuthCtx& ctx, int64_t workspace_id);
+  bool can_create(const AuthCtx& ctx, int64_t workspace_id);
+  // owner_id < 0 = no owner recorded (legacy rows): ownership check falls
+  // through to role checks only.
+  bool can_edit(const AuthCtx& ctx, int64_t owner_id, int64_t workspace_id);
+  bool can_ws_admin(const AuthCtx& ctx, int64_t workspace_id);
+  // owner + workspace of an experiment (via its project); false if absent.
+  bool experiment_scope(int64_t eid, int64_t* owner_id, int64_t* workspace_id);
+  bool can_edit_experiment(const AuthCtx& ctx, int64_t eid);
+  HttpResponse handle_groups(const HttpRequest& req,
+                             const std::vector<std::string>& parts);
+  HttpResponse handle_rbac(const HttpRequest& req,
+                           const std::vector<std::string>& parts);
+
   MasterConfig cfg_;
   Db db_;
   HttpServer server_;
+  std::string agent_token_;  // bootstrap token for the agent service account
 
   // --- streaming updates (reference internal/stream/publisher.go) ---
   // In-memory ring of entity-change events served by the long-poll

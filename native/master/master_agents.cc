@@ -60,6 +60,24 @@ HttpResponse Master::handle_agents_api(const HttpRequest& req,
     return json_resp(200, out);
   }
 
+  // Agent-protocol routes (register / actions long-poll / heartbeat /
+  // allocation state) are restricted to the agent service account (role
+  // "agent") and admins: the actions stream hands out task environments
+  // including per-owner session tokens, so letting an ordinary user
+  // register a fake agent would be a privilege escalation. The reference
+  // isolates this surface on the master↔agent websocket (aproto).
+  // Prefix-matched (>=, not ==): an extra trailing path segment must not
+  // skip the gate while a later handler still prefix-matches the route.
+  AuthCtx ctx = auth_ctx(req);
+  bool agent_protocol =
+      (parts.size() >= 2 && parts[1] == "register") ||
+      (parts.size() >= 3 &&
+       (parts[2] == "actions" || parts[2] == "heartbeat" ||
+        parts[2] == "allocations"));
+  if (agent_protocol && ctx.role != "agent" && !ctx.admin) {
+    return json_resp(403, err_body("agent role required"));
+  }
+
   // POST /api/v1/agents/register
   if (parts.size() == 2 && parts[1] == "register" && req.method == "POST") {
     Json body = Json::parse_or_null(req.body);
@@ -107,6 +125,23 @@ HttpResponse Master::handle_agents_api(const HttpRequest& req,
 
   if (parts.size() < 3) return json_resp(404, err_body("not found"));
   const std::string& agent_id = parts[1];
+
+  // POST /api/v1/agents/{id}/enable|disable — admin drain control
+  // (reference api_agent.go EnableAgent/DisableAgent): disabled slots take
+  // no new allocations; running work finishes normally.
+  if (parts.size() == 3 && (parts[2] == "enable" || parts[2] == "disable") &&
+      req.method == "POST") {
+    if (!ctx.admin) {
+      return json_resp(403, err_body("admin role required"));
+    }
+    bool enable = parts[2] == "enable";
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = agents_.find(agent_id);
+    if (it == agents_.end()) return json_resp(404, err_body("unknown agent"));
+    for (auto& s : it->second.slots) s.enabled = enable;
+    cv_.notify_all();
+    return json_resp(200, Json::object());
+  }
 
   // GET /api/v1/agents/{id}/actions?timeout_seconds=N — long-poll drain.
   if (parts[2] == "actions" && req.method == "GET") {
@@ -476,13 +511,15 @@ bool Master::try_fit_locked(Allocation& alloc) {
     }
     // NTSC/generic-task env (DET_ENTRYPOINT, DET_TASK_TYPE overrides, …).
     for (const auto& [k, v] : alloc.extra_env) env[k] = v;
-    // Pre-issued session token (reference: containers get
-    // DET_SESSION_TOKEN, tasks/task.go:194-234).
+    // Pre-issued session token for the allocation's OWNER (reference:
+    // containers get DET_SESSION_TOKEN and act as the submitting user,
+    // tasks/task.go:194-234) — this is what lets the trial-route authz
+    // gate hold without special-casing containers.
     std::string token = random_hex(24);
     db_.exec(
         "INSERT INTO user_sessions (user_id, token, expires_at) "
-        "VALUES (1, ?, datetime('now', '+7 days'))",
-        {Json(token)});
+        "VALUES (?, ?, datetime('now', '+7 days'))",
+        {Json(alloc.owner_id), Json(token)});
     env["DET_SESSION_TOKEN"] = token;
 
     Json action = Json::object();

@@ -56,9 +56,19 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
   // scheduling, no entrypoint required.
   if (parts.size() == 1 && req.method == "POST") {
     Json body = Json::parse(req.body);
+    AuthCtx ctx = auth_ctx(req);
+    if (!ctx.ok()) return json_resp(401, err_body("unauthenticated"));
+    // Authz: creation needs editor rights on the target project's
+    // workspace (reference api_experiment.go CanCreateExperiment).
+    int64_t project_id = body["project_id"].as_int(1);
+    auto prows = db_.query("SELECT workspace_id FROM projects WHERE id=?",
+                           {Json(project_id)});
+    if (prows.empty()) return json_resp(404, err_body("no such project"));
+    if (!can_create(ctx, prows[0]["workspace_id"].as_int(1))) {
+      return json_resp(403, err_body("viewer role cannot create experiments"));
+    }
     std::lock_guard<std::mutex> lock(mu_);
-    int64_t uid = auth_user(req);
-    if (uid < 0) return json_resp(401, err_body("unauthenticated"));
+    int64_t uid = ctx.uid;
     if (body["unmanaged"].as_bool(false)) {
       const Json& config = body["config"];
       std::string job_id = "job-unmanaged-" + random_hex(6);
@@ -142,6 +152,9 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
 
   // DELETE /api/v1/experiments/{id}
   if (parts.size() == 2 && req.method == "DELETE") {
+    if (!can_edit_experiment(auth_ctx(req), eid)) {
+      return json_resp(403, err_body("not authorized for this experiment"));
+    }
     std::lock_guard<std::mutex> lock(mu_);
     ExperimentState* exp = find_experiment_locked(eid);
     if (exp != nullptr && !is_terminal(exp->state)) {
@@ -191,6 +204,9 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
     auto erows = db_.query("SELECT unmanaged FROM experiments WHERE id=?",
                            {Json(eid)});
     if (erows.empty()) return json_resp(404, err_body("no such experiment"));
+    if (!can_edit_experiment(auth_ctx(req), eid)) {
+      return json_resp(403, err_body("not authorized for this experiment"));
+    }
     if (erows[0]["unmanaged"].as_int(0) == 0) {
       return json_resp(400,
                        err_body("trials of managed experiments are created "
@@ -214,6 +230,9 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
     auto erows = db_.query(
         "SELECT unmanaged, state FROM experiments WHERE id=?", {Json(eid)});
     if (erows.empty()) return json_resp(404, err_body("no such experiment"));
+    if (!can_edit_experiment(auth_ctx(req), eid)) {
+      return json_resp(403, err_body("not authorized for this experiment"));
+    }
     if (erows[0]["unmanaged"].as_int(0) == 0) {
       return json_resp(400, err_body("managed experiments complete via "
                                      "their searcher"));
@@ -297,6 +316,9 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
   if (parts.size() == 3 && parts[2] == "searcher_operations" &&
       req.method == "POST") {
     Json body = Json::parse(req.body);
+    if (!can_edit_experiment(auth_ctx(req), eid)) {
+      return json_resp(403, err_body("not authorized for this experiment"));
+    }
     std::lock_guard<std::mutex> lock(mu_);
     ExperimentState* exp = find_experiment_locked(eid);
     if (exp == nullptr || exp->searcher->custom() == nullptr) {
@@ -327,6 +349,11 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
   // unarchive}
   if (parts.size() == 3 && req.method == "POST") {
     const std::string& verb = parts[2];
+    // Ownership/role gate on every lifecycle mutation (reference authz in
+    // api_experiment.go: ActivateExperiment etc. check experiment authz).
+    if (!can_edit_experiment(auth_ctx(req), eid)) {
+      return json_resp(403, err_body("not authorized for this experiment"));
+    }
     if (verb == "archive" || verb == "unarchive") {
       db_.exec("UPDATE experiments SET archived=? WHERE id=?",
                {Json(verb == "archive" ? 1 : 0), Json(eid)});
@@ -393,6 +420,21 @@ HttpResponse Master::handle_trials(const HttpRequest& req,
                                    const std::vector<std::string>& parts) {
   if (parts.size() < 2) return json_resp(404, err_body("not found"));
   int64_t tid = to_id(parts[1]);
+
+  // One authz gate for every trial mutation (metric reports, searcher
+  // completions, heartbeats): edit rights on the owning experiment. Task
+  // containers pass because their pre-issued token belongs to the
+  // experiment owner (try_fit_locked). Reads stay open to all
+  // authenticated users.
+  if (req.method != "GET") {
+    auto trows = db_.query("SELECT experiment_id FROM trials WHERE id=?",
+                           {Json(tid)});
+    if (!trows.empty() &&
+        !can_edit_experiment(auth_ctx(req),
+                             trows[0]["experiment_id"].as_int())) {
+      return json_resp(403, err_body("not authorized for this trial"));
+    }
+  }
 
   // GET /api/v1/trials/{id}
   if (parts.size() == 2 && req.method == "GET") {
@@ -757,12 +799,32 @@ HttpResponse Master::handle_allocations(const HttpRequest& req,
 
 HttpResponse Master::handle_checkpoints(const HttpRequest& req,
                                         const std::vector<std::string>& parts) {
+  // Writes (report/GC-patch) come from task containers (owner tokens) and
+  // tooling; they need edit rights on the owning experiment — otherwise
+  // any user could reset another trial's resume pointer or mark its
+  // checkpoints DELETED. Deliberately grant-aware, NOT a blanket
+  // base-role block: a base-viewer holding a workspace editor grant runs
+  // experiments there, and their containers must be able to checkpoint.
+  AuthCtx ctx;
+  if (req.method != "GET") ctx = auth_ctx(req);
+
   // POST /api/v1/checkpoints — ReportCheckpoint.
   if (parts.size() == 1 && req.method == "POST") {
     Json body = Json::parse(req.body);
     const std::string& uuid = body["uuid"].as_string();
     if (uuid.empty()) return json_resp(400, err_body("uuid required"));
     int64_t trial_id = body["trial_id"].as_int(-1);
+    if (trial_id >= 0) {
+      auto trows = db_.query("SELECT experiment_id FROM trials WHERE id=?",
+                             {Json(trial_id)});
+      if (trows.empty()) return json_resp(404, err_body("no such trial"));
+      if (!can_edit_experiment(ctx, trows[0]["experiment_id"].as_int())) {
+        return json_resp(403, err_body("not authorized for this trial"));
+      }
+    } else if (ctx.role == "viewer") {
+      // Trial-less checkpoints have no scope to check grants against.
+      return json_resp(403, err_body("viewer role is read-only"));
+    }
     db_.exec(
         "INSERT OR REPLACE INTO checkpoints (uuid, task_id, allocation_id, "
         "trial_id, state, resources, metadata, steps_completed) "
@@ -788,12 +850,32 @@ HttpResponse Master::handle_checkpoints(const HttpRequest& req,
   }
 
   // PATCH /api/v1/checkpoints {checkpoints: [{uuid, state}]} — GC support.
+  // Authorize the WHOLE batch before touching any row: a mid-batch 403
+  // after partial updates would leave the caller unable to tell what was
+  // applied.
   if (parts.size() == 1 && req.method == "PATCH") {
     Json body = Json::parse(req.body);
     for (const auto& c : body["checkpoints"].as_array()) {
-      db_.exec("UPDATE checkpoints SET state=? WHERE uuid=?",
-               {c["state"], c["uuid"]});
+      auto rows = db_.query(
+          "SELECT t.experiment_id FROM checkpoints ck "
+          "JOIN trials t ON t.id = ck.trial_id WHERE ck.uuid=?",
+          {c["uuid"]});
+      if (rows.empty()) {
+        if (ctx.role == "viewer") {
+          return json_resp(403, err_body("viewer role is read-only"));
+        }
+      } else if (!can_edit_experiment(ctx,
+                                      rows[0]["experiment_id"].as_int())) {
+        return json_resp(403, err_body("not authorized for checkpoint " +
+                                       c["uuid"].as_string()));
+      }
     }
+    db_.tx([&] {
+      for (const auto& c : body["checkpoints"].as_array()) {
+        db_.exec("UPDATE checkpoints SET state=? WHERE uuid=?",
+                 {c["state"], c["uuid"]});
+      }
+    });
     return json_resp(200, Json::object());
   }
 
@@ -831,11 +913,39 @@ HttpResponse Master::handle_checkpoints(const HttpRequest& req,
 // ---------------------------------------------------------------------------
 
 HttpResponse Master::handle_task_logs(const HttpRequest& req) {
-  // POST /api/v1/task/logs — batched shipping.
+  // POST /api/v1/task/logs — batched shipping. Agents (which ship every
+  // task's stdout on the node) and admins pass; anyone else must hold
+  // edit rights on every task they write into — otherwise any user could
+  // forge lines into another user's log stream and trip their
+  // log-pattern policies.
   if (req.method == "POST") {
+    AuthCtx ctx = auth_ctx(req);
     Json body = Json::parse(req.body);
     const JsonArray& logs =
         body.is_array() ? body.as_array() : body["logs"].as_array();
+    if (ctx.role != "agent" && !ctx.admin) {
+      std::set<std::string> task_ids;
+      for (const auto& e : logs) task_ids.insert(e["task_id"].as_string());
+      for (const auto& tid : task_ids) {
+        auto rows = db_.query(
+            "SELECT owner_id, workspace_id FROM tasks WHERE id=?",
+            {Json(tid)});
+        if (rows.empty()) {
+          // Orphan stream: nobody to protect, but viewers stay read-only.
+          if (ctx.role == "viewer") {
+            return json_resp(403, err_body("viewer role is read-only"));
+          }
+          continue;
+        }
+        int64_t owner = rows[0]["owner_id"].is_int()
+                            ? rows[0]["owner_id"].as_int()
+                            : -1;
+        if (!can_edit(ctx, owner, rows[0]["workspace_id"].as_int(1))) {
+          return json_resp(403,
+                           err_body("not authorized for task " + tid));
+        }
+      }
+    }
     db_.tx([&] {
       for (const auto& entry : logs) {
         db_.exec(

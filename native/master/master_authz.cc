@@ -1,0 +1,319 @@
+// master_authz.cc — authorization: identity resolution, role checks,
+// user groups and role assignments.
+//
+// Reference: master/internal/rbac/rbac.go (roles + assignments),
+// internal/usergroup/ (groups), internal/user/ (users/sessions), and the
+// authz checks threaded through api_experiment.go. The TPU-native model is
+// deliberately lean: a base role per user ("admin" | "user" | "viewer") plus
+// workspace-scoped grants ("viewer" | "editor" | "admin") to users or
+// groups. Semantics:
+//
+//   - base admin            → everything, everywhere.
+//   - base user             → create anywhere, edit own entities, view all.
+//   - base viewer           → read-only, unless a grant raises a workspace.
+//   - base agent            → service account for node daemons: the only
+//                             role the agent-protocol routes accept; may
+//                             ship any task's logs; no experiment rights.
+//   - ws grant viewer       → (view is open to all authenticated users)
+//   - ws grant editor       → create/edit any entity in that workspace.
+//   - ws grant admin        → editor + manage grants on that workspace.
+//   - grant with NULL workspace = global-scope grant (same ladder).
+//
+// Enforcement lives in the route handlers; this file owns resolution and
+// the admin surfaces (/api/v1/groups, /api/v1/rbac/assignments).
+
+#include <algorithm>
+
+#include "master.h"
+
+namespace det {
+
+namespace {
+
+Json err_body(const std::string& msg) {
+  Json j = Json::object();
+  j["error"] = msg;
+  return j;
+}
+
+HttpResponse json_resp(int status, const Json& j) {
+  return HttpResponse::json(status, j.dump());
+}
+
+int64_t to_id(const std::string& s) {
+  try {
+    return std::stoll(s);
+  } catch (...) {
+    return -1;
+  }
+}
+
+int role_rank(const std::string& role) {
+  if (role == "admin") return 3;
+  if (role == "editor") return 2;
+  if (role == "viewer") return 1;
+  return 0;
+}
+
+Json row_to_json(const Row& row) {
+  return Json(JsonObject(row.begin(), row.end()));
+}
+
+}  // namespace
+
+AuthCtx Master::auth_ctx(const HttpRequest& req) {
+  AuthCtx ctx;
+  auto it = req.headers.find("authorization");
+  if (it == req.headers.end() || it->second.rfind("Bearer ", 0) != 0) {
+    return ctx;
+  }
+  auto rows = db_.query(
+      "SELECT u.id, u.username, u.role FROM users u "
+      "JOIN user_sessions s ON s.user_id = u.id WHERE s.token=? AND "
+      "(s.expires_at IS NULL OR s.expires_at > datetime('now')) AND "
+      "u.active=1",
+      {Json(it->second.substr(7))});
+  if (rows.empty()) return ctx;
+  ctx.uid = rows[0]["id"].as_int();
+  ctx.username = rows[0]["username"].as_string();
+  ctx.role = rows[0]["role"].as_string("user");
+  ctx.admin = ctx.role == "admin";
+  return ctx;
+}
+
+std::string Master::workspace_role(const AuthCtx& ctx, int64_t workspace_id) {
+  if (!ctx.ok()) return "";
+  if (ctx.admin) return "admin";
+  // Direct + group grants, workspace-scoped or global (NULL workspace).
+  auto rows = db_.query(
+      "SELECT ra.role FROM role_assignments ra "
+      "LEFT JOIN user_group_members gm ON gm.group_id = ra.group_id "
+      "WHERE (ra.user_id=? OR gm.user_id=?) AND "
+      "(ra.workspace_id IS NULL OR ra.workspace_id=?)",
+      {Json(ctx.uid), Json(ctx.uid), Json(workspace_id)});
+  std::string best;
+  for (auto& row : rows) {
+    const std::string r = row["role"].as_string();
+    if (role_rank(r) > role_rank(best)) best = r;
+  }
+  return best;
+}
+
+bool Master::can_create(const AuthCtx& ctx, int64_t workspace_id) {
+  if (!ctx.ok()) return false;
+  if (ctx.admin || ctx.role == "user") return true;
+  return role_rank(workspace_role(ctx, workspace_id)) >= role_rank("editor");
+}
+
+bool Master::can_edit(const AuthCtx& ctx, int64_t owner_id,
+                      int64_t workspace_id) {
+  if (!ctx.ok()) return false;
+  if (ctx.admin) return true;
+  if (ctx.role != "viewer" && owner_id >= 0 && owner_id == ctx.uid) {
+    return true;
+  }
+  return role_rank(workspace_role(ctx, workspace_id)) >= role_rank("editor");
+}
+
+bool Master::can_ws_admin(const AuthCtx& ctx, int64_t workspace_id) {
+  if (!ctx.ok()) return false;
+  return ctx.admin || workspace_role(ctx, workspace_id) == "admin";
+}
+
+bool Master::experiment_scope(int64_t eid, int64_t* owner_id,
+                              int64_t* workspace_id) {
+  auto rows = db_.query(
+      "SELECT e.owner_id, p.workspace_id FROM experiments e "
+      "JOIN projects p ON p.id = e.project_id WHERE e.id=?",
+      {Json(eid)});
+  if (rows.empty()) return false;
+  *owner_id = rows[0]["owner_id"].is_int() ? rows[0]["owner_id"].as_int() : -1;
+  *workspace_id = rows[0]["workspace_id"].as_int(1);
+  return true;
+}
+
+bool Master::can_edit_experiment(const AuthCtx& ctx, int64_t eid) {
+  int64_t owner = -1, ws = 1;
+  if (!experiment_scope(eid, &owner, &ws)) return ctx.admin;
+  return can_edit(ctx, owner, ws);
+}
+
+// ---------------------------------------------------------------------------
+// /api/v1/groups (reference internal/usergroup/) — admin-only management.
+// ---------------------------------------------------------------------------
+
+HttpResponse Master::handle_groups(const HttpRequest& req,
+                                   const std::vector<std::string>& parts) {
+  AuthCtx ctx = auth_ctx(req);
+  if (!ctx.ok()) return json_resp(401, err_body("unauthenticated"));
+
+  // GET /api/v1/groups — list with members (read open to all).
+  if (parts.size() == 1 && req.method == "GET") {
+    Json groups = Json::array();
+    for (auto& g : db_.query("SELECT id, name FROM user_groups ORDER BY id")) {
+      Json gj = row_to_json(g);
+      Json members = Json::array();
+      for (auto& m : db_.query(
+               "SELECT u.id, u.username FROM user_group_members gm "
+               "JOIN users u ON u.id = gm.user_id WHERE gm.group_id=? "
+               "ORDER BY u.id",
+               {g["id"]})) {
+        members.push_back(row_to_json(m));
+      }
+      gj["members"] = members;
+      groups.push_back(std::move(gj));
+    }
+    Json out = Json::object();
+    out["groups"] = groups;
+    return json_resp(200, out);
+  }
+
+  if (!ctx.admin) return json_resp(403, err_body("admin role required"));
+
+  // POST /api/v1/groups {name}
+  if (parts.size() == 1 && req.method == "POST") {
+    Json body = Json::parse_or_null(req.body);
+    const std::string& name = body["name"].as_string();
+    if (name.empty()) return json_resp(400, err_body("name required"));
+    db_.exec("INSERT INTO user_groups (name) VALUES (?)", {Json(name)});
+    Json out = Json::object();
+    out["id"] = db_.last_insert_id();
+    out["name"] = name;
+    return json_resp(200, out);
+  }
+
+  if (parts.size() >= 2) {
+    int64_t gid = to_id(parts[1]);
+    auto grows =
+        db_.query("SELECT id FROM user_groups WHERE id=?", {Json(gid)});
+    if (grows.empty()) return json_resp(404, err_body("no such group"));
+
+    // DELETE /api/v1/groups/{id}
+    if (parts.size() == 2 && req.method == "DELETE") {
+      db_.exec("DELETE FROM user_group_members WHERE group_id=?", {Json(gid)});
+      db_.exec("DELETE FROM role_assignments WHERE group_id=?", {Json(gid)});
+      db_.exec("DELETE FROM user_groups WHERE id=?", {Json(gid)});
+      return json_resp(200, Json::object());
+    }
+    // POST /api/v1/groups/{id}/members {user_id}
+    if (parts.size() == 3 && parts[2] == "members" && req.method == "POST") {
+      Json body = Json::parse_or_null(req.body);
+      int64_t uid = body["user_id"].as_int(-1);
+      auto urows = db_.query("SELECT id FROM users WHERE id=?", {Json(uid)});
+      if (urows.empty()) return json_resp(404, err_body("no such user"));
+      db_.exec(
+          "INSERT OR IGNORE INTO user_group_members (group_id, user_id) "
+          "VALUES (?, ?)",
+          {Json(gid), Json(uid)});
+      return json_resp(200, Json::object());
+    }
+    // DELETE /api/v1/groups/{id}/members/{uid}
+    if (parts.size() == 4 && parts[2] == "members" && req.method == "DELETE") {
+      db_.exec(
+          "DELETE FROM user_group_members WHERE group_id=? AND user_id=?",
+          {Json(gid), Json(to_id(parts[3]))});
+      return json_resp(200, Json::object());
+    }
+  }
+  return json_resp(404, err_body("not found"));
+}
+
+// ---------------------------------------------------------------------------
+// /api/v1/rbac/assignments (reference internal/rbac/): grants of
+// viewer/editor/admin to a user or group, workspace-scoped or global.
+// Global grants require the admin base role; workspace-scoped grants may
+// also be managed by that workspace's admins.
+// ---------------------------------------------------------------------------
+
+HttpResponse Master::handle_rbac(const HttpRequest& req,
+                                 const std::vector<std::string>& parts) {
+  AuthCtx ctx = auth_ctx(req);
+  if (!ctx.ok()) return json_resp(401, err_body("unauthenticated"));
+  if (parts.size() < 2 || parts[1] != "assignments") {
+    return json_resp(404, err_body("not found"));
+  }
+
+  // GET /api/v1/rbac/assignments[?workspace_id=]
+  if (parts.size() == 2 && req.method == "GET") {
+    std::string sql =
+        "SELECT ra.id, ra.role, ra.user_id, ra.group_id, ra.workspace_id, "
+        "u.username, g.name AS group_name FROM role_assignments ra "
+        "LEFT JOIN users u ON u.id = ra.user_id "
+        "LEFT JOIN user_groups g ON g.id = ra.group_id";
+    std::vector<Json> params;
+    if (!req.query_param("workspace_id").empty()) {
+      sql += " WHERE ra.workspace_id=?";
+      params.push_back(Json(to_id(req.query_param("workspace_id"))));
+    }
+    Json out = Json::object();
+    Json arr = Json::array();
+    for (auto& row : db_.query(sql + " ORDER BY ra.id", params)) {
+      arr.push_back(row_to_json(row));
+    }
+    out["assignments"] = arr;
+    return json_resp(200, out);
+  }
+
+  // POST /api/v1/rbac/assignments {role, user_id|group_id, workspace_id?}
+  if (parts.size() == 2 && req.method == "POST") {
+    Json body = Json::parse_or_null(req.body);
+    const std::string& role = body["role"].as_string();
+    if (role != "viewer" && role != "editor" && role != "admin") {
+      return json_resp(400, err_body("role must be viewer|editor|admin"));
+    }
+    bool scoped = body["workspace_id"].is_int();
+    int64_t ws = body["workspace_id"].as_int(-1);
+    if (scoped) {
+      auto wrows =
+          db_.query("SELECT id FROM workspaces WHERE id=?", {Json(ws)});
+      if (wrows.empty()) return json_resp(404, err_body("no such workspace"));
+      if (!can_ws_admin(ctx, ws)) {
+        return json_resp(403, err_body("workspace admin role required"));
+      }
+    } else if (!ctx.admin) {
+      return json_resp(403, err_body("admin role required for global grants"));
+    }
+    bool has_user = body["user_id"].is_int();
+    bool has_group = body["group_id"].is_int();
+    if (has_user == has_group) {
+      return json_resp(400,
+                       err_body("exactly one of user_id|group_id required"));
+    }
+    if (has_user) {
+      auto urows = db_.query("SELECT id FROM users WHERE id=?",
+                             {body["user_id"]});
+      if (urows.empty()) return json_resp(404, err_body("no such user"));
+    } else {
+      auto grows = db_.query("SELECT id FROM user_groups WHERE id=?",
+                             {body["group_id"]});
+      if (grows.empty()) return json_resp(404, err_body("no such group"));
+    }
+    db_.exec(
+        "INSERT INTO role_assignments (role, user_id, group_id, workspace_id)"
+        " VALUES (?, ?, ?, ?)",
+        {Json(role), has_user ? body["user_id"] : Json(),
+         has_group ? body["group_id"] : Json(), scoped ? Json(ws) : Json()});
+    Json out = Json::object();
+    out["id"] = db_.last_insert_id();
+    return json_resp(200, out);
+  }
+
+  // DELETE /api/v1/rbac/assignments/{id}
+  if (parts.size() == 3 && req.method == "DELETE") {
+    int64_t aid = to_id(parts[2]);
+    auto rows = db_.query(
+        "SELECT workspace_id FROM role_assignments WHERE id=?", {Json(aid)});
+    if (rows.empty()) return json_resp(404, err_body("no such assignment"));
+    bool scoped = rows[0]["workspace_id"].is_int();
+    if (scoped ? !can_ws_admin(ctx, rows[0]["workspace_id"].as_int())
+               : !ctx.admin) {
+      return json_resp(403, err_body("insufficient role"));
+    }
+    db_.exec("DELETE FROM role_assignments WHERE id=?", {Json(aid)});
+    return json_resp(200, Json::object());
+  }
+
+  return json_resp(404, err_body("not found"));
+}
+
+}  // namespace det

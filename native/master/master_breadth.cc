@@ -54,11 +54,15 @@ HttpResponse Master::handle_workspaces(const HttpRequest& req,
   }
   if (parts.size() == 1 && req.method == "POST") {
     Json body = Json::parse(req.body);
+    AuthCtx ctx = auth_ctx(req);
+    if (!ctx.ok()) return json_resp(401, err_body("unauthenticated"));
+    // New workspaces sit outside any grant scope → base role decides.
+    if (ctx.role == "viewer") {
+      return json_resp(403, err_body("viewer role cannot create workspaces"));
+    }
     std::lock_guard<std::mutex> lock(mu_);
-    int64_t uid = auth_user(req);
-    if (uid < 0) return json_resp(401, err_body("unauthenticated"));
     db_.exec("INSERT INTO workspaces (name, user_id) VALUES (?, ?)",
-             {body["name"], Json(uid)});
+             {body["name"], Json(ctx.uid)});
     Json out = Json::object();
     out["workspace"] = Json(JsonObject{{"id", Json(db_.last_insert_id())},
                                        {"name", body["name"]}});
@@ -82,6 +86,16 @@ HttpResponse Master::handle_workspaces(const HttpRequest& req,
       return json_resp(200, out);
     }
     if (parts.size() == 2 && req.method == "DELETE") {
+      auto rows = db_.query("SELECT user_id FROM workspaces WHERE id=?",
+                            {Json(wid)});
+      if (rows.empty()) return json_resp(404, err_body("no such workspace"));
+      AuthCtx ctx = auth_ctx(req);
+      int64_t owner =
+          rows[0]["user_id"].is_int() ? rows[0]["user_id"].as_int() : -1;
+      if (!can_ws_admin(ctx, wid) &&
+          !(owner >= 0 && owner == ctx.uid && ctx.role != "viewer")) {
+        return json_resp(403, err_body("not authorized for this workspace"));
+      }
       db_.exec("UPDATE workspaces SET archived=1 WHERE id=?", {Json(wid)});
       return json_resp(200, Json::object());
     }
@@ -93,14 +107,18 @@ HttpResponse Master::handle_projects(const HttpRequest& req,
                                      const std::vector<std::string>& parts) {
   if (parts.size() == 1 && req.method == "POST") {
     Json body = Json::parse(req.body);
+    AuthCtx ctx = auth_ctx(req);
+    if (!ctx.ok()) return json_resp(401, err_body("unauthenticated"));
+    int64_t wid = body["workspace_id"].as_int(1);
+    if (!can_create(ctx, wid)) {
+      return json_resp(403, err_body("not authorized for this workspace"));
+    }
     std::lock_guard<std::mutex> lock(mu_);
-    int64_t uid = auth_user(req);
-    if (uid < 0) return json_resp(401, err_body("unauthenticated"));
     db_.exec(
         "INSERT INTO projects (name, description, workspace_id, user_id) "
         "VALUES (?, ?, ?, ?)",
-        {body["name"], Json(body["description"].as_string()),
-         Json(body["workspace_id"].as_int(1)), Json(uid)});
+        {body["name"], Json(body["description"].as_string()), Json(wid),
+         Json(ctx.uid)});
     Json out = Json::object();
     out["project"] = Json(JsonObject{{"id", Json(db_.last_insert_id())},
                                      {"name", body["name"]}});
@@ -115,6 +133,15 @@ HttpResponse Master::handle_projects(const HttpRequest& req,
     return json_resp(200, out);
   }
   if (parts.size() == 2 && req.method == "DELETE") {
+    auto rows = db_.query(
+        "SELECT user_id, workspace_id FROM projects WHERE id=?",
+        {Json(to_id(parts[1]))});
+    if (rows.empty()) return json_resp(404, err_body("no such project"));
+    int64_t owner =
+        rows[0]["user_id"].is_int() ? rows[0]["user_id"].as_int() : -1;
+    if (!can_edit(auth_ctx(req), owner, rows[0]["workspace_id"].as_int(1))) {
+      return json_resp(403, err_body("not authorized for this project"));
+    }
     db_.exec("UPDATE projects SET archived=1 WHERE id=?",
              {Json(to_id(parts[1]))});
     return json_resp(200, Json::object());
@@ -140,15 +167,18 @@ HttpResponse Master::handle_models(const HttpRequest& req,
   }
   if (parts.size() == 1 && req.method == "POST") {
     Json body = Json::parse(req.body);
+    AuthCtx ctx = auth_ctx(req);
+    if (!ctx.ok()) return json_resp(401, err_body("unauthenticated"));
+    if (!can_create(ctx, body["workspace_id"].as_int(1))) {
+      return json_resp(403, err_body("not authorized for this workspace"));
+    }
     std::lock_guard<std::mutex> lock(mu_);
-    int64_t uid = auth_user(req);
-    if (uid < 0) return json_resp(401, err_body("unauthenticated"));
     db_.exec(
         "INSERT INTO models (name, description, metadata, labels, user_id, "
         "workspace_id) VALUES (?, ?, ?, ?, ?, ?)",
         {body["name"], Json(body["description"].as_string()),
-         Json(body["metadata"].dump()), Json(body["labels"].dump()), Json(uid),
-         Json(body["workspace_id"].as_int(1))});
+         Json(body["metadata"].dump()), Json(body["labels"].dump()),
+         Json(ctx.uid), Json(body["workspace_id"].as_int(1))});
     Json out = Json::object();
     out["model"] = Json(JsonObject{{"id", Json(db_.last_insert_id())},
                                    {"name", body["name"]}});
@@ -161,6 +191,15 @@ HttpResponse Master::handle_models(const HttpRequest& req,
         db_.query("SELECT * FROM models WHERE name=?", {Json(name)});
     if (mrows.empty()) return json_resp(404, err_body("no such model"));
     int64_t mid = mrows[0]["id"].as_int();
+    if (req.method != "GET") {
+      int64_t owner = mrows[0]["user_id"].is_int()
+                          ? mrows[0]["user_id"].as_int()
+                          : -1;
+      if (!can_edit(auth_ctx(req), owner,
+                    mrows[0]["workspace_id"].as_int(1))) {
+        return json_resp(403, err_body("not authorized for this model"));
+      }
+    }
     if (parts.size() == 2 && req.method == "GET") {
       Json m = row_to_json(mrows[0]);
       m["metadata"] = Json::parse_or_null(m["metadata"].as_string());
@@ -209,6 +248,9 @@ HttpResponse Master::handle_models(const HttpRequest& req,
 
 HttpResponse Master::handle_templates(const HttpRequest& req,
                                       const std::vector<std::string>& parts) {
+  if (req.method != "GET" && auth_ctx(req).role == "viewer") {
+    return json_resp(403, err_body("viewer role is read-only"));
+  }
   if (parts.size() == 1 && req.method == "GET") {
     Json tpls = Json::array();
     for (auto& row : db_.query("SELECT * FROM templates ORDER BY name")) {
@@ -248,6 +290,12 @@ HttpResponse Master::handle_templates(const HttpRequest& req,
 
 HttpResponse Master::handle_webhooks(const HttpRequest& req,
                                      const std::vector<std::string>& parts) {
+  // Webhook targets receive cluster-wide experiment events → managing them
+  // is an admin operation (reference: webhook permissions sit on the
+  // workspace-admin tier).
+  if (req.method != "GET" && !auth_ctx(req).admin) {
+    return json_resp(403, err_body("admin role required"));
+  }
   if (parts.size() == 1 && req.method == "GET") {
     Json hooks = Json::array();
     for (auto& row : db_.query("SELECT * FROM webhooks ORDER BY id")) {
@@ -279,6 +327,11 @@ HttpResponse Master::handle_webhooks(const HttpRequest& req,
 // Job queue introspection (reference job/jobservice/jobservice.go +
 // rm/tasklist/): queued/scheduled jobs per pool with queue positions.
 HttpResponse Master::handle_job_queue(const HttpRequest& req) {
+  // Reordering jumps other users' work in the queue → admin only
+  // (reference: job queue admin permission).
+  if (req.method == "POST" && !auth_ctx(req).admin) {
+    return json_resp(403, err_body("admin role required"));
+  }
   std::lock_guard<std::mutex> lock(mu_);
   // POST /api/v1/job-queues/reorder {allocation_id, ahead_of|behind}
   // (reference job queue UpdateJobQueue ahead-of/behind ops): reposition a

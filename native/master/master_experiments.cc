@@ -126,6 +126,13 @@ int64_t Master::create_experiment_locked(const Json& config,
 
   ExperimentState exp;
   exp.id = eid;
+  exp.owner_id = user_id;
+  exp.project_id = project_id;
+  {
+    auto prows = db_.query("SELECT workspace_id FROM projects WHERE id=?",
+                           {Json(project_id)});
+    if (!prows.empty()) exp.workspace_id = prows[0]["workspace_id"].as_int(1);
+  }
   exp.config = config;
   exp.state = "PAUSED";
   exp.job_id = job_id;
@@ -296,12 +303,14 @@ void Master::launch_checkpoint_gc_locked(ExperimentState& exp) {
   std::string task_id = "gc-exp" + std::to_string(exp.id) + "-" +
                         random_hex(4);
   db_.exec(
-      "INSERT INTO tasks (id, type, state, config, owner_id) "
-      "VALUES (?, 'GC', 'ACTIVE', ?, 1)",
-      {Json(task_id), Json(storage.dump())});
+      "INSERT INTO tasks (id, type, state, config, owner_id, workspace_id) "
+      "VALUES (?, 'GC', 'ACTIVE', ?, ?, ?)",
+      {Json(task_id), Json(storage.dump()), Json(exp.owner_id),
+       Json(exp.workspace_id)});
   Allocation alloc;
   alloc.id = "alloc-" + task_id;
   alloc.task_id = task_id;
+  alloc.owner_id = exp.owner_id;  // GC deletes with the owner's credentials
   alloc.resource_pool = exp.resource_pool.empty() ? cfg_.default_pool
                                                   : exp.resource_pool;
   alloc.slots = 0;  // zero-slot aux task
@@ -343,10 +352,11 @@ void Master::process_ops_locked(ExperimentState& exp,
         trial.hparams = op.hparams;
         trial.seed = op.seed;
         exp.trials[op.request_id] = std::move(trial);
-        db_.exec("INSERT OR IGNORE INTO tasks (id, type, state, job_id) "
-                 "VALUES (?, 'TRIAL', 'ACTIVE', ?)",
-                 {Json(trial_task_id(exp.trials[op.request_id].id)),
-                  Json(exp.job_id)});
+        db_.exec(
+            "INSERT OR IGNORE INTO tasks (id, type, state, job_id, "
+            "owner_id, workspace_id) VALUES (?, 'TRIAL', 'ACTIVE', ?, ?, ?)",
+            {Json(trial_task_id(exp.trials[op.request_id].id)),
+             Json(exp.job_id), Json(exp.owner_id), Json(exp.workspace_id)});
         break;
       }
       case SearcherOp::Kind::ValidateAfter: {
@@ -394,6 +404,7 @@ void Master::request_allocation_locked(ExperimentState& exp,
   alloc.slots = exp.slots_per_trial;
   alloc.priority = exp.priority;
   alloc.submitted_at = now();
+  alloc.owner_id = exp.owner_id;
   alloc.excluded_agents = trial.excluded_agents;  // exclude_node policies
   trial.allocation_id = alloc.id;
   db_.exec(
@@ -608,7 +619,9 @@ void Master::snapshot_experiment_locked(ExperimentState& exp) {
 
 void Master::restore_experiments() {
   auto rows = db_.query(
-      "SELECT e.id, e.state, e.config, s.content FROM experiments e "
+      "SELECT e.id, e.state, e.config, e.owner_id, e.project_id, "
+      "p.workspace_id, s.content FROM experiments e "
+      "LEFT JOIN projects p ON p.id = e.project_id "
       "LEFT JOIN experiment_snapshots s ON s.experiment_id = e.id "
       "WHERE e.unmanaged=0 AND e.state IN ('ACTIVE','PAUSED',"
       "'STOPPING_CANCELED','STOPPING_KILLED','STOPPING_COMPLETED')");
@@ -617,6 +630,9 @@ void Master::restore_experiments() {
     Json config = Json::parse_or_null(row["config"].as_string());
     ExperimentState exp;
     exp.id = eid;
+    exp.owner_id = row["owner_id"].as_int(1);
+    exp.project_id = row["project_id"].as_int(1);
+    exp.workspace_id = row["workspace_id"].as_int(1);
     exp.config = config;
     exp.state = row["state"].as_string();
     const Json& res = config["resources"];

@@ -163,15 +163,27 @@ HttpResponse Master::handle_runs(const HttpRequest& req,
   if (parts.size() == 2 && parts[1] == "move" && req.method == "POST") {
     Json body = Json::parse(req.body);
     int64_t project = body["project_id"].as_int(1);
-    auto prows = db_.query("SELECT id FROM projects WHERE id=?",
+    auto prows = db_.query("SELECT workspace_id FROM projects WHERE id=?",
                            {Json(project)});
     if (prows.empty()) return json_resp(404, err_body("no such project"));
+    AuthCtx ctx = auth_ctx(req);
+    // Moving INTO a project needs create rights on its workspace.
+    if (!can_create(ctx, prows[0]["workspace_id"].as_int(1))) {
+      return json_resp(403, err_body("not authorized for target project"));
+    }
     // Dedupe to parent experiments first — several runs may share one.
     std::set<int64_t> exp_ids;
     for (const auto& rid : body["run_ids"].as_array()) {
       auto trows = db_.query("SELECT experiment_id FROM trials WHERE id=?",
                              {rid});
       if (!trows.empty()) exp_ids.insert(trows[0]["experiment_id"].as_int());
+    }
+    // Moving OUT needs edit rights on every source experiment.
+    for (int64_t eid2 : exp_ids) {
+      if (!can_edit_experiment(ctx, eid2)) {
+        return json_resp(403, err_body("not authorized for experiment " +
+                                       std::to_string(eid2)));
+      }
     }
     int64_t moved = 0;
     for (int64_t eid2 : exp_ids) {
@@ -270,9 +282,13 @@ HttpResponse Master::handle_ntsc(const HttpRequest& req,
   if (parts.size() == 1 && req.method == "POST") {
     Json body = Json::parse(req.body);
     const Json& config = body["config"];
+    AuthCtx ctx = auth_ctx(req);
+    if (!ctx.ok()) return json_resp(401, err_body("unauthenticated"));
+    if (!can_create(ctx, body["workspace_id"].as_int(1))) {
+      return json_resp(403, err_body("viewer role cannot launch tasks"));
+    }
     std::lock_guard<std::mutex> lock(mu_);
-    int64_t uid = auth_user(req);
-    if (uid < 0) return json_resp(401, err_body("unauthenticated"));
+    int64_t uid = ctx.uid;
 
     std::string task_id =
         std::string(meta.type) + "-" + random_hex(6);
@@ -288,10 +304,11 @@ HttpResponse Master::handle_ntsc(const HttpRequest& req,
       }
     }
     db_.exec(
-        "INSERT INTO tasks (id, type, state, config, owner_id, parent_id) "
-        "VALUES (?, ?, 'ACTIVE', ?, ?, ?)",
+        "INSERT INTO tasks (id, type, state, config, owner_id, parent_id, "
+        "workspace_id) VALUES (?, ?, 'ACTIVE', ?, ?, ?, ?)",
         {Json(task_id), Json(meta.type), Json(config.dump()), Json(uid),
-         parent.empty() ? Json() : Json(parent)});
+         parent.empty() ? Json() : Json(parent),
+         Json(body["workspace_id"].as_int(1))});
 
     Allocation alloc;
     alloc.id = "alloc-" + task_id;
@@ -303,6 +320,7 @@ HttpResponse Master::handle_ntsc(const HttpRequest& req,
     alloc.submitted_at = now();
     alloc.idle_timeout_s = config["idle_timeout_s"].as_double(0);
     alloc.last_activity = now();
+    alloc.owner_id = uid;  // task containers act as the launching user
 
     // String entrypoints pass through verbatim (launch.py shlex-splits);
     // array entrypoints ship as JSON so argument boundaries survive
@@ -369,8 +387,19 @@ HttpResponse Master::handle_ntsc(const HttpRequest& req,
   if (parts.size() >= 2) {
     const std::string& task_id = parts[1];
     // POST /{kind}/{id}/kill — propagates down the task tree (reference
-    // api_generic_tasks.go:432 PropagateTaskState).
+    // api_generic_tasks.go:432 PropagateTaskState). Owner/admin/editor only.
     if (parts.size() == 3 && parts[2] == "kill" && req.method == "POST") {
+      auto trows = db_.query(
+          "SELECT owner_id, workspace_id FROM tasks WHERE id=?",
+          {Json(task_id)});
+      if (trows.empty()) return json_resp(404, err_body("no such task"));
+      int64_t owner = trows[0]["owner_id"].is_int()
+                          ? trows[0]["owner_id"].as_int()
+                          : -1;
+      if (!can_edit(auth_ctx(req), owner,
+                    trows[0]["workspace_id"].as_int(1))) {
+        return json_resp(403, err_body("not authorized for this task"));
+      }
       std::lock_guard<std::mutex> lock(mu_);
       kill_task_tree_locked(task_id);
       return json_resp(200, Json::object());

@@ -92,7 +92,8 @@ def test_exclude_node_policy(cluster, tmp_path):
          "--master-url", cluster.master_url,
          "--id", "agent-1", "--slots", "2", "--slot-type", "cpu",
          "--addr", "127.0.0.1",
-         "--work-root", os.path.join(cluster.tmpdir, "agent1-work")],
+         "--work-root", os.path.join(cluster.tmpdir, "agent1-work"),
+         "--token-file", cluster.db_path + ".agent_token"],
         env=cluster.env,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
     try:

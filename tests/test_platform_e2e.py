@@ -94,6 +94,8 @@ class Devcluster:
                 "--slot-type", "cpu",
                 "--addr", "127.0.0.1",
                 "--work-root", os.path.join(self.tmpdir, "agent-work"),
+                # Agent service-account bootstrap token minted by the master.
+                "--token-file", self.db_path + ".agent_token",
             ],
             env=self.env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
@@ -130,9 +132,9 @@ class Devcluster:
             text = resp.read().decode()
             return json.loads(text) if text else None
 
-    def login(self) -> str:
+    def login(self, user: str = "determined", password: str = "") -> str:
         return self.api("POST", "/api/v1/auth/login",
-                        {"username": "determined", "password": ""})["token"]
+                        {"username": user, "password": password})["token"]
 
 
 @pytest.fixture()

@@ -71,12 +71,13 @@ def test_log_retention_sweep(cluster):
          "timestamp": "2020-01-01 00:00:00"},
         {"task_id": "t-new", "log": "fresh line"},
     ]}, token=token)
+    admin = cluster.login("admin")  # cleanup is an admin operation
     out = cluster.api("POST", "/api/v1/master/cleanup_logs", {"days": 30},
-                      token=token)
+                      token=admin)
     assert out["deleted"] == 1
     # idempotent second sweep
     out = cluster.api("POST", "/api/v1/master/cleanup_logs", {"days": 30},
-                      token=token)
+                      token=admin)
     assert out["deleted"] == 0
 
 
@@ -109,10 +110,11 @@ def test_job_queue_reorder(cluster, tmp_path):
     # priority order: exp2 (41) ahead of exp3 (42). Move the last one ahead.
     last = next(j for j in q if j["priority"] == 42)
     first = next(j for j in q if j["priority"] == 41)
+    # Queue reordering is an admin operation (jumps other users' work).
     cluster.api("POST", "/api/v1/job-queues/reorder", {
         "allocation_id": last["allocation_id"],
         "ahead_of": first["allocation_id"],
-    }, token=token)
+    }, token=cluster.login("admin"))
     q2 = queued()
     pos = {j["allocation_id"]: j["queue_position"] for j in q2}
     assert pos[last["allocation_id"]] < pos[first["allocation_id"]], q2
