@@ -1,15 +1,21 @@
 #!/usr/bin/env python
-"""Headline benchmark: GPT-2 (124M) pretraining throughput on one TPU chip.
+"""BASELINE.md benchmarks. Headline: GPT-2 (124M) pretraining throughput on
+one TPU chip.
 
-Prints ONE JSON line:
+Prints one JSON line PER METRIC (gpt2 first — the headline — then
+resnet50 samples/sec/chip and asha trials/hour, so every BASELINE.md
+metric lands in BENCH_r{N}.json):
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
 The reference publishes no training-throughput numbers (BASELINE.md), so
 `vs_baseline` is measured MFU relative to the driver's 40% MFU target
 (BASELINE.json north star): vs_baseline = MFU / 0.40. >1.0 beats the target.
 
-Config: GPT-2 small, bf16, remat, seq 1024, per-chip batch 16 — the
+GPT-2 config: small, bf16, remat, seq 1024, per-chip batch 16 — the
 single-chip unit of the v5e-64 GPT-2 north-star workload.
+
+`--only gpt2|resnet|asha` runs a single section; a failing section prints
+an error line and the others still run.
 """
 
 import json
@@ -19,7 +25,7 @@ import time
 import numpy as np
 
 
-def main() -> None:
+def gpt2_bench() -> None:
     import jax
     import optax
 
@@ -159,6 +165,28 @@ def pp_compile_check() -> None:
         "mesh": dict(zip(AXIS_ORDER, shape)),
         "flops": compiled.cost_analysis().get("flops", 0),
     }))
+
+
+def main() -> int:
+    only = None
+    if "--only" in sys.argv:
+        only = sys.argv[sys.argv.index("--only") + 1]
+    sections = {
+        "gpt2": gpt2_bench,
+        "resnet": lambda: __import__("bench_resnet").main(),
+        "asha": lambda: __import__("bench_asha").main(),
+    }
+    rc = 0
+    for name, fn in sections.items():
+        if only is not None and name != only:
+            continue
+        try:
+            fn()
+            sys.stdout.flush()
+        except Exception as e:  # a broken section must not hide the others
+            print(json.dumps({"metric": name, "error": str(e)[:500]}))
+            rc = 1
+    return rc
 
 
 if __name__ == "__main__":

@@ -20,7 +20,7 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
 
-def main() -> None:
+def run() -> dict:
     subprocess.run(["make", "-C", os.path.join(REPO, "native")],
                    check=True, capture_output=True)
     # Reuse the e2e harness's devcluster (readiness checks, env
@@ -84,7 +84,7 @@ def main() -> None:
         trials = cluster.api("GET", f"/api/v1/experiments/{eid}/trials",
                              token=token)["trials"]
         trials_per_hour = len(trials) / elapsed * 3600
-        print(json.dumps({
+        return {
             "metric": "asha_trials_per_hour",
             "value": round(trials_per_hour, 1),
             "unit": "trials/hour (adaptive_asha, 8 artificial slots)",
@@ -94,9 +94,13 @@ def main() -> None:
                 "wall_seconds": round(elapsed, 1),
                 "max_concurrent": 8,
             },
-        }))
+        }
     finally:
         cluster.stop()
+
+
+def main() -> None:
+    print(json.dumps(run()))
 
 
 if __name__ == "__main__":

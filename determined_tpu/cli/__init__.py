@@ -317,6 +317,99 @@ def cmd_ntsc(session: Session, args) -> int:
     return 0
 
 
+def _open_tunnel(master: str, token: str, task_id: str, timeout: float = 60.0):
+    """Open a det-tcp tunnel to a task through the master's proxy
+    (reference cli/tunnel.py over proxy/tcp.go). Returns (socket, residual
+    bytes received past the 101)."""
+    import socket as socketlib
+    import urllib.parse
+
+    u = urllib.parse.urlparse(master)
+    host, port = u.hostname, u.port or 80
+    deadline = time.time() + timeout
+    last_err = "no attempt"
+    while time.time() < deadline:
+        s = socketlib.create_connection((host, port), timeout=30)
+        req = (
+            f"GET /proxy/{task_id}/ HTTP/1.1\r\nHost: {host}\r\n"
+            f"Authorization: Bearer {token}\r\n"
+            f"Connection: Upgrade\r\nUpgrade: det-tcp\r\n\r\n"
+        )
+        s.sendall(req.encode())
+        buf = b""
+        try:
+            while b"\r\n\r\n" not in buf:
+                d = s.recv(4096)
+                if not d:
+                    raise ConnectionError("closed during handshake")
+                buf += d
+        except (OSError, ConnectionError) as e:
+            last_err = str(e)
+            s.close()
+            time.sleep(1.0)
+            continue
+        head, rest = buf.split(b"\r\n\r\n", 1)
+        status = head.split(b"\r\n", 1)[0]
+        if b"101" in status:
+            s.settimeout(None)
+            return s, rest
+        s.close()
+        last_err = status.decode(errors="replace")
+        # 502 until the task reports its address — keep retrying.
+        time.sleep(1.0)
+    raise SystemExit(f"could not open tunnel to {task_id}: {last_err}")
+
+
+def cmd_shell(session: Session, args) -> int:
+    if args.action in ("list", "kill", "logs", "start"):
+        return cmd_ntsc(session, args)
+    task_id = args.task_id
+    s, rest = _open_tunnel(session.master_url, session.token, task_id)
+    if args.action == "run":
+        script = " ".join(args.cmd) + "\n"
+        s.sendall(script.encode())
+        s.shutdown(1)  # SHUT_WR: half-close ends the remote shell's stdin
+        if rest:
+            sys.stdout.buffer.write(rest)
+        while True:
+            d = s.recv(65536)
+            if not d:
+                break
+            sys.stdout.buffer.write(d)
+            sys.stdout.buffer.flush()
+        s.close()
+        return 0
+    # interactive `det shell open`: bridge stdin/stdout over the tunnel.
+    import threading
+
+    if rest:
+        sys.stdout.buffer.write(rest)
+        sys.stdout.buffer.flush()
+
+    def pump_out():
+        while True:
+            d = s.recv(65536)
+            if not d:
+                break
+            sys.stdout.buffer.write(d)
+            sys.stdout.buffer.flush()
+
+    t = threading.Thread(target=pump_out, daemon=True)
+    t.start()
+    try:
+        while True:
+            line = sys.stdin.buffer.readline()
+            if not line:
+                break
+            s.sendall(line)
+    except KeyboardInterrupt:
+        pass
+    s.shutdown(1)
+    t.join(timeout=5.0)
+    s.close()
+    return 0
+
+
 # ---------------------------------------------------------------------------
 # admin / registry commands
 # ---------------------------------------------------------------------------
@@ -397,17 +490,22 @@ def cmd_user_create(session: Session, args) -> int:
     return 0
 
 
-def _user_id_by_name(session: Session, name_or_id: str) -> int:
-    if name_or_id.isdigit():
-        return int(name_or_id)
+def _user_by_name(session: Session, name_or_id: str) -> Dict[str, Any]:
     for u in session.get("/api/v1/users")["users"]:
-        if u["username"] == name_or_id:
-            return u["id"]
+        if u["username"] == name_or_id or (
+            name_or_id.isdigit() and u["id"] == int(name_or_id)
+        ):
+            return u
     raise SystemExit(f"no such user: {name_or_id}")
 
 
+def _user_id_by_name(session: Session, name_or_id: str) -> int:
+    return _user_by_name(session, name_or_id)["id"]
+
+
 def cmd_user_patch(session: Session, args) -> int:
-    uid = _user_id_by_name(session, args.target_user)
+    user = _user_by_name(session, args.target_user)
+    uid = user["id"]
     body: Dict[str, Any] = {}
     if args.action == "activate":
         body["active"] = True
@@ -418,7 +516,9 @@ def cmd_user_patch(session: Session, args) -> int:
     elif args.action == "change-password":
         from determined_tpu.common.api import salted_hash
 
-        body["password"] = salted_hash(args.target_user, args.password)
+        # Salt with the USERNAME (login salts with it) — a numeric-id
+        # target must resolve to the name first or the hashes never match.
+        body["password"] = salted_hash(user["username"], args.password)
     session.patch(f"/api/v1/users/{uid}", body=body)
     print(f"{args.action} user {args.target_user}")
     return 0
@@ -623,6 +723,14 @@ def build_parser() -> argparse.ArgumentParser:
         lg.add_argument("task_id")
         lg.add_argument("-f", "--follow", action="store_true")
         lg.set_defaults(func=cmd_ntsc, kind=kind, action="logs")
+        if cli_name == "shell":
+            so = nt.add_parser("open")
+            so.add_argument("task_id")
+            so.set_defaults(func=cmd_shell, kind=kind, action="open")
+            sr = nt.add_parser("run")
+            sr.add_argument("task_id")
+            sr.add_argument("cmd", nargs=argparse.REMAINDER)
+            sr.set_defaults(func=cmd_shell, kind=kind, action="run")
 
     m = sub.add_parser("master").add_subparsers(dest="subcommand", required=True)
     m.add_parser("info").set_defaults(func=cmd_master_info)

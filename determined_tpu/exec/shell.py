@@ -1,0 +1,84 @@
+"""Shell NTSC task entrypoint.
+
+Reference: `det shell` runs sshd in the task container and tunnels ssh over
+the master's TCP proxy (master/internal/proxy/tcp.go + cli/tunnel.py). The
+TPU-VM design has no container/sshd; instead the task runs this small TCP
+shell server and the CLI reaches it through the master's `det-tcp` tunnel
+(`/proxy/{task_id}/` with `Upgrade: det-tcp`).
+
+Protocol per connection: all received bytes go to a fresh `/bin/sh -s`
+stdin; its stdout+stderr stream back. Half-close (client shutdown WR) ends
+stdin, the shell exits, output drains, connection closes — which makes
+one-shot `det shell run <id> <cmd>` a clean round-trip. Interactive use
+(`det shell open`) bridges the user's terminal over the same stream.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import subprocess
+import sys
+import threading
+
+from determined_tpu.exec._util import free_port, report_proxy_address
+
+logger = logging.getLogger("determined_tpu.exec.shell")
+
+
+def _serve_client(conn: socket.socket) -> None:
+    with conn:
+        proc = subprocess.Popen(
+            ["/bin/sh", "-s"],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+
+        def feed_stdin() -> None:
+            try:
+                while True:
+                    data = conn.recv(65536)
+                    if not data:
+                        break
+                    proc.stdin.write(data)
+                    proc.stdin.flush()
+            except (OSError, ValueError):
+                pass
+            try:
+                proc.stdin.close()
+            except OSError:
+                pass
+
+        t = threading.Thread(target=feed_stdin, daemon=True)
+        t.start()
+        try:
+            while True:
+                out = proc.stdout.read1(65536)
+                if not out:
+                    break
+                conn.sendall(out)
+        except OSError:
+            pass
+        proc.wait()
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    port = free_port()
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("0.0.0.0", port))
+    srv.listen(16)
+    addr = f"tcp://{socket.gethostname()}:{port}"
+    report_proxy_address(addr)
+    logger.info("shell server at %s", addr)
+    print(f"shell server listening on {addr}", flush=True)
+    while True:
+        conn, _ = srv.accept()
+        threading.Thread(target=_serve_client, args=(conn,),
+                         daemon=True).start()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

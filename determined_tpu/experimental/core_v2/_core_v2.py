@@ -49,8 +49,18 @@ class Context:
                 f"/api/v1/experiments/{self.experiment_id}/complete",
                 body={"state": state},
             )
-        except Exception:
-            pass
+        except Exception as e:
+            # Swallowing this silently would leave the run COMPLETED locally
+            # but RUNNING in the master forever — close() must not raise
+            # (it runs in finally blocks), but the operator has to know.
+            import warnings
+
+            warnings.warn(
+                f"core_v2.close: failed to report final state {state!r} for "
+                f"experiment {self.experiment_id} to the master: {e}; the "
+                f"run will appear RUNNING until completed manually",
+                RuntimeWarning,
+            )
 
 
 _ctx: Optional[Context] = None

@@ -173,7 +173,7 @@ void HttpServer::stop() {
   }
   if (accept_thread_.joinable()) accept_thread_.join();
   for (auto& w : workers_) {
-    if (w.joinable()) w.join();
+    if (w->t.joinable()) w->t.join();
   }
   workers_.clear();
 }
@@ -189,18 +189,23 @@ void HttpServer::accept_loop() {
     }
     char ip[INET_ADDRSTRLEN] = "?";
     inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof(ip));
-    // Detached-style worker threads, joined on stop. Reap finished ones
-    // opportunistically to bound the vector on long-lived servers.
-    static std::mutex mu;
-    std::lock_guard<std::mutex> lock(mu);
-    if (workers_.size() > 512) {
-      for (auto& w : workers_) {
-        if (w.joinable()) w.join();
+    // Reap ONLY finished workers (done flag): live ones may be long-lived
+    // tunnels, and joining them here would freeze accept for everyone.
+    for (auto it = workers_.begin(); it != workers_.end();) {
+      if ((*it)->done.load()) {
+        (*it)->t.join();
+        it = workers_.erase(it);
+      } else {
+        ++it;
       }
-      workers_.clear();
     }
-    workers_.emplace_back(
-        [this, fd, remote = std::string(ip)] { handle_connection(fd, remote); });
+    auto w = std::make_unique<Worker>();
+    Worker* wp = w.get();
+    wp->t = std::thread([this, fd, remote = std::string(ip), wp] {
+      handle_connection(fd, remote);
+      wp->done = true;
+    });
+    workers_.push_back(std::move(w));
   }
 }
 
@@ -218,6 +223,12 @@ void HttpServer::handle_connection(int fd, const std::string& remote) {
     } catch (const std::exception& e) {
       resp.status = 500;
       resp.body = std::string("{\"error\":\"") + e.what() + "\"}";
+    }
+    if (resp.hijack) {
+      // Upgrade-style takeover: the hijacker owns the socket from here
+      // (websocket/TCP tunnels). Residual buffered bytes go with it.
+      resp.hijack(fd, std::move(buf));
+      break;
     }
     std::ostringstream out;
     out << "HTTP/1.1 " << resp.status << ' ' << status_text(resp.status)
@@ -248,6 +259,39 @@ std::string url_encode(const std::string& s, bool keep_slash) {
     }
   }
   return out;
+}
+
+int tcp_connect(const std::string& host, int port, double timeout_s) {
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints, &res) !=
+      0) {
+    throw std::runtime_error("resolve failed: " + host);
+  }
+  int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd < 0) {
+    freeaddrinfo(res);
+    throw std::runtime_error("socket() failed");
+  }
+  if (timeout_s > 0) {
+    timeval tv;
+    tv.tv_sec = static_cast<long>(timeout_s);
+    tv.tv_usec = static_cast<long>((timeout_s - tv.tv_sec) * 1e6);
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  }
+  int rc = ::connect(fd, res->ai_addr, res->ai_addrlen);
+  freeaddrinfo(res);
+  if (rc != 0) {
+    ::close(fd);
+    throw std::runtime_error("connect failed: " + host + ":" +
+                             std::to_string(port));
+  }
+  int opt = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &opt, sizeof(opt));
+  return fd;
 }
 
 HttpClientResponse http_request(const std::string& method,

@@ -13,6 +13,7 @@
 #include <atomic>
 #include <functional>
 #include <map>
+#include <memory>
 #include <string>
 #include <thread>
 #include <vector>
@@ -39,6 +40,13 @@ struct HttpResponse {
   std::string content_type = "application/json";
   std::string body;
   std::map<std::string, std::string> headers;
+
+  // Connection hijack (reference master/internal/proxy/{ws,tcp}.go): when
+  // set, the server does NOT write a response; it hands the raw socket fd
+  // plus any bytes already buffered past the request (pipelined client
+  // data, e.g. eager websocket frames) to this function, which owns the
+  // connection until it returns (the server closes the fd afterwards).
+  std::function<void(int fd, std::string&& residual)> hijack;
 
   static HttpResponse json(int status, const std::string& body) {
     HttpResponse r;
@@ -68,12 +76,21 @@ class HttpServer {
   void accept_loop();
   void handle_connection(int fd, const std::string& remote);
 
+  // One thread per connection, with a done-flag so the accept loop reaps
+  // ONLY finished workers — hijacked tunnels (websocket/det-tcp) hold
+  // their thread open for the tunnel's lifetime, so joining live workers
+  // would freeze accept().
+  struct Worker {
+    std::thread t;
+    std::atomic<bool> done{false};
+  };
+
   int listen_fd_ = -1;
   int port_ = 0;
   Handler handler_;
   std::atomic<bool> running_{false};
   std::thread accept_thread_;
-  std::vector<std::thread> workers_;
+  std::vector<std::unique_ptr<Worker>> workers_;
 };
 
 // Blocking HTTP/1.1 client (one request per connection). Used by the agent
@@ -95,6 +112,9 @@ HttpClientResponse http_request(const std::string& method,
                                 double timeout_s = 30.0,
                                 const std::map<std::string, std::string>&
                                     headers = {});
+
+// Blocking TCP connect; returns fd >= 0 or throws std::runtime_error.
+int tcp_connect(const std::string& host, int port, double timeout_s = 10.0);
 
 std::string url_decode(const std::string& s);
 // Percent-encodes everything outside RFC3986 unreserved + '/' (for paths);

@@ -206,6 +206,7 @@ void Master::stop() {
     if (!running_) return;
     running_ = false;
   }
+  tunnels_run_ = false;  // live ws/tcp tunnels exit their pump loops
   cv_.notify_all();
   if (scheduler_thread_.joinable()) scheduler_thread_.join();
   server_.stop();
@@ -390,9 +391,11 @@ HttpResponse Master::handle_login(const HttpRequest& req) {
 int64_t Master::auth_user(const HttpRequest& req) {
   auto it = req.headers.find("authorization");
   if (it == req.headers.end() || it->second.rfind("Bearer ", 0) != 0) return -1;
+  // Same active-user predicate as auth_ctx — the two must never drift.
   auto rows = db_.query(
-      "SELECT user_id FROM user_sessions WHERE token=? AND "
-      "(expires_at IS NULL OR expires_at > datetime('now'))",
+      "SELECT s.user_id FROM user_sessions s JOIN users u ON u.id=s.user_id "
+      "WHERE s.token=? AND (s.expires_at IS NULL OR "
+      "s.expires_at > datetime('now')) AND u.active=1",
       {Json(it->second.substr(7))});
   return rows.empty() ? -1 : rows[0]["user_id"].as_int();
 }
@@ -451,8 +454,8 @@ HttpResponse Master::handle_users(const HttpRequest& req) {
     db_.exec(
         "INSERT INTO users (username, password_hash, admin, role) "
         "VALUES (?, ?, ?, ?)",
-        {Json(name), body["password"], Json(role == "admin" ? 1 : 0),
-         Json(role)});
+        {Json(name), Json(body["password"].as_string("")),
+         Json(role == "admin" ? 1 : 0), Json(role)});
     Json out = Json::object();
     out["id"] = db_.last_insert_id();
     return json_resp(200, out);

@@ -19,6 +19,7 @@
 
 #pragma once
 
+#include <atomic>
 #include <chrono>
 #include <condition_variable>
 #include <deque>
@@ -232,6 +233,10 @@ class Master {
                            const std::vector<std::string>& parts);
   HttpResponse handle_proxy(const HttpRequest& req,
                             const std::vector<std::string>& parts);
+  // Bidirectional byte pump for hijacked tunnels (websocket / det-tcp;
+  // reference internal/proxy/{ws,tcp}.go). Owns neither fd; the caller
+  // (hijack plumbing) closes client_fd, this closes target_fd.
+  void tunnel_pump(int client_fd, int target_fd, const std::string& task_id);
   void kill_task_tree_locked(const std::string& task_id);
   HttpResponse handle_prometheus_metrics();
   HttpResponse serve_webui(const std::string& path);
@@ -316,6 +321,8 @@ class Master {
     int64_t seconds_count = 0;
   };
   ApiStats api_stats_;
+
+  std::atomic<bool> tunnels_run_{true};  // drops hijacked tunnels on stop()
 
   std::mutex mu_;
   std::condition_variable cv_;

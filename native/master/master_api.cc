@@ -742,9 +742,34 @@ HttpResponse Master::handle_allocations(const HttpRequest& req,
     return json_resp(200, out);
   }
 
-  // POST /api/v1/allocations/{id}/proxy_address
-  if (parts.size() == 3 && parts[2] == "proxy_address") {
+  // POST /api/v1/allocations/{id}/proxy_address — repointing a task's
+  // proxy target redirects every tunnel into it, so it needs edit rights
+  // on the owning task (the container's owner token passes).
+  if (parts.size() == 3 && parts[2] == "proxy_address" &&
+      req.method == "POST") {
     Json body = Json::parse_or_null(req.body);
+    std::string task_id;
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      auto it = allocations_.find(aid);
+      if (it == allocations_.end()) {
+        return json_resp(404, err_body("unknown allocation"));
+      }
+      task_id = it->second.task_id;
+    }
+    auto trows = db_.query(
+        "SELECT owner_id, workspace_id FROM tasks WHERE id=?",
+        {Json(task_id)});
+    int64_t owner = -1, ws = 1;
+    if (!trows.empty()) {
+      owner = trows[0]["owner_id"].is_int() ? trows[0]["owner_id"].as_int()
+                                            : -1;
+      ws = trows[0]["workspace_id"].as_int(1);
+    }
+    AuthCtx ctx = auth_ctx(req);
+    if (ctx.role != "agent" && !can_edit(ctx, owner, ws)) {
+      return json_resp(403, err_body("not authorized for this task"));
+    }
     std::lock_guard<std::mutex> lock(mu_);
     auto it = allocations_.find(aid);
     if (it != allocations_.end()) {

@@ -10,7 +10,12 @@
 // server's URL) is surfaced on the task object in place of the reference's
 // built-in TCP/WS proxy (proxy/proxy.go).
 
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
 #include <algorithm>
+#include <sstream>
 
 #include "master.h"
 
@@ -47,7 +52,9 @@ NtscKind ntsc_kind(const std::string& kind) {
     return {"TENSORBOARD", "python3 -m determined_tpu.exec.tensorboard"};
   }
   if (kind == "shells") {
-    return {"SHELL", "sleep infinity"};
+    // TCP shell server reached through the det-tcp tunnel (reference:
+    // sshd + proxy/tcp.go; see exec/shell.py for the TPU-VM protocol).
+    return {"SHELL", "python3 -m determined_tpu.exec.shell"};
   }
   if (kind == "generic-tasks") {
     // Reference api_generic_tasks.go:207 CreateGenericTask — user-launched
@@ -198,13 +205,123 @@ HttpResponse Master::handle_runs(const HttpRequest& req,
   return json_resp(404, err_body("not found"));
 }
 
+// select()-based bidirectional pump (reference proxy/ws.go copyBytes /
+// tcp.go): forwards until either side closes or the master stops. Keeps
+// the task's idle clock fresh while bytes flow.
+void Master::tunnel_pump(int client_fd, int target_fd,
+                         const std::string& task_id) {
+  char buf[16384];
+  bool client_open = true, target_open = true;
+  double last_touch = 0;
+  while (tunnels_run_ && (client_open || target_open)) {
+    // poll(), not select(): with a thread per connection the master can
+    // legitimately hold >1024 fds, where FD_SET would write out of bounds.
+    pollfd fds[2] = {};
+    fds[0].fd = client_fd;
+    fds[0].events = client_open ? POLLIN : 0;
+    fds[1].fd = target_fd;
+    fds[1].events = target_open ? POLLIN : 0;
+    int rc = poll(fds, 2, 500 /* ms; wake to observe tunnels_run_ */);
+    if (rc < 0) break;
+    if (rc == 0) continue;
+    bool moved = false;
+    auto readable = [&](int fd) {
+      for (const auto& p : fds) {
+        if (p.fd == fd) return (p.revents & (POLLIN | POLLHUP | POLLERR)) != 0;
+      }
+      return false;
+    };
+    auto pump_one = [&](int from, int to, bool* from_open) {
+      if (!*from_open || !readable(from)) return true;
+      ssize_t n = recv(from, buf, sizeof(buf), 0);
+      if (n <= 0) {
+        *from_open = false;
+        shutdown(to, SHUT_WR);  // propagate half-close
+        return true;
+      }
+      moved = true;
+      size_t off = 0;
+      while (off < static_cast<size_t>(n)) {
+        ssize_t w = send(to, buf + off, static_cast<size_t>(n) - off,
+                         MSG_NOSIGNAL);
+        if (w <= 0) return false;
+        off += static_cast<size_t>(w);
+      }
+      return true;
+    };
+    if (!pump_one(client_fd, target_fd, &client_open)) break;
+    if (!pump_one(target_fd, client_fd, &target_open)) break;
+    if (moved) {
+      double t = now();
+      if (t - last_touch > 2.0) {  // throttle mu_ takes
+        last_touch = t;
+        std::lock_guard<std::mutex> lock(mu_);
+        for (auto& [aid, a] : allocations_) {
+          if (a.task_id == task_id) a.last_activity = t;
+        }
+      }
+    }
+  }
+  close(target_fd);
+}
+
+namespace {
+
+// "http://host:port[/base]" | "host:port" → (host, port, base_path).
+bool parse_target(const std::string& target, std::string* host, int* port,
+                  std::string* base_path) {
+  std::string rest = target;
+  auto scheme_end = rest.find("://");
+  if (scheme_end != std::string::npos) rest = rest.substr(scheme_end + 3);
+  auto slash = rest.find('/');
+  if (slash != std::string::npos) {
+    *base_path = rest.substr(slash);
+    if (*base_path == "/") base_path->clear();
+    rest = rest.substr(0, slash);
+  }
+  auto colon = rest.rfind(':');
+  if (colon == std::string::npos) return false;
+  *host = rest.substr(0, colon);
+  try {
+    *port = std::stoi(rest.substr(colon + 1));
+  } catch (...) {
+    return false;
+  }
+  return *port > 0;
+}
+
+}  // namespace
+
 HttpResponse Master::handle_proxy(const HttpRequest& req,
                                   const std::vector<std::string>& parts) {
   // /proxy/{task_id}/{rest...} → forward to the task's registered proxy
-  // address (PostAllocationProxyAddress). The reference runs a generic
-  // TCP/WS proxy (proxy/tcp.go, ws.go); here HTTP request/response
-  // forwarding, which covers the HTTP-serving NTSC types.
+  // address (PostAllocationProxyAddress). Three modes, mirroring the
+  // reference's proxy/{proxy,ws,tcp}.go:
+  //  - plain HTTP: buffered request/response forwarding;
+  //  - Upgrade: websocket — hijack the client socket, replay the upgrade
+  //    request upstream, then pump bytes both ways (jupyter kernels);
+  //  - Upgrade: det-tcp — raw TCP tunnel: the master answers 101 itself
+  //    and pumps the socket to the task's port (`det shell`).
+  //
+  // Authz: proxying IS acting as the task (a shell tunnel executes
+  // commands in the owner's environment), so it requires edit rights on
+  // the task — owner, admin, or a workspace editor.
   const std::string& task_id = parts[1];
+  {
+    auto trows = db_.query(
+        "SELECT owner_id, workspace_id FROM tasks WHERE id=?",
+        {Json(task_id)});
+    if (trows.empty()) {
+      return json_resp(404, err_body("no such task"));
+    }
+    int64_t owner = trows[0]["owner_id"].is_int()
+                        ? trows[0]["owner_id"].as_int()
+                        : -1;
+    if (!can_edit(auth_ctx(req), owner,
+                  trows[0]["workspace_id"].as_int(1))) {
+      return json_resp(403, err_body("not authorized for this task"));
+    }
+  }
   std::string target;
   {
     std::lock_guard<std::mutex> lock(mu_);
@@ -219,17 +336,12 @@ HttpResponse Master::handle_proxy(const HttpRequest& req,
   if (target.empty()) {
     return json_resp(502, err_body("task has no proxy address (yet)"));
   }
-  // Split "http://host:port[/base]" into origin + base path.
-  std::string base_path;
-  auto scheme_end = target.find("://");
-  if (scheme_end != std::string::npos) {
-    auto path_start = target.find('/', scheme_end + 3);
-    if (path_start != std::string::npos) {
-      base_path = target.substr(path_start);
-      if (base_path == "/") base_path.clear();
-      target = target.substr(0, path_start);
-    }
+  std::string t_host, base_path;
+  int t_port = 0;
+  if (!parse_target(target, &t_host, &t_port, &base_path)) {
+    return json_resp(502, err_body("bad proxy address: " + target));
   }
+  target = "http://" + t_host + ":" + std::to_string(t_port);
   // Re-encode: req.path/query arrive URL-decoded (http.cc read_request);
   // raw spaces etc. would corrupt the upstream request line.
   std::string fwd_path = base_path;
@@ -244,6 +356,88 @@ HttpResponse Master::handle_proxy(const HttpRequest& req,
             url_encode(v, false);
     }
     fwd_path += qs;
+  }
+
+  // Upgrade handling (Connection: Upgrade, possibly "keep-alive, Upgrade").
+  std::string upgrade_proto;
+  {
+    auto conn_it = req.headers.find("connection");
+    auto up_it = req.headers.find("upgrade");
+    if (conn_it != req.headers.end() && up_it != req.headers.end()) {
+      std::string c = conn_it->second;
+      for (auto& ch : c) ch = static_cast<char>(tolower(ch));
+      if (c.find("upgrade") != std::string::npos) {
+        upgrade_proto = up_it->second;
+        for (auto& ch : upgrade_proto) ch = static_cast<char>(tolower(ch));
+      }
+    }
+  }
+  if (upgrade_proto == "det-tcp") {
+    // Raw TCP tunnel (reference proxy/tcp.go): the master completes the
+    // pseudo-upgrade itself, then pumps bytes to the task's port.
+    HttpResponse r;
+    r.hijack = [this, t_host, t_port, task_id](int fd,
+                                               std::string&& residual) {
+      int target_fd = -1;
+      try {
+        target_fd = tcp_connect(t_host, t_port, 10.0);
+      } catch (const std::exception& e) {
+        std::string err = std::string("HTTP/1.1 502 Bad Gateway\r\n"
+                                      "Content-Length: 0\r\n\r\n");
+        send(fd, err.data(), err.size(), MSG_NOSIGNAL);
+        return;
+      }
+      const char ok[] =
+          "HTTP/1.1 101 Switching Protocols\r\n"
+          "Upgrade: det-tcp\r\nConnection: Upgrade\r\n\r\n";
+      send(fd, ok, sizeof(ok) - 1, MSG_NOSIGNAL);
+      if (!residual.empty()) {
+        send(target_fd, residual.data(), residual.size(), MSG_NOSIGNAL);
+      }
+      tunnel_pump(fd, target_fd, task_id);
+    };
+    return r;
+  }
+  if (!upgrade_proto.empty()) {
+    // Websocket (or other HTTP upgrade): replay the client's upgrade
+    // request upstream verbatim — Sec-WebSocket-* headers included — and
+    // splice the sockets (reference proxy/ws.go). The 101 (or refusal)
+    // comes from the task's server through the pump.
+    std::ostringstream head;
+    head << req.method << ' ' << fwd_path << " HTTP/1.1\r\n"
+         << "Host: " << t_host << ':' << t_port << "\r\n";
+    for (const auto& [k, v] : req.headers) {
+      if (k == "host" || k == "content-length") continue;
+      head << k << ": " << v << "\r\n";
+    }
+    if (!req.body.empty()) head << "content-length: " << req.body.size()
+                                << "\r\n";
+    head << "\r\n" << req.body;
+    std::string head_str = head.str();
+    HttpResponse r;
+    r.hijack = [this, t_host, t_port, task_id, head_str](
+                   int fd, std::string&& residual) {
+      int target_fd = -1;
+      try {
+        target_fd = tcp_connect(t_host, t_port, 10.0);
+      } catch (const std::exception&) {
+        std::string err = std::string("HTTP/1.1 502 Bad Gateway\r\n"
+                                      "Content-Length: 0\r\n\r\n");
+        send(fd, err.data(), err.size(), MSG_NOSIGNAL);
+        return;
+      }
+      bool sent = send(target_fd, head_str.data(), head_str.size(),
+                       MSG_NOSIGNAL) == static_cast<ssize_t>(head_str.size());
+      if (sent && !residual.empty()) {
+        send(target_fd, residual.data(), residual.size(), MSG_NOSIGNAL);
+      }
+      if (sent) {
+        tunnel_pump(fd, target_fd, task_id);  // closes target_fd
+      } else {
+        close(target_fd);
+      }
+    };
+    return r;
   }
   std::map<std::string, std::string> fwd_headers;
   auto it = req.headers.find("content-type");

@@ -123,6 +123,162 @@ def test_proxy_forwards_to_task(cluster, tmp_path):
             urllib.request.Request(
                 cluster.master_url + "/proxy/no-such-task/x",
                 headers={"Authorization": f"Bearer {token}"}), timeout=10)
-    assert ei.value.code == 502
+    assert ei.value.code == 404
+
+    # non-owner cannot tunnel into the task (it executes as the owner)
+    admin = cluster.login("admin")
+    cluster.api("POST", "/api/v1/users",
+                {"username": "proxy-bob", "role": "user"}, token=admin)
+    bob = cluster.login("proxy-bob")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            urllib.request.Request(
+                cluster.master_url + f"/proxy/{tid}/hello",
+                headers={"Authorization": f"Bearer {bob}"}), timeout=10)
+    assert ei.value.code == 403
 
     cluster.api("POST", f"/api/v1/commands/{tid}/kill", token=token)
+
+
+# Minimal RFC6455 server fixture: handshake + unmasked echo of masked
+# client text frames. Enough to prove the master splices the upgrade +
+# bidirectional frames (reference proxy/ws.go).
+WS_SERVER = textwrap.dedent("""
+    import base64, hashlib, socket, sys, threading
+    from determined_tpu.exec._util import report_proxy_address
+
+    MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+    def handle(conn):
+        buf = b""
+        while b"\\r\\n\\r\\n" not in buf:
+            d = conn.recv(4096)
+            if not d:
+                return
+            buf += d
+        head, rest = buf.split(b"\\r\\n\\r\\n", 1)
+        key = ""
+        for line in head.decode().split("\\r\\n"):
+            if line.lower().startswith("sec-websocket-key:"):
+                key = line.split(":", 1)[1].strip()
+        accept = base64.b64encode(
+            hashlib.sha1((key + MAGIC).encode()).digest()).decode()
+        conn.sendall((
+            "HTTP/1.1 101 Switching Protocols\\r\\n"
+            "Upgrade: websocket\\r\\nConnection: Upgrade\\r\\n"
+            f"Sec-WebSocket-Accept: {accept}\\r\\n\\r\\n").encode())
+        data = rest
+        while True:
+            while len(data) < 6:
+                d = conn.recv(4096)
+                if not d:
+                    return
+                data += d
+            ln = data[1] & 0x7F
+            need = 6 + ln
+            while len(data) < need:
+                data += conn.recv(4096)
+            mask = data[2:6]
+            payload = bytes(b ^ mask[i % 4]
+                            for i, b in enumerate(data[6:need]))
+            data = data[need:]
+            out = bytes([0x81, len(payload)]) + payload
+            conn.sendall(out)
+
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    report_proxy_address(f"http://127.0.0.1:{srv.getsockname()[1]}")
+    print("ws serving", srv.getsockname()[1]); sys.stdout.flush()
+    while True:
+        c, _ = srv.accept()
+        threading.Thread(target=handle, args=(c,), daemon=True).start()
+""")
+
+
+def _wait_proxy_addr(cluster, token, kind, tid, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        t = cluster.api("GET", f"/api/v1/{kind}/{tid}", token=token)["task"]
+        if t.get("proxy_address"):
+            return t["proxy_address"]
+        time.sleep(0.3)
+    raise TimeoutError("task never registered a proxy address")
+
+
+def test_websocket_proxy_echo(cluster, tmp_path):
+    """WS upgrade through /proxy/{task}/: handshake forwarded upstream,
+    frames pumped both ways (reference proxy/ws.go)."""
+    import base64
+    import hashlib
+    import socket
+
+    token = cluster.login()
+    script = tmp_path / "ws.py"
+    script.write_text(WS_SERVER)
+    tid = cluster.api(
+        "POST", "/api/v1/commands",
+        {"config": {"entrypoint": f"python3 {script}"}}, token=token)["id"]
+    _wait_proxy_addr(cluster, token, "commands", tid)
+
+    host, port = "127.0.0.1", cluster.port
+    s = socket.create_connection((host, port), timeout=20)
+    key = base64.b64encode(b"0123456789abcdef").decode()
+    s.sendall((
+        f"GET /proxy/{tid}/ HTTP/1.1\r\nHost: {host}\r\n"
+        f"Authorization: Bearer {token}\r\n"
+        "Connection: Upgrade\r\nUpgrade: websocket\r\n"
+        f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n\r\n"
+    ).encode())
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        d = s.recv(4096)
+        assert d, f"closed during handshake: {buf!r}"
+        buf += d
+    head, rest = buf.split(b"\r\n\r\n", 1)
+    assert b"101" in head.split(b"\r\n", 1)[0], head
+    magic = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+    want_accept = base64.b64encode(
+        hashlib.sha1((key + magic).encode()).digest()).decode()
+    assert want_accept.encode() in head, head
+
+    # two masked text frames round-trip through the tunnel
+    for msg in (b"hello-ws", b"second-message"):
+        mask = b"\x01\x02\x03\x04"
+        masked = bytes(b ^ mask[i % 4] for i, b in enumerate(msg))
+        s.sendall(bytes([0x81, 0x80 | len(msg)]) + mask + masked)
+        want = bytes([0x81, len(msg)]) + msg
+        got = rest
+        rest = b""
+        while len(got) < len(want):
+            d = s.recv(4096)
+            assert d, "tunnel closed mid-frame"
+            got += d
+        assert got == want, (got, want)
+    s.close()
+    cluster.api("POST", f"/api/v1/commands/{tid}/kill", token=token)
+
+
+def test_shell_round_trip(cluster, tmp_path):
+    """`det shell run`: start a shell task, run a command through the
+    det-tcp tunnel (reference: ssh over proxy/tcp.go; here exec/shell.py),
+    driven through the real CLI as a subprocess."""
+    import os
+    import subprocess
+    import sys
+
+    token = cluster.login()
+    tid = cluster.api("POST", "/api/v1/shells", {"config": {}},
+                      token=token)["id"]
+    _wait_proxy_addr(cluster, token, "shells", tid, timeout=60)
+
+    env = dict(cluster.env, HOME=str(tmp_path))  # isolate the token cache
+    r = subprocess.run(
+        [sys.executable, "-m", "determined_tpu.cli",
+         "-m", cluster.master_url, "shell", "run", tid,
+         "echo tunnel-says-$((20+3))"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "tunnel-says-23" in r.stdout, (r.stdout, r.stderr)
+    cluster.api("POST", f"/api/v1/shells/{tid}/kill", token=token)
