@@ -53,6 +53,13 @@ class Config:
     # (~10% step time on v5e) at the cost of longer compiles; 1 = rolled
     # (fast compile — the right default for tests and short ASHA trials).
     scan_unroll: int = 1
+    # Mixture-of-Experts: >1 replaces every block's MLP with a top-k routed
+    # MoE FFN whose experts shard over the mesh `expert` axis (ops/moe.py).
+    # The reference has no MoE at all (SURVEY §2.4).
+    num_experts: int = 1
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
 
     @property
     def ff_dim(self) -> int:
@@ -118,7 +125,7 @@ def init(rng: jax.Array, cfg: Config) -> Dict[str, Any]:
 
     def layer_params(k):
         ks = jax.random.split(k, 4)
-        return {
+        out = {
             "ln1": {"scale": jnp.ones((L, d), pd), "bias": jnp.zeros((L, d), pd)},
             "qkv": {
                 "kernel": _normal(ks[0], (L, d, 3 * d), std, pd),
@@ -129,15 +136,24 @@ def init(rng: jax.Array, cfg: Config) -> Dict[str, Any]:
                 "bias": jnp.zeros((L, d), pd),
             },
             "ln2": {"scale": jnp.ones((L, d), pd), "bias": jnp.zeros((L, d), pd)},
-            "mlp_up": {
+        }
+        if cfg.num_experts > 1:
+            from determined_tpu.ops.moe import init_moe
+
+            out["moe"] = init_moe(
+                ks[2], d, f, cfg.num_experts, param_dtype=pd, std=std,
+                layers=L,
+            )
+        else:
+            out["mlp_up"] = {
                 "kernel": _normal(ks[2], (L, d, f), std, pd),
                 "bias": jnp.zeros((L, f), pd),
-            },
-            "mlp_down": {
+            }
+            out["mlp_down"] = {
                 "kernel": _normal(ks[3], (L, f, d), res_std, pd),
                 "bias": jnp.zeros((L, d), pd),
-            },
-        }
+            }
+        return out
 
     return {
         "wte": _normal(keys[0], (v, d), std, pd),
@@ -152,17 +168,28 @@ def param_logical_axes(cfg: Config) -> Dict[str, Any]:
     stacked blocks shards over the `pipeline` mesh axis (replicated when
     pipeline=1)."""
     L = "layers"
+    blocks: Dict[str, Any] = {
+        "ln1": {"scale": (L, "embed"), "bias": (L, "embed")},
+        "qkv": {"kernel": (L, "embed", "heads"), "bias": (L, "heads")},
+        "attn_out": {"kernel": (L, "heads", "embed"), "bias": (L, "embed")},
+        "ln2": {"scale": (L, "embed"), "bias": (L, "embed")},
+    }
+    if cfg.num_experts > 1:
+        blocks["moe"] = {
+            "router": {"kernel": (L, "embed", None)},
+            "up": {"kernel": (L, "expert", "embed", "mlp"),
+                   "bias": (L, "expert", "mlp")},
+            "down": {"kernel": (L, "expert", "mlp", "embed"),
+                     "bias": (L, "expert", "embed")},
+        }
+    else:
+        blocks["mlp_up"] = {"kernel": (L, "embed", "mlp"), "bias": (L, "mlp")}
+        blocks["mlp_down"] = {"kernel": (L, "mlp", "embed"),
+                              "bias": (L, "embed")}
     return {
         "wte": ("vocab", "embed"),
         "wpe": (None, "embed"),
-        "blocks": {
-            "ln1": {"scale": (L, "embed"), "bias": (L, "embed")},
-            "qkv": {"kernel": (L, "embed", "heads"), "bias": (L, "heads")},
-            "attn_out": {"kernel": (L, "heads", "embed"), "bias": (L, "embed")},
-            "ln2": {"scale": (L, "embed"), "bias": (L, "embed")},
-            "mlp_up": {"kernel": (L, "embed", "mlp"), "bias": (L, "mlp")},
-            "mlp_down": {"kernel": (L, "mlp", "embed"), "bias": (L, "embed")},
-        },
+        "blocks": blocks,
         "ln_f": {"scale": ("embed",), "bias": ("embed",)},
     }
 
@@ -188,6 +215,10 @@ def _attention(q, k, v, cfg: Config, rules: Optional[LogicalRules]):
         from determined_tpu.ops.ring_attention import ring_attention
 
         return ring_attention(q, k, v, axis_name="context")
+    if cfg.attention_impl == "ulysses":
+        from determined_tpu.ops.ulysses import ulysses_attention
+
+        return ulysses_attention(q, k, v, causal=True)
     scale = 1.0 / math.sqrt(q.shape[-1])
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     s = q.shape[1]
@@ -222,17 +253,26 @@ def _block(x, lp, cfg: Config, rules: Optional[LogicalRules]):
     x = shard_logical(x, ("batch", "seq", "embed"), rules)
 
     y = _layer_norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"], cfg.layer_norm_eps)
-    up = jnp.einsum("bsd,df->bsf", y, lp["mlp_up"]["kernel"].astype(dt)) + lp["mlp_up"][
-        "bias"
-    ].astype(dt)
-    up = shard_logical(up, ("batch", "seq", "mlp"), rules)
-    up = jax.nn.gelu(up, approximate=True)
-    down = (
-        jnp.einsum("bsf,fd->bsd", up, lp["mlp_down"]["kernel"].astype(dt))
-        + lp["mlp_down"]["bias"].astype(dt)
-    )
+    if cfg.num_experts > 1:
+        from determined_tpu.ops.moe import moe_block
+
+        down, aux = moe_block(
+            y, lp["moe"], cfg.num_experts, top_k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor, rules=rules,
+        )
+    else:
+        up = jnp.einsum("bsd,df->bsf", y, lp["mlp_up"]["kernel"].astype(dt)) + lp[
+            "mlp_up"
+        ]["bias"].astype(dt)
+        up = shard_logical(up, ("batch", "seq", "mlp"), rules)
+        up = jax.nn.gelu(up, approximate=True)
+        down = (
+            jnp.einsum("bsf,fd->bsd", up, lp["mlp_down"]["kernel"].astype(dt))
+            + lp["mlp_down"]["bias"].astype(dt)
+        )
+        aux = jnp.zeros((), jnp.float32)
     x = x + down
-    return shard_logical(x, ("batch", "seq", "embed"), rules)
+    return shard_logical(x, ("batch", "seq", "embed"), rules), aux
 
 
 def _remat(block, cfg: Config):
@@ -269,8 +309,10 @@ def apply(
     tokens: jax.Array,  # [B, S] int32
     cfg: Config,
     rules: Optional[LogicalRules] = None,
-) -> jax.Array:
-    """Forward pass → logits [B, S, vocab] (bf16)."""
+    return_aux: bool = False,
+):
+    """Forward pass → logits [B, S, vocab] (bf16); with return_aux also the
+    mean MoE load-balance loss (0 for dense configs)."""
     b, s = tokens.shape
     dt = cfg.dtype
     x = params["wte"].astype(dt)[tokens] + params["wpe"].astype(dt)[:s][None]
@@ -281,13 +323,17 @@ def apply(
         block = _remat(block, cfg)
 
     def scan_body(carry, lp):
-        return block(carry, lp), None
+        x, aux = block(carry, lp)
+        return x, aux
 
     unroll = cfg.scan_unroll if cfg.scan_unroll > 0 else cfg.n_layer
-    x, _ = jax.lax.scan(scan_body, x, params["blocks"], unroll=unroll)
+    x, auxs = jax.lax.scan(scan_body, x, params["blocks"], unroll=unroll)
     x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"], cfg.layer_norm_eps)
     logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(dt))
-    return shard_logical(logits, ("batch", "seq", "vocab"), rules)
+    logits = shard_logical(logits, ("batch", "seq", "vocab"), rules)
+    if return_aux:
+        return logits, jnp.mean(auxs)
+    return logits
 
 
 def apply_pipelined(
@@ -316,8 +362,14 @@ def apply_pipelined(
          + params["wpe"].astype(compute)[:s][None])
     x = shard_logical(x, ("batch", "seq", "embed"), rules)
 
+    if cfg.num_experts > 1:
+        raise NotImplementedError(
+            "MoE blocks are not supported under pipeline parallelism yet — "
+            "drop the pipeline axis or use a dense config"
+        )
+
     def block(xx, lp):
-        return _block(xx.astype(compute), lp, cfg, rules).astype(compute)
+        return _block(xx.astype(compute), lp, cfg, rules)[0].astype(compute)
 
     if cfg.remat:
         block = _remat(block, cfg)
@@ -362,11 +414,13 @@ def loss_fn(
         inputs, targets = tokens, batch["targets"]
     else:
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits = apply(params, inputs, cfg, rules)
+    logits, aux = apply(params, inputs, cfg, rules, return_aux=True)
     # NLL without materialising a full fp32 log-softmax over the vocab:
     # nll = logsumexp(logits) - logits[target]. XLA fuses the f32 upcast into
     # the reduction, so the [B,S,V] array stays bf16 in HBM.
     lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
     tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    nll = lse - tgt.astype(jnp.float32)
-    return jnp.mean(nll)
+    nll = jnp.mean(lse - tgt.astype(jnp.float32))
+    if cfg.num_experts > 1:
+        nll = nll + cfg.moe_aux_coef * aux
+    return nll

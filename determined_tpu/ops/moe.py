@@ -1,0 +1,146 @@
+"""Mixture-of-Experts block with expert parallelism over the mesh's
+`expert` axis.
+
+The reference has no MoE/expert-parallel support at all (SURVEY.md §2.4:
+"expert parallel — absent"); the TPU build makes it first-class per the
+§2.4 TPU mapping ("shard_map for EP/Ulysses"). Design follows the
+GShard/Switch dispatch formulation re-derived for GSPMD:
+
+  - top-k router with capacity factor; overflow tokens are dropped (their
+    combine weight is zero, so the residual path carries them — standard
+    Switch behaviour);
+  - dispatch/combine are dense one-hot einsums: `xe = d[t,e,c] · x[t,d]`
+    gives per-expert token buffers [E, C, D] which GSPMD shards over the
+    `expert` mesh axis (the einsum boundary becomes the all-to-all); the
+    expert FFN itself is a batched matmul with weights sharded [E→expert];
+  - an auxiliary load-balancing loss (mean fraction × mean router prob ×
+    E²) keeps the router from collapsing onto one expert.
+
+Everything is expressed with logical-axis sharding constraints
+(parallel/sharding.py) so the same code runs replicated on one chip and
+expert-parallel on a mesh with `expert > 1`.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from determined_tpu.parallel.sharding import LogicalRules, shard_logical
+
+
+def init_moe(
+    rng: jax.Array,
+    d_model: int,
+    d_ff: int,
+    num_experts: int,
+    param_dtype=jnp.float32,
+    std: float = 0.02,
+    layers: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Parameters for one MoE FFN (or a stacked [layers, ...] pytree)."""
+    lead = () if layers is None else (layers,)
+    k_router, k_up, k_down = jax.random.split(rng, 3)
+
+    def normal(k, shape, s):
+        return (jax.random.normal(k, lead + shape) * s).astype(param_dtype)
+
+    return {
+        "router": {"kernel": normal(k_router, (d_model, num_experts), std)},
+        "up": {
+            "kernel": normal(k_up, (num_experts, d_model, d_ff), std),
+            "bias": jnp.zeros(lead + (num_experts, d_ff), param_dtype),
+        },
+        "down": {
+            "kernel": normal(
+                k_down, (num_experts, d_ff, d_model), std / math.sqrt(2)
+            ),
+            "bias": jnp.zeros(lead + (num_experts, d_model), param_dtype),
+        },
+    }
+
+
+def moe_logical_axes(layers: bool = False) -> Dict[str, Any]:
+    """Logical axis names for init_moe params (expert dim → `expert` mesh
+    axis via the default rules)."""
+    L = ("layers",) if layers else ()
+    return {
+        "router": {"kernel": L + ("embed", None)},
+        "up": {"kernel": L + ("expert", "embed", "mlp"),
+               "bias": L + ("expert", "mlp")},
+        "down": {"kernel": L + ("expert", "mlp", "embed"),
+                 "bias": L + ("expert", "embed")},
+    }
+
+
+def moe_block(
+    x: jax.Array,  # [B, S, D]
+    params: Dict[str, Any],
+    num_experts: int,
+    *,
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+    rules: Optional[LogicalRules] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """→ (y [B, S, D], aux_load_balance_loss scalar f32)."""
+    b, s, d = x.shape
+    t = b * s
+    e = num_experts
+    k = min(top_k, e)
+    dt = x.dtype
+    xt = x.reshape(t, d)
+
+    # Router in f32 (small matmul; numerics matter for the softmax).
+    logits = (xt.astype(jnp.float32)
+              @ params["router"]["kernel"].astype(jnp.float32))  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # Top-k expert choice per token.
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [T, k]
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+    capacity = max(1, int(math.ceil(t / e * capacity_factor)))
+
+    # GShard-style position assignment: for each of the k choices in
+    # priority order, a token takes the next free slot in its expert's
+    # buffer; tokens past capacity are dropped (combine weight 0).
+    dispatch = jnp.zeros((t, e, capacity), dtype=dt)
+    combine = jnp.zeros((t, e, capacity), dtype=dt)
+    used = jnp.zeros((e,), jnp.int32)  # slots consumed per expert so far
+    for choice in range(k):
+        sel = jax.nn.one_hot(gate_idx[:, choice], e, dtype=jnp.int32)  # [T,E]
+        pos = jnp.cumsum(sel, axis=0) - 1 + used[None, :]  # [T, E]
+        within = (pos < capacity) & (sel > 0)
+        pos_c = jnp.clip(pos, 0, capacity - 1)
+        oh = jax.nn.one_hot(pos_c, capacity, dtype=dt) * within[..., None]
+        dispatch = dispatch + oh
+        combine = combine + oh * gate_vals[:, choice, None, None].astype(dt)
+        used = used + jnp.sum(sel, axis=0)
+
+    # Per-expert token buffers; the [E, ...] dims shard over `expert`, so
+    # XLA places each expert's buffer (and its FFN) on its own sub-mesh and
+    # inserts the all-to-all at the einsum boundary.
+    xe = jnp.einsum("tec,td->ecd", dispatch, xt)
+    xe = shard_logical(xe, ("expert", None, "embed"), rules)
+    h = jnp.einsum("ecd,edf->ecf", xe, params["up"]["kernel"].astype(dt))
+    h = h + params["up"]["bias"].astype(dt)[:, None, :]
+    h = shard_logical(h, ("expert", None, "mlp"), rules)
+    h = jax.nn.gelu(h, approximate=True)
+    ye = jnp.einsum("ecf,efd->ecd", h, params["down"]["kernel"].astype(dt))
+    ye = ye + params["down"]["bias"].astype(dt)[:, None, :]
+    ye = shard_logical(ye, ("expert", None, "embed"), rules)
+    y = jnp.einsum("tec,ecd->td", combine, ye)
+
+    # Load-balance aux (Switch Transformer eq. 4): E · Σ_e f_e · p_e where
+    # f_e = fraction of tokens routed (first choice) to e, p_e = mean
+    # router prob for e. Minimised at uniform routing.
+    first = jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32)
+    f = jnp.mean(first, axis=0)
+    p = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(f * p)
+
+    return y.reshape(b, s, d), aux

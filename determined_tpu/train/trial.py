@@ -82,6 +82,14 @@ class JaxTrial(abc.ABC):
     def supports_pipeline(self) -> bool:
         return type(self).loss_pipelined is not JaxTrial.loss_pipelined
 
+    def supports_expert_parallel(self) -> bool:
+        """Trials whose model routes tokens over the mesh `expert` axis
+        (a MoE block — ops/moe.py) override this to return True. Meshes
+        requesting `expert > 1` are rejected for trials that don't — a
+        decoy expert axis would silently replicate compute across those
+        chips (same guard pattern as pipeline above)."""
+        return False
+
     def init_extra(self) -> Any:
         """Initial non-gradient state (stateful trials only)."""
         return None

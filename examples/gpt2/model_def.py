@@ -40,6 +40,10 @@ class GPT2Trial(JaxTrial):
             remat=bool(context.hparams.get("remat", True)),
             attention_impl=context.hparams.get("attention_impl", "flash"),
             scan_unroll=int(context.hparams.get("scan_unroll", 0)),
+            # MoE: num_experts > 1 routes every block's FFN over the mesh
+            # `expert` axis (ops/moe.py).
+            num_experts=int(context.hparams.get("num_experts", 1)),
+            moe_top_k=int(context.hparams.get("moe_top_k", 2)),
         )
         self.seq_len = int(context.hparams.get("seq_len", 1024))
         path = context.hparams.get("tokens_path") or os.environ.get("GPT2_TOKENS")
@@ -57,6 +61,11 @@ class GPT2Trial(JaxTrial):
 
     def loss(self, params, batch, rng):
         return gpt2.loss_fn(params, batch, self.cfg, self.sharding_rules())
+
+    def supports_expert_parallel(self):
+        # Only a MoE config routes tokens over the expert axis; declaring
+        # support unconditionally would re-open the decoy-axis trap.
+        return self.cfg.num_experts > 1
 
     def loss_pipelined(self, params, batch, rng, mesh):
         # Selected by the Trainer whenever the config mesh has pipeline > 1
