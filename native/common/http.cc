@@ -131,10 +131,10 @@ std::string url_decode(const std::string& s) {
 
 int HttpServer::listen(const std::string& host, int port, Handler handler) {
   handler_ = std::move(handler);
-  listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
-  if (listen_fd_ < 0) throw std::runtime_error("socket() failed");
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) throw std::runtime_error("socket() failed");
   int opt = 1;
-  setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &opt, sizeof(opt));
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &opt, sizeof(opt));
 
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
@@ -144,16 +144,17 @@ int HttpServer::listen(const std::string& host, int port, Handler handler) {
   } else if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
     throw std::runtime_error("bad listen host: " + host);
   }
-  if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
     throw std::runtime_error("bind failed on port " + std::to_string(port) +
                              ": " + strerror(errno));
   }
-  if (::listen(listen_fd_, 256) != 0) {
+  if (::listen(fd, 256) != 0) {
     throw std::runtime_error("listen failed");
   }
   socklen_t len = sizeof(addr);
-  getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
   port_ = ntohs(addr.sin_port);
+  listen_fd_ = fd;
   running_ = true;
   return port_;
 }
@@ -166,10 +167,10 @@ void HttpServer::start() {
 
 void HttpServer::stop() {
   if (!running_.exchange(false)) return;
-  if (listen_fd_ >= 0) {
-    ::shutdown(listen_fd_, SHUT_RDWR);
-    ::close(listen_fd_);
-    listen_fd_ = -1;
+  int fd = listen_fd_.exchange(-1);
+  if (fd >= 0) {
+    ::shutdown(fd, SHUT_RDWR);  // unblocks accept()
+    ::close(fd);
   }
   if (accept_thread_.joinable()) accept_thread_.join();
   for (auto& w : workers_) {
@@ -180,9 +181,11 @@ void HttpServer::stop() {
 
 void HttpServer::accept_loop() {
   while (running_) {
+    int lfd = listen_fd_.load();
+    if (lfd < 0) break;
     sockaddr_in peer{};
     socklen_t len = sizeof(peer);
-    int fd = ::accept(listen_fd_, reinterpret_cast<sockaddr*>(&peer), &len);
+    int fd = ::accept(lfd, reinterpret_cast<sockaddr*>(&peer), &len);
     if (fd < 0) {
       if (!running_) break;
       continue;

@@ -85,7 +85,8 @@ class HttpServer {
     std::atomic<bool> done{false};
   };
 
-  int listen_fd_ = -1;
+  // Atomic: stop() tears the fd down while accept_loop() reads it.
+  std::atomic<int> listen_fd_{-1};
   int port_ = 0;
   Handler handler_;
   std::atomic<bool> running_{false};

@@ -106,6 +106,12 @@ int64_t Db::exec(const std::string& sql, const std::vector<Json>& params) {
   return sqlite3_changes(db_);
 }
 
+int64_t Db::insert(const std::string& sql, const std::vector<Json>& params) {
+  std::lock_guard<std::recursive_mutex> lock(mu_);
+  query(sql, params);
+  return sqlite3_last_insert_rowid(db_);
+}
+
 int64_t Db::last_insert_id() {
   std::lock_guard<std::recursive_mutex> lock(mu_);
   return sqlite3_last_insert_rowid(db_);

@@ -45,6 +45,11 @@ class Db {
                          const std::vector<Json>& params = {});
   // Execute without result; returns number of affected rows.
   int64_t exec(const std::string& sql, const std::vector<Json>& params = {});
+  // INSERT + rowid under ONE lock hold. NEVER pair exec() with a separate
+  // last_insert_id() call — another thread's insert can land between them
+  // and the id you read belongs to it (found by the TSan threaded test as
+  // an FK violation during concurrent experiment creation).
+  int64_t insert(const std::string& sql, const std::vector<Json>& params = {});
   int64_t last_insert_id();
 
   // Run fn inside a transaction (BEGIN IMMEDIATE … COMMIT/ROLLBACK).

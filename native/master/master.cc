@@ -451,13 +451,13 @@ HttpResponse Master::handle_users(const HttpRequest& req) {
         role != "agent") {
       return json_resp(400, err_body("role must be admin|user|viewer|agent"));
     }
-    db_.exec(
+    int64_t new_id = db_.insert(
         "INSERT INTO users (username, password_hash, admin, role) "
         "VALUES (?, ?, ?, ?)",
         {Json(name), Json(body["password"].as_string("")),
          Json(role == "admin" ? 1 : 0), Json(role)});
     Json out = Json::object();
-    out["id"] = db_.last_insert_id();
+    out["id"] = new_id;
     return json_resp(200, out);
   }
   // PATCH /api/v1/users/{id} {active?, role?, password?, display_name?}.

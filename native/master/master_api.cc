@@ -74,13 +74,12 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
       std::string job_id = "job-unmanaged-" + random_hex(6);
       db_.exec("INSERT INTO jobs (id, type) VALUES (?, 'EXPERIMENT')",
                {Json(job_id)});
-      db_.exec(
+      int64_t eid = db_.insert(
           "INSERT INTO experiments (state, config, original_config, "
           "model_def, owner_id, project_id, job_id, unmanaged) "
           "VALUES ('ACTIVE', ?, ?, '', ?, ?, ?, 1)",
           {Json(config.dump()), Json(config.dump()), Json(uid),
            Json(body["project_id"].as_int(1)), Json(job_id)});
-      int64_t eid = db_.last_insert_id();
       Json out = Json::object();
       out["experiment"] = Json(JsonObject{
           {"id", Json(eid)}, {"state", Json(std::string("ACTIVE"))}});
@@ -214,13 +213,13 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
     }
     Json body = req.body.empty() ? Json::object() : Json::parse(req.body);
     int64_t seed = body["seed"].as_int(static_cast<int64_t>(now()));
-    db_.exec(
+    int64_t new_tid = db_.insert(
         "INSERT INTO trials (experiment_id, request_id, state, hparams, "
         "seed) VALUES (?, ?, 'RUNNING', ?, ?)",
         {Json(eid), Json("unmanaged-" + random_hex(4)),
          Json(body["hparams"].dump()), Json(seed)});
     Json out = Json::object();
-    out["id"] = db_.last_insert_id();
+    out["id"] = new_tid;
     out["seed"] = seed;
     return json_resp(200, out);
   }
@@ -541,8 +540,8 @@ HttpResponse Master::handle_trials(const HttpRequest& req,
     db_.exec(
         "INSERT INTO raw_metrics (trial_id, trial_run_id, group_name, "
         "total_batches, metrics) VALUES (?, ?, ?, ?, ?)",
-        {Json(tid), body["trial_run_id"], Json(group), Json(batches),
-         Json(body["metrics"].dump())});
+        {Json(tid), Json(body["trial_run_id"].as_int(0)), Json(group),
+         Json(batches), Json(body["metrics"].dump())});
     db_.exec(
         "UPDATE trials SET total_batches=MAX(total_batches, ?), "
         "last_activity=datetime('now') WHERE id=?",

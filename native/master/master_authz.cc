@@ -175,9 +175,10 @@ HttpResponse Master::handle_groups(const HttpRequest& req,
     Json body = Json::parse_or_null(req.body);
     const std::string& name = body["name"].as_string();
     if (name.empty()) return json_resp(400, err_body("name required"));
-    db_.exec("INSERT INTO user_groups (name) VALUES (?)", {Json(name)});
+    int64_t gid_new =
+        db_.insert("INSERT INTO user_groups (name) VALUES (?)", {Json(name)});
     Json out = Json::object();
-    out["id"] = db_.last_insert_id();
+    out["id"] = gid_new;
     out["name"] = name;
     return json_resp(200, out);
   }
@@ -288,13 +289,13 @@ HttpResponse Master::handle_rbac(const HttpRequest& req,
                              {body["group_id"]});
       if (grows.empty()) return json_resp(404, err_body("no such group"));
     }
-    db_.exec(
+    int64_t aid_new = db_.insert(
         "INSERT INTO role_assignments (role, user_id, group_id, workspace_id)"
         " VALUES (?, ?, ?, ?)",
         {Json(role), has_user ? body["user_id"] : Json(),
          has_group ? body["group_id"] : Json(), scoped ? Json(ws) : Json()});
     Json out = Json::object();
-    out["id"] = db_.last_insert_id();
+    out["id"] = aid_new;
     return json_resp(200, out);
   }
 

@@ -61,10 +61,11 @@ HttpResponse Master::handle_workspaces(const HttpRequest& req,
       return json_resp(403, err_body("viewer role cannot create workspaces"));
     }
     std::lock_guard<std::mutex> lock(mu_);
-    db_.exec("INSERT INTO workspaces (name, user_id) VALUES (?, ?)",
-             {body["name"], Json(ctx.uid)});
+    int64_t wid_new =
+        db_.insert("INSERT INTO workspaces (name, user_id) VALUES (?, ?)",
+                   {body["name"], Json(ctx.uid)});
     Json out = Json::object();
-    out["workspace"] = Json(JsonObject{{"id", Json(db_.last_insert_id())},
+    out["workspace"] = Json(JsonObject{{"id", Json(wid_new)},
                                        {"name", body["name"]}});
     return json_resp(200, out);
   }
@@ -114,13 +115,13 @@ HttpResponse Master::handle_projects(const HttpRequest& req,
       return json_resp(403, err_body("not authorized for this workspace"));
     }
     std::lock_guard<std::mutex> lock(mu_);
-    db_.exec(
+    int64_t pid_new = db_.insert(
         "INSERT INTO projects (name, description, workspace_id, user_id) "
         "VALUES (?, ?, ?, ?)",
         {body["name"], Json(body["description"].as_string()), Json(wid),
          Json(ctx.uid)});
     Json out = Json::object();
-    out["project"] = Json(JsonObject{{"id", Json(db_.last_insert_id())},
+    out["project"] = Json(JsonObject{{"id", Json(pid_new)},
                                      {"name", body["name"]}});
     return json_resp(200, out);
   }
@@ -173,14 +174,14 @@ HttpResponse Master::handle_models(const HttpRequest& req,
       return json_resp(403, err_body("not authorized for this workspace"));
     }
     std::lock_guard<std::mutex> lock(mu_);
-    db_.exec(
+    int64_t mid_new = db_.insert(
         "INSERT INTO models (name, description, metadata, labels, user_id, "
         "workspace_id) VALUES (?, ?, ?, ?, ?, ?)",
         {body["name"], Json(body["description"].as_string()),
          Json(body["metadata"].dump()), Json(body["labels"].dump()),
          Json(ctx.uid), Json(body["workspace_id"].as_int(1))});
     Json out = Json::object();
-    out["model"] = Json(JsonObject{{"id", Json(db_.last_insert_id())},
+    out["model"] = Json(JsonObject{{"id", Json(mid_new)},
                                    {"name", body["name"]}});
     return json_resp(200, out);
   }
@@ -223,7 +224,7 @@ HttpResponse Master::handle_models(const HttpRequest& req,
             "WHERE model_id=?",
             {Json(mid)});
         int64_t version = vrows[0]["v"].as_int();
-        db_.exec(
+        int64_t ver_id = db_.insert(
             "INSERT INTO model_versions (model_id, version, checkpoint_uuid, "
             "name, comment, metadata) VALUES (?, ?, ?, ?, ?, ?)",
             {Json(mid), Json(version), body["checkpoint_uuid"],
@@ -234,7 +235,7 @@ HttpResponse Master::handle_models(const HttpRequest& req,
             {Json(mid)});
         Json out = Json::object();
         out["model_version"] = Json(JsonObject{
-            {"id", Json(db_.last_insert_id())}, {"version", Json(version)}});
+            {"id", Json(ver_id)}, {"version", Json(version)}});
         return json_resp(200, out);
       }
     }
@@ -309,12 +310,12 @@ HttpResponse Master::handle_webhooks(const HttpRequest& req,
   }
   if (parts.size() == 1 && req.method == "POST") {
     Json body = Json::parse(req.body);
-    db_.exec(
+    int64_t hook_id = db_.insert(
         "INSERT INTO webhooks (url, webhook_type, triggers) VALUES (?, ?, ?)",
         {body["url"], Json(body["webhook_type"].as_string("DEFAULT")),
          Json(body["triggers"].dump())});
     Json out = Json::object();
-    out["id"] = db_.last_insert_id();
+    out["id"] = hook_id;
     return json_resp(200, out);
   }
   if (parts.size() == 2 && req.method == "DELETE") {

@@ -113,16 +113,14 @@ int64_t Master::create_experiment_locked(const Json& config,
     throw std::runtime_error("config.entrypoint is required");
   }
 
-  std::string job_id = "job-" + std::to_string(db_.last_insert_id()) + "-" +
-                       std::to_string(now());
+  std::string job_id = "job-" + random_hex(8);
   db_.exec("INSERT INTO jobs (id, type) VALUES (?, 'EXPERIMENT')",
            {Json(job_id)});
-  db_.exec(
+  int64_t eid = db_.insert(
       "INSERT INTO experiments (state, config, original_config, model_def, "
       "owner_id, project_id, job_id) VALUES ('PAUSED', ?, ?, ?, ?, ?, ?)",
       {Json(config.dump()), Json(config.dump()), Json(model_def_b64),
        Json(user_id), Json(project_id), Json(job_id)});
-  int64_t eid = db_.last_insert_id();
 
   ExperimentState exp;
   exp.id = eid;
@@ -340,13 +338,12 @@ void Master::process_ops_locked(ExperimentState& exp,
   for (const auto& op : ops) {
     switch (op.kind) {
       case SearcherOp::Kind::Create: {
-        db_.exec(
+        TrialState trial;
+        trial.id = db_.insert(
             "INSERT INTO trials (experiment_id, request_id, hparams, seed) "
             "VALUES (?, ?, ?, ?)",
             {Json(exp.id), Json(op.request_id), Json(op.hparams.dump()),
              Json(op.seed)});
-        TrialState trial;
-        trial.id = db_.last_insert_id();
         trial.request_id = op.request_id;
         trial.experiment_id = exp.id;
         trial.hparams = op.hparams;
