@@ -96,6 +96,88 @@ def test_app_api_sequence(cluster, tmp_path):
     assert isinstance(jobs, list)  # drained after completion
 
 
+def test_trial_log_viewer_flow(cluster, tmp_path):
+    """The trial page's log viewer: paged fetch by offset, then a follow
+    long-poll that returns promptly once lines exist (reference TrialLogs)."""
+    eid, token = _create_experiment(
+        cluster, _experiment_config(tmp_path), activate=True)
+    _wait_experiment(cluster, eid, token)
+    trials = cluster.api(
+        "GET", f"/api/v1/experiments/{eid}/trials", token=token)["trials"]
+    tid = trials[0]["id"]
+
+    # trial metadata the page header reads
+    t = cluster.api("GET", f"/api/v1/trials/{tid}", token=token)["trial"]
+    assert t["experiment_id"] == eid and t["total_batches"] >= 8
+
+    # paged fetch exactly as the viewer does
+    offset, lines = 0, []
+    while True:
+        logs = cluster.api(
+            "GET",
+            f"/api/v1/tasks/trial-{tid}/logs?offset={offset}&follow=false",
+            token=token)["logs"]
+        if not logs:
+            break
+        for line in logs:
+            offset = max(offset, line["id"])
+            lines.append(line["log"])
+        assert all({"id", "log"} <= set(line) for line in logs)
+    assert any("trial complete" in line for line in lines)
+
+    # follow=true from a fresh offset returns immediately with data
+    logs = cluster.api(
+        "GET",
+        f"/api/v1/tasks/trial-{tid}/logs?offset=0&follow=true"
+        f"&timeout_seconds=5",
+        token=token)["logs"]
+    assert logs
+
+
+def test_hp_search_view_data(cluster, tmp_path):
+    """The experiment page's HP table + hparam-vs-metric scatter need per-
+    trial hparams and searcher_metric_value from an adaptive search."""
+    searcher = {
+        "name": "adaptive_asha", "metric": "val_loss",
+        "max_length": {"batches": 8}, "max_trials": 4, "max_rungs": 2,
+        "divisor": 2, "max_concurrent_trials": 2,
+    }
+    config = _experiment_config(
+        tmp_path, searcher=searcher,
+        extra={"hyperparameters": {"lr": {"type": "log", "minval": -2,
+                                          "maxval": 0}}})
+    eid, token = _create_experiment(cluster, config, activate=True)
+    _wait_experiment(cluster, eid, token, timeout=180.0)
+    trials = cluster.api(
+        "GET", f"/api/v1/experiments/{eid}/trials", token=token)["trials"]
+    assert len(trials) == 4
+    scored = [t for t in trials if t.get("searcher_metric_value") is not None]
+    assert len(scored) >= 2, "scatter needs >=2 scored trials"
+    for t in scored:
+        assert isinstance(t["hparams"].get("lr"), float)
+    # distinct sampled hparams → a real scatter, not a vertical line
+    assert len({t["hparams"]["lr"] for t in scored}) >= 2
+
+
+def test_stream_live_update_contract(cluster, tmp_path):
+    """The list page's live refresh: an experiment state change surfaces as
+    a stream event the follower can react to."""
+    token = cluster.login()
+    out = cluster.api(
+        "GET", "/api/v1/stream?since=0&timeout_seconds=0", token=token)
+    since = out["latest_seq"]
+    eid, token = _create_experiment(
+        cluster, _experiment_config(tmp_path), activate=True)
+    _wait_experiment(cluster, eid, token)
+    out = cluster.api(
+        "GET",
+        f"/api/v1/stream?since={since}&entities=experiments"
+        f"&timeout_seconds=5",
+        token=token)
+    assert any(e["entity"] == "experiments" and e["payload"]["id"] == eid
+               for e in out["events"])
+
+
 def test_app_js_references_real_endpoints(cluster):
     """Static check: every /api/v1 path in app.js is routed by the master
     (no dead fetches shipped in the UI)."""

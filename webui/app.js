@@ -1,5 +1,9 @@
 /* determined-tpu WebUI — dependency-free SPA over the master REST API.
-   Pages: experiments list/detail (metric charts), cluster, job queue.
+   Pages: experiments list/detail (metric charts, HP search table +
+   hparam-vs-metric viz), trial detail (live log viewer with follow),
+   workspaces/projects, model registry, cluster, job queue.
+   Live updates ride the /api/v1/stream long-poll (reference
+   internal/stream/ websocket publisher).
    Charting follows the dataviz method: fixed-order categorical slots,
    2px lines, recessive grid, crosshair+tooltip hover, legend for >=2
    series + direct labels, table view toggle. */
@@ -7,6 +11,10 @@
 "use strict";
 
 const view = document.getElementById("view");
+
+// Generation counter: bumped on every route change so in-flight stream
+// long-polls and log follows from the previous page stop re-rendering.
+let gen = 0;
 
 // ---------------------------------------------------------------- api
 
@@ -43,6 +51,38 @@ function el(tag, attrs = {}, ...children) {
 }
 
 function stateBadge(s) { return el("span", { class: `state ${s}` }, s); }
+
+// Same client-side salted hash as the CLI/SDK (common/api.py salted_hash):
+// the master stores/compares the opaque digest, raw passwords stay off the
+// wire. Empty password maps to "" (bootstrap-user posture).
+async function saltedHash(username, password) {
+  if (!password) return "";
+  const data = new TextEncoder().encode(
+    `determined-tpu$${username}$${password}`);
+  const digest = await crypto.subtle.digest("SHA-256", data);
+  return [...new Uint8Array(digest)]
+    .map((b) => b.toString(16).padStart(2, "0")).join("");
+}
+
+// Long-poll /api/v1/stream and invoke cb(events) until the page changes.
+// Resyncs (cb(null)) when the master reports a dropped cursor.
+async function followStream(entities, cb) {
+  const myGen = gen;
+  let since = 0;
+  while (myGen === gen) {
+    try {
+      const out = await api("GET",
+        `/api/v1/stream?since=${since}&entities=${entities}` +
+        `&timeout_seconds=25`);
+      if (myGen !== gen) return;
+      if (out.dropped) { since = 0; cb(null); continue; }
+      if (out.events.length) { since = out.latest_seq; cb(out.events); }
+    } catch (e) {
+      if (e.message === "unauthenticated") return;
+      await new Promise((r) => setTimeout(r, 2000));
+    }
+  }
+}
 
 function fmt(v) {
   if (typeof v !== "number") return String(v);
@@ -232,6 +272,65 @@ function lineChart(title, series, xLabel) {
   return block;
 }
 
+// scatter: points [{x, y, label}] — hparam-vs-metric view for HP search.
+function scatterChart(title, points, xLabel, yLabel) {
+  const W = 720, H = 240, M = { l: 64, r: 24, t: 12, b: 32 };
+  const block = el("div", { class: "chart-block" },
+    el("div", { class: "chart-head" },
+      el("span", { class: "chart-title" }, title)));
+  if (!points.length) {
+    block.append(el("div", { class: "muted" }, "no data"));
+    return block;
+  }
+  const xs = points.map((p) => p.x), ys = points.map((p) => p.y);
+  const xmin = Math.min(...xs), xmax = Math.max(...xs);
+  const ymin = Math.min(...ys), ymax = Math.max(...ys);
+  const xpad = (xmax - xmin || Math.abs(xmax) || 1) * 0.06;
+  const ypad = (ymax - ymin || Math.abs(ymax) || 1) * 0.1;
+  const sx = (x) => M.l + ((x - (xmin - xpad)) /
+    ((xmax + xpad) - (xmin - xpad))) * (W - M.l - M.r);
+  const sy = (y) => H - M.b - ((y - (ymin - ypad)) /
+    ((ymax + ypad) - (ymin - ypad))) * (H - M.t - M.b);
+  const NS = "http://www.w3.org/2000/svg";
+  const svg = document.createElementNS(NS, "svg");
+  svg.setAttribute("class", "chart");
+  svg.setAttribute("viewBox", `0 0 ${W} ${H}`);
+  for (let i = 0; i <= 3; i++) {
+    const y = ymin - ypad + ((ymax + ypad) - (ymin - ypad)) * (i / 3);
+    const line = document.createElementNS(NS, "line");
+    line.setAttribute("class", "gridline");
+    line.setAttribute("x1", M.l); line.setAttribute("x2", W - M.r);
+    line.setAttribute("y1", sy(y)); line.setAttribute("y2", sy(y));
+    svg.append(line);
+    const lbl = document.createElementNS(NS, "text");
+    lbl.setAttribute("class", "axis-label");
+    lbl.setAttribute("x", M.l - 6); lbl.setAttribute("y", sy(y) + 4);
+    lbl.setAttribute("text-anchor", "end");
+    lbl.textContent = fmt(y);
+    svg.append(lbl);
+  }
+  const xl = document.createElementNS(NS, "text");
+  xl.setAttribute("class", "axis-label");
+  xl.setAttribute("x", (M.l + W - M.r) / 2); xl.setAttribute("y", H - 8);
+  xl.setAttribute("text-anchor", "middle");
+  xl.textContent = `${xLabel}  →  ${yLabel}`;
+  svg.append(xl);
+  for (const p of points) {
+    const dot = document.createElementNS(NS, "circle");
+    dot.setAttribute("cx", sx(p.x)); dot.setAttribute("cy", sy(p.y));
+    dot.setAttribute("r", 4.5);
+    dot.setAttribute("fill", seriesColor(0));
+    dot.append((() => {
+      const t = document.createElementNS(NS, "title");
+      t.textContent = `${p.label}: ${xLabel}=${fmt(p.x)} ${yLabel}=${fmt(p.y)}`;
+      return t;
+    })());
+    svg.append(dot);
+  }
+  block.append(el("div", { class: "chart-wrap" }, svg));
+  return block;
+}
+
 // ---------------------------------------------------------------- pages
 
 function renderLogin(err) {
@@ -247,7 +346,10 @@ function renderLogin(err) {
           const r = await fetch("/api/v1/auth/login", {
             method: "POST",
             headers: { "Content-Type": "application/json" },
-            body: JSON.stringify({ username: user.value, password: pass.value }),
+            body: JSON.stringify({
+              username: user.value,
+              password: await saltedHash(user.value, pass.value),
+            }),
           });
           if (!r.ok) throw new Error(`HTTP ${r.status}`);
           const j = await r.json();
@@ -311,12 +413,39 @@ async function pageExperiment(id) {
   actions.append(actErr);
   view.append(actions);
 
+  // HP search view: hparams per trial + searcher metric, with an
+  // hparam-vs-metric scatter per numeric hyperparameter (the reference's
+  // HP-viz pages in webui/react/src/pages/ExperimentDetails).
+  const metricName = experiment.config?.searcher?.metric || "metric";
+  const hpNames = [...new Set(trials.flatMap(
+    (t) => Object.keys(t.hparams || {})))].sort();
   view.append(el("h2", {}, "Trials"));
   view.append(el("table", {},
-    el("tr", {}, ["ID", "State", "Restarts"].map((h) => el("th", {}, h))),
+    el("tr", {}, ["ID", "State", "Batches", ...hpNames, metricName,
+                  "Restarts", "Logs"].map((h) => el("th", {}, h))),
     trials.map((t) => el("tr", {},
       el("td", {}, t.id), el("td", {}, stateBadge(t.state)),
-      el("td", {}, t.restarts ?? 0)))));
+      el("td", {}, t.total_batches ?? 0),
+      hpNames.map((h) => el("td", { class: "muted" },
+        t.hparams && h in t.hparams ? fmt(t.hparams[h]) : "")),
+      el("td", {}, t.searcher_metric_value == null
+        ? "" : fmt(t.searcher_metric_value)),
+      el("td", {}, t.restarts ?? 0),
+      el("td", {}, el("a", { href: `#/trials/${t.id}` }, "logs"))))));
+
+  const scored = trials.filter((t) => t.searcher_metric_value != null);
+  if (scored.length >= 2) {
+    view.append(el("h2", {}, "Hyperparameter search"));
+    for (const h of hpNames) {
+      const pts = scored
+        .filter((t) => typeof (t.hparams || {})[h] === "number")
+        .map((t) => ({ x: t.hparams[h], y: t.searcher_metric_value,
+                       label: `trial ${t.id}` }));
+      if (pts.length >= 2) {
+        view.append(scatterChart(`${h} vs ${metricName}`, pts, h, metricName));
+      }
+    }
+  }
 
   // metric charts from the first trial (single/first-trial view; the data
   // is per-trial at /api/v1/trials/{id}/metrics)
@@ -356,6 +485,98 @@ async function pageExperiment(id) {
   view.append(el("h2", {}, "Config"));
   view.append(el("pre", { class: "config" },
     JSON.stringify(experiment.config, null, 2)));
+}
+
+async function pageTrial(id) {
+  const myGen = gen;
+  const { trial } = await api("GET", `/api/v1/trials/${id}`);
+  view.textContent = "";
+  view.append(el("h1", {},
+    el("a", { href: `#/experiments/${trial.experiment_id}` },
+      `Experiment ${trial.experiment_id}`),
+    ` / Trial ${id} `, stateBadge(trial.state)));
+  view.append(el("p", { class: "muted" },
+    `batches ${trial.total_batches ?? 0} · restarts ${trial.restarts ?? 0}` +
+    (trial.latest_checkpoint ? ` · checkpoint ${trial.latest_checkpoint}` : "")));
+
+  // Log viewer with follow (reference TrialLogs page; long-polls the
+  // master's follow endpoint so new lines stream in live).
+  const followBox = el("input", { type: "checkbox", checked: "checked" });
+  view.append(el("h2", {}, "Logs ",
+    el("label", { class: "muted" }, followBox, " follow")));
+  const pane = el("pre", { class: "logpane" });
+  view.append(pane);
+  let offset = 0;
+  const pump = async () => {
+    while (myGen === gen) {
+      const follow = followBox.checked;
+      const { logs } = await api("GET",
+        `/api/v1/tasks/trial-${id}/logs?offset=${offset}` +
+        `&follow=${follow}&timeout_seconds=20`);
+      if (myGen !== gen) return;
+      for (const line of logs) {
+        offset = Math.max(offset, line.id);
+        pane.append(el("div", { class: `loglevel-${line.level || "INFO"}` },
+          `${line.timestamp ?? ""}  ${line.log}`));
+      }
+      if (logs.length && followBox.checked) pane.scrollTop = pane.scrollHeight;
+      if (!follow) {
+        if (!logs.length) return;  // drained; stop without follow
+      } else if (!logs.length) {
+        await new Promise((r) => setTimeout(r, 1000));
+      }
+    }
+  };
+  pump().catch((e) => {
+    if (myGen === gen) pane.append(el("div", { class: "error" }, String(e)));
+  });
+}
+
+async function pageWorkspaces() {
+  const { workspaces } = await api("GET", "/api/v1/workspaces");
+  view.textContent = "";
+  view.append(el("h1", {}, "Workspaces"));
+  for (const w of workspaces) {
+    if (w.archived) continue;
+    const { projects } = await api("GET", `/api/v1/workspaces/${w.id}/projects`);
+    view.append(el("h2", {}, `${w.name} `,
+      el("span", { class: "muted" }, `(id ${w.id})`)));
+    view.append(el("table", {},
+      el("tr", {}, ["Project", "Description", "Experiments"]
+        .map((h) => el("th", {}, h))),
+      projects.filter((p) => !p.archived).map((p) => el("tr", {},
+        el("td", {}, p.name),
+        el("td", { class: "muted" }, p.description ?? ""),
+        el("td", {}, el("a", {
+          href: `#/experiments`,
+          onclick: () => sessionStorage.setItem("project_filter", p.id),
+        }, "view"))))));
+  }
+  if (!workspaces.length) view.append(el("p", { class: "muted" }, "none"));
+}
+
+async function pageModels() {
+  const { models } = await api("GET", "/api/v1/models");
+  view.textContent = "";
+  view.append(el("h1", {}, "Model registry"));
+  if (!models.length) {
+    view.append(el("p", { class: "muted" }, "no registered models"));
+    return;
+  }
+  for (const m of models) {
+    if (m.archived) continue;
+    const { model_versions } = await api(
+      "GET", `/api/v1/models/${encodeURIComponent(m.name)}/versions`);
+    view.append(el("h2", {}, m.name,
+      el("span", { class: "muted" }, `  ${m.description ?? ""}`)));
+    view.append(el("table", {},
+      el("tr", {}, ["Version", "Checkpoint", "Registered"]
+        .map((h) => el("th", {}, h))),
+      model_versions.map((v) => el("tr", {},
+        el("td", {}, v.version),
+        el("td", { class: "muted" }, v.checkpoint_uuid),
+        el("td", { class: "muted" }, v.creation_time ?? "")))));
+  }
 }
 
 async function pageCluster() {
@@ -399,6 +620,7 @@ async function pageJobs() {
 // --------------------------------------------------------------- router
 
 async function route() {
+  gen += 1;  // cancels the previous page's stream/log followers
   document.getElementById("whoami").textContent =
     localStorage.getItem("det_user") || "";
   const hash = location.hash || "#/experiments";
@@ -406,10 +628,37 @@ async function route() {
     a.classList.toggle("active", hash.startsWith(a.getAttribute("href"))));
   try {
     const m = hash.match(/^#\/experiments\/(\d+)/);
-    if (m) return await pageExperiment(m[1]);
+    if (m) {
+      await pageExperiment(m[1]);
+      // Live refresh: any experiment/trial/metric event for this
+      // experiment re-renders (throttled by the long-poll itself).
+      const myGen = gen;
+      followStream("experiments,trials,metrics", (events) => {
+        if (myGen !== gen) return;
+        if (events === null ||
+            events.some((e) =>
+              String(e.payload?.id) === m[1] ||
+              String(e.payload?.experiment_id) === m[1] ||
+              e.entity === "metrics")) {
+          pageExperiment(m[1]);
+        }
+      });
+      return;
+    }
+    const t = hash.match(/^#\/trials\/(\d+)/);
+    if (t) return await pageTrial(t[1]);
+    if (hash.startsWith("#/workspaces")) return await pageWorkspaces();
+    if (hash.startsWith("#/models")) return await pageModels();
     if (hash.startsWith("#/cluster")) return await pageCluster();
     if (hash.startsWith("#/jobs")) return await pageJobs();
-    return await pageExperiments();
+    await pageExperiments();
+    {
+      // Experiment list stays live without reload via /api/v1/stream.
+      const myGen = gen;
+      followStream("experiments", () => {
+        if (myGen === gen) pageExperiments();
+      });
+    }
   } catch (e) {
     if (e.message !== "unauthenticated") {
       view.textContent = "";
