@@ -447,6 +447,43 @@ int main(int argc, char** argv) {
   gethostname(hostname, sizeof(hostname));
   opts.id = hostname;
   opts.addr = "127.0.0.1";
+
+  // Config precedence flags > env > JSON config file — the same
+  // viper-style layering as the master (reference
+  // agent/internal/options/options.go reads agent.yaml the same way).
+  std::string cfg_path;
+  if (const char* p = getenv("DET_AGENT_CONFIG")) cfg_path = p;
+  for (int i = 1; i < argc - 1; ++i) {
+    if (strcmp(argv[i], "--config") == 0) cfg_path = argv[i + 1];
+  }
+  if (!cfg_path.empty()) {
+    std::ifstream f(cfg_path);
+    if (!f) {
+      std::cerr << "cannot read config " << cfg_path << std::endl;
+      return 1;
+    }
+    std::stringstream ss;
+    ss << f.rdbuf();
+    Json j = Json::parse_or_null(ss.str());
+    if (!j.is_object()) {
+      std::cerr << "config " << cfg_path << " is not a JSON object"
+                << std::endl;
+      return 1;
+    }
+    if (j["master_url"].is_string()) opts.master_url = j["master_url"].as_string();
+    if (j["id"].is_string()) opts.id = j["id"].as_string();
+    if (j["resource_pool"].is_string()) {
+      opts.resource_pool = j["resource_pool"].as_string();
+    }
+    if (j["addr"].is_string()) opts.addr = j["addr"].as_string();
+    if (j["work_root"].is_string()) opts.work_root = j["work_root"].as_string();
+    if (j["token_file"].is_string()) opts.token_file = j["token_file"].as_string();
+    if (j["slots"].is_number()) {
+      opts.slots_override = static_cast<int>(j["slots"].as_int());
+    }
+    if (j["slot_type"].is_string()) opts.slot_type = j["slot_type"].as_string();
+  }
+
   if (const char* p = getenv("DET_MASTER")) opts.master_url = p;
   if (const char* p = getenv("DET_AGENT_SLOTS")) {
     opts.slots_override = atoi(p);
@@ -466,9 +503,10 @@ int main(int argc, char** argv) {
     else if (a == "--slot-type") opts.slot_type = next();
     else if (a == "--work-root") opts.work_root = next();
     else if (a == "--token-file") opts.token_file = next();
+    else if (a == "--config") next();
     else if (a == "--help" || a == "-h") {
-      std::cout << "determined-agent --master-url URL [--id ID] "
-                   "[--resource-pool P] [--addr A] [--slots N] "
+      std::cout << "determined-agent [--config agent.json] --master-url URL "
+                   "[--id ID] [--resource-pool P] [--addr A] [--slots N] "
                    "[--slot-type tpu|cpu] [--work-root DIR] "
                    "[--token-file PATH]\n";
       return 0;

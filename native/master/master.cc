@@ -95,11 +95,72 @@ MasterConfig MasterConfig::from_json(const Json& j) {
   for (const auto& [pool, policy] : j["resource_pools"].as_object()) {
     c.pool_policies[pool] = policy["scheduler"].as_string("priority");
   }
+  // Resource-manager backend selection + settings (reference
+  // rm/resource_manager_iface.go seam; config.ResourceManager).
+  if (j["resource_manager"].is_string()) {
+    c.resource_manager = j["resource_manager"].as_string();
+  } else if (j["resource_manager"]["type"].is_string()) {
+    c.resource_manager = j["resource_manager"]["type"].as_string();
+  }
+  if (j["advertised_url"].is_string()) {
+    c.advertised_url = j["advertised_url"].as_string();
+  }
+  const Json& k8s = j["kubernetes"];
+  if (k8s.is_object()) {
+    c.k8s.api_url = k8s["api_url"].as_string(c.k8s.api_url);
+    c.k8s.namespace_ = k8s["namespace"].as_string(c.k8s.namespace_);
+    c.k8s.image = k8s["image"].as_string(c.k8s.image);
+    c.k8s.slots_per_pod =
+        static_cast<int>(k8s["slots_per_pod"].as_int(c.k8s.slots_per_pod));
+    c.k8s.max_pods = static_cast<int>(k8s["max_pods"].as_int(c.k8s.max_pods));
+    c.k8s.bearer_token = k8s["bearer_token"].as_string("");
+    c.k8s.service_subdomain =
+        k8s["service_subdomain"].as_string(c.k8s.service_subdomain);
+  }
+  const Json& prov = j["provisioner"];
+  if (prov.is_object()) {
+    c.provisioner.webhook_url = prov["webhook_url"].as_string("");
+    c.provisioner.sustain_s =
+        prov["sustain_seconds"].as_double(c.provisioner.sustain_s);
+    c.provisioner.cooldown_s =
+        prov["cooldown_seconds"].as_double(c.provisioner.cooldown_s);
+    c.provisioner.max_slots =
+        static_cast<int>(prov["max_slots"].as_int(c.provisioner.max_slots));
+  }
   return c;
 }
 
 Master::Master(MasterConfig cfg) : cfg_(std::move(cfg)), db_(cfg_.db_path) {
   db_.migrate();
+  // Resource-manager backend behind the rm.h seam (reference
+  // rm/resource_manager_iface.go): built-in agent RM, or pods on k8s.
+  if (cfg_.resource_manager == "kubernetes") {
+    RmHooks hooks;
+    hooks.build_task_env = [this](Allocation& a, const std::string& node,
+                                  const std::vector<int>& slots, int rank,
+                                  int n, const std::string& chief) {
+      return build_task_env_locked(a, node, slots, rank, n, chief);
+    };
+    hooks.on_resource_state = [this](const std::string& aid,
+                                     const std::string& node,
+                                     const std::string& state, int code,
+                                     const std::string& addr) {
+      apply_resource_state_locked(aid, node, state, code, addr);
+    };
+    hooks.notify = [this] { cv_.notify_all(); };
+    rm_ = std::make_unique<KubernetesResourceManager>(cfg_.k8s, hooks);
+    std::cerr << "master: kubernetes RM against " << cfg_.k8s.api_url
+              << " namespace " << cfg_.k8s.namespace_ << std::endl;
+    if (cfg_.advertised_url.empty()) {
+      std::cerr << "master: WARNING advertised_url is unset — pods will "
+                   "get DET_MASTER derived from the bind address, which is "
+                   "not reachable from inside a pod; set advertised_url in "
+                   "the master config" << std::endl;
+    }
+  } else {
+    rm_ = make_agent_rm(*this);
+  }
+  provisioner_ = std::make_unique<Provisioner>(cfg_.provisioner);
   // Default users, as in the reference bootstrap — plus the agent service
   // account: node daemons authenticate as "determined-agent" (role
   // "agent"), the only role allowed on the agent-protocol routes. Those

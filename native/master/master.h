@@ -34,6 +34,7 @@
 #include "../common/http.h"
 #include "../common/json.h"
 #include "db.h"
+#include "rm.h"
 #include "searcher.h"
 
 namespace det {
@@ -69,6 +70,15 @@ struct MasterConfig {
   // Task-log retention sweep (reference internal/logretention/):
   // logs older than this many days are deleted hourly; <= 0 keeps forever.
   int log_retention_days = 0;
+  // Resource-manager backend: "agent" (built-in) | "kubernetes"
+  // (reference rm/resource_manager_iface.go seam over agentrm/k8srm).
+  std::string resource_manager = "agent";
+  // URL tasks use to reach the master (DET_MASTER). Required for k8s pods
+  // (the bind host — let alone 0.0.0.0→127.0.0.1 — is meaningless inside
+  // a pod's network namespace); default derives from host:port.
+  std::string advertised_url;
+  KubernetesRmConfig k8s;
+  ProvisionerConfig provisioner;
 
   static MasterConfig from_json(const Json& j);
 };
@@ -270,6 +280,17 @@ class Master {
   bool try_fit_locked(Allocation& alloc);
   void release_resources_locked(Allocation& alloc);
   void check_agents_locked();
+  // RM seam pieces (rm.h): task-spec rendering and resource-state
+  // transitions are master-owned; placement/node lifecycle is RM-owned.
+  Json build_task_env_locked(Allocation& alloc, const std::string& node_id,
+                             const std::vector<int>& slot_ids, int rank,
+                             int num_nodes, const std::string& chief_addr);
+  void apply_resource_state_locked(const std::string& alloc_id,
+                                   const std::string& node_id,
+                                   const std::string& state, int exit_code,
+                                   const std::string& daemon_addr);
+  void send_kill_actions_locked(Allocation& alloc);
+  void sweep_dead_agents_locked(double now);
 
   ExperimentState* find_experiment_locked(int64_t id);
   TrialState* find_trial_locked(int64_t trial_id, ExperimentState** exp_out);
@@ -324,6 +345,12 @@ class Master {
 
   std::atomic<bool> tunnels_run_{true};  // drops hijacked tunnels on stop()
 
+  // Resource-manager backend behind the rm.h seam; the built-in agent RM
+  // delegates back into the master's agent machinery (friend below).
+  std::unique_ptr<ResourceManager> rm_;
+  std::unique_ptr<Provisioner> provisioner_;
+  friend class AgentResourceManager;
+
   std::mutex mu_;
   std::condition_variable cv_;
   std::map<std::string, AgentState> agents_;
@@ -335,5 +362,8 @@ class Master {
   std::thread scheduler_thread_;
   int64_t alloc_counter_ = 0;
 };
+
+// Factory for the built-in agent RM (defined in master_agents.cc).
+std::unique_ptr<ResourceManager> make_agent_rm(Master& m);
 
 }  // namespace det

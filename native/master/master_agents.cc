@@ -198,39 +198,49 @@ HttpResponse Master::handle_agents_api(const HttpRequest& req,
       req.method == "POST") {
     Json body = Json::parse_or_null(req.body);
     std::lock_guard<std::mutex> lock(mu_);
-    auto it = allocations_.find(parts[3]);
-    if (it == allocations_.end()) {
+    if (allocations_.find(parts[3]) == allocations_.end()) {
       return json_resp(404, err_body("unknown allocation"));
     }
-    Allocation& alloc = it->second;
-    const std::string& state = body["state"].as_string();
-    bool all_running = true, all_exited = true;
-    for (auto& r : alloc.resources) {
-      if (r.agent_id == agent_id) {
-        r.state = state;
-        if (state == "EXITED") {
-          r.exit_code = static_cast<int>(body["exit_code"].as_int(-1));
-        }
-        if (body["daemon_addr"].is_string()) {
-          r.daemon_addr = body["daemon_addr"].as_string();
-        }
-      }
-      all_running &= r.state == "RUNNING" || r.state == "EXITED";
-      all_exited &= r.state == "EXITED";
-    }
-    if (alloc.state == "ASSIGNED" && all_running) {
-      alloc.state = "RUNNING";
-      db_.exec("UPDATE allocations SET state='RUNNING' WHERE id=?",
-               {Json(alloc.id)});
-    }
-    if (all_exited && alloc.state != "TERMINATED") {
-      on_allocation_exit_locked(alloc);
-    }
-    cv_.notify_all();
+    apply_resource_state_locked(
+        parts[3], agent_id, body["state"].as_string(),
+        static_cast<int>(body["exit_code"].as_int(-1)),
+        body["daemon_addr"].as_string(""));
     return json_resp(200, Json::object());
   }
 
   return json_resp(404, err_body("not found"));
+}
+
+// A node's share of an allocation changed state — shared by the agent
+// long-poll protocol and the k8s RM's pod reconciliation (rm.h
+// on_resource_state hook).
+void Master::apply_resource_state_locked(const std::string& alloc_id,
+                                         const std::string& node_id,
+                                         const std::string& state,
+                                         int exit_code,
+                                         const std::string& daemon_addr) {
+  auto it = allocations_.find(alloc_id);
+  if (it == allocations_.end()) return;
+  Allocation& alloc = it->second;
+  bool all_running = true, all_exited = true;
+  for (auto& r : alloc.resources) {
+    if (r.agent_id == node_id) {
+      r.state = state;
+      if (state == "EXITED") r.exit_code = exit_code;
+      if (!daemon_addr.empty()) r.daemon_addr = daemon_addr;
+    }
+    all_running &= r.state == "RUNNING" || r.state == "EXITED";
+    all_exited &= r.state == "EXITED";
+  }
+  if (alloc.state == "ASSIGNED" && all_running) {
+    alloc.state = "RUNNING";
+    db_.exec("UPDATE allocations SET state='RUNNING' WHERE id=?",
+             {Json(alloc.id)});
+  }
+  if (all_exited && alloc.state != "TERMINATED") {
+    on_allocation_exit_locked(alloc);
+  }
+  cv_.notify_all();
 }
 
 // ---------------------------------------------------------------------------
@@ -287,6 +297,28 @@ void Master::check_agents_locked() {
       kill_allocation_locked(alloc);
     }
   }
+  // Backend upkeep: dead-agent sweep (agent RM) / pod reconcile (k8s RM).
+  rm_->tick(t);
+  // Provisioner: sustained unmet demand fires a scale-up webhook.
+  if (provisioner_ && provisioner_->enabled()) {
+    std::map<std::string, ScalingSnapshot> pools;
+    for (const auto& aid : pending_) {
+      auto it = allocations_.find(aid);
+      if (it == allocations_.end() || it->second.state != "PENDING") continue;
+      ScalingSnapshot& s = pools[it->second.resource_pool];
+      s.pending_slots += it->second.slots;
+      s.pending_allocations += 1;
+    }
+    for (auto& [pool, snap] : pools) {
+      ScalingSnapshot cap = rm_->scaling(pool);
+      snap.total_slots = cap.total_slots;
+      snap.free_slots = cap.free_slots;
+      provisioner_->observe(pool, snap, t);
+    }
+  }
+}
+
+void Master::sweep_dead_agents_locked(double t) {
   for (auto& [id, a] : agents_) {
     if (!a.alive) continue;
     if (t - a.last_heartbeat > cfg_.agent_timeout_s) {
@@ -364,7 +396,22 @@ void Master::schedule_locked() {
   for (const auto& aid : queue) {
     auto it = allocations_.find(aid);
     if (it == allocations_.end() || it->second.state != "PENDING") continue;
-    if (!try_fit_locked(it->second)) still_pending.push_back(aid);
+    if (rm_->allocate(it->second)) {
+      // Placement is the RM's; binding the trial + persisting is ours.
+      Allocation& alloc = it->second;
+      ExperimentState* exp = find_experiment_locked(alloc.experiment_id);
+      if (exp != nullptr) {
+        auto tit = exp->trials.find(alloc.request_id);
+        if (tit != exp->trials.end()) tit->second.allocation_id = alloc.id;
+      }
+      db_.exec(
+          "UPDATE allocations SET state='ASSIGNED', agent_id=? WHERE id=?",
+          {Json(alloc.resources.empty() ? "" : alloc.resources[0].agent_id),
+           Json(alloc.id)});
+      cv_.notify_all();
+    } else {
+      still_pending.push_back(aid);
+    }
   }
   pending_.assign(still_pending.begin(), still_pending.end());
 
@@ -459,68 +506,10 @@ bool Master::try_fit_locked(Allocation& alloc) {
       }
     }
 
-    Json env = Json::object();
-    env["DET_MASTER"] = "http://" +
-                        (cfg_.host == "0.0.0.0" ? "127.0.0.1" : cfg_.host) +
-                        ":" + std::to_string(server_.port());
-    env["DET_CLUSTER_ID"] = cfg_.cluster_id;
-    env["DET_AGENT_ID"] = agent->id;
-    env["DET_TASK_ID"] = alloc.task_id;
-    env["DET_TASK_TYPE"] = trial != nullptr ? "TRIAL" : "GENERIC";
-    env["DET_ALLOCATION_ID"] = alloc.id;
-    env["DET_RESOURCES_ID"] = res.container_id;
+    Json env = build_task_env_locked(alloc, agent->id, slot_ids, rank,
+                                     num_nodes, chief_addr);
     env["DET_CONTAINER_ID"] = res.container_id;
-    env["DET_NODE_RANK"] = static_cast<int64_t>(rank);
-    env["DET_NUM_NODES"] = static_cast<int64_t>(num_nodes);
-    env["DET_CHIEF_IP"] = chief_addr;
-    Json sids = Json::array();
-    for (int sid : slot_ids) sids.push_back(Json(static_cast<int64_t>(sid)));
-    env["DET_SLOT_IDS"] = sids.dump();
-    if (exp != nullptr) {
-      // Experiment-config environment variables (expconf environment
-      // block): either {"K": "V", ...} or
-      // {"environment_variables": ["K=V", ...]}. Schema keys with their
-      // own semantics (venv/python_path, applied by exec/launch.py) are
-      // not env vars.
-      const Json& env_cfg = exp->config["environment"];
-      for (const auto& [k, v] : env_cfg.as_object()) {
-        if (k == "environment_variables" || k == "venv" || k == "python_path")
-          continue;
-        if (v.is_string()) env[k] = v;
-      }
-      for (const auto& kv : env_cfg["environment_variables"].as_array()) {
-        const std::string& s = kv.as_string();
-        auto eq = s.find('=');
-        if (eq != std::string::npos) {
-          env[s.substr(0, eq)] = s.substr(eq + 1);
-        }
-      }
-    }
-    if (exp != nullptr && trial != nullptr) {
-      env["DET_EXPERIMENT_ID"] = exp->id;
-      env["DET_EXPERIMENT_CONFIG"] = exp->config.dump();
-      env["DET_TRIAL_ID"] = trial->id;
-      env["DET_TRIAL_REQUEST_ID"] = trial->request_id;
-      env["DET_TRIAL_RUN_ID"] = trial->run_id;
-      env["DET_TRIAL_SEED"] = trial->seed;
-      env["DET_HPARAMS"] = trial->hparams.dump();
-      env["DET_STEPS_COMPLETED"] = trial->steps_completed;
-      if (!trial->latest_checkpoint.empty()) {
-        env["DET_LATEST_CHECKPOINT"] = trial->latest_checkpoint;
-      }
-    }
-    // NTSC/generic-task env (DET_ENTRYPOINT, DET_TASK_TYPE overrides, …).
-    for (const auto& [k, v] : alloc.extra_env) env[k] = v;
-    // Pre-issued session token for the allocation's OWNER (reference:
-    // containers get DET_SESSION_TOKEN and act as the submitting user,
-    // tasks/task.go:194-234) — this is what lets the trial-route authz
-    // gate hold without special-casing containers.
-    std::string token = random_hex(24);
-    db_.exec(
-        "INSERT INTO user_sessions (user_id, token, expires_at) "
-        "VALUES (?, ?, datetime('now', '+7 days'))",
-        {Json(alloc.owner_id), Json(token)});
-    env["DET_SESSION_TOKEN"] = token;
+    env["DET_RESOURCES_ID"] = res.container_id;
 
     Json action = Json::object();
     action["type"] = "start";
@@ -532,25 +521,148 @@ bool Master::try_fit_locked(Allocation& alloc) {
 
   alloc.state = "ASSIGNED";
   alloc.preempting = false;
-  if (trial != nullptr) trial->allocation_id = alloc.id;
-  Json sids = Json::array();
-  db_.exec(
-      "UPDATE allocations SET state='ASSIGNED', agent_id=?, slot_ids=? "
-      "WHERE id=?",
-      {Json(assignment.empty() ? "" : assignment[0].first->id),
-       Json(sids.dump()), Json(alloc.id)});
-  cv_.notify_all();
+  // Trial binding + persistence happen in schedule_locked, uniformly for
+  // every RM backend.
   return true;
 }
 
-void Master::release_resources_locked(Allocation& alloc) {
-  for (const auto& res : alloc.resources) {
-    auto it = agents_.find(res.agent_id);
-    if (it == agents_.end()) continue;
-    for (auto& s : it->second.slots) {
-      if (s.allocation_id == alloc.id) s.allocation_id.clear();
+// ---------------------------------------------------------------------------
+// AgentResourceManager — the built-in backend behind the rm.h seam. The
+// placement/protocol machinery above predates the seam and lives on the
+// Master (it is welded to the agent long-poll routes); this adapter is the
+// interface the scheduler actually talks to, so a config switch can swap
+// in the Kubernetes RM without touching the scheduler (reference
+// rm/resource_manager_iface.go:12-57).
+// ---------------------------------------------------------------------------
+
+class AgentResourceManager : public ResourceManager {
+ public:
+  explicit AgentResourceManager(Master& m) : m_(m) {}
+
+  std::string name() const override { return "agent"; }
+
+  bool allocate(Allocation& alloc) override {
+    return m_.try_fit_locked(alloc);
+  }
+
+  void release(Allocation& alloc) override {
+    for (const auto& res : alloc.resources) {
+      auto it = m_.agents_.find(res.agent_id);
+      if (it == m_.agents_.end()) continue;
+      for (auto& s : it->second.slots) {
+        if (s.allocation_id == alloc.id) s.allocation_id.clear();
+      }
     }
   }
+
+  void kill(Allocation& alloc) override {
+    m_.send_kill_actions_locked(alloc);
+  }
+
+  void tick(double now) override { m_.sweep_dead_agents_locked(now); }
+
+  ScalingSnapshot scaling(const std::string& pool) const override {
+    ScalingSnapshot s;
+    for (const auto& [id, a] : m_.agents_) {
+      if (!a.alive || a.resource_pool != pool) continue;
+      for (const auto& slot : a.slots) {
+        ++s.total_slots;
+        if (slot.enabled && slot.allocation_id.empty()) ++s.free_slots;
+      }
+    }
+    return s;
+  }
+
+ private:
+  Master& m_;
+};
+
+std::unique_ptr<ResourceManager> make_agent_rm(Master& m) {
+  return std::make_unique<AgentResourceManager>(m);
+}
+
+// Rendered DET_* environment for one node of an allocation — shared by the
+// agent RM (long-poll start actions) and the k8s RM (pod env). Also mints
+// the owner-scoped session token the container authenticates with.
+Json Master::build_task_env_locked(Allocation& alloc,
+                                   const std::string& node_id,
+                                   const std::vector<int>& slot_ids, int rank,
+                                   int num_nodes,
+                                   const std::string& chief_addr) {
+  ExperimentState* exp = find_experiment_locked(alloc.experiment_id);
+  TrialState* trial = nullptr;
+  if (exp != nullptr) {
+    auto tit = exp->trials.find(alloc.request_id);
+    if (tit != exp->trials.end()) trial = &tit->second;
+  }
+
+  Json env = Json::object();
+  env["DET_MASTER"] =
+      !cfg_.advertised_url.empty()
+          ? cfg_.advertised_url
+          : "http://" + (cfg_.host == "0.0.0.0" ? "127.0.0.1" : cfg_.host) +
+                ":" + std::to_string(server_.port());
+  env["DET_CLUSTER_ID"] = cfg_.cluster_id;
+  env["DET_AGENT_ID"] = node_id;
+  env["DET_TASK_ID"] = alloc.task_id;
+  env["DET_TASK_TYPE"] = trial != nullptr ? "TRIAL" : "GENERIC";
+  env["DET_ALLOCATION_ID"] = alloc.id;
+  env["DET_NODE_RANK"] = static_cast<int64_t>(rank);
+  env["DET_NUM_NODES"] = static_cast<int64_t>(num_nodes);
+  env["DET_CHIEF_IP"] = chief_addr;
+  Json sids = Json::array();
+  for (int sid : slot_ids) sids.push_back(Json(static_cast<int64_t>(sid)));
+  env["DET_SLOT_IDS"] = sids.dump();
+  if (exp != nullptr) {
+    // Experiment-config environment variables (expconf environment
+    // block): either {"K": "V", ...} or
+    // {"environment_variables": ["K=V", ...]}. Schema keys with their
+    // own semantics (venv/python_path, applied by exec/launch.py) are
+    // not env vars.
+    const Json& env_cfg = exp->config["environment"];
+    for (const auto& [k, v] : env_cfg.as_object()) {
+      if (k == "environment_variables" || k == "venv" || k == "python_path")
+        continue;
+      if (v.is_string()) env[k] = v;
+    }
+    for (const auto& kv : env_cfg["environment_variables"].as_array()) {
+      const std::string& s = kv.as_string();
+      auto eq = s.find('=');
+      if (eq != std::string::npos) {
+        env[s.substr(0, eq)] = s.substr(eq + 1);
+      }
+    }
+  }
+  if (exp != nullptr && trial != nullptr) {
+    env["DET_EXPERIMENT_ID"] = exp->id;
+    env["DET_EXPERIMENT_CONFIG"] = exp->config.dump();
+    env["DET_TRIAL_ID"] = trial->id;
+    env["DET_TRIAL_REQUEST_ID"] = trial->request_id;
+    env["DET_TRIAL_RUN_ID"] = trial->run_id;
+    env["DET_TRIAL_SEED"] = trial->seed;
+    env["DET_HPARAMS"] = trial->hparams.dump();
+    env["DET_STEPS_COMPLETED"] = trial->steps_completed;
+    if (!trial->latest_checkpoint.empty()) {
+      env["DET_LATEST_CHECKPOINT"] = trial->latest_checkpoint;
+    }
+  }
+  // NTSC/generic-task env (DET_ENTRYPOINT, DET_TASK_TYPE overrides, …).
+  for (const auto& [k, v] : alloc.extra_env) env[k] = v;
+  // Pre-issued session token for the allocation's OWNER (reference:
+  // containers get DET_SESSION_TOKEN and act as the submitting user,
+  // tasks/task.go:194-234) — this is what lets the trial-route authz
+  // gate hold without special-casing containers.
+  std::string token = random_hex(24);
+  db_.exec(
+      "INSERT INTO user_sessions (user_id, token, expires_at) "
+      "VALUES (?, ?, datetime('now', '+7 days'))",
+      {Json(alloc.owner_id), Json(token)});
+  env["DET_SESSION_TOKEN"] = token;
+  return env;
+}
+
+void Master::release_resources_locked(Allocation& alloc) {
+  rm_->release(alloc);
 }
 
 void Master::preempt_allocation_locked(Allocation& alloc,
@@ -563,6 +675,12 @@ void Master::preempt_allocation_locked(Allocation& alloc,
 
 void Master::kill_allocation_locked(Allocation& alloc) {
   alloc.killed = true;
+  rm_->kill(alloc);
+  cv_.notify_all();
+}
+
+// Agent-backend kill: enqueue kill actions on each node's long-poll.
+void Master::send_kill_actions_locked(Allocation& alloc) {
   for (const auto& res : alloc.resources) {
     auto it = agents_.find(res.agent_id);
     if (it == agents_.end()) continue;
@@ -572,7 +690,6 @@ void Master::kill_allocation_locked(Allocation& alloc) {
     action["container_id"] = res.container_id;
     it->second.actions.push_back(action);
   }
-  cv_.notify_all();
 }
 
 }  // namespace det

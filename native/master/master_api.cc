@@ -537,15 +537,78 @@ HttpResponse Master::handle_trials(const HttpRequest& req,
     Json body = Json::parse(req.body);
     const std::string& group = body["group"].as_string("training");
     int64_t batches = body["steps_completed"].as_int();
-    db_.exec(
-        "INSERT INTO raw_metrics (trial_id, trial_run_id, group_name, "
-        "total_batches, metrics) VALUES (?, ?, ?, ?, ?)",
-        {Json(tid), Json(body["trial_run_id"].as_int(0)), Json(group),
-         Json(batches), Json(body["metrics"].dump())});
-    db_.exec(
-        "UPDATE trials SET total_batches=MAX(total_batches, ?), "
-        "last_activity=datetime('now') WHERE id=?",
-        {Json(batches), Json(tid)});
+    // Raw insert + summary rollup in one transaction (reference
+    // static/srv/calculate-full-trial-summary-metrics.sql — but maintained
+    // incrementally ON REPORT, so list views and the WebUI read
+    // trials.summary_metrics instead of scanning raw_metrics).
+    int64_t run_id = body["trial_run_id"].as_int(0);
+    db_.tx([&] {
+      db_.exec(
+          "INSERT INTO raw_metrics (trial_id, trial_run_id, group_name, "
+          "total_batches, metrics) VALUES (?, ?, ?, ?, ?)",
+          {Json(tid), Json(run_id), Json(group), Json(batches),
+           Json(body["metrics"].dump())});
+      auto srows = db_.query(
+          "SELECT summary_metrics FROM trials WHERE id=?", {Json(tid)});
+      Json summary = srows.empty()
+                         ? Json::object()
+                         : Json::parse_or_null(
+                               srows[0]["summary_metrics"].as_string());
+      if (!summary.is_object()) summary = Json::object();
+
+      auto fold = [](Json& grp, const Json& metrics) {
+        for (const auto& [name, v] : metrics.as_object()) {
+          if (!v.is_number()) continue;
+          double x = v.as_double();
+          Json s = grp[name].is_object() ? grp[name] : Json::object();
+          int64_t count = s["count"].as_int(0);
+          double mn = count > 0 ? s["min"].as_double() : x;
+          double mx = count > 0 ? s["max"].as_double() : x;
+          double sum = s["sum"].as_double(0.0);
+          Json ns = Json::object();
+          ns["min"] = std::min(mn, x);
+          ns["max"] = std::max(mx, x);
+          ns["sum"] = sum + x;
+          ns["count"] = count + 1;
+          ns["last"] = x;
+          ns["mean"] = (sum + x) / static_cast<double>(count + 1);
+          grp[name] = std::move(ns);
+        }
+      };
+
+      if (summary["_run_id"].as_int(-1) != run_id) {
+        // Run boundary (restart-from-checkpoint): the rerun re-reports
+        // batches it already trained, so blind incremental folding would
+        // double-count them. Recompute from raw metrics deduped to the
+        // LATEST report per (group, batch) — the incremental fold then
+        // resumes from a consistent base (reference
+        // calculate-full-trial-summary-metrics.sql recomputes similarly).
+        summary = Json::object();
+        auto rows = db_.query(
+            "SELECT m.group_name, m.metrics FROM raw_metrics m JOIN "
+            "(SELECT group_name g, total_batches b, MAX(id) mid "
+            " FROM raw_metrics WHERE trial_id=? "
+            " GROUP BY group_name, total_batches) d ON m.id = d.mid "
+            "ORDER BY m.id",
+            {Json(tid)});
+        for (auto& row : rows) {
+          const std::string g = row["group_name"].as_string();
+          Json grp = summary[g].is_object() ? summary[g] : Json::object();
+          fold(grp, Json::parse_or_null(row["metrics"].as_string()));
+          summary[g] = std::move(grp);
+        }
+        summary["_run_id"] = run_id;
+      } else {
+        Json grp =
+            summary[group].is_object() ? summary[group] : Json::object();
+        fold(grp, body["metrics"]);
+        summary[group] = std::move(grp);
+      }
+      db_.exec(
+          "UPDATE trials SET total_batches=MAX(total_batches, ?), "
+          "summary_metrics=?, last_activity=datetime('now') WHERE id=?",
+          {Json(batches), Json(summary.dump()), Json(tid)});
+    });
     {
       std::lock_guard<std::mutex> lock(mu_);
       ExperimentState* exp = nullptr;

@@ -1,0 +1,184 @@
+// rm.h — the resource-manager seam.
+//
+// Reference: master/internal/rm/resource_manager_iface.go:12-57 — a uniform
+// interface (Allocate/Release/GetAgents/scaling info) over three backends
+// (agentrm, kubernetesrm, dispatcherrm) plus multirm routing. The TPU
+// master grows the same seam: the scheduler loop talks to a
+// ResourceManager, and the backend is chosen by config —
+//
+//   "agent"       — the built-in topology-aware agent RM (node daemons
+//                   long-polling; slots are TPU chips; contiguous-fit
+//                   scheduling in scheduler_fit.cc)
+//   "kubernetes"  — pods on a k8s/GKE cluster (reference
+//                   rm/kubernetesrm/pods.go): one pod per allocation node,
+//                   reconciliation by polling the API server.
+//
+// All methods run under the master mutex (mu_) — same concurrency model as
+// the rest of the control plane; RMs must not block (network I/O happens on
+// detached threads or in tick-driven polls with short timeouts).
+
+#pragma once
+
+#include <atomic>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "../common/json.h"
+
+namespace det {
+
+struct Allocation;
+struct AgentState;
+struct MasterConfig;
+class Db;
+
+// What the provisioner sees (reference rm/agentrm/scaledecider): sustained
+// pending demand beyond capacity triggers a scale-up request.
+struct ScalingSnapshot {
+  int total_slots = 0;
+  int free_slots = 0;
+  int pending_slots = 0;        // demanded by queued allocations
+  int pending_allocations = 0;  // queue depth
+};
+
+// Hooks the RM needs from the master; keeps the dependency one-way (the
+// master owns experiments/trials/task-spec building; the RM owns placement
+// and node lifecycle).
+struct RmHooks {
+  // Render the DET_* task environment for one node of an allocation
+  // (rank, chief address, slot ids) — master_agents.cc build_task_env.
+  std::function<Json(Allocation&, const std::string& node_id,
+                     const std::vector<int>& slot_ids, int rank,
+                     int num_nodes, const std::string& chief_addr)>
+      build_task_env;
+  // A node's share of the allocation changed state (RUNNING/EXITED …);
+  // the master advances the allocation/trial state machines.
+  std::function<void(const std::string& alloc_id, const std::string& node_id,
+                     const std::string& state, int exit_code,
+                     const std::string& daemon_addr)>
+      on_resource_state;
+  std::function<void()> notify;  // wake cv_ waiters after state changes
+};
+
+class ResourceManager {
+ public:
+  virtual ~ResourceManager() = default;
+  virtual std::string name() const = 0;
+
+  // Try to place a PENDING allocation. On success: alloc.resources is
+  // populated, slots/nodes are reserved, alloc.state == "ASSIGNED".
+  virtual bool allocate(Allocation& alloc) = 0;
+
+  // Return an allocation's resources to the pool (terminal or preempted).
+  virtual void release(Allocation& alloc) = 0;
+
+  // Deliver a kill to the allocation's nodes.
+  virtual void kill(Allocation& alloc) = 0;
+
+  // Periodic upkeep under mu_: health sweeps / API reconciliation.
+  virtual void tick(double now) = 0;
+
+  // Scaling view of one resource pool, for the provisioner.
+  virtual ScalingSnapshot scaling(const std::string& pool) const = 0;
+};
+
+// ---------------------------------------------------------------------------
+// Kubernetes RM (skeleton with a real API client; reference
+// rm/kubernetesrm/pods.go). Each allocation node is one pod created via the
+// API server's REST interface; reconciliation polls pod phases.
+// ---------------------------------------------------------------------------
+
+struct KubernetesRmConfig {
+  std::string api_url;            // e.g. http://127.0.0.1:8001 (kubectl proxy)
+  std::string namespace_ = "default";
+  std::string image = "determined-tpu-task:latest";
+  int slots_per_pod = 4;          // TPU chips per pod (node-pool shape)
+  int max_pods = 64;              // capacity ceiling for scaling math
+  std::string bearer_token;       // service-account token ("" = none)
+  // Headless-service subdomain for pod DNS: pods get spec.hostname +
+  // spec.subdomain so <pod>.<subdomain>.<ns>.svc resolves (the deploy
+  // tooling creates the matching clusterIP:None Service).
+  std::string service_subdomain = "determined-tpu";
+};
+
+class KubernetesResourceManager : public ResourceManager {
+ public:
+  KubernetesResourceManager(KubernetesRmConfig cfg, RmHooks hooks);
+
+  std::string name() const override { return "kubernetes"; }
+  bool allocate(Allocation& alloc) override;
+  void release(Allocation& alloc) override;
+  void kill(Allocation& alloc) override;
+  void tick(double now) override;
+  ScalingSnapshot scaling(const std::string& pool) const override;
+
+ private:
+  struct Pod {
+    std::string name;
+    std::string alloc_id;
+    int rank = 0;
+    std::string phase = "Pending";
+    double created_at = 0;  // steady seconds; guards against judging a
+                            // just-created pod by a pre-creation snapshot
+  };
+  Json pod_manifest(Allocation& alloc, int rank, int num_nodes,
+                    const std::vector<int>& slot_ids);
+  std::string pod_name(const std::string& alloc_id, int rank) const;
+  bool api_create_pod(const Json& manifest, std::string* err);
+  void api_delete_pod_async(const std::string& name);
+  Json api_list_pods();
+
+  KubernetesRmConfig cfg_;
+  RmHooks hooks_;
+  std::map<std::string, Pod> pods_;  // by pod name
+  double last_reconcile_ = 0;
+  // Pod list snapshot refreshed by a background poller OUTSIDE the master
+  // lock (a blocking LIST under mu_ would stall the whole control plane
+  // whenever the API server is slow); tick() consumes the latest snapshot.
+  std::shared_ptr<const Json> live_snapshot_;
+  std::shared_ptr<std::mutex> snapshot_mu_ = std::make_shared<std::mutex>();
+  std::shared_ptr<std::atomic<bool>> poller_run_;
+  std::thread poller_;
+
+ public:
+  ~KubernetesResourceManager() override;
+};
+
+// ---------------------------------------------------------------------------
+// Provisioner hook (reference rm/agentrm/provisioner + scaledecider):
+// when pending demand exceeds capacity for `sustain_s`, POST a scale-up
+// request to a webhook (deploy tooling / autoscaler reacts — for GKE TPU
+// node pools or TPU-VM managed instance groups). Cooldown-limited.
+// ---------------------------------------------------------------------------
+
+struct ProvisionerConfig {
+  std::string webhook_url;  // empty = disabled
+  double sustain_s = 30;    // demand must persist this long
+  double cooldown_s = 300;  // min seconds between scale-up requests
+  int max_slots = 256;      // never request beyond this
+};
+
+class Provisioner {
+ public:
+  explicit Provisioner(ProvisionerConfig cfg) : cfg_(std::move(cfg)) {}
+
+  // Called each scheduler tick with the RM's scaling snapshot; fires the
+  // webhook (detached thread) when demand is sustained. Returns true if a
+  // scale-up request was issued (tests observe this).
+  bool observe(const std::string& pool, const ScalingSnapshot& snap,
+               double now);
+
+  bool enabled() const { return !cfg_.webhook_url.empty(); }
+
+ private:
+  ProvisionerConfig cfg_;
+  std::map<std::string, double> demand_since_;  // pool → first unmet time
+  std::map<std::string, double> last_fired_;
+};
+
+}  // namespace det

@@ -197,6 +197,68 @@ def _wait_experiment(cluster, eid, token, timeout=120.0, want=("COMPLETED",)):
 # ---------------------------------------------------------------------------
 
 
+def test_devcluster_boots_from_config_files(tmp_path, native_binaries):
+    """Master AND agent boot from JSON config files alone (viper-style
+    file+env+flags layering, reference cmd/determined-master/init.go:13 and
+    agent/internal/options/options.go) and run an experiment end to end."""
+    port = _free_port()
+    db_path = os.path.join(str(tmp_path), "m.db")
+    master_cfg = {"host": "127.0.0.1", "port": port, "db_path": db_path,
+                  "cluster_name": "from-config", "agent_timeout_s": 15}
+    agent_cfg = {"master_url": f"http://127.0.0.1:{port}", "id": "cfg-agent",
+                 "addr": "127.0.0.1", "slots": 2, "slot_type": "cpu",
+                 "work_root": os.path.join(str(tmp_path), "work"),
+                 "token_file": db_path + ".agent_token"}
+    mp = os.path.join(str(tmp_path), "master.json")
+    ap = os.path.join(str(tmp_path), "agent.json")
+    with open(mp, "w") as f:
+        json.dump(master_cfg, f)
+    with open(ap, "w") as f:
+        json.dump(agent_cfg, f)
+
+    env = dict(os.environ,
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+               JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    master = subprocess.Popen(
+        [os.path.join(native_binaries, "determined-master"), "--config", mp],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    agent = None
+    try:
+        _wait_http(f"http://127.0.0.1:{port}/api/v1/master")
+        agent = subprocess.Popen(
+            [os.path.join(native_binaries, "determined-agent"),
+             "--config", ap],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+        c = Devcluster.__new__(Devcluster)
+        c.master_url = f"http://127.0.0.1:{port}"
+        info = c.api("GET", "/api/v1/master")
+        assert info["cluster_name"] == "from-config"
+        token = c.login()
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            agents = c.api("GET", "/api/v1/agents", token=token)["agents"]
+            if any(a["id"] == "cfg-agent" and a["alive"] for a in agents):
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError("config-file agent did not register")
+
+        import determined_tpu.cli as cli
+        model_def = cli._tar_context(FIXTURES)
+        eid = c.api("POST", "/api/v1/experiments",
+                    {"config": _experiment_config(tmp_path),
+                     "model_definition": model_def, "activate": True},
+                    token=token)["id"]
+        _wait_experiment(c, eid, token)
+    finally:
+        for proc in (agent, master):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+
 def test_master_info_and_agent_registration(cluster):
     info = cluster.api("GET", "/api/v1/master")
     assert info["cluster_name"] == "determined-tpu"
@@ -243,6 +305,38 @@ def test_single_experiment_end_to_end(cluster, tmp_path):
         "GET", f"/api/v1/tasks/trial-{trial['id']}/logs?offset=0", token=token
     )["logs"]
     assert any("trial complete" in line["log"] for line in logs)
+
+
+def test_metric_summary_rollups(cluster, tmp_path):
+    """trials.summary_metrics (min/max/last/mean/count per metric per
+    group) is maintained incrementally on report and must agree with a
+    full scan of raw_metrics (reference
+    static/srv/calculate-full-trial-summary-metrics.sql)."""
+    eid, token = _create_experiment(cluster, _experiment_config(tmp_path))
+    _wait_experiment(cluster, eid, token)
+    trial = cluster.api("GET", f"/api/v1/experiments/{eid}/trials",
+                        token=token)["trials"][0]
+    summary = trial["summary_metrics"]
+    raw = cluster.api(
+        "GET", f"/api/v1/trials/{trial['id']}/metrics", token=token
+    )["metrics"]
+    assert summary, "rollups missing"
+    for group in ("training", "validation"):
+        vals = {}
+        for m in raw:
+            if m["group_name"] != group:
+                continue
+            for k, v in m["metrics"].items():
+                if isinstance(v, (int, float)):
+                    vals.setdefault(k, []).append(float(v))
+        assert vals, f"no raw {group} metrics"
+        for k, xs in vals.items():
+            s = summary[group][k]
+            assert s["count"] == len(xs)
+            assert abs(s["min"] - min(xs)) < 1e-9
+            assert abs(s["max"] - max(xs)) < 1e-9
+            assert abs(s["last"] - xs[-1]) < 1e-9
+            assert abs(s["mean"] - sum(xs) / len(xs)) < 1e-9
 
 
 def test_asha_search_end_to_end(cluster, tmp_path):

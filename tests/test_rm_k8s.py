@@ -1,0 +1,216 @@
+"""Kubernetes RM + provisioner against a fake API server (VERDICT r3 #7).
+
+Reference: master/internal/rm/kubernetesrm/pods.go (pods as allocation
+nodes) and rm/agentrm/provisioner (scale-up on sustained demand). The
+master boots with `resource_manager: kubernetes` from a config FILE (the
+viper-style layering), creates pods through the API server's REST
+interface, reconciles pod phases into allocation state, deletes pods on
+kill — all observed through an in-test fake API server.
+"""
+
+import json
+import socket
+import subprocess
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tests.test_platform_e2e import (  # noqa: F401
+    Devcluster,
+    _wait_http,
+    native_binaries,
+)
+
+
+class FakeK8s:
+    """Just enough of the pods API: create/list/delete + phase control."""
+
+    def __init__(self):
+        self.pods = {}  # name -> manifest (with injected status)
+        self.deletes = []
+        self.scaleups = []
+        self.lock = threading.Lock()
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if self.path == "/scaleup":
+                    with outer.lock:
+                        outer.scaleups.append(body)
+                    return self._json(200, {})
+                if self.path.endswith("/pods"):
+                    name = body["metadata"]["name"]
+                    with outer.lock:
+                        body["status"] = {"phase": "Pending"}
+                        outer.pods[name] = body
+                    return self._json(201, body)
+                self._json(404, {})
+
+            def do_GET(self):
+                if "/pods" in self.path:
+                    with outer.lock:
+                        items = list(outer.pods.values())
+                    return self._json(200, {"items": items})
+                self._json(404, {})
+
+            def do_DELETE(self):
+                name = self.path.rsplit("/", 1)[-1]
+                with outer.lock:
+                    outer.deletes.append(name)
+                    outer.pods.pop(name, None)
+                self._json(200, {})
+
+        self.srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.url = f"http://127.0.0.1:{self.srv.server_address[1]}"
+        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+
+    def set_phase(self, name, phase, pod_ip=None, exit_code=None):
+        with self.lock:
+            status = {"phase": phase}
+            if pod_ip:
+                status["podIP"] = pod_ip
+            if exit_code is not None:
+                status["containerStatuses"] = [
+                    {"state": {"terminated": {"exitCode": exit_code}}}]
+            self.pods[name]["status"] = status
+
+    def pod_names(self):
+        with self.lock:
+            return sorted(self.pods)
+
+    def stop(self):
+        self.srv.shutdown()
+
+
+@pytest.fixture()
+def k8s_cluster(tmp_path, native_binaries):
+    fake = FakeK8s()
+    cfg = {
+        "resource_manager": "kubernetes",
+        "kubernetes": {
+            "api_url": fake.url,
+            "namespace": "det-test",
+            "image": "determined-tpu-task:test",
+            "slots_per_pod": 2,
+            "max_pods": 2,
+        },
+        "provisioner": {
+            "webhook_url": fake.url + "/scaleup",
+            "sustain_seconds": 1,
+            "cooldown_seconds": 2,
+        },
+    }
+    cfg_path = tmp_path / "master.json"
+    cfg_path.write_text(json.dumps(cfg))
+    c = Devcluster(str(tmp_path), native_binaries)
+
+    # Boot the master from the config FILE + flags for port/db.
+    import os
+
+    c.master = subprocess.Popen(
+        [os.path.join(c.binaries, "determined-master"),
+         "--config", str(cfg_path),
+         "--port", str(c.port), "--host", "127.0.0.1", "--db", c.db_path],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    _wait_http(c.master_url + "/api/v1/master")
+    yield c, fake
+    c.stop()
+    fake.stop()
+
+
+def _wait(cond, timeout=30, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.2)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def test_pods_lifecycle_and_reconcile(k8s_cluster):
+    cluster, fake = k8s_cluster
+    token = cluster.login()
+
+    # A 4-slot command task → ceil(4/2) = 2 pods with the DET_* env.
+    resp = cluster.api(
+        "POST", "/api/v1/commands",
+        {"config": {"entrypoint": "echo hi",
+                    "resources": {"slots": 4}}}, token=token)
+    aid = resp["allocation_id"]
+    names = _wait(lambda: fake.pod_names() if len(fake.pod_names()) == 2
+                  else None, what="2 pods created")
+    assert all(n.startswith("det-") for n in names)
+    manifest = fake.pods[names[0]]
+    env = {e["name"]: e.get("value") for e in
+           manifest["spec"]["containers"][0]["env"]}
+    assert env["DET_ALLOCATION_ID"] == aid
+    assert env["DET_NUM_NODES"] == "2"
+    assert "DET_SESSION_TOKEN" in env
+    assert manifest["metadata"]["namespace"] == "det-test"
+    assert manifest["spec"]["containers"][0]["resources"]["limits"][
+        "google.com/tpu"] == 2
+
+    # Phase Running + podIP reconciles into allocation RUNNING with
+    # rendezvous addresses.
+    for i, n in enumerate(names):
+        fake.set_phase(n, "Running", pod_ip=f"10.0.0.{i + 1}")
+    _wait(lambda: cluster.api(
+        "GET", f"/api/v1/allocations/{aid}", token=token
+    )["allocation"]["state"] == "RUNNING", what="allocation RUNNING")
+
+    # Success reconciles to COMPLETED and the pods are deleted.
+    for n in names:
+        fake.set_phase(n, "Succeeded", exit_code=0)
+    _wait(lambda: cluster.api(
+        "GET", f"/api/v1/commands/{resp['id']}", token=token
+    )["task"]["state"] == "COMPLETED", what="task COMPLETED")
+    assert set(names) <= set(fake.deletes)
+
+
+def test_kill_deletes_pods(k8s_cluster):
+    cluster, fake = k8s_cluster
+    token = cluster.login()
+    resp = cluster.api(
+        "POST", "/api/v1/commands",
+        {"config": {"entrypoint": "sleep 999",
+                    "resources": {"slots": 2}}}, token=token)
+    names = _wait(lambda: fake.pod_names() or None, what="pod created")
+    cluster.api("POST", f"/api/v1/commands/{resp['id']}/kill", token=token)
+    _wait(lambda: set(names) <= set(fake.deletes), what="pods deleted")
+
+
+def test_provisioner_fires_on_sustained_demand(k8s_cluster):
+    cluster, fake = k8s_cluster
+    token = cluster.login()
+    # Fill capacity (max_pods=2 × 2 slots), then queue one more: demand
+    # exceeds free slots for > sustain_seconds → scale-up webhook.
+    a = cluster.api("POST", "/api/v1/commands",
+                    {"config": {"entrypoint": "sleep 999",
+                                "resources": {"slots": 4}}}, token=token)
+    _wait(lambda: len(fake.pod_names()) == 2, what="capacity filled")
+    cluster.api("POST", "/api/v1/commands",
+                {"config": {"entrypoint": "sleep 999",
+                            "resources": {"slots": 2}}}, token=token)
+    scale = _wait(lambda: fake.scaleups[:] or None, timeout=30,
+                  what="scale-up webhook")[0]
+    assert scale["event"] == "scale_up"
+    assert scale["pending_slots"] >= 2
+    assert scale["desired_total_slots"] > scale["total_slots"] - scale[
+        "free_slots"] - 1
+    (a,)

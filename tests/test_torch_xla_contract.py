@@ -1,0 +1,150 @@
+"""torch-xla contract pinned with a stubbed module (VERDICT r3 #8).
+
+torch_xla is not installable in this (or any CPU) test image, so the
+xla-specific paths in the torch compat layer — backend selection, one
+process per host, `xla://` init, per-step `mark_step`, FSDP-not-DDP — had
+zero coverage and a typo would ship green. These tests inject a fake
+`torch_xla` into sys.modules and pin the exact contract
+(reference harness/determined/launch/torch_distributed.py:74 and
+_pytorch_context.py device/wrap semantics).
+"""
+
+import importlib.machinery
+import json
+import os
+import sys
+import types
+
+import pytest
+import torch
+
+
+@pytest.fixture()
+def fake_torch_xla(monkeypatch):
+    """Install a minimal torch_xla into sys.modules; yields the xm stub."""
+    xm = types.ModuleType("torch_xla.core.xla_model")
+    xm.mark_step_calls = 0
+    xm.xla_device = lambda: torch.device("cpu")  # stand-in device
+
+    def mark_step():
+        xm.mark_step_calls += 1
+
+    xm.mark_step = mark_step
+
+    core = types.ModuleType("torch_xla.core")
+    core.xla_model = xm
+    root = types.ModuleType("torch_xla")
+    root.core = core
+    # find_spec (used by the launcher) consults sys.modules first; a
+    # module needs a __spec__ for that lookup to succeed.
+    for name, mod in (("torch_xla", root), ("torch_xla.core", core),
+                      ("torch_xla.core.xla_model", xm)):
+        mod.__spec__ = importlib.machinery.ModuleSpec(name, None)
+        monkeypatch.setitem(sys.modules, name, mod)
+    return xm
+
+
+def test_launcher_picks_xla_one_proc_per_host(fake_torch_xla, monkeypatch,
+                                              capfd):
+    """With torch_xla importable the launcher must choose backend=xla and
+    ONE worker per host (a torch-xla process owns all local chips), wiring
+    RANK/WORLD_SIZE from the node topology, not from a per-device fanout."""
+    from determined_tpu.launch import torch_distributed as launch
+
+    assert launch.pick_backend() == "xla"
+
+    monkeypatch.setenv("DET_NODE_RANK", "1")
+    monkeypatch.setenv("DET_NUM_NODES", "2")
+    monkeypatch.setenv("DET_CHIEF_IP", "10.9.8.7")
+    monkeypatch.setenv("DET_NPROC_PER_NODE", "4")  # must be IGNORED for xla
+    rc = launch.main([
+        "--", sys.executable, "-c",
+        "import os, json; print(json.dumps({k: os.environ[k] for k in "
+        "['RANK','WORLD_SIZE','LOCAL_WORLD_SIZE','MASTER_ADDR',"
+        "'DET_TORCH_BACKEND']}))",
+    ])
+    assert rc == 0
+    out = capfd.readouterr().out
+    # exactly one worker, rank-prefixed
+    payloads = [line for line in out.splitlines() if "{" in line]
+    assert len(payloads) == 1 and payloads[0].startswith("[rank=1] ")
+    env = json.loads(payloads[0].split(" ", 1)[1])
+    assert env == {"RANK": "1", "WORLD_SIZE": "2", "LOCAL_WORLD_SIZE": "1",
+                   "MASTER_ADDR": "10.9.8.7", "DET_TORCH_BACKEND": "xla"}
+
+
+def test_xla_process_group_init(fake_torch_xla, monkeypatch):
+    """DET_TORCH_BACKEND=xla must init the process group with the xla
+    backend over an xla:// store — not env:// (reference
+    launch/torch_distributed.py:74's USE_TORCH_DISTRIBUTED contract)."""
+    from determined_tpu.pytorch import _trial
+
+    calls = []
+    monkeypatch.setattr(_trial, "torch", torch)
+    import torch.distributed as dist
+
+    monkeypatch.setattr(dist, "is_initialized", lambda: False)
+    monkeypatch.setattr(
+        dist, "init_process_group",
+        lambda backend, init_method=None: calls.append((backend, init_method)))
+    monkeypatch.setattr(dist, "get_rank", lambda: 0)
+    monkeypatch.setattr(dist, "get_world_size", lambda: 2)
+    monkeypatch.setenv("WORLD_SIZE", "2")
+    monkeypatch.setenv("DET_TORCH_BACKEND", "xla")
+
+    ctx = _trial.init_torch_distributed()
+    assert calls == [("xla", "xla://")]
+    assert ctx is not None and ctx.size == 2
+
+
+def test_default_device_is_xla(fake_torch_xla):
+    from determined_tpu.pytorch import _trial
+
+    assert _trial._default_device() == fake_torch_xla.xla_device()
+
+
+def test_mark_step_per_optimizer_step(fake_torch_xla):
+    """step_optimizer must cut the lazy-tensor graph with xm.mark_step()
+    once per optimizer step — forgetting it makes torch-xla accumulate an
+    unbounded graph (the classic silent perf cliff)."""
+    from determined_tpu.pytorch._trial import PyTorchTrialContext
+
+    ctx = PyTorchTrialContext(hparams={})
+    model = torch.nn.Linear(4, 2)
+    opt = ctx.wrap_optimizer(torch.optim.SGD(model.parameters(), lr=0.1))
+    loss = model(torch.zeros(1, 4)).sum()
+    ctx.backward(loss)
+    before = fake_torch_xla.mark_step_calls
+    ctx.step_optimizer(opt)
+    ctx.step_optimizer(opt)
+    assert fake_torch_xla.mark_step_calls == before + 2
+
+
+def test_fsdp_wrapped_model_skips_ddp(fake_torch_xla):
+    """An (Xla)FullyShardedDataParallel model must NOT be re-wrapped in
+    DDP: FSDP owns its reduce-scatter comms and DDP on top would
+    all-reduce sharded grads (wrong math)."""
+    from determined_tpu.core._distributed import DistributedContext
+    from determined_tpu.pytorch._trial import PyTorchTrialContext
+
+    class XlaFullyShardedDataParallel(torch.nn.Module):
+        def __init__(self, module):
+            super().__init__()
+            self.module = module
+
+        def forward(self, x):
+            return self.module(x)
+
+    ctx = PyTorchTrialContext(hparams={})
+    # Simulate a 2-way distributed launch without a process group.
+    ctx.dist = DistributedContext(rank=0, size=2, transport=None)
+
+    fsdp = XlaFullyShardedDataParallel(torch.nn.Linear(4, 2))
+    wrapped = ctx.wrap_model(fsdp)
+    assert wrapped is fsdp  # untouched
+
+    # ...while a plain module WOULD be DDP-wrapped (guard sanity) — DDP
+    # needs a real process group, so expect its constructor to be reached
+    # and fail loudly rather than being skipped.
+    with pytest.raises((RuntimeError, ValueError)):
+        ctx.wrap_model(torch.nn.Linear(4, 2))
