@@ -75,13 +75,16 @@ int main(int argc, char** argv) {
   // Default WebUI dir: <exe dir>/../../webui (bin/ lives in native/).
   // /proc/self/exe, not argv[0] — a PATH-resolved launch would otherwise
   // anchor the default to the cwd.
-  if (cfg.webui_dir.empty()) {
+  if (cfg.webui_dir.empty() || cfg.openapi_path.empty()) {
     char exe_buf[4096];
     ssize_t n = readlink("/proc/self/exe", exe_buf, sizeof(exe_buf) - 1);
     std::string exe = n > 0 ? std::string(exe_buf, n) : std::string(argv[0]);
     auto slash = exe.rfind('/');
     std::string dir = slash == std::string::npos ? "." : exe.substr(0, slash);
-    cfg.webui_dir = dir + "/../../webui";
+    if (cfg.webui_dir.empty()) cfg.webui_dir = dir + "/../../webui";
+    if (cfg.openapi_path.empty()) {
+      cfg.openapi_path = dir + "/../../proto/openapi.json";
+    }
   }
 
   try {

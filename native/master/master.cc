@@ -364,6 +364,16 @@ HttpResponse Master::route(const HttpRequest& req) {
       return json_resp(200, out);
     }
     if (root == "stream" && req.method == "GET") return handle_stream(req);
+    if (root == "openapi" && req.method == "GET") {
+      // The REST surface's schema source of truth
+      // (proto/gen_openapi.py → proto/openapi.json; reference
+      // proto/src/determined/api/v1/api.proto + swagger bindings).
+      std::ifstream f(cfg_.openapi_path);
+      if (!f) return json_resp(404, err_body("openapi document not found"));
+      std::stringstream ss;
+      ss << f.rdbuf();
+      return HttpResponse::json(200, ss.str());
+    }
     if (root == "users" || root == "me") return handle_users(req);
     if (root == "groups") return handle_groups(req, rest);
     if (root == "rbac") return handle_rbac(req, rest);

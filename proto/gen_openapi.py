@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Generate proto/openapi.json — the REST surface's schema source of truth.
+
+Reference: proto/src/determined/api/v1/api.proto (230 gRPC RPCs) +
+swagger→client codegen in bindings/. The TPU-native master speaks plain
+REST/JSON, so the source of truth is an OpenAPI 3 document generated from
+the terse route table below (same codegen discipline: edit the table, run
+this script, commit both). Contract tests (tests/test_openapi.py) assert
+the spec and the live master agree in BOTH directions — every spec path is
+routed, and every path the Python clients call is in the spec.
+"""
+
+import json
+import os
+
+# (method, path, tag, summary). {x} segments are path parameters.
+ROUTES = [
+    ("post", "/api/v1/auth/login", "auth", "Log in; returns a bearer token"),
+    ("post", "/api/v1/auth/logout", "auth", "Invalidate the current token"),
+    ("get", "/api/v1/master", "master", "Cluster info (no auth required)"),
+    ("post", "/api/v1/master/cleanup_logs", "master",
+     "Manual task-log retention sweep (admin)"),
+    ("get", "/api/v1/stream", "stream",
+     "Long-poll entity-change events (since/entities/timeout_seconds)"),
+    ("get", "/api/v1/me", "users", "Current user"),
+    ("get", "/api/v1/users", "users", "List users"),
+    ("post", "/api/v1/users", "users", "Create user (admin)"),
+    ("get", "/api/v1/users/{id}", "users", "Get user"),
+    ("patch", "/api/v1/users/{id}", "users",
+     "Patch user: active/role/password (admin; self for password)"),
+    ("get", "/api/v1/groups", "rbac", "List user groups with members"),
+    ("post", "/api/v1/groups", "rbac", "Create group (admin)"),
+    ("delete", "/api/v1/groups/{id}", "rbac", "Delete group (admin)"),
+    ("post", "/api/v1/groups/{id}/members", "rbac", "Add member (admin)"),
+    ("delete", "/api/v1/groups/{id}/members/{uid}", "rbac",
+     "Remove member (admin)"),
+    ("get", "/api/v1/rbac/assignments", "rbac", "List role assignments"),
+    ("post", "/api/v1/rbac/assignments", "rbac",
+     "Grant viewer/editor/admin to a user or group, optionally "
+     "workspace-scoped"),
+    ("delete", "/api/v1/rbac/assignments/{id}", "rbac", "Revoke assignment"),
+    ("get", "/api/v1/agents", "agents", "List agents and slots"),
+    ("post", "/api/v1/agents/register", "agents",
+     "Agent registration (agent service account)"),
+    ("get", "/api/v1/agents/{id}/actions", "agents",
+     "Agent action long-poll (agent service account)"),
+    ("post", "/api/v1/agents/{id}/heartbeat", "agents",
+     "Agent heartbeat + reconcile (agent service account)"),
+    ("post", "/api/v1/agents/{id}/allocations/{aid}/state", "agents",
+     "Report a container state change (agent service account)"),
+    ("post", "/api/v1/agents/{id}/enable", "agents", "Enable slots (admin)"),
+    ("post", "/api/v1/agents/{id}/disable", "agents",
+     "Drain: disable slots (admin)"),
+    ("get", "/api/v1/experiments", "experiments", "List experiments"),
+    ("post", "/api/v1/experiments", "experiments",
+     "Create experiment (managed, or unmanaged with unmanaged: true)"),
+    ("get", "/api/v1/experiments/{id}", "experiments", "Get experiment"),
+    ("delete", "/api/v1/experiments/{id}", "experiments",
+     "Delete a terminal experiment"),
+    ("get", "/api/v1/experiments/{id}/trials", "experiments", "List trials"),
+    ("post", "/api/v1/experiments/{id}/trials", "experiments",
+     "Create a trial on an unmanaged experiment"),
+    ("post", "/api/v1/experiments/{id}/complete", "experiments",
+     "Close out an unmanaged experiment"),
+    ("get", "/api/v1/experiments/{id}/checkpoints", "experiments",
+     "List experiment checkpoints"),
+    ("get", "/api/v1/experiments/{id}/model_def", "experiments",
+     "Download the model definition tarball (base64)"),
+    ("get", "/api/v1/experiments/{id}/searcher_events", "experiments",
+     "Custom-searcher event long-poll"),
+    ("post", "/api/v1/experiments/{id}/searcher_operations", "experiments",
+     "Submit custom-searcher operations"),
+    ("post", "/api/v1/experiments/{id}/activate", "experiments", "Activate"),
+    ("post", "/api/v1/experiments/{id}/pause", "experiments", "Pause"),
+    ("post", "/api/v1/experiments/{id}/cancel", "experiments", "Cancel"),
+    ("post", "/api/v1/experiments/{id}/kill", "experiments", "Kill"),
+    ("post", "/api/v1/experiments/{id}/archive", "experiments", "Archive"),
+    ("post", "/api/v1/experiments/{id}/unarchive", "experiments",
+     "Unarchive"),
+    ("get", "/api/v1/trials/{id}", "trials", "Get trial"),
+    ("get", "/api/v1/trials/{id}/progress", "trials", "Searcher progress"),
+    ("post", "/api/v1/trials/{id}/progress", "trials", "Report progress"),
+    ("get", "/api/v1/trials/{id}/searcher/operation", "trials",
+     "Long-poll the current searcher op (length to train to)"),
+    ("post", "/api/v1/trials/{id}/searcher/completed_operation", "trials",
+     "Report the searcher metric for a completed op"),
+    ("get", "/api/v1/trials/{id}/metrics", "trials", "Read metrics"),
+    ("post", "/api/v1/trials/{id}/metrics", "trials",
+     "Report metrics (also maintains the summary rollups)"),
+    ("post", "/api/v1/trials/{id}/run_prepare", "trials",
+     "RunPrepareForReporting analogue"),
+    ("post", "/api/v1/trials/{id}/runner/metadata", "trials",
+     "Runner heartbeat/state"),
+    ("get", "/api/v1/trials/{id}/logs", "trials", "Trial log alias"),
+    ("get", "/api/v1/allocations/{id}", "allocations", "Introspect"),
+    ("get", "/api/v1/allocations/{id}/signals/preemption", "allocations",
+     "Preemption long-poll"),
+    ("post", "/api/v1/allocations/{id}/signals/ack_preemption",
+     "allocations", "Ack preemption before checkpointing"),
+    ("get", "/api/v1/allocations/{id}/rendezvous", "allocations",
+     "Block until all hosts are up; returns ranked addresses"),
+    ("post", "/api/v1/allocations/{id}/all_gather", "allocations",
+     "REST-level allgather barrier"),
+    ("post", "/api/v1/allocations/{id}/proxy_address", "allocations",
+     "Register the task's proxy target (owner/agent)"),
+    ("post", "/api/v1/allocations/{id}/ready", "allocations",
+     "NotifyContainerRunning analogue"),
+    ("post", "/api/v1/checkpoints", "checkpoints", "Report checkpoint"),
+    ("patch", "/api/v1/checkpoints", "checkpoints",
+     "Batch state updates (GC)"),
+    ("get", "/api/v1/checkpoints/{uuid}", "checkpoints", "Get checkpoint"),
+    ("post", "/api/v1/task/logs", "logs",
+     "Batched task-log shipping (agent / task owner)"),
+    ("get", "/api/v1/tasks/{id}", "tasks", "Get task"),
+    ("get", "/api/v1/tasks/{id}/context", "tasks",
+     "Model-def tarball for the task"),
+    ("get", "/api/v1/tasks/{id}/logs", "tasks",
+     "Task logs (offset/follow/timeout_seconds)"),
+    ("get", "/api/v1/runs", "runs", "Flat runs view over trials"),
+    ("post", "/api/v1/runs/move", "runs", "Move runs' experiments"),
+    ("get", "/api/v1/job-queues", "jobs", "Queue introspection"),
+    ("post", "/api/v1/job-queues/reorder", "jobs",
+     "Reorder ahead-of/behind (admin)"),
+    ("get", "/api/v1/workspaces", "workspaces", "List"),
+    ("post", "/api/v1/workspaces", "workspaces", "Create"),
+    ("get", "/api/v1/workspaces/{id}", "workspaces", "Get"),
+    ("delete", "/api/v1/workspaces/{id}", "workspaces", "Archive"),
+    ("get", "/api/v1/workspaces/{id}/projects", "workspaces",
+     "List projects"),
+    ("post", "/api/v1/projects", "projects", "Create"),
+    ("get", "/api/v1/projects/{id}", "projects", "Get"),
+    ("delete", "/api/v1/projects/{id}", "projects", "Archive"),
+    ("get", "/api/v1/models", "models", "List models"),
+    ("post", "/api/v1/models", "models", "Create model"),
+    ("get", "/api/v1/models/{name}", "models", "Get model"),
+    ("delete", "/api/v1/models/{name}", "models", "Archive model"),
+    ("get", "/api/v1/models/{name}/versions", "models", "List versions"),
+    ("post", "/api/v1/models/{name}/versions", "models",
+     "Register a checkpoint as a version"),
+    ("get", "/api/v1/templates", "templates", "List"),
+    ("post", "/api/v1/templates", "templates", "Create/replace"),
+    ("get", "/api/v1/templates/{name}", "templates", "Get"),
+    ("delete", "/api/v1/templates/{name}", "templates", "Delete"),
+    ("get", "/api/v1/webhooks", "webhooks", "List"),
+    ("post", "/api/v1/webhooks", "webhooks", "Create (admin)"),
+    ("delete", "/api/v1/webhooks/{id}", "webhooks", "Delete (admin)"),
+    ("get", "/api/v1/openapi", "master", "This document"),
+]
+
+# NTSC task kinds share one route shape.
+for kind in ("commands", "notebooks", "shells", "tensorboards",
+             "generic-tasks"):
+    ROUTES += [
+        ("get", f"/api/v1/{kind}", "ntsc", f"List {kind}"),
+        ("post", f"/api/v1/{kind}", "ntsc",
+         f"Launch a {kind[:-1]} task (config.entrypoint/resources/"
+         "environment/idle_timeout_s)"),
+        ("get", f"/api/v1/{kind}/{{id}}", "ntsc", "Get task"),
+        ("post", f"/api/v1/{kind}/{{id}}/kill", "ntsc",
+         "Kill (propagates down the task tree)"),
+    ]
+
+
+def build() -> dict:
+    paths: dict = {}
+    for method, path, tag, summary in ROUTES:
+        params = [
+            {"name": seg[1:-1], "in": "path", "required": True,
+             "schema": {"type": "string"}}
+            for seg in path.split("/") if seg.startswith("{")
+        ]
+        op = {
+            "tags": [tag],
+            "summary": summary,
+            "responses": {"200": {"description": "OK"}},
+        }
+        if params:
+            op["parameters"] = params
+        if path not in ("/api/v1/auth/login", "/api/v1/master"):
+            op["security"] = [{"bearerAuth": []}]
+        paths.setdefault(path, {})[method] = op
+    return {
+        "openapi": "3.0.3",
+        "info": {
+            "title": "determined-tpu master API",
+            "version": "0.1.0",
+            "description": (
+                "REST surface of the TPU-native master. Long-poll endpoints "
+                "(stream, searcher ops, preemption, rendezvous, agent "
+                "actions, log follow) take timeout_seconds. /proxy/{task}/ "
+                "additionally serves HTTP, websocket, and det-tcp tunnels "
+                "outside this JSON surface."
+            ),
+        },
+        "components": {
+            "securitySchemes": {
+                "bearerAuth": {"type": "http", "scheme": "bearer"}
+            }
+        },
+        "paths": dict(sorted(paths.items())),
+    }
+
+
+if __name__ == "__main__":
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "openapi.json")
+    with open(out, "w") as f:
+        json.dump(build(), f, indent=1, sort_keys=False)
+        f.write("\n")
+    print(f"wrote {out} ({len(ROUTES)} operations)")

@@ -1,0 +1,138 @@
+"""OpenAPI schema source of truth ↔ live master contract (VERDICT r3 #7).
+
+Reference: proto/src/determined/api/v1/api.proto defines the service;
+bindings are generated from it. Here the source of truth is
+proto/gen_openapi.py → proto/openapi.json, and these tests pin BOTH
+directions: every spec path is actually routed by the master (no vapor
+endpoints), and every /api/v1 path the Python clients + WebUI call is in
+the spec (no undocumented surface).
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tests.test_platform_e2e import Devcluster, native_binaries  # noqa: F401
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SPEC_PATH = os.path.join(REPO, "proto", "openapi.json")
+
+
+@pytest.fixture(scope="module")
+def spec():
+    with open(SPEC_PATH) as f:
+        return json.load(f)
+
+
+@pytest.fixture()
+def cluster(tmp_path, native_binaries):  # noqa: F811
+    c = Devcluster(str(tmp_path), native_binaries)
+    c.start_master()
+    yield c
+    c.stop()
+
+
+def test_spec_is_regenerated(spec):
+    """proto/openapi.json must match gen_openapi.py output (codegen
+    discipline: edit the table, run the generator, commit both)."""
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import sys, json; sys.path.insert(0, 'proto'); "
+         "import gen_openapi; print(json.dumps(gen_openapi.build()))"],
+        capture_output=True, text=True, cwd=REPO, check=True)
+    assert json.loads(out.stdout) == spec, (
+        "proto/openapi.json is stale — run python proto/gen_openapi.py")
+
+
+def test_every_spec_path_is_routed(cluster, spec):
+    """No vapor endpoints: substitute path params and hit each operation;
+    the master must answer with anything but 404-not-found-route. (Many
+    answer 400/403/404-entity for bogus ids — that still proves routing.)"""
+    token = cluster.login()
+    admin = cluster.login("admin")
+    subs = {"{id}": "999999", "{uid}": "999999", "{aid}": "x",
+            "{uuid}": "no-such", "{name}": "no-such"}
+    misses = []
+    for path, ops in spec["paths"].items():
+        for method in ops:
+            p = path
+            for k, v in subs.items():
+                p = p.replace(k, v)
+            req = urllib.request.Request(
+                cluster.master_url + p +
+                ("?timeout_seconds=0" if method == "get" else ""),
+                data=b"{}" if method in ("post", "patch") else None,
+                headers={"Authorization": f"Bearer {admin}",
+                         "Content-Type": "application/json"},
+                method=method.upper())
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    status, body = r.status, ""
+            except urllib.error.HTTPError as e:
+                status = e.code
+                body = e.read().decode(errors="replace")
+            if status == 404 and "not found" == json.loads(body or "{}").get(
+                    "error", ""):
+                misses.append(f"{method.upper()} {path} -> unrouted 404")
+    assert not misses, "\n".join(misses)
+    (token,)
+
+
+def test_every_client_path_is_in_spec(spec):
+    """No undocumented surface: every /api/v1 literal the Python harness,
+    CLI, SDK, tests' Devcluster, and WebUI call must appear in the spec
+    (path params normalized)."""
+    def compatible(used_path, spec_path):
+        # Segment-wise: a parameter on EITHER side matches anything (the
+        # client side has f-string members like /{kind}/{id} that cannot
+        # be resolved statically).
+        u, s = used_path.split("/"), spec_path.split("/")
+        if len(u) != len(s):
+            return False
+        for a, b in zip(u, s):
+            if a.startswith("{") or b.startswith("{"):
+                continue
+            if a != b:
+                return False
+        return True
+
+    used = set()
+    roots = [os.path.join(REPO, "determined_tpu"), os.path.join(REPO, "webui")]
+    for root in roots:
+        for dirpath, _, files in os.walk(root):
+            for fn in files:
+                if not fn.endswith((".py", ".js")):
+                    continue
+                src = open(os.path.join(dirpath, fn),
+                           errors="replace").read()
+                # literal paths; f-string/template members become {…} params
+                for m in re.findall(r"/api/v1/[A-Za-z0-9_\-/{}$.\[\]']*",
+                                    src):
+                    path = m.split("?")[0]
+                    path = re.sub(r"\{[^}]*\}|\$\{[^}]*\}", "{id}", path)
+                    path = path.rstrip("/.")  # prose periods, trailing /
+                    if path.endswith(("'", "]")) or "[" in path:
+                        continue
+                    used.add(path)
+
+    unknown = [
+        path for path in sorted(used)
+        if not any(compatible(path, sp) for sp in spec["paths"])
+    ]
+    assert not unknown, f"paths used by clients but not in spec: {unknown}"
+
+
+def test_openapi_served_by_master(cluster, spec):
+    token = cluster.login()
+    req = urllib.request.Request(
+        cluster.master_url + "/api/v1/openapi",
+        headers={"Authorization": f"Bearer {token}"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        served = json.loads(r.read())
+    assert served["paths"].keys() == spec["paths"].keys()
