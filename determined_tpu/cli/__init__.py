@@ -433,6 +433,16 @@ def cmd_deploy(session: Session, args) -> int:
                 print("no local cluster running")
             else:
                 print(json.dumps(state, indent=2))
+    elif args.target == "gke":
+        from determined_tpu.deploy import gke
+
+        out = gke.generate(args.target_dir, project=args.project,
+                           cluster=args.cluster, zone=args.zone,
+                           namespace=args.namespace,
+                           slots_per_pod=args.slots_per_pod,
+                           num_nodes=args.num_nodes)
+        print(f"manifests written to {out}; review then "
+              f"`bash {out}/cluster.sh && kubectl apply -f {out}`")
     else:  # gcp
         from determined_tpu.deploy import gcp
 
@@ -841,6 +851,15 @@ def build_parser() -> argparse.ArgumentParser:
     dg.add_argument("--accelerator-type", default="v5litepod-8")
     dg.add_argument("--num-slices", type=int, default=1)
     dg.set_defaults(func=cmd_deploy, target="gcp")
+    dk = dp.add_parser("gke")
+    dk.add_argument("target_dir")
+    dk.add_argument("--project", required=True)
+    dk.add_argument("--cluster", default="determined-tpu")
+    dk.add_argument("--zone", default="us-east5-b")
+    dk.add_argument("--namespace", default="default")
+    dk.add_argument("--slots-per-pod", type=int, default=4)
+    dk.add_argument("--num-nodes", type=int, default=2)
+    dk.set_defaults(func=cmd_deploy, target="gke")
 
     tp = sub.add_parser("template").add_subparsers(dest="subcommand", required=True)
     tp.add_parser("list").set_defaults(func=cmd_template, action="list")

@@ -1,0 +1,209 @@
+"""`det deploy gke` — GKE deployment generator for the kubernetes RM.
+
+Reference: harness/determined/deploy/gke/ (gcloud/kubectl wrapper creating
+a GPU cluster + helm install). The TPU-native variant pairs with the
+master's kubernetes resource manager (native/master/rm_k8s.cc): it writes
+
+  - cluster.sh        gcloud commands: GKE cluster + a TPU node pool
+                      (ct5lp machine types for v5e) sized for the RM's
+                      slots_per_pod shape
+  - master.yaml       master Deployment + Service (+ PVC for the SQLite
+                      db) running with `resource_manager: kubernetes`
+                      against the in-cluster API via its service account
+  - rbac.yaml         ServiceAccount + Role (pods CRUD in the task
+                      namespace) + RoleBinding for the master
+  - task-svc.yaml     the headless Service whose subdomain gives task
+                      pods DNS (<pod>.<subdomain> — rm_k8s.cc sets
+                      spec.hostname/subdomain to match)
+
+The operator reviews and applies (`bash cluster.sh && kubectl apply -f .`);
+no cloud credentials are touched from inside this tool.
+"""
+
+from __future__ import annotations
+
+import os
+
+CLUSTER_SH = """#!/bin/bash
+set -ex
+# GKE cluster + TPU v5e node pool for determined-tpu (review before running)
+gcloud container clusters create {cluster} \\
+  --project {project} --zone {zone} \\
+  --num-nodes 1 --machine-type e2-standard-8 --release-channel regular
+
+gcloud container node-pools create tpu-v5e \\
+  --project {project} --zone {zone} --cluster {cluster} \\
+  --machine-type {machine_type} \\
+  --tpu-topology {topology} \\
+  --num-nodes {num_nodes} --spot
+
+gcloud container clusters get-credentials {cluster} \\
+  --project {project} --zone {zone}
+"""
+
+RBAC_YAML = """apiVersion: v1
+kind: ServiceAccount
+metadata:
+  name: determined-master
+  namespace: {namespace}
+---
+apiVersion: rbac.authorization.k8s.io/v1
+kind: Role
+metadata:
+  name: determined-master-pods
+  namespace: {namespace}
+rules:
+  - apiGroups: [""]
+    resources: ["pods"]
+    verbs: ["create", "delete", "get", "list", "watch"]
+---
+apiVersion: rbac.authorization.k8s.io/v1
+kind: RoleBinding
+metadata:
+  name: determined-master-pods
+  namespace: {namespace}
+subjects:
+  - kind: ServiceAccount
+    name: determined-master
+    namespace: {namespace}
+roleRef:
+  kind: Role
+  name: determined-master-pods
+  apiGroup: rbac.authorization.k8s.io
+"""
+
+TASK_SVC_YAML = """# Headless service: task pods set spec.hostname + spec.subdomain to this
+# name, so rank-0's DNS (<pod>.{subdomain}.{namespace}.svc) resolves for
+# multi-host rendezvous (rm_k8s.cc pod_manifest).
+apiVersion: v1
+kind: Service
+metadata:
+  name: {subdomain}
+  namespace: {namespace}
+spec:
+  clusterIP: None
+  selector:
+    det-managed: "true"
+"""
+
+MASTER_YAML = """apiVersion: v1
+kind: PersistentVolumeClaim
+metadata:
+  name: determined-master-db
+  namespace: {namespace}
+spec:
+  accessModes: ["ReadWriteOnce"]
+  resources:
+    requests:
+      storage: 10Gi
+---
+apiVersion: v1
+kind: ConfigMap
+metadata:
+  name: determined-master-config
+  namespace: {namespace}
+data:
+  master.json: |
+    {{
+      "port": 8080,
+      "db_path": "/var/determined/master.db",
+      "cluster_name": "{cluster}",
+      "resource_manager": "kubernetes",
+      "advertised_url": "http://determined-master.{namespace}.svc:8080",
+      "kubernetes": {{
+        "api_url": "https://kubernetes.default.svc",
+        "namespace": "{namespace}",
+        "image": "{task_image}",
+        "slots_per_pod": {slots_per_pod},
+        "max_pods": {max_pods},
+        "service_subdomain": "{subdomain}"
+      }}
+    }}
+---
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: determined-master
+  namespace: {namespace}
+spec:
+  replicas: 1
+  selector:
+    matchLabels: {{ app: determined-master }}
+  template:
+    metadata:
+      labels: {{ app: determined-master }}
+    spec:
+      serviceAccountName: determined-master
+      containers:
+        - name: master
+          image: {master_image}
+          command: ["/opt/determined-tpu/determined-master",
+                    "--config", "/etc/determined/master.json"]
+          ports: [{{ containerPort: 8080 }}]
+          volumeMounts:
+            - name: db
+              mountPath: /var/determined
+            - name: config
+              mountPath: /etc/determined
+      volumes:
+        - name: db
+          persistentVolumeClaim: {{ claimName: determined-master-db }}
+        - name: config
+          configMap: {{ name: determined-master-config }}
+---
+apiVersion: v1
+kind: Service
+metadata:
+  name: determined-master
+  namespace: {namespace}
+spec:
+  selector: {{ app: determined-master }}
+  ports:
+    - port: 8080
+      targetPort: 8080
+"""
+
+# v5e GKE machine shapes: chips per host → (machine type, topology).
+V5E_SHAPES = {
+    1: ("ct5lp-hightpu-1t", "1x1"),
+    4: ("ct5lp-hightpu-4t", "2x2"),
+    8: ("ct5lp-hightpu-8t", "2x4"),
+}
+
+
+def generate(
+    target_dir: str,
+    project: str,
+    cluster: str = "determined-tpu",
+    zone: str = "us-east5-b",
+    namespace: str = "default",
+    slots_per_pod: int = 4,
+    num_nodes: int = 2,
+    max_pods: int = 64,
+    master_image: str = "determined-tpu-master:latest",
+    task_image: str = "determined-tpu-task:latest",
+    subdomain: str = "determined-tpu",
+) -> str:
+    if slots_per_pod not in V5E_SHAPES:
+        raise ValueError(
+            f"slots_per_pod must be one of {sorted(V5E_SHAPES)} "
+            f"(v5e host shapes), got {slots_per_pod}")
+    machine_type, topology = V5E_SHAPES[slots_per_pod]
+    os.makedirs(target_dir, exist_ok=True)
+    files = {
+        "cluster.sh": CLUSTER_SH.format(
+            project=project, cluster=cluster, zone=zone,
+            machine_type=machine_type, topology=topology,
+            num_nodes=num_nodes),
+        "rbac.yaml": RBAC_YAML.format(namespace=namespace),
+        "task-svc.yaml": TASK_SVC_YAML.format(
+            namespace=namespace, subdomain=subdomain),
+        "master.yaml": MASTER_YAML.format(
+            namespace=namespace, cluster=cluster, task_image=task_image,
+            master_image=master_image, slots_per_pod=slots_per_pod,
+            max_pods=max_pods, subdomain=subdomain),
+    }
+    for name, content in files.items():
+        with open(os.path.join(target_dir, name), "w") as f:
+            f.write(content)
+    return target_dir

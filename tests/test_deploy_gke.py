@@ -1,0 +1,58 @@
+"""`det deploy gke` generator (reference harness/determined/deploy/gke/):
+the manifests must be valid YAML, pair with the kubernetes RM's config
+contract, and wire the headless-service DNS the RM relies on."""
+
+import json
+import subprocess
+import sys
+
+import yaml
+
+
+def test_gke_manifests(tmp_path):
+    from determined_tpu.deploy import gke
+
+    out = gke.generate(str(tmp_path / "gke"), project="p", cluster="c",
+                       namespace="ns", slots_per_pod=4, num_nodes=3)
+
+    master_docs = list(yaml.safe_load_all(open(f"{out}/master.yaml")))
+    kinds = [d["kind"] for d in master_docs]
+    assert kinds == ["PersistentVolumeClaim", "ConfigMap", "Deployment",
+                     "Service"]
+    cfg = json.loads(master_docs[1]["data"]["master.json"])
+    # The served config must match the master's kubernetes RM schema
+    # (MasterConfig::from_json keys).
+    assert cfg["resource_manager"] == "kubernetes"
+    assert cfg["kubernetes"]["namespace"] == "ns"
+    assert cfg["kubernetes"]["slots_per_pod"] == 4
+    assert cfg["advertised_url"].startswith("http://determined-master.ns")
+    dep = master_docs[2]
+    assert dep["spec"]["template"]["spec"]["serviceAccountName"] == \
+        "determined-master"
+
+    rbac = list(yaml.safe_load_all(open(f"{out}/rbac.yaml")))
+    role = next(d for d in rbac if d["kind"] == "Role")
+    assert {"create", "delete", "list"} <= set(role["rules"][0]["verbs"])
+
+    svc = yaml.safe_load(open(f"{out}/task-svc.yaml"))
+    assert svc["spec"]["clusterIP"] == "None"  # k8s headless literal
+    assert svc["metadata"]["name"] == cfg["kubernetes"]["service_subdomain"]
+    assert svc["spec"]["selector"] == {"det-managed": "true"}
+
+    sh = open(f"{out}/cluster.sh").read()
+    assert "ct5lp-hightpu-4t" in sh and "--num-nodes 3" in sh
+
+    # bad host shape rejected
+    import pytest
+    with pytest.raises(ValueError, match="slots_per_pod"):
+        gke.generate(str(tmp_path / "bad"), project="p", slots_per_pod=3)
+
+
+def test_gke_cli(tmp_path):
+    r = subprocess.run(
+        [sys.executable, "-m", "determined_tpu.cli", "deploy", "gke",
+         str(tmp_path / "out"), "--project", "p", "--slots-per-pod", "8"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "kubectl apply" in r.stdout
+    assert (tmp_path / "out" / "master.yaml").exists()
