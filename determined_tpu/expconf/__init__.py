@@ -296,6 +296,54 @@ def _validate_environment(envcfg: Any, errors: List[str]) -> None:
             )
 
 
+def shim(config: Dict[str, Any]) -> Dict[str, Any]:
+    """Translate legacy config shapes into the current schema (reference
+    pkg/schemas/expconf/legacy.go + the v0 version shims): configs written
+    for older formats keep working, torch/container-era knobs that have no
+    TPU meaning are dropped with a warning instead of failing validation.
+
+    Shims (applied before validate):
+      - bare-int lengths → {"batches": N}: searcher.max_length,
+        min_validation_period, min_checkpoint_period
+      - searcher.max_steps (ancient) → max_length {batches}
+      - searcher.name "adaptive"/"adaptive_simple" → adaptive_asha,
+        "sync_halving" → async_halving (semantics preserved; the legacy
+        names stay accepted by validate for byte-for-byte old configs)
+      - resources.slots → resources.slots_per_trial
+      - dropped with a warning: optimizations (torch-specific),
+        bind_mounts (no containers), data_layers, entrypoint_script
+    """
+    import warnings
+
+    c = copy.deepcopy(config)
+    if not isinstance(c, dict):
+        return c
+
+    searcher = c.get("searcher")
+    if isinstance(searcher, dict):
+        if "max_length" not in searcher and "max_steps" in searcher:
+            searcher["max_length"] = {"batches": searcher.pop("max_steps")}
+        if isinstance(searcher.get("max_length"), (int, float)):
+            searcher["max_length"] = {"batches": int(searcher["max_length"])}
+    for period in ("min_validation_period", "min_checkpoint_period"):
+        if isinstance(c.get(period), (int, float)):
+            c[period] = {"batches": int(c[period])}
+
+    res = c.get("resources")
+    if isinstance(res, dict) and "slots_per_trial" not in res and \
+            isinstance(res.get("slots"), int):
+        res["slots_per_trial"] = res.pop("slots")
+
+    for dropped in ("optimizations", "bind_mounts", "data_layers",
+                    "entrypoint_script"):
+        if dropped in c:
+            warnings.warn(
+                f"expconf: `{dropped}` has no meaning on the TPU platform "
+                "and is ignored", stacklevel=2)
+            c.pop(dropped)
+    return c
+
+
 def apply_defaults(config: Dict[str, Any]) -> Dict[str, Any]:
     """Fill schema defaults (reference: WithDefaults code-gen)."""
     c = copy.deepcopy(config)
@@ -353,7 +401,8 @@ def merge(config: Dict[str, Any], defaults: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def check(config: Dict[str, Any]) -> Dict[str, Any]:
-    """validate + defaults; raises ValueError with all errors joined."""
+    """shim + validate + defaults; raises ValueError with all errors."""
+    config = shim(config)
     errors = validate(config)
     if errors:
         raise ValueError("invalid experiment config:\n  " + "\n  ".join(errors))

@@ -103,3 +103,53 @@ class TestDefaults:
         out = expconf.apply_defaults(base_config())
         assert out["max_restarts"] == 5
         assert out["resources"]["slots_per_trial"] == 1
+
+
+class TestLegacyShims:
+    """Version shims (reference pkg/schemas/expconf/legacy.go): old config
+    shapes keep working through expconf.check()."""
+
+    def _base(self, **searcher):
+        return {
+            "entrypoint": "python3 train.py",
+            "searcher": {"name": "single", "metric": "loss", **searcher},
+        }
+
+    def test_bare_int_lengths(self):
+        cfg = self._base(max_length=500)
+        cfg["min_validation_period"] = 50
+        out = expconf.check(cfg)
+        assert out["searcher"]["max_length"] == {"batches": 500}
+        assert out["min_validation_period"] == {"batches": 50}
+
+    def test_max_steps_alias(self):
+        out = expconf.check(self._base(max_steps=100))
+        assert out["searcher"]["max_length"] == {"batches": 100}
+
+    def test_resources_slots_alias(self):
+        cfg = self._base(max_length={"batches": 4})
+        cfg["resources"] = {"slots": 8}
+        out = expconf.check(cfg)
+        assert out["resources"]["slots_per_trial"] == 8
+
+    def test_dropped_container_era_keys_warn(self):
+        import warnings
+
+        cfg = self._base(max_length={"batches": 4})
+        cfg["bind_mounts"] = [{"host_path": "/x", "container_path": "/y"}]
+        cfg["optimizations"] = {"aggregation_frequency": 2}
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out = expconf.check(cfg)
+        assert "bind_mounts" not in out and "optimizations" not in out
+        joined = " ".join(str(x.message) for x in w)
+        assert "bind_mounts" in joined and "optimizations" in joined
+
+    def test_legacy_adaptive_runs_through(self):
+        out = expconf.check({
+            "entrypoint": "python3 train.py",
+            "searcher": {"name": "adaptive", "metric": "loss",
+                         "max_length": 16, "max_trials": 4},
+        })
+        assert out["searcher"]["max_length"] == {"batches": 16}
+        assert out["searcher"]["divisor"] == 4

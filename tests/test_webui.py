@@ -178,16 +178,44 @@ def test_stream_live_update_contract(cluster, tmp_path):
                for e in out["events"])
 
 
+def test_user_admin_page_data(cluster):
+    """The users/admin page's API sequence: list users + me + assignments,
+    admin mutations (create / role change / deactivate / grant / revoke)."""
+    admin = cluster.login("admin")
+    me = cluster.api("GET", "/api/v1/me", token=admin)["user"]
+    assert me["role"] == "admin"
+    cluster.api("POST", "/api/v1/users",
+                {"username": "ui-user", "role": "viewer"}, token=admin)
+    users = cluster.api("GET", "/api/v1/users", token=admin)["users"]
+    u = next(x for x in users if x["username"] == "ui-user")
+    assert u["role"] == "viewer" and u["active"] == 1
+    cluster.api("PATCH", f"/api/v1/users/{u['id']}", {"role": "user"},
+                token=admin)
+    grant = cluster.api("POST", "/api/v1/rbac/assignments",
+                        {"role": "editor", "user_id": u["id"],
+                         "workspace_id": 1}, token=admin)
+    rows = cluster.api("GET", "/api/v1/rbac/assignments",
+                       token=admin)["assignments"]
+    assert any(r["id"] == grant["id"] and r["username"] == "ui-user"
+               for r in rows)
+    cluster.api("DELETE", f"/api/v1/rbac/assignments/{grant['id']}",
+                token=admin)
+    cluster.api("PATCH", f"/api/v1/users/{u['id']}", {"active": False},
+                token=admin)
+    users = cluster.api("GET", "/api/v1/users", token=admin)["users"]
+    assert next(x for x in users if x["id"] == u["id"])["active"] == 0
+
+
 def test_app_js_references_real_endpoints(cluster):
     """Static check: every /api/v1 path in app.js is routed by the master
     (no dead fetches shipped in the UI)."""
     js = _get(cluster.master_url + "/ui/app.js")
     token = cluster.login()
-    paths = set(re.findall(r'"(/api/v1/[a-z\-]+)', js))
+    paths = set(re.findall(r'"(/api/v1/[a-z\-/]+)', js))
     assert paths  # sanity
     for p in paths:
-        if p == "/api/v1/auth":
-            continue  # covered by login itself
+        if p.startswith("/api/v1/auth"):
+            continue  # POST-only; covered by login itself
         status = 0
         req = urllib.request.Request(
             cluster.master_url + p,

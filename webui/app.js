@@ -579,6 +579,73 @@ async function pageModels() {
   }
 }
 
+async function pageUsers() {
+  const [{ users }, me, { assignments }] = await Promise.all([
+    api("GET", "/api/v1/users"),
+    api("GET", "/api/v1/me"),
+    api("GET", "/api/v1/rbac/assignments"),
+  ]);
+  const admin = me.user.role === "admin";
+  view.textContent = "";
+  view.append(el("h1", {}, "Users"));
+  const err = el("span", { class: "error" });
+  const act = (label, fn) => el("button", {
+    onclick: async () => {
+      try { await fn(); pageUsers(); }
+      catch (e) { err.textContent = `${label} failed: ${e.message}`; }
+    },
+  }, label);
+  view.append(el("table", {},
+    el("tr", {}, ["ID", "Username", "Role", "Active",
+                  ...(admin ? ["Admin actions"] : [])]
+      .map((h) => el("th", {}, h))),
+    users.map((u) => el("tr", {},
+      el("td", {}, u.id),
+      el("td", {}, u.username),
+      el("td", {}, u.role),
+      el("td", {}, u.active ? "yes" : "no"),
+      ...(admin ? [el("td", {},
+        act(u.active ? "deactivate" : "activate", () =>
+          api("PATCH", `/api/v1/users/${u.id}`, { active: !u.active })),
+        " ",
+        act("make viewer", () =>
+          api("PATCH", `/api/v1/users/${u.id}`, { role: "viewer" })),
+        " ",
+        act("make user", () =>
+          api("PATCH", `/api/v1/users/${u.id}`, { role: "user" })),
+        " ",
+        act("make admin", () =>
+          api("PATCH", `/api/v1/users/${u.id}`, { role: "admin" })))]
+        : [])))));
+  if (admin) {
+    const name = el("input", { placeholder: "username" });
+    const role = el("select", {},
+      ["user", "viewer", "admin"].map((r) => el("option", { value: r }, r)));
+    view.append(el("div", { class: "actions" }, name, role,
+      act("create user", async () => {
+        await api("POST", "/api/v1/users",
+                  { username: name.value, role: role.value });
+      }), err));
+  } else {
+    view.append(el("p", { class: "muted" },
+      "admin role required for user management"));
+  }
+
+  view.append(el("h2", {}, "Role assignments"));
+  view.append(el("table", {},
+    el("tr", {}, ["ID", "Role", "User", "Group", "Workspace",
+                  ...(admin ? [""] : [])].map((h) => el("th", {}, h))),
+    assignments.map((a) => el("tr", {},
+      el("td", {}, a.id), el("td", {}, a.role),
+      el("td", {}, a.username ?? ""), el("td", {}, a.group_name ?? ""),
+      el("td", {}, a.workspace_id ?? "global"),
+      ...(admin ? [el("td", {}, act("revoke", () =>
+        api("DELETE", `/api/v1/rbac/assignments/${a.id}`)))] : [])))));
+  if (!assignments.length) {
+    view.append(el("p", { class: "muted" }, "no grants"));
+  }
+}
+
 async function pageCluster() {
   const { agents } = await api("GET", "/api/v1/agents");
   view.textContent = "";
@@ -649,6 +716,7 @@ async function route() {
     if (t) return await pageTrial(t[1]);
     if (hash.startsWith("#/workspaces")) return await pageWorkspaces();
     if (hash.startsWith("#/models")) return await pageModels();
+    if (hash.startsWith("#/users")) return await pageUsers();
     if (hash.startsWith("#/cluster")) return await pageCluster();
     if (hash.startsWith("#/jobs")) return await pageJobs();
     await pageExperiments();
