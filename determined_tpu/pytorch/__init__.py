@@ -21,3 +21,9 @@ from determined_tpu.pytorch._trial import (  # noqa: F401
     Trainer,
     TorchData,
 )
+from determined_tpu.pytorch.deepspeed import (  # noqa: F401
+    DeepSpeedTrial,
+    DeepSpeedTrialContext,
+    DeepSpeedTrainer,
+    ModelParallelUnit,
+)
