@@ -49,6 +49,7 @@ def ulysses_attention(
     seq_axis: str = "context",
     batch_axes=("data", "fsdp"),
     head_axis: str = "tensor",
+    inner: str = "flash",  # full-seq kernel inside the shard: flash | dot
 ) -> jax.Array:
     """Attention with the sequence dim sharded over `seq_axis` via two
     all-to-alls (head-sharding inside). Falls back to plain attention when
@@ -70,6 +71,19 @@ def ulysses_attention(
 
     spec = P(batch_axes, seq_axis, head_axis, None)
 
+    # This is Ulysses' composability advantage over ring attention: after
+    # the all-to-all the shard holds the FULL sequence for a head subset,
+    # so any single-device attention kernel drops in — including the
+    # pallas flash kernel (which falls back to the XLA path off-TPU).
+    if inner == "flash":
+        from determined_tpu.ops.flash_attention import flash_attention
+
+        def attend(qq, kk, vv):
+            return flash_attention(qq, kk, vv, causal=causal)
+    else:
+        def attend(qq, kk, vv):
+            return _inner_attention(qq, kk, vv, causal)
+
     @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
              out_specs=spec, check_vma=False)
     def sharded(ql, kl, vl):
@@ -83,7 +97,7 @@ def ulysses_attention(
             return jax.lax.all_to_all(
                 x, seq_axis, split_axis=1, concat_axis=2, tiled=True)
 
-        out = _inner_attention(spread(ql), spread(kl), spread(vl), causal)
+        out = attend(spread(ql), spread(kl), spread(vl))
         return gather_back(out)
 
     return sharded(q, k, v)

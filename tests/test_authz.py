@@ -241,6 +241,41 @@ def test_agent_drain_admin_path(cluster):
         cluster.api("POST", "/api/v1/agents/no-such-agent/disable", token=admin)
 
 
+def test_agent_drain_blocks_scheduling(tmp_path, native_binaries):
+    """Drained agents take no new work; enable releases the queue
+    (reference api_agent.go DisableAgent semantics)."""
+    import time
+
+    c = Devcluster(str(tmp_path), native_binaries)
+    c.start_master()
+    c.start_agent()
+    try:
+        admin = c.login("admin")
+        user = c.login()
+        c.api("POST", "/api/v1/agents/agent-0/disable", token=admin)
+        agents = c.api("GET", "/api/v1/agents", token=admin)["agents"]
+        assert all(not s["enabled"] for s in agents[0]["slots"])
+
+        tid = c.api("POST", "/api/v1/commands",
+                    {"config": {"entrypoint": "echo drained",
+                                "resources": {"slots": 1}}},
+                    token=user)["id"]
+        time.sleep(2.0)  # several scheduler ticks
+        task = c.api("GET", f"/api/v1/commands/{tid}", token=user)["task"]
+        assert task.get("allocation_state") in (None, "PENDING"), task
+
+        c.api("POST", "/api/v1/agents/agent-0/enable", token=admin)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            task = c.api("GET", f"/api/v1/commands/{tid}", token=user)["task"]
+            if task["state"] == "COMPLETED":
+                break
+            time.sleep(0.5)
+        assert task["state"] == "COMPLETED", task
+    finally:
+        c.stop()
+
+
 def test_agent_protocol_requires_agent_role(cluster):
     """A normal user must not be able to register a fake agent: the actions
     stream hands out task environments including per-owner session tokens,

@@ -157,6 +157,17 @@ def test_hp_search_view_data(cluster, tmp_path):
         assert isinstance(t["hparams"].get("lr"), float)
     # distinct sampled hparams → a real scatter, not a vertical line
     assert len({t["hparams"]["lr"] for t in scored}) >= 2
+    # trial-comparison chart data: per-trial validation series exist, and
+    # ASHA rung geometry shows as different curve lengths across trials
+    lengths = set()
+    for t in trials:
+        vm = cluster.api(
+            "GET", f"/api/v1/trials/{t['id']}/metrics?group=validation",
+            token=token)["metrics"]
+        assert vm, f"trial {t['id']} has no validation series"
+        assert all("val_loss" in m["metrics"] for m in vm)
+        lengths.add(max(m["total_batches"] for m in vm))
+    assert len(lengths) >= 2, f"expected distinct rung lengths, got {lengths}"
 
 
 def test_stream_live_update_contract(cluster, tmp_path):

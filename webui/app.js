@@ -447,6 +447,35 @@ async function pageExperiment(id) {
     }
   }
 
+  // Trial comparison: the searcher metric's curve per trial, overlaid
+  // (ASHA rungs become visibly different lengths). lineChart folds >4
+  // series into the table view so nothing is dropped silently.
+  if (trials.length >= 2) {
+    const metricLists = await Promise.all(trials.slice(0, 12).map((t) =>
+      api("GET", `/api/v1/trials/${t.id}/metrics?group=validation`)));
+    const series = [];
+    trials.slice(0, 12).forEach((t, i) => {
+      const pts = [];
+      for (const m of metricLists[i].metrics) {
+        for (const key of [metricName, `validation_${metricName}`]) {
+          const v = (m.metrics || {})[key];
+          if (typeof v === "number" && isFinite(v)) {
+            pts.push({ x: m.total_batches, y: v });
+          }
+        }
+      }
+      if (pts.length) series.push({ name: `trial ${t.id}`, points: pts });
+    });
+    if (series.length >= 2) {
+      view.append(el("h2", {}, "Trial comparison"));
+      view.append(lineChart(`${metricName} by trial`, series));
+      if (trials.length > 12) {
+        view.append(el("p", { class: "muted" },
+          `first 12 of ${trials.length} trials shown`));
+      }
+    }
+  }
+
   // metric charts from the first trial (single/first-trial view; the data
   // is per-trial at /api/v1/trials/{id}/metrics)
   if (trials.length) {
