@@ -61,7 +61,11 @@ struct Task {
   std::string allocation_id;
   std::string container_id;
   std::string task_id;
-  pid_t pid = -1;
+  std::string workdir;
+  pid_t pid = -1;        // the sh wrapper's pid (the task's process group)
+  int rank = 0;
+  bool adopted = false;  // reattached after an agent restart: not our
+                         // child, supervised by /proc polling
   std::atomic<bool> exited{false};
 };
 
@@ -221,25 +225,105 @@ Json detect_slots(AgentOptions& opts) {
 }
 
 // ---- task lifecycle -----------------------------------------------------
+//
+// Task stdout/stderr go to FILES in the task workdir (not pipes): files
+// survive an agent restart, which is what makes reattach possible at all
+// (reference container reattach, agent/internal/container/container.go:89
+// — docker keeps the logs; here the filesystem does). A tail thread ships
+// lines as they appear; the wrapper records the exit status to
+// `.det_status` so even a non-child (adopted) task's exit code is
+// recoverable.
 
-void reader_thread(int fd, std::shared_ptr<Task> task,
-                   const std::string& agent_id, int rank,
-                   const std::string& stdtype) {
-  FILE* f = fdopen(fd, "r");
-  if (f == nullptr) {
-    close(fd);
-    return;
+void tail_thread(std::string path, std::shared_ptr<Task> task,
+                 std::string agent_id, int rank, std::string stdtype,
+                 bool start_at_end) {
+  FILE* f = nullptr;
+  long offset = 0;
+  if (start_at_end) {
+    // Reattach: resume from EOF — re-shipping the whole file would
+    // duplicate every line in the master (and re-trip log policies).
+    struct stat st;
+    if (stat(path.c_str(), &st) == 0) offset = st.st_size;
   }
-  char* line = nullptr;
-  size_t cap = 0;
-  ssize_t len;
-  while ((len = getline(&line, &cap, f)) != -1) {
-    if (len > 0 && line[len - 1] == '\n') line[len - 1] = '\0';
+  std::string partial;
+  char buf[8192];
+  while (true) {
+    if (f == nullptr) {
+      f = fopen(path.c_str(), "r");
+      if (f != nullptr) fseek(f, offset, SEEK_SET);
+    }
+    size_t n = 0;
+    if (f != nullptr) {
+      n = fread(buf, 1, sizeof(buf), f);
+      clearerr(f);  // EOF is transient while the task still runs
+    }
+    if (n > 0) {
+      offset += static_cast<long>(n);
+      partial.append(buf, n);
+      size_t nl;
+      while ((nl = partial.find('\n')) != std::string::npos) {
+        enqueue_log(task->task_id, task->allocation_id, task->container_id,
+                    agent_id, rank, stdtype, partial.substr(0, nl));
+        partial.erase(0, nl + 1);
+      }
+      continue;  // drain greedily
+    }
+    if (task->exited) break;  // final read above drained the file
+    std::this_thread::sleep_for(std::chrono::milliseconds(200));
+  }
+  if (!partial.empty()) {
     enqueue_log(task->task_id, task->allocation_id, task->container_id,
-                agent_id, rank, stdtype, line);
+                agent_id, rank, stdtype, partial);
   }
-  free(line);
-  fclose(f);
+  if (f != nullptr) fclose(f);
+}
+
+// ---- task registry: work_root/running.json -------------------------------
+// Persisted on every start/exit so a restarted agent can reattach the
+// tasks that survived it (reference containers/manager.go:76
+// ReattachContainers).
+
+void persist_registry(const AgentOptions& opts) {
+  Json arr = Json::array();
+  {
+    std::lock_guard<std::mutex> lock(g_mu);
+    for (const auto& [cid, t] : g_tasks) {
+      if (t->exited) continue;
+      arr.push_back(Json(JsonObject{
+          {"container_id", Json(t->container_id)},
+          {"allocation_id", Json(t->allocation_id)},
+          {"task_id", Json(t->task_id)},
+          {"workdir", Json(t->workdir)},
+          {"pid", Json(static_cast<int64_t>(t->pid))},
+          {"rank", Json(static_cast<int64_t>(t->rank))},
+      }));
+    }
+  }
+  std::string path = opts.work_root + "/running.json";
+  std::string tmp = path + ".tmp";
+  std::ofstream f(tmp, std::ios::trunc);
+  f << arr.dump();
+  f.close();
+  rename(tmp.c_str(), path.c_str());
+}
+
+bool pid_alive(pid_t pid) {
+  return pid > 0 && kill(pid, 0) == 0;
+}
+
+int read_status_file(const std::string& workdir, double wait_s) {
+  // The sh wrapper writes the exit code to .det_status as its last act;
+  // give it a moment to land after the process disappears.
+  std::string path = workdir + "/.det_status";
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(static_cast<int>(wait_s * 1000));
+  while (std::chrono::steady_clock::now() < deadline) {
+    std::ifstream f(path);
+    int code;
+    if (f && (f >> code)) return code;
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  }
+  return 137;  // unknowable → treat as killed
 }
 
 void report_state(const AgentOptions& opts, const std::string& alloc_id,
@@ -256,22 +340,68 @@ void report_state(const AgentOptions& opts, const std::string& alloc_id,
   }
 }
 
+void finish_task(const AgentOptions& opts, std::shared_ptr<Task> task,
+                 int code) {
+  task->exited = true;
+  Json done = Json::object();
+  done["container_id"] = task->container_id;
+  done["state"] = "EXITED";
+  done["exit_code"] = static_cast<int64_t>(code);
+  report_state(opts, task->allocation_id, done);
+  {
+    std::lock_guard<std::mutex> lock(g_mu);
+    g_tasks.erase(task->container_id);
+  }
+  persist_registry(opts);
+}
+
+void supervise(const AgentOptions& opts, std::shared_ptr<Task> task) {
+  // Start the log tails + the appropriate waiter.
+  std::thread(tail_thread, task->workdir + "/stdout.log", task, opts.id,
+              task->rank, "stdout", task->adopted).detach();
+  std::thread(tail_thread, task->workdir + "/stderr.log", task, opts.id,
+              task->rank, "stderr", task->adopted).detach();
+  if (!task->adopted) {
+    std::thread([task, opts] {
+      int status = 0;
+      waitpid(task->pid, &status, 0);
+      int code = WIFEXITED(status) ? WEXITSTATUS(status)
+                                   : 128 + WTERMSIG(status);
+      finish_task(opts, task, code);
+    }).detach();
+  } else {
+    // Reattached task is NOT our child — waitpid is impossible. Poll
+    // liveness; the wrapper's .det_status file carries the exit code.
+    std::thread([task, opts] {
+      while (pid_alive(task->pid)) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(500));
+      }
+      finish_task(opts, task, read_status_file(task->workdir, 3.0));
+    }).detach();
+  }
+}
+
 void start_task(const AgentOptions& opts, const Json& action) {
   auto task = std::make_shared<Task>();
   task->allocation_id = action["allocation_id"].as_string();
   task->container_id = action["container_id"].as_string();
   const Json& env = action["env"];
   task->task_id = env["DET_TASK_ID"].as_string();
-  int rank = static_cast<int>(env["DET_NODE_RANK"].as_int(0));
+  task->rank = static_cast<int>(env["DET_NODE_RANK"].as_int(0));
 
   std::string workdir = opts.work_root + "/" + task->allocation_id + "-r" +
-                        std::to_string(rank);
+                        std::to_string(task->rank);
+  task->workdir = workdir;
   mkdir(opts.work_root.c_str(), 0755);
   mkdir(workdir.c_str(), 0755);
 
-  int out_pipe[2], err_pipe[2];
-  if (pipe(out_pipe) != 0 || pipe(err_pipe) != 0) {
-    std::cerr << "pipe() failed" << std::endl;
+  // stdout/stderr to FILES (reattach survives us; the tail threads ship).
+  int out_fd = open((workdir + "/stdout.log").c_str(),
+                    O_WRONLY | O_CREAT | O_APPEND, 0644);
+  int err_fd = open((workdir + "/stderr.log").c_str(),
+                    O_WRONLY | O_CREAT | O_APPEND, 0644);
+  if (out_fd < 0 || err_fd < 0) {
+    std::cerr << "open log files failed in " << workdir << std::endl;
     return;
   }
 
@@ -279,12 +409,10 @@ void start_task(const AgentOptions& opts, const Json& action) {
   if (pid == 0) {
     // Child: own process group so kill() reaps the whole task tree.
     setpgid(0, 0);
-    dup2(out_pipe[1], STDOUT_FILENO);
-    dup2(err_pipe[1], STDERR_FILENO);
-    close(out_pipe[0]);
-    close(out_pipe[1]);
-    close(err_pipe[0]);
-    close(err_pipe[1]);
+    dup2(out_fd, STDOUT_FILENO);
+    dup2(err_fd, STDERR_FILENO);
+    close(out_fd);
+    close(err_fd);
     if (chdir(workdir.c_str()) != 0) _exit(125);
     for (const auto& [k, v] : env.as_object()) {
       std::string val = v.is_string() ? v.as_string() : v.dump();
@@ -293,15 +421,18 @@ void start_task(const AgentOptions& opts, const Json& action) {
     setenv("DET_WORKDIR", workdir.c_str(), 1);
     setenv("DET_RUN_DIR", workdir.c_str(), 1);
     setenv("PYTHONUNBUFFERED", "1", 1);
-    // The in-container bootstrap (reference entrypoint.sh →
-    // exec/prep_container.py → exec/launch.py) lives in the Python
-    // harness; python resolves the experiment entrypoint from env.
-    execlp("python3", "python3", "-m", "determined_tpu.exec.launch",
+    // sh wrapper records the exit status to .det_status — that is what
+    // lets a RESTARTED agent (which cannot waitpid an orphan) recover the
+    // code. The in-container bootstrap (reference entrypoint.sh →
+    // prep_container.py → launch.py) lives in the Python harness.
+    execlp("/bin/sh", "sh", "-c",
+           "python3 -m determined_tpu.exec.launch; st=$?; "
+           "echo $st > .det_status; exit $st",
            static_cast<char*>(nullptr));
     _exit(127);
   }
-  close(out_pipe[1]);
-  close(err_pipe[1]);
+  close(out_fd);
+  close(err_fd);
   if (pid < 0) {
     std::cerr << "fork() failed" << std::endl;
     return;
@@ -313,11 +444,8 @@ void start_task(const AgentOptions& opts, const Json& action) {
     std::lock_guard<std::mutex> lock(g_mu);
     g_tasks[task->container_id] = task;
   }
-
-  std::thread(reader_thread, out_pipe[0], task, opts.id, rank, "stdout")
-      .detach();
-  std::thread(reader_thread, err_pipe[0], task, opts.id, rank, "stderr")
-      .detach();
+  persist_registry(opts);
+  supervise(opts, task);
 
   // Report RUNNING with our reachable address (feeds rendezvous).
   Json body = Json::object();
@@ -325,22 +453,50 @@ void start_task(const AgentOptions& opts, const Json& action) {
   body["state"] = "RUNNING";
   body["daemon_addr"] = opts.addr;
   report_state(opts, task->allocation_id, body);
+}
 
-  // Waiter thread: reap + report exit.
-  std::thread([task, opts] {
-    int status = 0;
-    waitpid(task->pid, &status, 0);
-    int code = WIFEXITED(status) ? WEXITSTATUS(status)
-                                 : 128 + WTERMSIG(status);
-    task->exited = true;
-    Json done = Json::object();
-    done["container_id"] = task->container_id;
-    done["state"] = "EXITED";
-    done["exit_code"] = static_cast<int64_t>(code);
-    report_state(opts, task->allocation_id, done);
-    std::lock_guard<std::mutex> lock(g_mu);
-    g_tasks.erase(task->container_id);
-  }).detach();
+// Reattach tasks recorded by a previous agent incarnation (reference
+// containers/manager.go:76 ReattachContainers): live pids are adopted
+// (tail from EOF + /proc-poll waiter), dead ones get their exit reported
+// from the wrapper's status file. Returns true if anything was adopted.
+bool reattach_tasks(const AgentOptions& opts) {
+  std::ifstream f(opts.work_root + "/running.json");
+  if (!f) return false;
+  std::stringstream ss;
+  ss << f.rdbuf();
+  Json arr = Json::parse_or_null(ss.str());
+  bool adopted_any = false;
+  for (const auto& e : arr.as_array()) {
+    auto task = std::make_shared<Task>();
+    task->container_id = e["container_id"].as_string();
+    task->allocation_id = e["allocation_id"].as_string();
+    task->task_id = e["task_id"].as_string();
+    task->workdir = e["workdir"].as_string();
+    task->pid = static_cast<pid_t>(e["pid"].as_int(-1));
+    task->rank = static_cast<int>(e["rank"].as_int(0));
+    task->adopted = true;
+    if (pid_alive(task->pid)) {
+      std::cerr << "agent: reattached " << task->container_id << " pid="
+                << task->pid << std::endl;
+      {
+        std::lock_guard<std::mutex> lock(g_mu);
+        g_tasks[task->container_id] = task;
+      }
+      supervise(opts, task);
+      Json body = Json::object();
+      body["container_id"] = task->container_id;
+      body["state"] = "RUNNING";
+      body["daemon_addr"] = opts.addr;
+      report_state(opts, task->allocation_id, body);
+      adopted_any = true;
+    } else {
+      std::cerr << "agent: task " << task->container_id
+                << " died while we were down" << std::endl;
+      finish_task(opts, task, read_status_file(task->workdir, 0.5));
+    }
+  }
+  persist_registry(opts);
+  return adopted_any;
 }
 
 void kill_allocation(const std::string& alloc_id) {
@@ -516,11 +672,15 @@ int main(int argc, char** argv) {
 
   signal(SIGPIPE, SIG_IGN);
 
-  // Install the bootstrap credential (env first, then token file), then
-  // register (retry until master is up — the file may not exist until the
-  // master has booted and minted it).
+  // Install the bootstrap credential (env first, then token file), adopt
+  // any tasks that survived a previous agent incarnation, then register
+  // (retry until master is up — the file may not exist until the master
+  // has booted and minted it). reconnect=true when anything was adopted
+  // so the master runs the reattach reconcile instead of a fresh reset.
   agent_login(opts.master_url, /*use_env_token=*/true);
-  while (!register_with_master(opts, false)) {
+  mkdir(opts.work_root.c_str(), 0755);
+  bool adopted = reattach_tasks(opts);
+  while (!register_with_master(opts, adopted)) {
     agent_login(opts.master_url, /*use_env_token=*/true);
     std::this_thread::sleep_for(std::chrono::seconds(2));
   }

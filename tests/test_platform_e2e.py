@@ -398,6 +398,54 @@ def test_pause_resume_preempts_and_resumes_from_checkpoint(cluster, tmp_path):
     assert any("resumed from checkpoint" in line["log"] for line in logs)
 
 
+def test_agent_restart_reattaches_running_task(cluster, tmp_path):
+    """Kill -9 the agent mid-trial and restart it: the task process (its
+    own process group, logging to files) survives, the new agent adopts it
+    from running.json, and the trial COMPLETES with restarts == 0 — a
+    reattach, not a restart-from-checkpoint (reference
+    containers/manager.go:76 ReattachContainers)."""
+    config = _experiment_config(
+        tmp_path,
+        searcher={"name": "single", "metric": "val_loss",
+                  "max_length": {"batches": 150}},
+    )
+    config["environment"] = {"TRIAL_STEP_SLEEP": "0.05"}
+    eid, token = _create_experiment(cluster, config)
+
+    # Wait until the trial is actually running and logging.
+    deadline = time.time() + 60
+    trial = None
+    while time.time() < deadline:
+        trials = cluster.api("GET", f"/api/v1/experiments/{eid}/trials",
+                             token=token)["trials"]
+        if trials:
+            logs = cluster.api(
+                "GET", f"/api/v1/tasks/trial-{trials[0]['id']}/logs?offset=0",
+                token=token)["logs"]
+            if logs:
+                trial = trials[0]
+                break
+        time.sleep(0.3)
+    assert trial is not None, "trial never started logging"
+
+    cluster.agent.kill()  # SIGKILL: no cleanup, the task is orphaned
+    cluster.agent.wait()
+    time.sleep(1.0)
+    cluster.start_agent()  # same id + work_root → reattach path
+
+    _wait_experiment(cluster, eid, token, timeout=180.0)
+    trials = cluster.api("GET", f"/api/v1/experiments/{eid}/trials",
+                         token=token)["trials"]
+    assert trials[0]["state"] == "COMPLETED"
+    assert trials[0]["restarts"] == 0, (
+        "reattach must not consume a restart: the surviving process "
+        "finished the trial")
+    logs = cluster.api(
+        "GET", f"/api/v1/tasks/trial-{trials[0]['id']}/logs?offset=0",
+        token=token)["logs"]
+    assert any("trial complete" in line["log"] for line in logs)
+
+
 def test_master_restart_restores_experiment(cluster, tmp_path):
     config = _experiment_config(
         tmp_path,
