@@ -22,6 +22,8 @@
 #include <sys/wait.h>
 #include <unistd.h>
 
+#include <climits>
+
 #include <atomic>
 #include <condition_variable>
 #include <cstring>
@@ -63,10 +65,20 @@ struct Task {
   std::string task_id;
   std::string workdir;
   pid_t pid = -1;        // the sh wrapper's pid (the task's process group)
+  long long pid_start = 0;  // /proc/<pid>/stat starttime: adoption identity
+                            // check against pid recycling
   int rank = 0;
   bool adopted = false;  // reattached after an agent restart: not our
                          // child, supervised by /proc polling
   std::atomic<bool> exited{false};
+  // Exit code awaiting a CONFIRMED delivery to the master (INT_MIN =
+  // none). Kept in the registry until delivered so a master outage — or
+  // an agent death mid-retry — never loses an exit.
+  std::atomic<int> pending_exit{INT_MIN};
+  // Shipped-log offsets, persisted so a restarted agent resumes the tail
+  // without dropping the downtime window (duplicates of up to one flush
+  // interval are possible; the log-policy actions are idempotent).
+  std::atomic<long> off_out{0}, off_err{0};
 };
 
 std::mutex g_mu;
@@ -236,15 +248,10 @@ Json detect_slots(AgentOptions& opts) {
 
 void tail_thread(std::string path, std::shared_ptr<Task> task,
                  std::string agent_id, int rank, std::string stdtype,
-                 bool start_at_end) {
+                 std::atomic<long>* offset_slot) {
   FILE* f = nullptr;
-  long offset = 0;
-  if (start_at_end) {
-    // Reattach: resume from EOF — re-shipping the whole file would
-    // duplicate every line in the master (and re-trip log policies).
-    struct stat st;
-    if (stat(path.c_str(), &st) == 0) offset = st.st_size;
-  }
+  long offset = offset_slot->load();  // adoption resumes from the
+                                      // persisted shipped offset
   std::string partial;
   char buf[8192];
   while (true) {
@@ -259,6 +266,7 @@ void tail_thread(std::string path, std::shared_ptr<Task> task,
     }
     if (n > 0) {
       offset += static_cast<long>(n);
+      offset_slot->store(offset);
       partial.append(buf, n);
       size_t nl;
       while ((nl = partial.find('\n')) != std::string::npos) {
@@ -278,33 +286,78 @@ void tail_thread(std::string path, std::shared_ptr<Task> task,
   if (f != nullptr) fclose(f);
 }
 
+// /proc/<pid>/stat field 22 (starttime, clock ticks since boot): the
+// adoption identity — a recycled pid has a different starttime.
+long long pid_starttime(pid_t pid) {
+  std::ifstream f("/proc/" + std::to_string(pid) + "/stat");
+  if (!f) return 0;
+  std::string line;
+  std::getline(f, line);
+  // comm can contain spaces/parens: skip to the LAST ')'.
+  auto close_paren = line.rfind(')');
+  if (close_paren == std::string::npos) return 0;
+  std::istringstream rest(line.substr(close_paren + 2));
+  std::string tok;
+  // fields 3..21 then starttime (field 22)
+  for (int i = 0; i < 19; ++i) rest >> tok;
+  long long start = 0;
+  rest >> start;
+  return start;
+}
+
 // ---- task registry: work_root/running.json -------------------------------
 // Persisted on every start/exit so a restarted agent can reattach the
 // tasks that survived it (reference containers/manager.go:76
 // ReattachContainers).
+
+std::mutex g_registry_mu;  // one writer at a time for running.json
 
 void persist_registry(const AgentOptions& opts) {
   Json arr = Json::array();
   {
     std::lock_guard<std::mutex> lock(g_mu);
     for (const auto& [cid, t] : g_tasks) {
-      if (t->exited) continue;
-      arr.push_back(Json(JsonObject{
+      JsonObject e{
           {"container_id", Json(t->container_id)},
           {"allocation_id", Json(t->allocation_id)},
           {"task_id", Json(t->task_id)},
           {"workdir", Json(t->workdir)},
           {"pid", Json(static_cast<int64_t>(t->pid))},
+          {"pid_start", Json(static_cast<int64_t>(t->pid_start))},
           {"rank", Json(static_cast<int64_t>(t->rank))},
-      }));
+          {"off_out", Json(static_cast<int64_t>(t->off_out.load()))},
+          {"off_err", Json(static_cast<int64_t>(t->off_err.load()))},
+      };
+      // Exited-but-unreported tasks stay in the registry carrying their
+      // exit code until the master confirms receipt.
+      int pe = t->pending_exit.load();
+      if (pe != INT_MIN) e["exit_code"] = Json(static_cast<int64_t>(pe));
+      arr.push_back(Json(std::move(e)));
     }
   }
+  // Serialize the write+rename: concurrent exiting tasks must not
+  // interleave into a corrupt file.
+  std::lock_guard<std::mutex> lock(g_registry_mu);
   std::string path = opts.work_root + "/running.json";
   std::string tmp = path + ".tmp";
   std::ofstream f(tmp, std::ios::trunc);
   f << arr.dump();
   f.close();
   rename(tmp.c_str(), path.c_str());
+}
+
+// Flush shipped-log offsets every couple of seconds while tasks run —
+// bounds reattach log duplication to the flush interval.
+void registry_flusher(const AgentOptions& opts) {
+  while (g_running) {
+    std::this_thread::sleep_for(std::chrono::seconds(2));
+    bool any;
+    {
+      std::lock_guard<std::mutex> lock(g_mu);
+      any = !g_tasks.empty();
+    }
+    if (any) persist_registry(opts);
+  }
 }
 
 bool pid_alive(pid_t pid) {
@@ -343,11 +396,27 @@ void report_state(const AgentOptions& opts, const std::string& alloc_id,
 void finish_task(const AgentOptions& opts, std::shared_ptr<Task> task,
                  int code) {
   task->exited = true;
+  task->pending_exit = code;
+  persist_registry(opts);  // the exit is durable BEFORE we try to report
   Json done = Json::object();
   done["container_id"] = task->container_id;
   done["state"] = "EXITED";
   done["exit_code"] = static_cast<int64_t>(code);
-  report_state(opts, task->allocation_id, done);
+  // Retry until the master confirms (2xx) or explicitly no longer knows
+  // the allocation (404): an exit report lost to a master outage would
+  // wedge the allocation in RUNNING forever. If the AGENT dies mid-retry,
+  // the registry entry's exit_code lets the next incarnation resume this
+  // loop.
+  std::string path = "/api/v1/agents/" + opts.id + "/allocations/" +
+                     task->allocation_id + "/state";
+  while (g_running) {
+    try {
+      auto r = master_call(opts.master_url, "POST", path, done.dump(), 10.0);
+      if (r.ok() || r.status == 404) break;
+    } catch (const std::exception&) {
+    }
+    std::this_thread::sleep_for(std::chrono::seconds(2));
+  }
   {
     std::lock_guard<std::mutex> lock(g_mu);
     g_tasks.erase(task->container_id);
@@ -358,9 +427,9 @@ void finish_task(const AgentOptions& opts, std::shared_ptr<Task> task,
 void supervise(const AgentOptions& opts, std::shared_ptr<Task> task) {
   // Start the log tails + the appropriate waiter.
   std::thread(tail_thread, task->workdir + "/stdout.log", task, opts.id,
-              task->rank, "stdout", task->adopted).detach();
+              task->rank, "stdout", &task->off_out).detach();
   std::thread(tail_thread, task->workdir + "/stderr.log", task, opts.id,
-              task->rank, "stderr", task->adopted).detach();
+              task->rank, "stderr", &task->off_err).detach();
   if (!task->adopted) {
     std::thread([task, opts] {
       int status = 0;
@@ -401,7 +470,16 @@ void start_task(const AgentOptions& opts, const Json& action) {
   int err_fd = open((workdir + "/stderr.log").c_str(),
                     O_WRONLY | O_CREAT | O_APPEND, 0644);
   if (out_fd < 0 || err_fd < 0) {
+    if (out_fd >= 0) close(out_fd);
+    if (err_fd >= 0) close(err_fd);
     std::cerr << "open log files failed in " << workdir << std::endl;
+    // The master must not wait forever on an ASSIGNED container that
+    // never launched.
+    Json fail = Json::object();
+    fail["container_id"] = task->container_id;
+    fail["state"] = "EXITED";
+    fail["exit_code"] = static_cast<int64_t>(125);
+    report_state(opts, task->allocation_id, fail);
     return;
   }
 
@@ -409,6 +487,7 @@ void start_task(const AgentOptions& opts, const Json& action) {
   if (pid == 0) {
     // Child: own process group so kill() reaps the whole task tree.
     setpgid(0, 0);
+    unlink(".det_status");  // a stale status must not mask this run's
     dup2(out_fd, STDOUT_FILENO);
     dup2(err_fd, STDERR_FILENO);
     close(out_fd);
@@ -438,6 +517,7 @@ void start_task(const AgentOptions& opts, const Json& action) {
     return;
   }
   task->pid = pid;
+  task->pid_start = pid_starttime(pid);
   std::cerr << "agent: started " << task->container_id << " pid=" << pid
             << " workdir=" << workdir << std::endl;
   {
@@ -473,9 +553,30 @@ bool reattach_tasks(const AgentOptions& opts) {
     task->task_id = e["task_id"].as_string();
     task->workdir = e["workdir"].as_string();
     task->pid = static_cast<pid_t>(e["pid"].as_int(-1));
+    task->pid_start = e["pid_start"].as_int(0);
     task->rank = static_cast<int>(e["rank"].as_int(0));
+    task->off_out = static_cast<long>(e["off_out"].as_int(0));
+    task->off_err = static_cast<long>(e["off_err"].as_int(0));
     task->adopted = true;
-    if (pid_alive(task->pid)) {
+    if (e["exit_code"].is_int()) {
+      // Exited but the previous incarnation never got a confirmed
+      // delivery: resume the report loop (off-thread; the master may
+      // still be booting).
+      int code = static_cast<int>(e["exit_code"].as_int());
+      {
+        std::lock_guard<std::mutex> lock(g_mu);
+        g_tasks[task->container_id] = task;
+      }
+      std::thread([task, opts, code] { finish_task(opts, task, code); })
+          .detach();
+      continue;
+    }
+    // Identity check: same pid AND same /proc starttime — a recycled pid
+    // is some unrelated process, not our task.
+    bool same_proc = pid_alive(task->pid) &&
+                     pid_starttime(task->pid) == task->pid_start &&
+                     task->pid_start != 0;
+    if (same_proc) {
       std::cerr << "agent: reattached " << task->container_id << " pid="
                 << task->pid << std::endl;
       {
@@ -492,7 +593,13 @@ bool reattach_tasks(const AgentOptions& opts) {
     } else {
       std::cerr << "agent: task " << task->container_id
                 << " died while we were down" << std::endl;
-      finish_task(opts, task, read_status_file(task->workdir, 0.5));
+      int code = read_status_file(task->workdir, 0.5);
+      {
+        std::lock_guard<std::mutex> lock(g_mu);
+        g_tasks[task->container_id] = task;
+      }
+      std::thread([task, opts, code] { finish_task(opts, task, code); })
+          .detach();
     }
   }
   persist_registry(opts);
@@ -689,6 +796,7 @@ int main(int argc, char** argv) {
 
   std::thread(shipper_loop, std::cref(opts)).detach();
   std::thread(heartbeat_loop, std::cref(opts)).detach();
+  std::thread(registry_flusher, std::cref(opts)).detach();
 
   // Action long-poll loop.
   std::string actions_path = "/api/v1/agents/" + opts.id +
