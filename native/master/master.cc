@@ -116,6 +116,9 @@ MasterConfig MasterConfig::from_json(const Json& j) {
     c.k8s.bearer_token = k8s["bearer_token"].as_string("");
     c.k8s.service_subdomain =
         k8s["service_subdomain"].as_string(c.k8s.service_subdomain);
+    for (const auto& pool : k8s["pools"].as_array()) {
+      if (pool.is_string()) c.k8s.pools.push_back(pool.as_string());
+    }
   }
   const Json& prov = j["provisioner"];
   if (prov.is_object()) {
@@ -134,7 +137,8 @@ Master::Master(MasterConfig cfg) : cfg_(std::move(cfg)), db_(cfg_.db_path) {
   db_.migrate();
   // Resource-manager backend behind the rm.h seam (reference
   // rm/resource_manager_iface.go): built-in agent RM, or pods on k8s.
-  if (cfg_.resource_manager == "kubernetes") {
+  if (cfg_.resource_manager == "kubernetes" ||
+      cfg_.resource_manager == "multi") {
     RmHooks hooks;
     hooks.build_task_env = [this](Allocation& a, const std::string& node,
                                   const std::vector<int>& slots, int rank,
@@ -148,7 +152,8 @@ Master::Master(MasterConfig cfg) : cfg_(std::move(cfg)), db_(cfg_.db_path) {
       apply_resource_state_locked(aid, node, state, code, addr);
     };
     hooks.notify = [this] { cv_.notify_all(); };
-    rm_ = std::make_unique<KubernetesResourceManager>(cfg_.k8s, hooks);
+    auto k8s_rm =
+        std::make_unique<KubernetesResourceManager>(cfg_.k8s, hooks);
     std::cerr << "master: kubernetes RM against " << cfg_.k8s.api_url
               << " namespace " << cfg_.k8s.namespace_ << std::endl;
     if (cfg_.advertised_url.empty()) {
@@ -156,6 +161,18 @@ Master::Master(MasterConfig cfg) : cfg_(std::move(cfg)), db_(cfg_.db_path) {
                    "get DET_MASTER derived from the bind address, which is "
                    "not reachable from inside a pod; set advertised_url in "
                    "the master config" << std::endl;
+    }
+    if (cfg_.resource_manager == "multi") {
+      // MultiRM (reference rm/multirm): configured pools → k8s, the rest
+      // → the built-in agent backend.
+      std::set<std::string> pools(cfg_.k8s.pools.begin(),
+                                  cfg_.k8s.pools.end());
+      std::cerr << "master: multiRM — " << pools.size()
+                << " pool(s) routed to kubernetes" << std::endl;
+      rm_ = std::make_unique<MultiResourceManager>(
+          make_agent_rm(*this), std::move(k8s_rm), std::move(pools));
+    } else {
+      rm_ = std::move(k8s_rm);
     }
   } else {
     rm_ = make_agent_rm(*this);

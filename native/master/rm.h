@@ -23,6 +23,7 @@
 #include <functional>
 #include <map>
 #include <memory>
+#include <set>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -93,6 +94,27 @@ class ResourceManager {
 // API server's REST interface; reconciliation polls pod phases.
 // ---------------------------------------------------------------------------
 
+// MultiRM (reference rm/multirm/multirm.go): routes by resource pool —
+// configured pools to the kubernetes RM, the rest to the default backend.
+class MultiResourceManager : public ResourceManager {
+ public:
+  MultiResourceManager(std::unique_ptr<ResourceManager> default_rm,
+                       std::unique_ptr<ResourceManager> k8s_rm,
+                       std::set<std::string> k8s_pools);
+  std::string name() const override { return "multi"; }
+  bool allocate(Allocation& alloc) override;
+  void release(Allocation& alloc) override;
+  void kill(Allocation& alloc) override;
+  void tick(double now) override;
+  ScalingSnapshot scaling(const std::string& pool) const override;
+
+ private:
+  ResourceManager& route(const std::string& pool) const;
+  std::unique_ptr<ResourceManager> default_rm_;
+  std::unique_ptr<ResourceManager> k8s_rm_;
+  std::set<std::string> k8s_pools_;
+};
+
 struct KubernetesRmConfig {
   std::string api_url;            // e.g. http://127.0.0.1:8001 (kubectl proxy)
   std::string namespace_ = "default";
@@ -104,6 +126,9 @@ struct KubernetesRmConfig {
   // spec.subdomain so <pod>.<subdomain>.<ns>.svc resolves (the deploy
   // tooling creates the matching clusterIP:None Service).
   std::string service_subdomain = "determined-tpu";
+  // Pools routed to this RM under `resource_manager: multi`
+  // (reference rm/multirm).
+  std::vector<std::string> pools;
 };
 
 class KubernetesResourceManager : public ResourceManager {

@@ -1,0 +1,50 @@
+// rm_multi.cc — MultiRM: route allocations to backends by resource pool.
+//
+// Reference: master/internal/rm/multirm/multirm.go — a thin router
+// implementing the ResourceManager interface over named sub-RMs. Here:
+// configured pool names map to the kubernetes RM; everything else goes to
+// the built-in agent RM. Selected with `resource_manager: multi` plus
+// `kubernetes.pools: ["gke", ...]` in the master config.
+
+#include <iostream>
+
+#include "master.h"
+#include "rm.h"
+
+namespace det {
+
+MultiResourceManager::MultiResourceManager(
+    std::unique_ptr<ResourceManager> default_rm,
+    std::unique_ptr<ResourceManager> k8s_rm,
+    std::set<std::string> k8s_pools)
+    : default_rm_(std::move(default_rm)),
+      k8s_rm_(std::move(k8s_rm)),
+      k8s_pools_(std::move(k8s_pools)) {}
+
+ResourceManager& MultiResourceManager::route(const std::string& pool) const {
+  if (k8s_rm_ && k8s_pools_.count(pool)) return *k8s_rm_;
+  return *default_rm_;
+}
+
+bool MultiResourceManager::allocate(Allocation& alloc) {
+  return route(alloc.resource_pool).allocate(alloc);
+}
+
+void MultiResourceManager::release(Allocation& alloc) {
+  route(alloc.resource_pool).release(alloc);
+}
+
+void MultiResourceManager::kill(Allocation& alloc) {
+  route(alloc.resource_pool).kill(alloc);
+}
+
+void MultiResourceManager::tick(double now) {
+  default_rm_->tick(now);
+  if (k8s_rm_) k8s_rm_->tick(now);
+}
+
+ScalingSnapshot MultiResourceManager::scaling(const std::string& pool) const {
+  return route(pool).scaling(pool);
+}
+
+}  // namespace det

@@ -195,6 +195,57 @@ def test_kill_deletes_pods(k8s_cluster):
     _wait(lambda: set(names) <= set(fake.deletes), what="pods deleted")
 
 
+def test_multirm_routes_pools_to_backends(tmp_path, native_binaries):
+    """resource_manager: multi (reference rm/multirm): the 'gke' pool goes
+    to the kubernetes RM (fake API observes the pod), the default pool to
+    the agent RM (a real agent runs the task to completion)."""
+    import os
+
+    fake = FakeK8s()
+    cfg = {
+        "resource_manager": "multi",
+        "kubernetes": {
+            "api_url": fake.url, "namespace": "det-test",
+            "image": "x", "slots_per_pod": 2, "max_pods": 2,
+            "pools": ["gke"],
+        },
+    }
+    cfg_path = tmp_path / "master.json"
+    cfg_path.write_text(json.dumps(cfg))
+    c = Devcluster(str(tmp_path), native_binaries)
+    c.master = subprocess.Popen(
+        [os.path.join(c.binaries, "determined-master"),
+         "--config", str(cfg_path),
+         "--port", str(c.port), "--host", "127.0.0.1", "--db", c.db_path],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        _wait_http(c.master_url + "/api/v1/master")
+        c.start_agent()  # registers into the default pool
+        token = c.login()
+
+        # k8s-pool task → a pod appears on the fake API server.
+        c.api("POST", "/api/v1/commands",
+              {"config": {"entrypoint": "sleep 999",
+                          "resources": {"slots": 2,
+                                        "resource_pool": "gke"}}},
+              token=token)
+        _wait(lambda: fake.pod_names() or None, what="k8s pod created")
+
+        # default-pool task → runs on the agent to completion.
+        tid = c.api("POST", "/api/v1/commands",
+                    {"config": {"entrypoint": "echo agent-pool-ran"}},
+                    token=token)["id"]
+        _wait(lambda: c.api("GET", f"/api/v1/commands/{tid}", token=token)
+              ["task"]["state"] == "COMPLETED", what="agent task COMPLETED")
+        logs = c.api("GET", f"/api/v1/tasks/{tid}/logs?offset=0",
+                     token=token)["logs"]
+        assert any("agent-pool-ran" in line["log"] for line in logs)
+        assert len(fake.pod_names()) == 1  # agent task never touched k8s
+    finally:
+        c.stop()
+        fake.stop()
+
+
 def test_provisioner_fires_on_sustained_demand(k8s_cluster):
     cluster, fake = k8s_cluster
     token = cluster.login()
