@@ -388,9 +388,39 @@ void Master::schedule_locked() {
       if (rx != ry) return rx < ry;
       return ax.submitted_at < ay.submitted_at;
     }
+    if (policy == "round_robin") {
+      // Keep submit order here; the per-pool rotation below interleaves.
+      return ax.submitted_at < ay.submitted_at;
+    }
     if (ax.priority != ay.priority) return ax.priority < ay.priority;
     return ax.submitted_at < ay.submitted_at;
   });
+
+  // round_robin pools (reference rm/agentrm/round_robin.go): experiments
+  // take turns, one allocation per experiment per round, with the
+  // starting experiment rotated each scheduling pass. The sort above
+  // partitioned the queue by pool, so each pool is a contiguous slice.
+  for (size_t i = 0; i < queue.size();) {
+    const std::string pool = allocations_.at(queue[i]).resource_pool;
+    size_t j = i;
+    while (j < queue.size() &&
+           allocations_.at(queue[j]).resource_pool == pool) {
+      ++j;
+    }
+    if (pool_policy(pool) == "round_robin" && j - i > 1) {
+      std::vector<long long> group_keys;
+      for (size_t k = i; k < j; ++k) {
+        group_keys.push_back(allocations_.at(queue[k]).experiment_id);
+      }
+      std::vector<size_t> order =
+          round_robin_order(group_keys, pool_rr_cursor_[pool]++);
+      std::vector<std::string> slice;
+      slice.reserve(j - i);
+      for (size_t idx : order) slice.push_back(queue[i + idx]);
+      std::copy(slice.begin(), slice.end(), queue.begin() + i);
+    }
+    i = j;
+  }
 
   std::vector<std::string> still_pending;
   for (const auto& aid : queue) {

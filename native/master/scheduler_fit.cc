@@ -93,4 +93,30 @@ std::vector<std::pair<size_t, std::vector<int>>> find_fit(
   return {};
 }
 
+std::vector<size_t> round_robin_order(const std::vector<long long>& groups,
+                                      int cursor) {
+  // Group indices by key, preserving first-appearance group order and
+  // submit order within each group.
+  std::vector<long long> order;  // distinct keys, first-appearance order
+  std::map<long long, std::vector<size_t>> by_group;
+  for (size_t i = 0; i < groups.size(); ++i) {
+    if (!by_group.count(groups[i])) order.push_back(groups[i]);
+    by_group[groups[i]].push_back(i);
+  }
+  std::vector<size_t> out;
+  out.reserve(groups.size());
+  if (order.empty()) return out;
+  size_t n = order.size();
+  size_t start = static_cast<size_t>(((cursor % static_cast<int>(n)) +
+                                      static_cast<int>(n)) %
+                                     static_cast<int>(n));
+  for (size_t round = 0; out.size() < groups.size(); ++round) {
+    for (size_t g = 0; g < n; ++g) {
+      auto& items = by_group[order[(start + g) % n]];
+      if (round < items.size()) out.push_back(items[round]);
+    }
+  }
+  return out;
+}
+
 }  // namespace det

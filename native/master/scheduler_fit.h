@@ -27,4 +27,12 @@ struct HostFreeView {
 std::vector<std::pair<size_t, std::vector<int>>> find_fit(
     int need, std::vector<HostFreeView> views);
 
+// Round-robin queue order (reference rm/agentrm/round_robin.go): given the
+// pending items' group keys (experiment/job ids) in submit order, return
+// the item indices reordered so groups take turns — one item per group per
+// round — with the STARTING group rotated by `cursor` so successive ticks
+// don't always favor the first submitter. Pure; unit-tested standalone.
+std::vector<size_t> round_robin_order(const std::vector<long long>& groups,
+                                      int cursor);
+
 }  // namespace det

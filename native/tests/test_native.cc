@@ -315,6 +315,26 @@ static void test_fit_zero_slot_aux() {
   CHECK(picks[0].second.empty());
 }
 
+static void test_round_robin_order() {
+  // Groups take turns, one per round; within a group, submit order holds
+  // (reference rm/agentrm/round_robin.go).
+  using V = std::vector<size_t>;
+  // items: A A A B B C (indices 0..5), cursor 0 → A B C A B A
+  CHECK(det::round_robin_order({7, 7, 7, 8, 8, 9}, 0) ==
+        (V{0, 3, 5, 1, 4, 2}));
+  // cursor 1 rotates the starting group: B C A B A A
+  CHECK(det::round_robin_order({7, 7, 7, 8, 8, 9}, 1) ==
+        (V{3, 5, 0, 4, 1, 2}));
+  // cursor wraps (and negative cursors behave)
+  CHECK(det::round_robin_order({7, 8}, 2) == (V{0, 1}));
+  CHECK(det::round_robin_order({7, 8}, -1) == (V{1, 0}));
+  // single group / empty input
+  CHECK(det::round_robin_order({5, 5, 5}, 3) == (V{0, 1, 2}));
+  CHECK(det::round_robin_order({}, 0).empty());
+  // interleaved submit order: A B A B keeps per-group order
+  CHECK(det::round_robin_order({1, 2, 1, 2}, 0) == (V{0, 1, 2, 3}));
+}
+
 // -------------------------------------------------------------- driver
 
 int main() {
@@ -339,6 +359,7 @@ int main() {
       {"fit_multihost_heterogeneous", test_fit_multihost_heterogeneous_groups},
       {"fit_no_fit", test_fit_no_fit},
       {"fit_zero_slot_aux", test_fit_zero_slot_aux},
+      {"round_robin_order", test_round_robin_order},
   };
   for (auto& t : tests) {
     int before = g_failures;
