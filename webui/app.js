@@ -511,6 +511,22 @@ async function pageExperiment(id) {
     }
   }
 
+  // Checkpoints (registry view; GC'd ones show as DELETED)
+  const { checkpoints } = await api(
+    "GET", `/api/v1/experiments/${id}/checkpoints`);
+  if (checkpoints.length) {
+    view.append(el("h2", {}, "Checkpoints"));
+    view.append(el("table", {},
+      el("tr", {}, ["UUID", "Trial", "Steps", "State", "Reported"]
+        .map((h) => el("th", {}, h))),
+      checkpoints.map((c) => el("tr", {},
+        el("td", { class: "muted" }, c.uuid),
+        el("td", {}, c.trial_id ?? ""),
+        el("td", {}, c.steps_completed ?? 0),
+        el("td", {}, stateBadge(c.state)),
+        el("td", { class: "muted" }, c.report_time ?? "")))));
+  }
+
   view.append(el("h2", {}, "Config"));
   view.append(el("pre", { class: "config" },
     JSON.stringify(experiment.config, null, 2)));
