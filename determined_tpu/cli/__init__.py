@@ -246,6 +246,23 @@ def cmd_checkpoint_describe(session: Session, args) -> int:
     return 0
 
 
+def cmd_task_list(session: Session, args) -> int:
+    params = {"type": args.type} if args.type else None
+    tasks = session.get("/api/v1/tasks", params=params)["tasks"]
+    rows = [
+        {
+            "id": t["id"],
+            "type": t["type"],
+            "state": t.get("allocation_state", t["state"]),
+            "started": t.get("start_time", ""),
+            "ended": t.get("end_time") or "",
+        }
+        for t in tasks
+    ]
+    _print_table(rows, ["id", "type", "state", "started", "ended"])
+    return 0
+
+
 def cmd_task_logs(session: Session, args) -> int:
     ns = argparse.Namespace(id=None, follow=args.follow)
     offset = 0
@@ -710,6 +727,10 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("task_id")
     t.add_argument("-f", "--follow", action="store_true")
     t.set_defaults(func=cmd_task_logs)
+    tl = tk.add_parser("list")
+    tl.add_argument("--type", default=None,
+                    help="TRIAL|COMMAND|NOTEBOOK|SHELL|TENSORBOARD|GENERIC|GC")
+    tl.set_defaults(func=cmd_task_list)
 
     for cli_name, kind in (("cmd", "commands"), ("notebook", "notebooks"),
                            ("shell", "shells"), ("tensorboard", "tensorboards")):

@@ -1095,6 +1095,38 @@ HttpResponse Master::handle_task_logs(const HttpRequest& req) {
 
 HttpResponse Master::handle_tasks(const HttpRequest& req,
                                   const std::vector<std::string>& parts) {
+  // GET /api/v1/tasks[?type=] — all task rows (trials, NTSC, generic, GC)
+  // with live allocation state overlay (reference GetTasks).
+  if (parts.size() == 1 && req.method == "GET") {
+    std::string sql =
+        "SELECT id, type, state, owner_id, workspace_id, parent_id, "
+        "start_time, end_time FROM tasks";
+    std::vector<Json> params;
+    const std::string type = req.query_param("type");
+    if (!type.empty()) {
+      sql += " WHERE type=?";
+      params.push_back(Json(type));
+    }
+    sql += " ORDER BY start_time DESC LIMIT 500";
+    auto rows = db_.query(sql, params);
+    Json tasks = Json::array();
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      for (auto& row : rows) {
+        Json t = row_to_json(row);
+        for (const auto& [aid, a] : allocations_) {
+          if (a.task_id == row["id"].as_string()) {
+            t["allocation_state"] = a.state;
+          }
+        }
+        tasks.push_back(std::move(t));
+      }
+    }
+    Json out = Json::object();
+    out["tasks"] = tasks;
+    return json_resp(200, out);
+  }
+
   if (parts.size() < 2) return json_resp(404, err_body("not found"));
   const std::string& task_id = parts[1];
 

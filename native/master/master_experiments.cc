@@ -696,8 +696,9 @@ void Master::restore_experiments() {
 
 void Master::fire_webhooks_locked(const ExperimentState& exp) {
   // Reference internal/webhooks/shipper.go: POST event JSON to registered
-  // URLs on experiment state change. Fire-and-forget from a detached
-  // thread; failures are logged to stderr only.
+  // URLs on experiment state change, filtered by each webhook's triggers
+  // (e.g. ["COMPLETED", "ERROR"]; empty = all states). Fire-and-forget
+  // from a detached thread; failures are logged to stderr only.
   auto hooks = db_.query("SELECT url, triggers FROM webhooks");
   if (hooks.empty()) return;
   Json event = Json::object();
@@ -706,6 +707,17 @@ void Master::fire_webhooks_locked(const ExperimentState& exp) {
   event["state"] = exp.state;
   std::string payload = event.dump();
   for (auto& h : hooks) {
+    const Json triggers = Json::parse_or_null(h["triggers"].as_string());
+    if (triggers.is_array() && !triggers.as_array().empty()) {
+      bool matched = false;
+      for (const auto& t : triggers.as_array()) {
+        // Accept both "COMPLETED" and the reference's
+        // {trigger_type, condition: {state}} object shape.
+        matched |= t.as_string() == exp.state ||
+                   t["condition"]["state"].as_string() == exp.state;
+      }
+      if (!matched) continue;
+    }
     std::string url = h["url"].as_string();
     std::thread([url, payload] {
       try {

@@ -111,6 +111,8 @@ ROUTES = [
     ("get", "/api/v1/checkpoints/{uuid}", "checkpoints", "Get checkpoint"),
     ("post", "/api/v1/task/logs", "logs",
      "Batched task-log shipping (agent / task owner)"),
+    ("get", "/api/v1/tasks", "tasks",
+     "List all tasks (trials/NTSC/generic/GC), optional ?type="),
     ("get", "/api/v1/tasks/{id}", "tasks", "Get task"),
     ("get", "/api/v1/tasks/{id}/context", "tasks",
      "Model-def tarball for the task"),
