@@ -31,9 +31,12 @@ class GPT2Trial(JaxTrial):
             "medium": gpt2.Config.medium,
             "large": gpt2.Config.large,
         }[size]()
+        seq_len = int(context.hparams.get("seq_len", 1024))
         self.cfg = gpt2.Config(
             vocab_size=base.vocab_size,
-            n_positions=base.n_positions,
+            # Long-context runs (long_context.yaml) train past the preset's
+            # position-table size: widen wpe to the configured sequence.
+            n_positions=max(base.n_positions, seq_len),
             d_model=base.d_model,
             n_layer=base.n_layer,
             n_head=base.n_head,
@@ -45,7 +48,7 @@ class GPT2Trial(JaxTrial):
             num_experts=int(context.hparams.get("num_experts", 1)),
             moe_top_k=int(context.hparams.get("moe_top_k", 2)),
         )
-        self.seq_len = int(context.hparams.get("seq_len", 1024))
+        self.seq_len = seq_len
         path = context.hparams.get("tokens_path") or os.environ.get("GPT2_TOKENS")
         self.tokens = None
         if path and os.path.exists(path):

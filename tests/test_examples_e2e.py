@@ -205,21 +205,21 @@ def test_gpt2_long_context_example(cluster, tmp_path):
     """long_context.yaml runs sequence parallelism inside the spawned
     trial: mesh.context=2 shards the sequence, ulysses all-to-all head
     sharding computes attention (ring needs the pallas kernel's TPU
-    shapes; ulysses exercises the same context axis on the CPU mesh)."""
-    import yaml
+    shapes; ulysses exercises the same context axis on the CPU mesh).
+    seq_len 256 deliberately EXCEEDS tiny's n_positions=128 so the
+    config's defining behavior — widening the position table for long
+    context — is what the test exercises."""
+    def shrink(cfg):
+        cfg["searcher"]["max_length"] = {"batches": 2}
+        cfg["hyperparameters"].update(
+            model_size="tiny", seq_len=256, global_batch_size=4,
+            attention_impl="ulysses", scan_unroll=1, remat=False,
+            mesh={"context": 2, "data": -1})
+        cfg["resources"]["slots_per_trial"] = 2
 
-    with open(os.path.join(EXAMPLES, "gpt2", "long_context.yaml")) as f:
-        cfg = yaml.safe_load(f)
-    cfg["checkpoint_storage"]["host_path"] = os.path.join(str(tmp_path), "ckpts")
-    cfg["searcher"]["max_length"] = {"batches": 2}
-    cfg["hyperparameters"].update(
-        model_size="tiny", seq_len=32, global_batch_size=8,
-        attention_impl="ulysses", scan_unroll=1, remat=False,
-        mesh={"context": 2, "data": -1})
-    cfg["resources"]["slots_per_trial"] = 2
-    out = os.path.join(str(tmp_path), "long_context.yaml")
-    with open(out, "w") as f:
-        yaml.safe_dump(cfg, f)
+    out = _patch_storage(
+        tmp_path, os.path.join(EXAMPLES, "gpt2", "long_context.yaml"),
+        shrink)
     r = _cli(cluster, "experiment", "create", out,
              os.path.join(EXAMPLES, "gpt2"), "--follow", timeout=600)
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
