@@ -291,10 +291,18 @@ def cmd_ntsc(session: Session, args) -> int:
     kind = args.kind  # commands | notebooks | shells | tensorboards
     if args.action == "list":
         tasks = session.get(f"/api/v1/{kind}")[kind]
+
+        def show_state(t):
+            # A finished task's outcome (COMPLETED/ERROR/CANCELED) beats
+            # the allocation's generic TERMINATED.
+            if t["state"] in ("COMPLETED", "ERROR", "CANCELED"):
+                return t["state"]
+            return t.get("allocation_state", t["state"])
+
         rows = [
             {
                 "id": t["id"],
-                "state": t.get("allocation_state", t["state"]),
+                "state": show_state(t),
                 "started": t.get("start_time", ""),
                 "address": t.get("proxy_address", ""),
             }
