@@ -153,3 +153,24 @@ class TestLegacyShims:
         })
         assert out["searcher"]["max_length"] == {"batches": 16}
         assert out["searcher"]["divisor"] == 4
+
+
+def test_all_shipped_example_configs_validate():
+    """Every yaml under examples/ must pass expconf.check — shipped
+    configs rotting against schema changes is exactly what the reference's
+    schema CI prevents."""
+    import glob
+    import os
+
+    import yaml
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    configs = sorted(glob.glob(os.path.join(repo, "examples", "*", "*.yaml")))
+    assert len(configs) >= 8, configs
+    for path in configs:
+        with open(path) as f:
+            cfg = yaml.safe_load(f)
+        try:
+            expconf.check(cfg)
+        except ValueError as e:
+            raise AssertionError(f"{path} fails validation: {e}") from None
