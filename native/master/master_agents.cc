@@ -276,6 +276,11 @@ int64_t Master::sweep_task_logs(int days) {
   // giant DELETE would stall log shipping/metrics for its whole duration.
   const std::string cutoff = "-" + std::to_string(days) + " days";
   int64_t total = 0;
+  // Expired sessions ride the same sweep (task containers mint one
+  // 7-day token per launch; without cleanup the table grows forever).
+  db_.exec(
+      "DELETE FROM user_sessions WHERE expires_at IS NOT NULL AND "
+      "expires_at < datetime('now')");
   while (true) {
     int64_t n = db_.exec(
         "DELETE FROM task_logs WHERE id IN (SELECT id FROM task_logs "
