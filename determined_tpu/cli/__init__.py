@@ -246,6 +246,14 @@ def cmd_checkpoint_describe(session: Session, args) -> int:
     return 0
 
 
+def _show_task_state(t: dict) -> str:
+    # A finished task's outcome (COMPLETED/ERROR/CANCELED) beats the
+    # allocation's generic TERMINATED overlay.
+    if t["state"] in ("COMPLETED", "ERROR", "CANCELED"):
+        return t["state"]
+    return t.get("allocation_state", t["state"])
+
+
 def cmd_task_list(session: Session, args) -> int:
     params = {"type": args.type} if args.type else None
     tasks = session.get("/api/v1/tasks", params=params)["tasks"]
@@ -253,7 +261,7 @@ def cmd_task_list(session: Session, args) -> int:
         {
             "id": t["id"],
             "type": t["type"],
-            "state": t.get("allocation_state", t["state"]),
+            "state": _show_task_state(t),
             "started": t.get("start_time", ""),
             "ended": t.get("end_time") or "",
         }
@@ -291,18 +299,10 @@ def cmd_ntsc(session: Session, args) -> int:
     kind = args.kind  # commands | notebooks | shells | tensorboards
     if args.action == "list":
         tasks = session.get(f"/api/v1/{kind}")[kind]
-
-        def show_state(t):
-            # A finished task's outcome (COMPLETED/ERROR/CANCELED) beats
-            # the allocation's generic TERMINATED.
-            if t["state"] in ("COMPLETED", "ERROR", "CANCELED"):
-                return t["state"]
-            return t.get("allocation_state", t["state"])
-
         rows = [
             {
                 "id": t["id"],
-                "state": show_state(t),
+                "state": _show_task_state(t),
                 "started": t.get("start_time", ""),
                 "address": t.get("proxy_address", ""),
             }

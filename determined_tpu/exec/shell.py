@@ -15,7 +15,9 @@ one-shot `det shell run <id> <cmd>` a clean round-trip. Interactive use
 
 from __future__ import annotations
 
+import hmac
 import logging
+import os
 import socket
 import subprocess
 import sys
@@ -25,9 +27,45 @@ from determined_tpu.exec._util import free_port, report_proxy_address
 
 logger = logging.getLogger("determined_tpu.exec.shell")
 
+# Connections must lead with this secret (master_agents.cc injects it and
+# the master's det-tcp tunnel prepends it after its can_edit check); the
+# server binds 0.0.0.0 so the task's peers can be on other hosts, and
+# without the handshake anyone with network reach could run commands as
+# the task owner.
+_SECRET = os.environ.get("DET_PROXY_SECRET", "")
+
+
+def _read_handshake(conn: socket.socket, max_len: int = 256) -> tuple[bool, bytes]:
+    """Read up to the first newline; return (ok, residual-after-newline)."""
+    buf = b""
+    while b"\n" not in buf:
+        if len(buf) > max_len:
+            return False, b""
+        data = conn.recv(4096)
+        if not data:
+            return False, b""
+        buf += data
+    line, _, residual = buf.partition(b"\n")
+    ok = hmac.compare_digest(line.strip(), _SECRET.encode())
+    return ok, residual
+
 
 def _serve_client(conn: socket.socket) -> None:
     with conn:
+        if _SECRET:
+            # Pre-auth deadline: an unauthenticated client that connects
+            # and sends nothing must not pin a thread + fd forever.
+            conn.settimeout(15)
+            try:
+                ok, residual = _read_handshake(conn)
+            except OSError:
+                return
+            if not ok:
+                logger.warning("refusing connection: bad proxy secret")
+                return
+            conn.settimeout(None)
+        else:
+            residual = b""
         proc = subprocess.Popen(
             ["/bin/sh", "-s"],
             stdin=subprocess.PIPE,
@@ -37,6 +75,9 @@ def _serve_client(conn: socket.socket) -> None:
 
         def feed_stdin() -> None:
             try:
+                if residual:
+                    proc.stdin.write(residual)
+                    proc.stdin.flush()
                 while True:
                     data = conn.recv(65536)
                     if not data:
@@ -70,6 +111,12 @@ def main() -> int:
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     srv.bind(("0.0.0.0", port))
     srv.listen(16)
+    if not _SECRET:
+        # Should only happen under a pre-handshake master; the downgrade
+        # to unauthenticated remote command execution must be loud.
+        logger.warning(
+            "DET_PROXY_SECRET not set: serving UNAUTHENTICATED shell on "
+            "0.0.0.0 — anyone with network reach can run commands")
     addr = f"tcp://{socket.gethostname()}:{port}"
     report_proxy_address(addr)
     logger.info("shell server at %s", addr)

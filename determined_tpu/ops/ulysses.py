@@ -59,10 +59,21 @@ def ulysses_attention(
     if cp <= 1:
         return _inner_attention(q, k, v, causal)
 
+    # Inside the shard_map below the head dim is already sharded over
+    # `head_axis`, so the all_to_all (split_axis=2) splits the LOCAL head
+    # count — that, not the global count, must divide the context size.
     n_head = q.shape[2]
-    if n_head % cp != 0:
+    tp = mesh.shape.get(head_axis, 1) or 1
+    if n_head % tp != 0:
         raise ValueError(
             f"ulysses attention needs n_head ({n_head}) divisible by the "
+            f"{head_axis} axis size ({tp})"
+        )
+    local_heads = n_head // tp
+    if local_heads % cp != 0:
+        raise ValueError(
+            f"ulysses attention needs per-shard head count {local_heads} "
+            f"(n_head {n_head} / {head_axis} size {tp}) divisible by the "
             f"{seq_axis} axis size ({cp})"
         )
 

@@ -487,12 +487,15 @@ void start_task(const AgentOptions& opts, const Json& action) {
   if (pid == 0) {
     // Child: own process group so kill() reaps the whole task tree.
     setpgid(0, 0);
-    unlink(".det_status");  // a stale status must not mask this run's
     dup2(out_fd, STDOUT_FILENO);
     dup2(err_fd, STDERR_FILENO);
     close(out_fd);
     close(err_fd);
     if (chdir(workdir.c_str()) != 0) _exit(125);
+    // After chdir: a stale status in the task workdir must not mask this
+    // run's exit (a SIGKILLed run writes none, and read_status_file would
+    // otherwise return the previous run's code instead of 137).
+    unlink(".det_status");
     for (const auto& [k, v] : env.as_object()) {
       std::string val = v.is_string() ? v.as_string() : v.dump();
       setenv(k.c_str(), val.c_str(), 1);

@@ -258,13 +258,21 @@ void Master::scheduler_loop() {
     // Hourly task-log retention sweep (reference internal/logretention/).
     // Runs with mu_ RELEASED — a big DELETE must not stall the scheduler
     // or API handlers (the db has its own lock).
-    if (cfg_.log_retention_days > 0 && now() - last_log_sweep > 3600) {
+    if (now() - last_log_sweep > 3600) {
       last_log_sweep = now();
       lock.unlock();
-      int64_t n = sweep_task_logs(cfg_.log_retention_days);
-      if (n > 0) {
-        std::cerr << "master: log retention deleted " << n << " rows"
-                  << std::endl;
+      // Expired-session purge runs unconditionally: task containers mint
+      // one 7-day token per launch, so the table grows forever without
+      // it — log retention (default 0 = keep forever) must not gate it.
+      db_.exec(
+          "DELETE FROM user_sessions WHERE expires_at IS NOT NULL AND "
+          "expires_at < datetime('now')");
+      if (cfg_.log_retention_days > 0) {
+        int64_t n = sweep_task_logs(cfg_.log_retention_days);
+        if (n > 0) {
+          std::cerr << "master: log retention deleted " << n << " rows"
+                    << std::endl;
+        }
       }
       lock.lock();
     }
@@ -276,11 +284,6 @@ int64_t Master::sweep_task_logs(int days) {
   // giant DELETE would stall log shipping/metrics for its whole duration.
   const std::string cutoff = "-" + std::to_string(days) + " days";
   int64_t total = 0;
-  // Expired sessions ride the same sweep (task containers mint one
-  // 7-day token per launch; without cleanup the table grows forever).
-  db_.exec(
-      "DELETE FROM user_sessions WHERE expires_at IS NOT NULL AND "
-      "expires_at < datetime('now')");
   while (true) {
     int64_t n = db_.exec(
         "DELETE FROM task_logs WHERE id IN (SELECT id FROM task_logs "
@@ -642,6 +645,12 @@ Json Master::build_task_env_locked(Allocation& alloc,
   env["DET_TASK_ID"] = alloc.task_id;
   env["DET_TASK_TYPE"] = trial != nullptr ? "TRIAL" : "GENERIC";
   env["DET_ALLOCATION_ID"] = alloc.id;
+  // Secret handshake for tunneled TCP services (exec/shell.py): tasks
+  // refuse connections that don't lead with this line, closing the
+  // bind-0.0.0.0 impersonation hole (the master's det-tcp proxy
+  // prepends it after its own can_edit check).
+  if (alloc.proxy_secret.empty()) alloc.proxy_secret = random_hex(16);
+  env["DET_PROXY_SECRET"] = alloc.proxy_secret;
   env["DET_NODE_RANK"] = static_cast<int64_t>(rank);
   env["DET_NUM_NODES"] = static_cast<int64_t>(num_nodes);
   env["DET_CHIEF_IP"] = chief_addr;

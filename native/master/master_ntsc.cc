@@ -307,9 +307,10 @@ HttpResponse Master::handle_proxy(const HttpRequest& req,
   // commands in the owner's environment), so it requires edit rights on
   // the task — owner, admin, or a workspace editor.
   const std::string& task_id = parts[1];
+  std::string task_type;
   {
     auto trows = db_.query(
-        "SELECT owner_id, workspace_id FROM tasks WHERE id=?",
+        "SELECT owner_id, workspace_id, type FROM tasks WHERE id=?",
         {Json(task_id)});
     if (trows.empty()) {
       return json_resp(404, err_body("no such task"));
@@ -321,14 +322,17 @@ HttpResponse Master::handle_proxy(const HttpRequest& req,
                   trows[0]["workspace_id"].as_int(1))) {
       return json_resp(403, err_body("not authorized for this task"));
     }
+    task_type = trows[0]["type"].as_string();
   }
   std::string target;
+  std::string proxy_secret;
   {
     std::lock_guard<std::mutex> lock(mu_);
     for (auto& [aid, a] : allocations_) {
       if (a.task_id == task_id && !a.proxy_addresses.empty() &&
           a.state != "TERMINATED") {
         target = a.proxy_addresses.begin()->second;
+        proxy_secret = a.proxy_secret;
         a.last_activity = now();  // proxy traffic keeps the task non-idle
       }
     }
@@ -336,6 +340,7 @@ HttpResponse Master::handle_proxy(const HttpRequest& req,
   if (target.empty()) {
     return json_resp(502, err_body("task has no proxy address (yet)"));
   }
+  if (task_type != "SHELL") proxy_secret.clear();
   std::string t_host, base_path;
   int t_port = 0;
   if (!parse_target(target, &t_host, &t_port, &base_path)) {
@@ -376,8 +381,8 @@ HttpResponse Master::handle_proxy(const HttpRequest& req,
     // Raw TCP tunnel (reference proxy/tcp.go): the master completes the
     // pseudo-upgrade itself, then pumps bytes to the task's port.
     HttpResponse r;
-    r.hijack = [this, t_host, t_port, task_id](int fd,
-                                               std::string&& residual) {
+    r.hijack = [this, t_host, t_port, task_id, proxy_secret](
+                   int fd, std::string&& residual) {
       int target_fd = -1;
       try {
         target_fd = tcp_connect(t_host, t_port, 10.0);
@@ -391,6 +396,15 @@ HttpResponse Master::handle_proxy(const HttpRequest& req,
           "HTTP/1.1 101 Switching Protocols\r\n"
           "Upgrade: det-tcp\r\nConnection: Upgrade\r\n\r\n";
       send(fd, ok, sizeof(ok) - 1, MSG_NOSIGNAL);
+      // Authenticating handshake: the task-side TCP server only serves
+      // connections that lead with the allocation's secret, so reaching
+      // it requires coming through this (authz-gated) tunnel. Only the
+      // built-in shell task speaks the handshake — a user task serving
+      // its own TCP protocol must not get the secret injected as garbage.
+      if (!proxy_secret.empty()) {
+        std::string hello = proxy_secret + "\n";
+        send(target_fd, hello.data(), hello.size(), MSG_NOSIGNAL);
+      }
       if (!residual.empty()) {
         send(target_fd, residual.data(), residual.size(), MSG_NOSIGNAL);
       }

@@ -170,6 +170,19 @@ class TestUlysses:
             with pytest.raises(ValueError, match="divisible"):
                 jax.jit(lambda a: ulysses_attention(a, a, a))(q)
 
+    def test_local_head_divisibility_with_tensor_axis(self, devices):
+        """With tensor>1 the heads are already sharded, so the all_to_all
+        splits the LOCAL head count: n_head=4 over tensor=2 leaves 2 local
+        heads, which context=4 cannot split — must raise clearly, not die
+        inside XLA (ADVICE r4)."""
+        from determined_tpu.ops.ulysses import ulysses_attention
+
+        mesh = create_mesh(MeshConfig(tensor=2, context=4).resolve(8), devices)
+        q = jnp.zeros((2, 32, 4, 8), jnp.float32)  # global 4 % cp 4 == 0!
+        with jax.sharding.set_mesh(mesh):
+            with pytest.raises(ValueError, match="per-shard head count"):
+                jax.jit(lambda a: ulysses_attention(a, a, a))(q)
+
 
 class TestExpertAxisGuard:
     def test_dense_trial_rejects_expert_axis(self, devices):

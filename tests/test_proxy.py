@@ -281,4 +281,29 @@ def test_shell_round_trip(cluster, tmp_path):
         capture_output=True, text=True, timeout=120, env=env)
     assert r.returncode == 0, r.stderr
     assert "tunnel-says-23" in r.stdout, (r.stdout, r.stderr)
+
+    # Direct connection WITHOUT the per-task secret (ADVICE r4 high): the
+    # shell binds 0.0.0.0, so anyone with network reach could otherwise run
+    # commands as the owner. A connection that doesn't lead with
+    # DET_PROXY_SECRET must be dropped with no shell spawned.
+    import socket as socketmod
+
+    addr = _wait_proxy_addr(cluster, token, "shells", tid)
+    hostport = addr.split("://", 1)[1]
+    host, port = hostport.rsplit(":", 1)
+    s = socketmod.create_connection((host, int(port)), timeout=10)
+    s.sendall(b"wrong-secret\necho direct-pwned-$((40+2))\n")
+    s.shutdown(socketmod.SHUT_WR)
+    got = b""
+    s.settimeout(10)
+    try:
+        while True:
+            d = s.recv(4096)
+            if not d:
+                break
+            got += d
+    except OSError:
+        pass
+    s.close()
+    assert b"direct-pwned-42" not in got, got
     cluster.api("POST", f"/api/v1/shells/{tid}/kill", token=token)
