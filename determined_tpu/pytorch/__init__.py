@@ -27,3 +27,4 @@ from determined_tpu.pytorch.deepspeed import (  # noqa: F401
     DeepSpeedTrainer,
     ModelParallelUnit,
 )
+from determined_tpu.pytorch.zero import ZeroOneEngine  # noqa: F401

@@ -1,0 +1,150 @@
+"""GPT-NeoX pretraining through the DeepSpeedTrial API with ZeRO-1.
+
+The BASELINE workload "examples/deepspeed GPT-NeoX (DeepSpeedTrial ZeRO-1 →
+XLA all-gather/reduce-scatter)" (reference
+examples/deepspeed/gpt_neox/zero1.yaml + gpt2_trial.py): users arriving
+with DeepSpeedTrial subclasses keep the same trial shape — train_batch
+receives the DATA ITERATOR and drives the engine's microbatch loop — while
+the engine is the platform's TPU-native ZeroOneEngine
+(determined_tpu/pytorch/zero.py): optimizer state partitioned across the
+data-parallel group, gradients averaged with flat-bucket collectives that
+lower to XLA ICI collectives on torch-xla task images.
+
+The model is the GPT-NeoX architecture (rotary embeddings, parallel
+attention+FFN residual) via transformers.GPTNeoXForCausalLM — the HF
+implementation of the same network the reference example trains from the
+EleutherAI gpt-neox repo.
+"""
+
+import numpy as np
+import torch
+
+from determined_tpu.pytorch import (
+    DataLoader,
+    DeepSpeedTrainer,
+    DeepSpeedTrial,
+    DeepSpeedTrialContext,
+    ZeroOneEngine,
+)
+
+
+class SyntheticTokens(torch.utils.data.Dataset):
+    """Deterministic synthetic token stream (air-gapped image); point
+    hyperparameters.tokens_path at an int32 memmap for real data."""
+
+    def __init__(self, vocab, seq_len, n=4096, path=None, seed=0):
+        self.seq_len = seq_len
+        if path:
+            self.tokens = np.memmap(path, dtype=np.int32, mode="r")
+            self.n = (len(self.tokens) - 1) // seq_len
+        else:
+            rng = np.random.default_rng(seed)
+            self.tokens = rng.integers(
+                0, vocab, size=(n * seq_len + 1,)).astype(np.int64)
+            self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        chunk = np.asarray(
+            self.tokens[i * self.seq_len : (i + 1) * self.seq_len + 1],
+            dtype=np.int64,
+        )
+        return {"input_ids": torch.from_numpy(chunk[:-1]),
+                "labels": torch.from_numpy(chunk[1:])}
+
+
+SIZES = {
+    # hidden, layers, heads, vocab — "tiny" is the CI/e2e size.
+    "tiny": dict(hidden_size=64, num_hidden_layers=2,
+                 num_attention_heads=4, vocab_size=512,
+                 intermediate_size=256),
+    "160m": dict(hidden_size=768, num_hidden_layers=12,
+                 num_attention_heads=12, vocab_size=50304,
+                 intermediate_size=3072),
+    "410m": dict(hidden_size=1024, num_hidden_layers=24,
+                 num_attention_heads=16, vocab_size=50304,
+                 intermediate_size=4096),
+}
+
+
+class NeoXZeroTrial(DeepSpeedTrial):
+    def __init__(self, context: DeepSpeedTrialContext):
+        super().__init__(context)
+        import transformers
+
+        hp = context.get_hparams()
+        size = hp.get("model_size", "tiny")
+        seq_len = int(hp.get("seq_len", 128))
+        cfg = transformers.GPTNeoXConfig(
+            max_position_embeddings=max(seq_len, 128),
+            use_parallel_residual=True,
+            **SIZES[size],
+        )
+        model = transformers.GPTNeoXForCausalLM(cfg)
+        self.vocab = cfg.vocab_size
+        self.seq_len = seq_len
+        lr = float(hp.get("learning_rate", 6e-4))
+        self.engine = context.wrap_model_engine(
+            ZeroOneEngine(
+                model.to(context.device),
+                lambda params: torch.optim.AdamW(params, lr=lr),
+                micro_batch_size=int(hp.get("micro_batch_size", 4)),
+                gradient_accumulation=int(hp.get("gradient_accumulation", 2)),
+            )
+        )
+
+    def build_training_data_loader(self):
+        hp = self.context.get_hparams()
+        return DataLoader(
+            SyntheticTokens(self.vocab, self.seq_len,
+                            path=hp.get("tokens_path")),
+            batch_size=self.engine.train_micro_batch_size_per_gpu(),
+        )
+
+    def build_validation_data_loader(self):
+        return DataLoader(
+            SyntheticTokens(self.vocab, self.seq_len, n=64, seed=7),
+            batch_size=self.engine.train_micro_batch_size_per_gpu(),
+        )
+
+    def train_batch(self, dataloader_iter, epoch_idx, batch_idx):
+        """One call = one gradient-accumulation window (reference
+        _deepspeed_trial.py:729 — the user pulls microbatches and drives
+        engine.backward/step; the engine steps the optimizer at the
+        accumulation boundary)."""
+        total = 0.0
+        n = self.context.num_micro_batches_per_slot()
+        for _ in range(n):
+            batch = next(dataloader_iter)
+            out = self.engine(input_ids=batch["input_ids"],
+                              labels=batch["labels"])
+            self.engine.backward(out.loss)
+            self.engine.step()
+            total += float(out.loss.item())
+        return {"loss": total / n}
+
+    def evaluate_batch(self, dataloader_iter, batch_idx):
+        batch = next(dataloader_iter)
+        with torch.no_grad():
+            out = self.engine(input_ids=batch["input_ids"],
+                              labels=batch["labels"])
+        return {"val_loss": float(out.loss.item())}
+
+
+if __name__ == "__main__":
+    import logging
+
+    from determined_tpu import core
+
+    logging.basicConfig(level=logging.INFO)
+    ctx = DeepSpeedTrialContext()
+    core_ctx = core.init(distributed=ctx.dist)
+    ctx._core = core_ctx
+    ctx._hparams = core_ctx.hparams
+    trial = NeoXZeroTrial(ctx)
+    DeepSpeedTrainer(trial, core_context=core_ctx).fit(
+        searcher_metric="val_loss", report_period=10,
+    )
+    core_ctx.close()

@@ -178,6 +178,37 @@ def test_gpt2_torch_distributed_example(cluster, tmp_path):
     assert "[rank=0]" in r.stdout and "[rank=1]" in r.stdout, r.stdout[-2000:]
 
 
+def test_gpt_neox_zero1_example(cluster, tmp_path):
+    """BASELINE config 4: GPT-NeoX through the DeepSpeedTrial API with the
+    TPU-native ZeRO-1 engine, shrunk to 2 processes (gloo) in a managed
+    task. The shipped zero1.yaml is this with 410m/64 slots."""
+    import yaml
+
+    with open(os.path.join(EXAMPLES, "gpt_neox", "zero1.yaml")) as f:
+        cfg = yaml.safe_load(f)
+    cfg["checkpoint_storage"]["host_path"] = os.path.join(str(tmp_path), "ckpts")
+    cfg["searcher"]["max_length"] = {"batches": 2}
+    cfg["hyperparameters"].update(
+        model_size="tiny", seq_len=32, micro_batch_size=2,
+        gradient_accumulation=2)
+    cfg["resources"]["slots_per_trial"] = 2
+    cfg["entrypoint"] = (
+        "python3 -m determined_tpu.launch.torch_distributed "
+        "--nproc-per-node 2 -- python3 model_def.py"
+    )
+    out = os.path.join(str(tmp_path), "gpt_neox.yaml")
+    with open(out, "w") as f:
+        yaml.safe_dump(cfg, f)
+    r = _cli(cluster, "experiment", "create", out,
+             os.path.join(EXAMPLES, "gpt_neox"), "--follow", timeout=600)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "COMPLETED" in r.stdout, r.stdout[-2000:]
+    assert "[rank=0]" in r.stdout and "[rank=1]" in r.stdout, r.stdout[-2000:]
+    # the engine partitioned the optimizer across the two workers
+    assert "zero1: rank 0/2" in r.stdout and "zero1: rank 1/2" in r.stdout, \
+        r.stdout[-2000:]
+
+
 def test_gpt2_pipeline_example(cluster, tmp_path):
     """pipeline.yaml runs the GPipe path: mesh.pipeline=2 makes the Trainer
     select loss_pipelined inside the spawned trial (8-device CPU mesh via the
