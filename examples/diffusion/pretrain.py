@@ -1,0 +1,66 @@
+"""Produce a pretrained diffusion checkpoint for the finetune example.
+
+Standalone (no master needed): trains the UNet for --steps on the
+synthetic set (or --data-path npz) and pickles the params pytree to
+--out, which `finetune_asha.yaml` consumes via
+`hyperparameters.pretrained_path`. On a real cluster you would instead
+pretrain through the platform and point pretrained_path at the
+checkpoint's params file.
+"""
+
+import argparse
+
+import jax
+import numpy as np
+import optax
+
+from examples.diffusion.model_def import save_params, synthetic_images
+from determined_tpu.models import diffusion
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=500)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--model-size", default="base",
+                    choices=["tiny", "base"])
+    ap.add_argument("--data-path", default=None)
+    ap.add_argument("--out", default="diffusion_pretrained.pkl")
+    args = ap.parse_args()
+
+    cfg = {"tiny": diffusion.Config.tiny(),
+           "base": diffusion.Config()}[args.model_size]
+    if args.data_path:
+        with np.load(args.data_path) as d:
+            images = d["images"].astype(np.float32)
+    else:
+        images = synthetic_images(2048, cfg.image_size)
+
+    params = diffusion.init(jax.random.PRNGKey(0), cfg)
+    tx = optax.adamw(1e-4)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, batch, rng):
+        (loss, _), grads = jax.value_and_grad(
+            lambda p: diffusion.loss_fn(p, batch, cfg, rng),
+            has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    rng = np.random.default_rng(1)
+    key = jax.random.PRNGKey(1)
+    for i in range(args.steps):
+        idx = rng.integers(0, len(images), args.batch)
+        key, sub = jax.random.split(key)
+        params, opt_state, loss = step(
+            params, opt_state, {"images": images[idx]}, sub)
+        if i % 50 == 0:
+            print(f"step {i}: loss {float(loss):.4f}", flush=True)
+    save_params(params, args.out)
+    print(f"saved pretrained params to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -209,6 +209,48 @@ def test_gpt_neox_zero1_example(cluster, tmp_path):
         r.stdout[-2000:]
 
 
+def test_diffusion_finetune_asha_example(cluster, tmp_path):
+    """BASELINE config 5: diffusion finetune + adaptive_asha across
+    sub-slices, shrunk: tiny UNet, 2-slot trials on the 2-slot agent,
+    3-trial search. Also exercises the finetune path: a pretrained pickle
+    is produced first and pretrained_path points at it."""
+    import yaml
+
+    # Pretrain for real (tiny, 4 steps) via the shipped script — this is
+    # pretrain.py's only end-to-end coverage, don't hand-pickle instead.
+    pre = os.path.join(str(tmp_path), "pretrained.pkl")
+    env = dict(os.environ,
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+               JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    pr = subprocess.run(
+        [sys.executable, "-m", "examples.diffusion.pretrain",
+         "--steps", "4", "--batch", "8", "--model-size", "tiny",
+         "--out", pre],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert pr.returncode == 0, pr.stdout[-2000:] + pr.stderr[-2000:]
+    assert os.path.exists(pre)
+
+    with open(os.path.join(EXAMPLES, "diffusion", "finetune_asha.yaml")) as f:
+        cfg = yaml.safe_load(f)
+    cfg["checkpoint_storage"]["host_path"] = os.path.join(str(tmp_path), "ckpts")
+    cfg["searcher"].update(max_trials=3, max_length={"batches": 4})
+    cfg["hyperparameters"].update(
+        model_size="tiny", global_batch_size=8, pretrained_path=pre)
+    cfg["resources"]["slots_per_trial"] = 2
+    out = os.path.join(str(tmp_path), "diffusion.yaml")
+    with open(out, "w") as f:
+        yaml.safe_dump(cfg, f)
+    r = _cli(cluster, "experiment", "create", out,
+             os.path.join(EXAMPLES, "diffusion"), "--follow", timeout=900)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "COMPLETED" in r.stdout, r.stdout[-2000:]
+    token = cluster.login()
+    trials = cluster.api("GET", "/api/v1/experiments/1/trials",
+                         token=token)["trials"]
+    assert len(trials) == 3  # the search really ran multiple trials
+
+
 def test_gpt2_pipeline_example(cluster, tmp_path):
     """pipeline.yaml runs the GPipe path: mesh.pipeline=2 makes the Trainer
     select loss_pipelined inside the spawned trial (8-device CPU mesh via the
