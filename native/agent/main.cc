@@ -79,6 +79,14 @@ struct Task {
   // without dropping the downtime window (duplicates of up to one flush
   // interval are possible; the log-policy actions are idempotent).
   std::atomic<long> off_out{0}, off_err{0};
+  // Tail threads that have finished their final drain (2 = both).
+  // finish_task waits on this so logs are DURABLE before EXITED is
+  // reported — `det task logs` on a just-finished task must see output.
+  std::atomic<int> tails_done{0};
+  // Whether supervise() actually spawned tails for this incarnation: the
+  // reattach paths that find a task already dead never do, and must not
+  // stall the drain waiting for threads that don't exist.
+  bool tails_spawned = false;
 };
 
 std::mutex g_mu;
@@ -150,6 +158,10 @@ struct LogEntry {
 std::mutex g_log_mu;
 std::condition_variable g_log_cv;
 std::deque<Json> g_log_queue;
+// Undelivered line count per task id (queued + in-flight). Exit reporting
+// waits for THIS task's count to hit zero — completion implies logs
+// durable, and an unrelated chatty task can't stall the drain.
+std::map<std::string, long> g_log_pending;
 std::atomic<bool> g_running{true};
 
 void enqueue_log(const std::string& task_id, const std::string& alloc_id,
@@ -167,8 +179,20 @@ void enqueue_log(const std::string& task_id, const std::string& alloc_id,
   e["level"] = stdtype == "stderr" ? "ERROR" : "INFO";
   e["log"] = line;
   std::lock_guard<std::mutex> lock(g_log_mu);
+  ++g_log_pending[task_id];
   g_log_queue.push_back(std::move(e));
   g_log_cv.notify_one();
+}
+
+// Called with g_log_mu held: account a batch's lines as delivered (or
+// dropped) and wake drain waiters.
+void settle_batch_locked(const std::vector<Json>& batch) {
+  for (const auto& e : batch) {
+    auto it = g_log_pending.find(e["task_id"].as_string());
+    if (it != g_log_pending.end() && --it->second <= 0) {
+      g_log_pending.erase(it);
+    }
+  }
 }
 
 void shipper_loop(const AgentOptions& opts) {
@@ -186,18 +210,67 @@ void shipper_loop(const AgentOptions& opts) {
     if (batch.empty()) continue;
     Json body = Json::object();
     Json logs = Json::array();
-    for (auto& e : batch) logs.push_back(std::move(e));
+    for (const auto& e : batch) logs.push_back(e);
     body["logs"] = logs;
-    for (int attempt = 0; attempt < 3; ++attempt) {
+    bool delivered = false, poisoned = false;
+    for (int attempt = 0; attempt < 3 && g_running; ++attempt) {
       try {
         auto r = master_call(opts.master_url, "POST",
                              "/api/v1/task/logs", body.dump(), 10.0);
-        if (r.ok()) break;
+        if (r.ok()) { delivered = true; break; }
+        if (r.status >= 400 && r.status < 500) {
+          // The master REJECTED the batch — retrying can't help and
+          // would wedge every later line behind it.
+          std::cerr << "agent: log batch rejected (" << r.status
+                    << "), dropping " << batch.size() << " lines"
+                    << std::endl;
+          poisoned = true;
+          break;
+        }
       } catch (const std::exception&) {
       }
       std::this_thread::sleep_for(std::chrono::seconds(1));
     }
+    if (delivered || poisoned) {
+      std::lock_guard<std::mutex> lock(g_log_mu);
+      settle_batch_locked(batch);
+      g_log_cv.notify_all();
+      continue;
+    }
+    // Transient failure (master down/unreachable): the lines must NOT be
+    // silently lost — completion implies logs durable now. Requeue at
+    // the FRONT (order-preserving) and let the loop retry; the exit
+    // report's own retry loop waits behind the same master.
+    {
+      std::lock_guard<std::mutex> lock(g_log_mu);
+      for (auto it = batch.rbegin(); it != batch.rend(); ++it) {
+        g_log_queue.push_front(std::move(*it));
+      }
+    }
+    std::this_thread::sleep_for(std::chrono::seconds(2));
   }
+}
+
+// Wait (bounded) until this task's tails drained their files and the
+// shipper delivered everything they queued. Called before the exit
+// report so a COMPLETED task's logs are already readable on the master
+// (the reference drains its Collector before exiting,
+// master/static/srv/ship_logs.py). Waits on THIS task's pending count
+// only; skipped entirely when no tails were spawned (reattach paths that
+// found the task already dead).
+void drain_task_logs(std::shared_ptr<Task> task) {
+  if (!task->tails_spawned) return;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::seconds(15);
+  while (task->tails_done.load() < 2 &&
+         std::chrono::steady_clock::now() < deadline) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+  std::unique_lock<std::mutex> lock(g_log_mu);
+  g_log_cv.wait_until(lock, deadline, [&task] {
+    return g_log_pending.find(task->task_id) == g_log_pending.end() ||
+           !g_running;
+  });
 }
 
 // ---- device detection ---------------------------------------------------
@@ -255,6 +328,10 @@ void tail_thread(std::string path, std::shared_ptr<Task> task,
   std::string partial;
   char buf[8192];
   while (true) {
+    // Sample exited BEFORE reading: if the flag flips between our fread
+    // and the check we must loop for one more full read pass, or output
+    // written in that window is lost (durability would silently break).
+    bool exit_seen = task->exited.load();
     if (f == nullptr) {
       f = fopen(path.c_str(), "r");
       if (f != nullptr) fseek(f, offset, SEEK_SET);
@@ -276,7 +353,7 @@ void tail_thread(std::string path, std::shared_ptr<Task> task,
       }
       continue;  // drain greedily
     }
-    if (task->exited) break;  // final read above drained the file
+    if (exit_seen) break;  // exited observed before this (empty) read
     std::this_thread::sleep_for(std::chrono::milliseconds(200));
   }
   if (!partial.empty()) {
@@ -284,6 +361,7 @@ void tail_thread(std::string path, std::shared_ptr<Task> task,
                 agent_id, rank, stdtype, partial);
   }
   if (f != nullptr) fclose(f);
+  task->tails_done.fetch_add(1);
 }
 
 // /proc/<pid>/stat field 22 (starttime, clock ticks since boot): the
@@ -398,6 +476,11 @@ void finish_task(const AgentOptions& opts, std::shared_ptr<Task> task,
   task->exited = true;
   task->pending_exit = code;
   persist_registry(opts);  // the exit is durable BEFORE we try to report
+  // Ship the remaining log lines BEFORE the exit report: the master flips
+  // the task terminal on EXITED, and a user reading `det task logs` right
+  // after must see the full output (bounded wait; a wedged master can't
+  // hold the exit hostage forever).
+  drain_task_logs(task);
   Json done = Json::object();
   done["container_id"] = task->container_id;
   done["state"] = "EXITED";
@@ -426,6 +509,7 @@ void finish_task(const AgentOptions& opts, std::shared_ptr<Task> task,
 
 void supervise(const AgentOptions& opts, std::shared_ptr<Task> task) {
   // Start the log tails + the appropriate waiter.
+  task->tails_spawned = true;
   std::thread(tail_thread, task->workdir + "/stdout.log", task, opts.id,
               task->rank, "stdout", &task->off_out).detach();
   std::thread(tail_thread, task->workdir + "/stderr.log", task, opts.id,
@@ -601,6 +685,16 @@ bool reattach_tasks(const AgentOptions& opts) {
         std::lock_guard<std::mutex> lock(g_mu);
         g_tasks[task->container_id] = task;
       }
+      // Ship whatever the dead task wrote after our previous incarnation's
+      // last offset flush: exited is already set, so each tail does one
+      // drain pass from the persisted offset to EOF and finishes; the
+      // finish_task drain then waits for delivery before EXITED.
+      task->exited = true;
+      task->tails_spawned = true;
+      std::thread(tail_thread, task->workdir + "/stdout.log", task,
+                  opts.id, task->rank, "stdout", &task->off_out).detach();
+      std::thread(tail_thread, task->workdir + "/stderr.log", task,
+                  opts.id, task->rank, "stderr", &task->off_err).detach();
       std::thread([task, opts, code] { finish_task(opts, task, code); })
           .detach();
     }
