@@ -122,13 +122,30 @@ MasterConfig MasterConfig::from_json(const Json& j) {
   }
   const Json& prov = j["provisioner"];
   if (prov.is_object()) {
-    c.provisioner.webhook_url = prov["webhook_url"].as_string("");
-    c.provisioner.sustain_s =
-        prov["sustain_seconds"].as_double(c.provisioner.sustain_s);
-    c.provisioner.cooldown_s =
-        prov["cooldown_seconds"].as_double(c.provisioner.cooldown_s);
-    c.provisioner.max_slots =
-        static_cast<int>(prov["max_slots"].as_int(c.provisioner.max_slots));
+    ProvisionerConfig& p = c.provisioner;
+    p.webhook_url = prov["webhook_url"].as_string("");
+    // Untyped configs keep the old meaning: webhook_url present → webhook.
+    p.type = prov["type"].as_string(
+        p.webhook_url.empty() ? "gcp" : "webhook");
+    p.sustain_s = prov["sustain_seconds"].as_double(p.sustain_s);
+    p.cooldown_s = prov["cooldown_seconds"].as_double(p.cooldown_s);
+    p.max_slots = static_cast<int>(prov["max_slots"].as_int(p.max_slots));
+    p.api_base = prov["api_base"].as_string("");
+    p.project = prov["project"].as_string("");
+    p.zone = prov["zone"].as_string("");
+    p.accelerator_type =
+        prov["accelerator_type"].as_string(p.accelerator_type);
+    p.runtime_version = prov["runtime_version"].as_string(p.runtime_version);
+    p.bearer_token = prov["bearer_token"].as_string("");
+    p.slots_per_node =
+        static_cast<int>(prov["slots_per_node"].as_int(p.slots_per_node));
+    p.idle_s = prov["idle_seconds"].as_double(p.idle_s);
+    p.reconcile_s = prov["reconcile_seconds"].as_double(p.reconcile_s);
+    p.create_grace_s =
+        prov["create_grace_seconds"].as_double(p.create_grace_s);
+    p.boot_grace_s = prov["boot_grace_seconds"].as_double(p.boot_grace_s);
+    p.spot = prov["spot"].as_bool(p.spot);
+    p.node_prefix = prov["node_prefix"].as_string(p.node_prefix);
   }
   return c;
 }

@@ -307,7 +307,10 @@ void Master::check_agents_locked() {
   }
   // Backend upkeep: dead-agent sweep (agent RM) / pod reconcile (k8s RM).
   rm_->tick(t);
-  // Provisioner: sustained unmet demand fires a scale-up webhook.
+  // Provisioner: sustained unmet demand launches nodes; idle ones are
+  // scaled down. Every pool with demand OR capacity OR a tracked node
+  // gets an observation — scale-DOWN decisions need ticks with zero
+  // pending demand, which the old demand-only enumeration never gave.
   if (provisioner_ && provisioner_->enabled()) {
     std::map<std::string, ScalingSnapshot> pools;
     for (const auto& aid : pending_) {
@@ -317,10 +320,16 @@ void Master::check_agents_locked() {
       s.pending_slots += it->second.slots;
       s.pending_allocations += 1;
     }
+    for (const auto& [id, a] : agents_) {
+      if (a.alive) pools[a.resource_pool];  // ensure pool present
+    }
+    for (const auto& n : provisioner_->nodes()) pools[n.pool];
     for (auto& [pool, snap] : pools) {
       ScalingSnapshot cap = rm_->scaling(pool);
       snap.total_slots = cap.total_slots;
       snap.free_slots = cap.free_slots;
+      snap.agents = std::move(cap.agents);
+      snap.idle_agents = std::move(cap.idle_agents);
       provisioner_->observe(pool, snap, t);
     }
   }
@@ -603,10 +612,17 @@ class AgentResourceManager : public ResourceManager {
     ScalingSnapshot s;
     for (const auto& [id, a] : m_.agents_) {
       if (!a.alive || a.resource_pool != pool) continue;
+      s.agents.push_back(id);
+      bool all_free = true;
       for (const auto& slot : a.slots) {
         ++s.total_slots;
-        if (slot.enabled && slot.allocation_id.empty()) ++s.free_slots;
+        if (slot.enabled && slot.allocation_id.empty()) {
+          ++s.free_slots;
+        } else {
+          all_free = false;
+        }
       }
+      if (all_free) s.idle_agents.push_back(id);
     }
     return s;
   }

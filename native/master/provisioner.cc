@@ -1,0 +1,451 @@
+// Provisioner: the node lifecycle for TPU pools.
+//
+// Reference shape: master/internal/rm/agentrm/provisioner/provisioner.go
+// drives a cloud executor (aws_spot.go:35-763 creates one-time spot
+// requests, tracks interruptions, terminates instances) from
+// scaledecider's sustained-demand / idle-instance calculus. The TPU
+// design keeps the same three duties —
+//
+//   launch:    sustained unmet demand → create TPU-VM nodes via the TPU
+//              REST API. Launched-but-not-yet-registered capacity counts
+//              toward the decision (bounded by boot_grace_s), so one
+//              demand spike can't fire a node per tick while the first
+//              boots, and a node whose agent never joins can't suppress
+//              scale-up forever.
+//   shrink:    a node WE manage whose agent has been idle past idle_s is
+//              deleted (never scales below pending demand; never touches
+//              operator-managed nodes). Nodes that outlive boot_grace_s
+//              without ever registering an agent are deleted as broken.
+//   reconcile: the node list is polled off-lock (paginated). Tracked
+//              nodes missing from it (spot interruption, manual delete)
+//              are dropped — their agents die, the dead-agent sweep
+//              fails the allocations, and max_restarts reschedules
+//              them. Listed nodes carrying our name prefix that we are
+//              NOT tracking (master restart) are adopted, so provisioned
+//              VMs never outlive the master's memory of them.
+//
+// All network I/O runs on detached threads that capture the shared
+// state block — observe() is called under the master mutex and must
+// never block on the cloud API, and a master shutdown mid-request must
+// not use-after-free.
+
+#include <iostream>
+#include <thread>
+
+#include "../common/http.h"
+#include "rm.h"
+
+namespace det {
+
+namespace {
+
+// url → (scheme://host:port, path-prefix)
+void split_url(const std::string& url, std::string* base, std::string* path) {
+  auto pos = url.find('/', url.find("//") + 2);
+  *base = pos == std::string::npos ? url : url.substr(0, pos);
+  *path = pos == std::string::npos ? "" : url.substr(pos);
+}
+
+std::string basename_of(const std::string& resource) {
+  auto pos = resource.rfind('/');
+  return pos == std::string::npos ? resource : resource.substr(pos + 1);
+}
+
+}  // namespace
+
+Provisioner::Provisioner(ProvisionerConfig cfg)
+    : cfg_(std::move(cfg)), st_(std::make_shared<State>()) {
+  if (!cfg_.api_base.empty()) split_url(cfg_.api_base, &api_url_, &api_path_);
+}
+
+bool Provisioner::observe(const std::string& pool,
+                          const ScalingSnapshot& snap, double now) {
+  if (!enabled()) return false;
+  if (cfg_.type == "gcp") return observe_gcp(pool, snap, now);
+  return observe_webhook(pool, snap, now);
+}
+
+std::vector<ProvNode> Provisioner::nodes() const {
+  std::lock_guard<std::mutex> lock(st_->mu);
+  std::vector<ProvNode> out;
+  for (const auto& [name, n] : st_->nodes) out.push_back(n);
+  return out;
+}
+
+std::string Provisioner::nodes_path() const {
+  return api_path_ + "/projects/" + cfg_.project + "/locations/" +
+         cfg_.zone + "/nodes";
+}
+
+std::map<std::string, std::string> Provisioner::auth_headers() const {
+  std::map<std::string, std::string> h;
+  if (!cfg_.bearer_token.empty()) {
+    h["Authorization"] = "Bearer " + cfg_.bearer_token;
+  }
+  return h;
+}
+
+// ---------------------------------------------------------------------------
+// GCP TPU-VM executor mode.
+// ---------------------------------------------------------------------------
+
+bool Provisioner::observe_gcp(const std::string& pool,
+                              const ScalingSnapshot& snap, double now) {
+  reconcile(now);
+
+  auto is_agent = [&snap](const std::string& name) {
+    for (const auto& a : snap.agents) {
+      if (a == name) return true;
+    }
+    return false;
+  };
+
+  // Launched-but-not-joined capacity: nodes we created whose agent has
+  // not registered yet still satisfy future demand — count them as free
+  // for the decision or every tick during boot launches another node.
+  // Bounded by boot_grace_s: a node whose agent never shows up stops
+  // counting (and is deleted below) instead of suppressing scale-up
+  // forever.
+  int joining = 0;
+  std::vector<std::string> never_joined;
+  {
+    std::lock_guard<std::mutex> lock(st_->mu);
+    for (const auto& [name, n] : st_->nodes) {
+      if (n.pool != pool || n.state == "DELETING" || is_agent(name)) {
+        continue;
+      }
+      if (now - n.created_at > cfg_.boot_grace_s) {
+        never_joined.push_back(name);
+      } else {
+        joining += cfg_.slots_per_node;
+      }
+    }
+  }
+  bool acted = false;
+  for (const auto& name : never_joined) {
+    std::cerr << "provisioner: node " << name << " never joined within "
+              << cfg_.boot_grace_s << "s, deleting" << std::endl;
+    delete_node(name, now);
+    acted = true;
+  }
+
+  // ---- launch ----
+  int effective_free = snap.free_slots + joining;
+  if (snap.pending_slots > effective_free) {
+    auto it = demand_since_.find(pool);
+    if (it == demand_since_.end()) {
+      demand_since_[pool] = now;
+    } else if (now - it->second >= cfg_.sustain_s) {
+      double& last = last_fired_[pool];
+      if (last == 0 || now - last >= cfg_.cooldown_s) {
+        int deficit = snap.pending_slots - effective_free;
+        int want_nodes =
+            (deficit + cfg_.slots_per_node - 1) / cfg_.slots_per_node;
+        int room = cfg_.max_slots - snap.total_slots - joining;
+        int can_nodes = room / cfg_.slots_per_node;
+        int n_new = std::min(want_nodes, can_nodes);
+        if (n_new > 0) {
+          last = now;
+          for (int i = 0; i < n_new; ++i) launch_node(pool, now);
+          acted = true;
+        }
+      }
+    }
+  } else {
+    demand_since_.erase(pool);
+  }
+
+  // ---- shrink ----
+  // Only agents on nodes WE manage; never below pending demand.
+  std::set<std::string> pool_agents(snap.agents.begin(), snap.agents.end());
+  for (const auto& aid : snap.agents) {
+    bool idle = false;
+    for (const auto& i : snap.idle_agents) {
+      if (i == aid) { idle = true; break; }
+    }
+    if (!idle) {
+      idle_since_.erase(aid);
+      continue;
+    }
+    std::string node_state;
+    {
+      std::lock_guard<std::mutex> lock(st_->mu);
+      auto nit = st_->nodes.find(aid);
+      if (nit == st_->nodes.end()) continue;
+      node_state = nit->second.state;
+    }
+    if (node_state == "DELETING") continue;
+    auto iit = idle_since_.find(aid);
+    if (iit == idle_since_.end()) {
+      idle_since_[aid] = now;
+      continue;
+    }
+    if (now - iit->second < cfg_.idle_s) continue;
+    if (snap.pending_slots > 0) continue;  // capacity still wanted
+    std::cerr << "provisioner: node " << aid << " idle "
+              << static_cast<long>(now - iit->second)
+              << "s, scaling down" << std::endl;
+    delete_node(aid, now);
+    idle_since_.erase(iit);
+    acted = true;
+  }
+  // An agent that died or deregistered must not leave a stale idle
+  // timestamp behind — a later re-register would inherit it and get its
+  // node deleted instantly instead of a fresh idle window.
+  for (auto it = idle_since_.begin(); it != idle_since_.end();) {
+    bool this_pool;
+    {
+      std::lock_guard<std::mutex> lock(st_->mu);
+      auto nit = st_->nodes.find(it->first);
+      this_pool = nit != st_->nodes.end() && nit->second.pool == pool;
+    }
+    if (this_pool && pool_agents.count(it->first) == 0) {
+      it = idle_since_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  return acted;
+}
+
+void Provisioner::launch_node(const std::string& pool, double now) {
+  std::string name;
+  {
+    std::lock_guard<std::mutex> lock(st_->mu);
+    // Skip names still present in tracking (e.g. adopted after a master
+    // restart) so we never create over an existing node.
+    do {
+      name = cfg_.node_prefix + "-" + pool + "-" +
+             std::to_string(st_->seq++);
+    } while (st_->nodes.count(name) > 0);
+    ProvNode n;
+    n.name = name;
+    n.pool = pool;
+    n.state = "CREATING";
+    n.created_at = now;
+    st_->nodes[name] = n;
+  }
+  std::cerr << "provisioner: creating node " << name << " ("
+            << cfg_.accelerator_type << ") for pool " << pool << std::endl;
+
+  Json body = Json::object();
+  body["acceleratorType"] = cfg_.accelerator_type;
+  body["runtimeVersion"] = cfg_.runtime_version;
+  Json sched = Json::object();
+  sched["preemptible"] = cfg_.spot;
+  body["schedulingConfig"] = sched;
+  // The agent on the node must come up knowing its pool and id; real
+  // TPU-VM metadata carries a startup script — the fake test server and
+  // deploy tooling read these labels instead.
+  Json labels = Json::object();
+  labels["det-pool"] = pool;
+  labels["det-agent-id"] = name;
+  body["labels"] = labels;
+
+  auto st = st_;
+  std::string url = api_url_;
+  std::string path = nodes_path() + "?nodeId=" + name;
+  std::string payload = body.dump();
+  auto headers = auth_headers();
+  std::thread([st, url, path, payload, headers, name] {
+    try {
+      auto r = http_request("POST", url, path, payload, 30.0, headers);
+      if (!r.ok()) {
+        std::cerr << "provisioner: create " << name << " failed ("
+                  << r.status << "): " << r.body << std::endl;
+        std::lock_guard<std::mutex> lock(st->mu);
+        st->nodes.erase(name);
+      }
+    } catch (const std::exception& e) {
+      std::cerr << "provisioner: create " << name << " failed: " << e.what()
+                << std::endl;
+      std::lock_guard<std::mutex> lock(st->mu);
+      st->nodes.erase(name);
+    }
+  }).detach();
+}
+
+void Provisioner::delete_node(const std::string& name, double now) {
+  {
+    std::lock_guard<std::mutex> lock(st_->mu);
+    auto it = st_->nodes.find(name);
+    if (it == st_->nodes.end()) return;
+    it->second.state = "DELETING";
+    it->second.deleting_since = now;
+  }
+  auto st = st_;
+  std::string url = api_url_;
+  std::string path = nodes_path() + "/" + name;
+  auto headers = auth_headers();
+  std::thread([st, url, path, headers, name] {
+    bool gone = false;
+    try {
+      auto r = http_request("DELETE", url, path, "", 30.0, headers);
+      gone = r.ok() || r.status == 404;
+      if (!gone) {
+        std::cerr << "provisioner: delete " << name << " failed ("
+                  << r.status << "), will retry" << std::endl;
+      }
+    } catch (const std::exception& e) {
+      std::cerr << "provisioner: delete " << name << " failed: " << e.what()
+                << ", will retry" << std::endl;
+    }
+    std::lock_guard<std::mutex> lock(st->mu);
+    if (gone) {
+      st->nodes.erase(name);
+    } else {
+      // Leave it DELETING with the timestamp cleared so the reconcile
+      // pass re-issues the delete — one transient API error must not
+      // leak a billing TPU-VM forever.
+      auto it = st->nodes.find(name);
+      if (it != st->nodes.end()) it->second.deleting_since = 0;
+    }
+  }).detach();
+}
+
+void Provisioner::reconcile(double now) {
+  if (now - last_reconcile_ < cfg_.reconcile_s) return;
+  last_reconcile_ = now;
+
+  // Re-issue stale DELETEs (failed attempt cleared deleting_since).
+  std::vector<std::string> redo;
+  {
+    std::lock_guard<std::mutex> lock(st_->mu);
+    for (auto& [name, n] : st_->nodes) {
+      if (n.state == "DELETING" && n.deleting_since == 0) {
+        n.deleting_since = now;  // claimed; delete_node re-stamps anyway
+        redo.push_back(name);
+      }
+    }
+  }
+  for (const auto& name : redo) delete_node(name, now);
+
+  auto st = st_;
+  std::string url = api_url_;
+  std::string base_path = nodes_path();
+  auto headers = auth_headers();
+  std::string prefix = cfg_.node_prefix + "-";
+  double grace = cfg_.create_grace_s;
+  std::thread([st, url, base_path, headers, now, prefix, grace] {
+    std::map<std::string, std::string> listed;  // name → state
+    std::string page_token;
+    // Paginated list: the real API caps page size; treating page 1 as
+    // the world would mass-drop healthy nodes as "vanished".
+    for (int page = 0; page < 64; ++page) {
+      std::string path = base_path;
+      if (!page_token.empty()) path += "?pageToken=" + page_token;
+      Json resp;
+      try {
+        auto r = http_request("GET", url, path, "", 30.0, headers);
+        if (!r.ok()) return;
+        resp = Json::parse_or_null(r.body);
+      } catch (const std::exception&) {
+        return;  // transient; keep current view
+      }
+      for (const auto& n : resp["nodes"].as_array()) {
+        listed[basename_of(n["name"].as_string())] =
+            n["state"].as_string("READY");
+      }
+      page_token = resp["nextPageToken"].as_string("");
+      if (page_token.empty()) break;
+    }
+    std::lock_guard<std::mutex> lock(st->mu);
+    for (auto it = st->nodes.begin(); it != st->nodes.end();) {
+      const ProvNode& n = it->second;
+      bool present = listed.count(it->first) > 0;
+      if (present) {
+        if (n.state == "CREATING") it->second.state = "READY";
+        ++it;
+        continue;
+      }
+      bool booting = n.state == "CREATING" && now - n.created_at < grace;
+      if (booting) {
+        ++it;  // not visible yet; grace period
+        continue;
+      }
+      // Vanished: spot interruption or out-of-band delete. The agent on
+      // it stops heartbeating; sweep_dead_agents fails its allocations
+      // and max_restarts reschedules them on remaining capacity.
+      if (n.state != "DELETING") {
+        std::cerr << "provisioner: node " << it->first
+                  << " vanished (spot interruption?); dropping" << std::endl;
+      }
+      it = st->nodes.erase(it);
+    }
+    // Adopt listed nodes carrying our prefix that we aren't tracking
+    // (master restart lost the in-memory view): without this they would
+    // never be idle-deleted and their names could collide with future
+    // launches. Name shape: <prefix>-<pool>-<seq>.
+    for (const auto& [name, state] : listed) {
+      if (name.rfind(prefix, 0) != 0 || st->nodes.count(name) > 0) continue;
+      auto last_dash = name.rfind('-');
+      if (last_dash == std::string::npos ||
+          last_dash < prefix.size()) continue;
+      ProvNode n;
+      n.name = name;
+      n.pool = name.substr(prefix.size(), last_dash - prefix.size());
+      n.state = state == "DELETING" ? "DELETING" : "READY";
+      n.created_at = now;  // fresh boot-grace window
+      st->nodes[name] = n;
+      int seq = atoi(name.substr(last_dash + 1).c_str());
+      if (seq >= st->seq) st->seq = seq + 1;
+      std::cerr << "provisioner: adopted node " << name << " (pool "
+                << n.pool << ")" << std::endl;
+    }
+  }).detach();
+}
+
+// ---------------------------------------------------------------------------
+// Webhook mode (escape hatch; scale-up notification only).
+// ---------------------------------------------------------------------------
+
+bool Provisioner::observe_webhook(const std::string& pool,
+                                  const ScalingSnapshot& snap, double now) {
+  bool unmet = snap.pending_slots > snap.free_slots;
+  if (!unmet) {
+    demand_since_.erase(pool);
+    return false;
+  }
+  auto it = demand_since_.find(pool);
+  if (it == demand_since_.end()) {
+    demand_since_[pool] = now;
+    return false;
+  }
+  if (now - it->second < cfg_.sustain_s) return false;
+  double& last = last_fired_[pool];
+  if (last != 0 && now - last < cfg_.cooldown_s) return false;
+  last = now;
+
+  int want = std::min(cfg_.max_slots,
+                      snap.total_slots + snap.pending_slots - snap.free_slots);
+  if (want <= snap.total_slots) {
+    // Already at the provisioning ceiling — a zero-growth webhook would
+    // only burn the cooldown and mask real requests.
+    return false;
+  }
+  Json payload = Json::object();
+  payload["event"] = "scale_up";
+  payload["resource_pool"] = pool;
+  payload["pending_slots"] = static_cast<int64_t>(snap.pending_slots);
+  payload["free_slots"] = static_cast<int64_t>(snap.free_slots);
+  payload["total_slots"] = static_cast<int64_t>(snap.total_slots);
+  payload["desired_total_slots"] = static_cast<int64_t>(want);
+  std::string url = cfg_.webhook_url;
+  std::string body = payload.dump();
+  std::cerr << "provisioner: scale-up request for pool " << pool << " ("
+            << snap.pending_slots << " pending > " << snap.free_slots
+            << " free)" << std::endl;
+  std::thread([url, body] {
+    try {
+      std::string base, path;
+      split_url(url, &base, &path);
+      if (path.empty()) path = "/";
+      http_request("POST", base, path, body, 10.0);
+    } catch (const std::exception& e) {
+      std::cerr << "provisioner webhook failed: " << e.what() << std::endl;
+    }
+  }).detach();
+  return true;
+}
+
+}  // namespace det

@@ -45,6 +45,10 @@ struct ScalingSnapshot {
   int free_slots = 0;
   int pending_slots = 0;        // demanded by queued allocations
   int pending_allocations = 0;  // queue depth
+  // Node-level view for scale-down and launch accounting: all alive
+  // agents in the pool, and the subset with every slot free.
+  std::vector<std::string> agents;
+  std::vector<std::string> idle_agents;
 };
 
 // Hooks the RM needs from the master; keeps the dependency one-way (the
@@ -175,35 +179,102 @@ class KubernetesResourceManager : public ResourceManager {
 };
 
 // ---------------------------------------------------------------------------
-// Provisioner hook (reference rm/agentrm/provisioner + scaledecider):
-// when pending demand exceeds capacity for `sustain_s`, POST a scale-up
-// request to a webhook (deploy tooling / autoscaler reacts — for GKE TPU
-// node pools or TPU-VM managed instance groups). Cooldown-limited.
+// Provisioner (reference rm/agentrm/provisioner + scaledecider +
+// provisioner/aws/aws_spot.go — there AWS spot instances; here GCP
+// TPU-VMs): the full node lifecycle, not just a notification.
+//
+//   type: "gcp"     — creates/deletes TPU-VM nodes itself through the
+//                     TPU API (tpu.googleapis.com-shaped REST; tests run
+//                     a fake). Sustained unmet demand launches nodes;
+//                     nodes idle past idle_seconds are deleted; nodes
+//                     that vanish from the list (spot interruption) are
+//                     dropped from tracking and their allocations fail
+//                     over through the normal dead-agent/max_restarts
+//                     path.
+//   type: "webhook" — escape hatch: POST a scale-up event and let
+//                     external tooling (GKE autoscaler, deploy scripts)
+//                     react. No scale-down.
 // ---------------------------------------------------------------------------
 
 struct ProvisionerConfig {
-  std::string webhook_url;  // empty = disabled
+  std::string type = "webhook";  // webhook | gcp
+  std::string webhook_url;       // webhook mode; empty = disabled
   double sustain_s = 30;    // demand must persist this long
-  double cooldown_s = 300;  // min seconds between scale-up requests
-  int max_slots = 256;      // never request beyond this
+  double cooldown_s = 300;  // min seconds between scale-up rounds
+  int max_slots = 256;      // never provision beyond this
+  // gcp executor
+  std::string api_base;     // e.g. https://tpu.googleapis.com/v2
+  std::string project;
+  std::string zone;
+  std::string accelerator_type = "v5litepod-4";
+  std::string runtime_version = "tpu-ubuntu2204-base";
+  std::string bearer_token;  // "" = unauthenticated (tests/metadata-auth)
+  int slots_per_node = 4;    // chips a node adds to the pool
+  double idle_s = 300;       // idle this long → scale-down
+  double reconcile_s = 5;    // node-list poll period
+  double create_grace_s = 300;  // CREATING node absent from list → drop
+  double boot_grace_s = 600;    // listed node whose agent never joins →
+                                // delete + stop counting as capacity
+  bool spot = false;         // request preemptible capacity
+  std::string node_prefix = "det-prov";
+};
+
+struct ProvNode {
+  std::string name;
+  std::string pool;
+  std::string state;  // CREATING → READY → DELETING
+  double created_at = 0;
+  double deleting_since = 0;  // re-issue the DELETE if it goes stale
 };
 
 class Provisioner {
  public:
-  explicit Provisioner(ProvisionerConfig cfg) : cfg_(std::move(cfg)) {}
+  explicit Provisioner(ProvisionerConfig cfg);
 
-  // Called each scheduler tick with the RM's scaling snapshot; fires the
-  // webhook (detached thread) when demand is sustained. Returns true if a
-  // scale-up request was issued (tests observe this).
+  // Called each scheduler tick per pool. GCP mode: full scale decision
+  // (launch / idle-terminate / vanish-reconcile). Webhook mode: fire the
+  // scale-up event. Returns true if a scale action was initiated (tests
+  // observe this). Network calls run on detached threads — never blocks
+  // the scheduler.
   bool observe(const std::string& pool, const ScalingSnapshot& snap,
                double now);
 
-  bool enabled() const { return !cfg_.webhook_url.empty(); }
+  bool enabled() const {
+    return cfg_.type == "gcp" ? !cfg_.api_base.empty()
+                              : !cfg_.webhook_url.empty();
+  }
+
+  // Introspection (tests + /metrics).
+  std::vector<ProvNode> nodes() const;
 
  private:
+  // Node tracking shared with the detached I/O threads: they capture the
+  // shared_ptr, so a master shutdown mid-request can't use-after-free.
+  struct State {
+    std::mutex mu;
+    std::map<std::string, ProvNode> nodes;  // instances WE manage
+    int seq = 0;
+  };
+
+  bool observe_webhook(const std::string& pool, const ScalingSnapshot& snap,
+                       double now);
+  bool observe_gcp(const std::string& pool, const ScalingSnapshot& snap,
+                   double now);
+  void reconcile(double now);  // rate-limited list poll (async)
+  void launch_node(const std::string& pool, double now);
+  void delete_node(const std::string& name, double now);
+  std::map<std::string, std::string> auth_headers() const;
+  std::string api_url_;   // scheme://host:port split of api_base
+  std::string api_path_;  // path prefix of api_base
+  std::string nodes_path() const;
+
   ProvisionerConfig cfg_;
+  std::shared_ptr<State> st_;
+  // Decision-only state, touched exclusively under the master mutex.
   std::map<std::string, double> demand_since_;  // pool → first unmet time
   std::map<std::string, double> last_fired_;
+  std::map<std::string, double> idle_since_;   // agent id → idle start
+  double last_reconcile_ = 0;
 };
 
 }  // namespace det

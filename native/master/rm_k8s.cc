@@ -345,60 +345,4 @@ ScalingSnapshot KubernetesResourceManager::scaling(
   return s;
 }
 
-// ---------------------------------------------------------------------------
-// Provisioner.
-// ---------------------------------------------------------------------------
-
-bool Provisioner::observe(const std::string& pool,
-                          const ScalingSnapshot& snap, double now) {
-  if (!enabled()) return false;
-  bool unmet = snap.pending_slots > snap.free_slots;
-  if (!unmet) {
-    demand_since_.erase(pool);
-    return false;
-  }
-  auto it = demand_since_.find(pool);
-  if (it == demand_since_.end()) {
-    demand_since_[pool] = now;
-    return false;
-  }
-  if (now - it->second < cfg_.sustain_s) return false;
-  double& last = last_fired_[pool];
-  if (last != 0 && now - last < cfg_.cooldown_s) return false;
-  last = now;
-
-  int want = std::min(cfg_.max_slots,
-                      snap.total_slots + snap.pending_slots - snap.free_slots);
-  if (want <= snap.total_slots) {
-    // Already at the provisioning ceiling — a zero-growth webhook would
-    // only burn the cooldown and mask real requests.
-    return false;
-  }
-  Json payload = Json::object();
-  payload["event"] = "scale_up";
-  payload["resource_pool"] = pool;
-  payload["pending_slots"] = static_cast<int64_t>(snap.pending_slots);
-  payload["free_slots"] = static_cast<int64_t>(snap.free_slots);
-  payload["total_slots"] = static_cast<int64_t>(snap.total_slots);
-  payload["desired_total_slots"] = static_cast<int64_t>(want);
-  std::string url = cfg_.webhook_url;
-  std::string body = payload.dump();
-  std::cerr << "provisioner: scale-up request for pool " << pool << " ("
-            << snap.pending_slots << " pending > " << snap.free_slots
-            << " free)" << std::endl;
-  std::thread([url, body] {
-    try {
-      auto path_pos = url.find('/', url.find("//") + 2);
-      std::string base =
-          path_pos == std::string::npos ? url : url.substr(0, path_pos);
-      std::string path =
-          path_pos == std::string::npos ? "/" : url.substr(path_pos);
-      http_request("POST", base, path, body, 10.0);
-    } catch (const std::exception& e) {
-      std::cerr << "provisioner webhook failed: " << e.what() << std::endl;
-    }
-  }).detach();
-  return true;
-}
-
 }  // namespace det
