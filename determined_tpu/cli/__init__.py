@@ -16,6 +16,7 @@ import base64
 import io
 import json
 import os
+import ssl
 import sys
 import tarfile
 import time
@@ -350,11 +351,27 @@ def _open_tunnel(master: str, token: str, task_id: str, timeout: float = 60.0):
     import urllib.parse
 
     u = urllib.parse.urlparse(master)
-    host, port = u.hostname, u.port or 80
+    https = u.scheme == "https"
+    host, port = u.hostname, u.port or (443 if https else 80)
     deadline = time.time() + timeout
     last_err = "no attempt"
     while time.time() < deadline:
         s = socketlib.create_connection((host, port), timeout=30)
+        if https:
+            from determined_tpu.common.api import _https_context
+
+            try:
+                s = _https_context().wrap_socket(s, server_hostname=host)
+            except ssl.SSLCertVerificationError:
+                s.close()
+                raise  # retrying can't make an untrusted cert trusted
+            except OSError as e:
+                # Transient handshake failure (task still starting):
+                # retry like every other transport error here.
+                last_err = str(e)
+                s.close()
+                time.sleep(1.0)
+                continue
         req = (
             f"GET /proxy/{task_id}/ HTTP/1.1\r\nHost: {host}\r\n"
             f"Authorization: Bearer {token}\r\n"
@@ -446,9 +463,12 @@ def cmd_deploy(session: Session, args) -> int:
     if args.target == "local":
         if args.action == "up":
             state = deploy_mod.cluster_up(port=args.port, agents=args.agents,
-                                          slots=args.slots)
+                                          slots=args.slots,
+                                          tls=getattr(args, "tls", False))
             print(f"cluster up: master pid {state['master_pid']} on port "
                   f"{state['port']}; logs in {state['logs']}")
+            if state.get("tls"):
+                print(f"TLS on: export DET_MASTER_CERT_FILE={state['cert']}")
         elif args.action == "down":
             print("cluster stopped" if deploy_mod.cluster_down()
                   else "no local cluster running")
@@ -868,6 +888,8 @@ def build_parser() -> argparse.ArgumentParser:
     up.add_argument("--port", type=int, default=8080)
     up.add_argument("--agents", type=int, default=1)
     up.add_argument("--slots", type=int, default=None)
+    up.add_argument("--tls", action="store_true",
+                    help="serve HTTPS with a generated self-signed cert")
     up.set_defaults(func=cmd_deploy, target="local", action="up")
     dl.add_parser("down").set_defaults(func=cmd_deploy, target="local",
                                        action="down")

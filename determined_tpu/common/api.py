@@ -9,12 +9,28 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 import socket
+import ssl
 import time
 import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Any, Dict, Optional
+
+
+def _https_context() -> Optional[ssl.SSLContext]:
+    """TLS context for https:// masters. DET_MASTER_CERT_FILE pins the CA
+    bundle the server chain must anchor in (reference
+    common/api/certs.py); unset = system roots. Self-signed deploy certs
+    are their own CA, so hostname checking is off and trust comes from
+    the pinned bundle — exactly the reference's cert-pinning posture."""
+    cert_file = os.environ.get("DET_MASTER_CERT_FILE", "")
+    if cert_file:
+        ctx = ssl.create_default_context(cafile=cert_file)
+        ctx.check_hostname = False
+        return ctx
+    return ssl.create_default_context()
 
 
 def salted_hash(username: str, password: str) -> str:
@@ -53,6 +69,9 @@ class Session:
         self.token = token
         self.max_retries = max_retries
         self.timeout = timeout
+        self._ssl_ctx = (
+            _https_context() if self.master_url.startswith("https://") else None
+        )
 
     @classmethod
     def login(cls, master_url: str, user: str = "determined",
@@ -85,7 +104,8 @@ class Session:
         for attempt in range(self.max_retries):
             req = urllib.request.Request(url, data=data, headers=headers, method=method)
             try:
-                with urllib.request.urlopen(req, timeout=timeout or self.timeout) as resp:
+                with urllib.request.urlopen(req, timeout=timeout or self.timeout,
+                                            context=self._ssl_ctx) as resp:
                     text = resp.read().decode()
                     return json.loads(text) if text else None
             except urllib.error.HTTPError as e:
@@ -94,7 +114,12 @@ class Session:
                     last_exc = e
                 else:
                     raise APIError(e.code, body_text, url) from None
+            except ssl.SSLCertVerificationError:
+                raise  # retrying can't make an untrusted cert trusted
             except (urllib.error.URLError, socket.timeout, ConnectionError, OSError) as e:
+                reason = getattr(e, "reason", None)
+                if isinstance(reason, ssl.SSLCertVerificationError):
+                    raise reason from None
                 last_exc = e
             time.sleep(min(2.0 ** attempt * 0.1, 5.0))
         raise ConnectionError(f"master unreachable at {url}: {last_exc}")

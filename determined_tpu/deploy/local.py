@@ -47,6 +47,22 @@ def _load_state() -> Optional[Dict[str, Any]]:
         return None
 
 
+def generate_self_signed_cert(cert_path: str, key_path: str,
+                              host: str = "127.0.0.1") -> None:
+    """Self-signed TLS bootstrap (reference `det deploy` security
+    bootstrap): one openssl invocation, cert doubles as the CA bundle
+    clients pin via DET_MASTER_CERT_FILE."""
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key_path, "-out", cert_path, "-days", "825",
+         "-subj", f"/CN={host}",
+         "-addext", f"subjectAltName=IP:{host}"
+         if host.replace(".", "").isdigit() else
+         f"subjectAltName=DNS:{host}"],
+        check=True, capture_output=True,
+    )
+
+
 def cluster_up(
     port: int = 8080,
     agents: int = 1,
@@ -54,6 +70,7 @@ def cluster_up(
     db_path: Optional[str] = None,
     work_root: Optional[str] = None,
     wait_s: float = 20.0,
+    tls: bool = False,
 ) -> Dict[str, Any]:
     if _load_state() is not None:
         raise RuntimeError("local cluster already running; `det deploy local down` first")
@@ -63,16 +80,33 @@ def cluster_up(
     work_root = work_root or os.path.join(base, "agent-work")
     master_log = os.path.join(base, "master.log")
 
+    master_cmd = [_find_bin("determined-master"), "--port", str(port),
+                  "--db", db_path]
+    cert_path = os.path.join(base, "master-cert.pem")
+    key_path = os.path.join(base, "master-key.pem")
+    if tls:
+        if not (os.path.exists(cert_path) and os.path.exists(key_path)):
+            generate_self_signed_cert(cert_path, key_path)
+        master_cmd += ["--tls-cert", cert_path, "--tls-key", key_path]
+
     master = subprocess.Popen(
-        [_find_bin("determined-master"), "--port", str(port), "--db", db_path],
+        master_cmd,
         stdout=open(master_log, "a"), stderr=subprocess.STDOUT,
         start_new_session=True,
     )
-    url = f"http://127.0.0.1:{port}"
+    scheme = "https" if tls else "http"
+    url = f"{scheme}://127.0.0.1:{port}"
+    ssl_ctx = None
+    if tls:
+        import ssl as ssl_mod
+
+        ssl_ctx = ssl_mod.create_default_context(cafile=cert_path)
+        ssl_ctx.check_hostname = False
     deadline = time.time() + wait_s
     while time.time() < deadline:
         try:
-            urllib.request.urlopen(url + "/api/v1/master", timeout=2)
+            urllib.request.urlopen(url + "/api/v1/master", timeout=2,
+                                   context=ssl_ctx)
             break
         except Exception:
             time.sleep(0.3)
@@ -92,6 +126,10 @@ def cluster_up(
             # Agent service-account bootstrap token minted by the master.
             "--token-file", db_path + ".agent_token",
         ]
+        if tls:
+            cmd += ["--master-cert-file", cert_path]
+            # Spawned trials reach the master through the same pinned CA.
+            env["DET_MASTER_CERT_FILE"] = cert_path
         if slots is not None:
             cmd += ["--slots", str(slots), "--slot-type", "cpu"]
         agent = subprocess.Popen(
@@ -102,7 +140,8 @@ def cluster_up(
         agent_pids.append(agent.pid)
 
     state = {"master_pid": master.pid, "agent_pids": agent_pids,
-             "port": port, "db_path": db_path, "logs": base}
+             "port": port, "db_path": db_path, "logs": base,
+             "tls": tls, "cert": cert_path if tls else None}
     _save_state(state)
     return state
 
@@ -115,9 +154,10 @@ def cluster_down(drain_timeout: float = 20.0) -> bool:
     # them), so killing the daemons alone would orphan running trials/NTSC
     # tasks. Ask the master to kill all active work first and let the agents
     # deliver the kills.
-    url = f"http://127.0.0.1:{state['port']}"
+    scheme = "https" if state.get("tls") else "http"
+    url = f"{scheme}://127.0.0.1:{state['port']}"
     try:
-        _kill_all_work(url, drain_timeout)
+        _kill_all_work(url, drain_timeout, cert=state.get("cert"))
     except Exception:
         pass  # master already dead — nothing to drain
     for pid in state.get("agent_pids", []) + [state.get("master_pid")]:
@@ -130,8 +170,16 @@ def cluster_down(drain_timeout: float = 20.0) -> bool:
     return True
 
 
-def _kill_all_work(url: str, drain_timeout: float) -> None:
+def _kill_all_work(url: str, drain_timeout: float,
+                   cert: Optional[str] = None) -> None:
     import json as jsonlib
+
+    ssl_ctx = None
+    if cert:
+        import ssl as ssl_mod
+
+        ssl_ctx = ssl_mod.create_default_context(cafile=cert)
+        ssl_ctx.check_hostname = False
 
     def api(method: str, path: str, body: Optional[dict] = None,
             token: Optional[str] = None):
@@ -142,7 +190,7 @@ def _kill_all_work(url: str, drain_timeout: float) -> None:
                      **({"Authorization": f"Bearer {token}"} if token else {})},
             method=method,
         )
-        with urllib.request.urlopen(req, timeout=10) as resp:
+        with urllib.request.urlopen(req, timeout=10, context=ssl_ctx) as resp:
             text = resp.read().decode()
             return jsonlib.loads(text) if text else None
 

@@ -54,6 +54,9 @@ struct AgentOptions {
   // Path to the master-minted bootstrap token (<db>.agent_token). The
   // service account is token-only; there is no password fallback.
   std::string token_file;
+  // CA bundle for an https:// master (DET_MASTER_CERT_FILE analogue of
+  // reference certs.py); empty = system roots.
+  std::string master_cert_file;
   int slots_override = -1;  // DET_AGENT_SLOTS / --slots ("artificial")
   std::string slot_type = "auto";
   double poll_timeout_s = 20.0;
@@ -587,6 +590,11 @@ void start_task(const AgentOptions& opts, const Json& action) {
     setenv("DET_WORKDIR", workdir.c_str(), 1);
     setenv("DET_RUN_DIR", workdir.c_str(), 1);
     setenv("PYTHONUNBUFFERED", "1", 1);
+    if (!opts.master_cert_file.empty()) {
+      // Trial processes verify the https master against the same pinned
+      // CA the agent uses (reference: cert propagated into containers).
+      setenv("DET_MASTER_CERT_FILE", opts.master_cert_file.c_str(), 1);
+    }
     // sh wrapper records the exit status to .det_status — that is what
     // lets a RESTARTED agent (which cannot waitpid an orphan) recover the
     // code. The in-container bootstrap (reference entrypoint.sh →
@@ -838,6 +846,9 @@ int main(int argc, char** argv) {
     if (j["addr"].is_string()) opts.addr = j["addr"].as_string();
     if (j["work_root"].is_string()) opts.work_root = j["work_root"].as_string();
     if (j["token_file"].is_string()) opts.token_file = j["token_file"].as_string();
+    if (j["master_cert_file"].is_string()) {
+      opts.master_cert_file = j["master_cert_file"].as_string();
+    }
     if (j["slots"].is_number()) {
       opts.slots_override = static_cast<int>(j["slots"].as_int());
     }
@@ -849,6 +860,9 @@ int main(int argc, char** argv) {
     opts.slots_override = atoi(p);
   }
   if (const char* p = getenv("DET_AGENT_TOKEN_FILE")) opts.token_file = p;
+  if (const char* p = getenv("DET_MASTER_CERT_FILE")) {
+    opts.master_cert_file = p;
+  }
 
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
@@ -863,6 +877,7 @@ int main(int argc, char** argv) {
     else if (a == "--slot-type") opts.slot_type = next();
     else if (a == "--work-root") opts.work_root = next();
     else if (a == "--token-file") opts.token_file = next();
+    else if (a == "--master-cert-file") opts.master_cert_file = next();
     else if (a == "--config") next();
     else if (a == "--help" || a == "-h") {
       std::cout << "determined-agent [--config agent.json] --master-url URL "
@@ -873,6 +888,9 @@ int main(int argc, char** argv) {
     }
   }
   g_token_file = opts.token_file;
+  if (!opts.master_cert_file.empty()) {
+    det::set_https_ca_file(opts.master_cert_file);
+  }
 
   signal(SIGPIPE, SIG_IGN);
 

@@ -13,17 +13,55 @@
 #include <sstream>
 #include <stdexcept>
 
+#include "tls.h"
+
 namespace det {
+
+ssize_t Stream::read(char* buf, size_t n) {
+  if (ssl != nullptr) return tls_read(ssl, buf, n);
+  return ::recv(fd, buf, n, 0);
+}
+
+bool Stream::write_all(const char* data, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t w = ssl != nullptr
+                    ? tls_write(ssl, data + off, n - off)
+                    : ::send(fd, data + off, n - off, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    off += static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool Stream::write_all(const std::string& data) {
+  return write_all(data.data(), data.size());
+}
+
+size_t Stream::pending() const {
+  return ssl != nullptr ? tls_pending(ssl) : 0;
+}
+
+void Stream::close() {
+  if (ssl != nullptr) {
+    tls_free(ssl);
+    ssl = nullptr;
+  }
+  if (fd >= 0) {
+    ::close(fd);
+    fd = -1;
+  }
+}
 
 namespace {
 
 // Read until we have a full request head + body (Content-Length framed).
 // Returns false on EOF / malformed input.
-bool read_request(int fd, HttpRequest* req, std::string* buf) {
+bool read_request(Stream& s, HttpRequest* req, std::string* buf) {
   char chunk[8192];
   size_t head_end = std::string::npos;
   while ((head_end = buf->find("\r\n\r\n")) == std::string::npos) {
-    ssize_t n = ::recv(fd, chunk, sizeof(chunk), 0);
+    ssize_t n = s.read(chunk, sizeof(chunk));
     if (n <= 0) return false;
     buf->append(chunk, static_cast<size_t>(n));
     if (buf->size() > (16u << 20)) return false;  // 16 MiB head guard
@@ -75,22 +113,12 @@ bool read_request(int fd, HttpRequest* req, std::string* buf) {
   if (it != req->headers.end()) content_len = std::stoul(it->second);
   size_t body_start = head_end + 4;
   while (buf->size() < body_start + content_len) {
-    ssize_t n = ::recv(fd, chunk, sizeof(chunk), 0);
+    ssize_t n = s.read(chunk, sizeof(chunk));
     if (n <= 0) return false;
     buf->append(chunk, static_cast<size_t>(n));
   }
   req->body = buf->substr(body_start, content_len);
   buf->erase(0, body_start + content_len);
-  return true;
-}
-
-bool write_all(int fd, const std::string& data) {
-  size_t off = 0;
-  while (off < data.size()) {
-    ssize_t n = ::send(fd, data.data() + off, data.size() - off, MSG_NOSIGNAL);
-    if (n <= 0) return false;
-    off += static_cast<size_t>(n);
-  }
   return true;
 }
 
@@ -130,6 +158,9 @@ std::string url_decode(const std::string& s) {
 }
 
 int HttpServer::listen(const std::string& host, int port, Handler handler) {
+  // Plaintext writes use MSG_NOSIGNAL, but SSL_write is a plain write(2):
+  // a client hanging up mid-response would SIGPIPE the whole process.
+  ::signal(SIGPIPE, SIG_IGN);
   handler_ = std::move(handler);
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) throw std::runtime_error("socket() failed");
@@ -212,14 +243,29 @@ void HttpServer::accept_loop() {
   }
 }
 
+void HttpServer::enable_tls(const std::string& cert_file,
+                            const std::string& key_file) {
+  tls_ctx_ = tls_server_ctx(cert_file, key_file);
+}
+
 void HttpServer::handle_connection(int fd, const std::string& remote) {
   int opt = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &opt, sizeof(opt));
+  Stream s;
+  s.fd = fd;
+  if (tls_ctx_ != nullptr) {
+    s.ssl = tls_accept(static_cast<TlsCtx*>(tls_ctx_), fd);
+    if (s.ssl == nullptr) {
+      // Plaintext (or bad) client on a TLS port: refuse.
+      ::close(fd);
+      return;
+    }
+  }
   std::string buf;
   while (running_) {
     HttpRequest req;
     req.remote_addr = remote;
-    if (!read_request(fd, &req, &buf)) break;
+    if (!read_request(s, &req, &buf)) break;
     HttpResponse resp;
     try {
       resp = handler_(req);
@@ -228,9 +274,10 @@ void HttpServer::handle_connection(int fd, const std::string& remote) {
       resp.body = std::string("{\"error\":\"") + e.what() + "\"}";
     }
     if (resp.hijack) {
-      // Upgrade-style takeover: the hijacker owns the socket from here
-      // (websocket/TCP tunnels). Residual buffered bytes go with it.
-      resp.hijack(fd, std::move(buf));
+      // Upgrade-style takeover: the hijacker owns the connection until
+      // it returns (websocket/TCP tunnels); residual buffered bytes go
+      // with it. The server closes the stream afterwards, as before.
+      resp.hijack(s, std::move(buf));
       break;
     }
     std::ostringstream out;
@@ -240,11 +287,11 @@ void HttpServer::handle_connection(int fd, const std::string& remote) {
         << "\r\nConnection: keep-alive\r\n";
     for (const auto& [k, v] : resp.headers) out << k << ": " << v << "\r\n";
     out << "\r\n" << resp.body;
-    if (!write_all(fd, out.str())) break;
+    if (!s.write_all(out.str())) break;
     auto conn = req.headers.find("connection");
     if (conn != req.headers.end() && conn->second == "close") break;
   }
-  ::close(fd);
+  s.close();
 }
 
 std::string url_encode(const std::string& s, bool keep_slash) {
@@ -297,19 +344,53 @@ int tcp_connect(const std::string& host, int port, double timeout_s) {
   return fd;
 }
 
+namespace {
+
+std::mutex g_ca_mu;
+std::string g_https_ca_file;
+
+TlsCtx* https_client_ctx() {
+  // One context per configured CA file; contexts live for the process.
+  static std::mutex mu;
+  static std::map<std::string, TlsCtx*> cache;
+  std::string ca;
+  {
+    std::lock_guard<std::mutex> lock(g_ca_mu);
+    ca = g_https_ca_file;
+  }
+  std::lock_guard<std::mutex> lock(mu);
+  auto it = cache.find(ca);
+  if (it != cache.end()) return it->second;
+  TlsCtx* ctx = tls_client_ctx(ca);
+  cache[ca] = ctx;
+  return ctx;
+}
+
+}  // namespace
+
+void set_https_ca_file(const std::string& path) {
+  std::lock_guard<std::mutex> lock(g_ca_mu);
+  g_https_ca_file = path;
+}
+
 HttpClientResponse http_request(const std::string& method,
                                 const std::string& url, const std::string& path,
                                 const std::string& body, double timeout_s,
                                 const std::map<std::string, std::string>&
                                     headers) {
-  // Parse "http://host:port".
+  // Parse "http(s)://host:port".
   std::string rest = url;
-  const std::string scheme = "http://";
-  if (rest.rfind(scheme, 0) == 0) rest = rest.substr(scheme.size());
+  bool https = false;
+  if (rest.rfind("https://", 0) == 0) {
+    https = true;
+    rest = rest.substr(8);
+  } else if (rest.rfind("http://", 0) == 0) {
+    rest = rest.substr(7);
+  }
   auto slash = rest.find('/');
   if (slash != std::string::npos) rest = rest.substr(0, slash);
   std::string host = rest;
-  int port = 80;
+  int port = https ? 443 : 80;
   auto colon = rest.rfind(':');
   if (colon != std::string::npos) {
     host = rest.substr(0, colon);
@@ -344,6 +425,17 @@ HttpClientResponse http_request(const std::string& method,
                              std::to_string(port));
   }
 
+  Stream s;
+  s.fd = fd;
+  if (https) {
+    s.ssl = tls_connect(https_client_ctx(), fd, host);
+    if (s.ssl == nullptr) {
+      ::close(fd);
+      throw std::runtime_error("TLS handshake/verification failed: " + host +
+                               ":" + std::to_string(port));
+    }
+  }
+
   std::ostringstream out;
   out << method << ' ' << path << " HTTP/1.1\r\nHost: " << host
       << "\r\nContent-Length: " << body.size()
@@ -353,8 +445,8 @@ HttpClientResponse http_request(const std::string& method,
   }
   for (const auto& [k, v] : headers) out << k << ": " << v << "\r\n";
   out << "\r\n" << body;
-  if (!write_all(fd, out.str())) {
-    ::close(fd);
+  if (!s.write_all(out.str())) {
+    s.close();
     throw std::runtime_error("send failed");
   }
 
@@ -366,9 +458,9 @@ HttpClientResponse http_request(const std::string& method,
   ssize_t n;
   size_t head_end = std::string::npos;
   while ((head_end = resp_buf.find("\r\n\r\n")) == std::string::npos) {
-    n = ::recv(fd, chunk, sizeof(chunk), 0);
+    n = s.read(chunk, sizeof(chunk));
     if (n <= 0) {
-      ::close(fd);
+      s.close();
       throw std::runtime_error("malformed/timeout response head from " + host +
                                path);
     }
@@ -411,10 +503,10 @@ HttpClientResponse http_request(const std::string& method,
     // commonly chunk): read to EOF (we sent Connection: close), then
     // de-frame. The same invariant as below applies: a timeout mid-body
     // must be an error, never a silently partial 200.
-    while ((n = ::recv(fd, chunk, sizeof(chunk), 0)) > 0) {
+    while ((n = s.read(chunk, sizeof(chunk))) > 0) {
       resp_buf.append(chunk, static_cast<size_t>(n));
     }
-    ::close(fd);
+    s.close();
     if (n < 0) {
       throw std::runtime_error("timeout reading chunked body from " + host);
     }
@@ -448,9 +540,9 @@ HttpClientResponse http_request(const std::string& method,
   }
   if (content_len >= 0) {
     while (resp_buf.size() < body_start + static_cast<size_t>(content_len)) {
-      n = ::recv(fd, chunk, sizeof(chunk), 0);
+      n = s.read(chunk, sizeof(chunk));
       if (n <= 0) {
-        ::close(fd);
+        s.close();
         throw std::runtime_error(
             "truncated response body from " + host + path + " (got " +
             std::to_string(resp_buf.size() - body_start) + "/" +
@@ -461,12 +553,12 @@ HttpClientResponse http_request(const std::string& method,
     r.body = resp_buf.substr(body_start, static_cast<size_t>(content_len));
   } else {
     // No Content-Length (Connection: close framing): read to EOF.
-    while ((n = ::recv(fd, chunk, sizeof(chunk), 0)) > 0) {
+    while ((n = s.read(chunk, sizeof(chunk))) > 0) {
       resp_buf.append(chunk, static_cast<size_t>(n));
     }
     r.body = resp_buf.substr(body_start);
   }
-  ::close(fd);
+  s.close();
   return r;
 }
 

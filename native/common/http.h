@@ -35,6 +35,22 @@ struct HttpRequest {
   }
 };
 
+// A connection that is either a raw TCP fd or a TLS session over one —
+// every server/client byte goes through here so HTTPS covers the whole
+// surface, hijacked tunnels included.
+struct Stream {
+  int fd = -1;
+  void* ssl = nullptr;  // SSL* when the connection is TLS
+
+  ssize_t read(char* buf, size_t n);
+  bool write_all(const std::string& data);
+  bool write_all(const char* data, size_t n);
+  // TLS buffers whole records: bytes can be pending inside the SSL layer
+  // with nothing readable on the fd — poll()-based pumps must drain this.
+  size_t pending() const;
+  void close();
+};
+
 struct HttpResponse {
   int status = 200;
   std::string content_type = "application/json";
@@ -42,11 +58,11 @@ struct HttpResponse {
   std::map<std::string, std::string> headers;
 
   // Connection hijack (reference master/internal/proxy/{ws,tcp}.go): when
-  // set, the server does NOT write a response; it hands the raw socket fd
-  // plus any bytes already buffered past the request (pipelined client
-  // data, e.g. eager websocket frames) to this function, which owns the
-  // connection until it returns (the server closes the fd afterwards).
-  std::function<void(int fd, std::string&& residual)> hijack;
+  // set, the server does NOT write a response; it hands the connection
+  // stream plus any bytes already buffered past the request (pipelined
+  // client data, e.g. eager websocket frames) to this function, which
+  // owns the connection until it returns (the server closes it after).
+  std::function<void(Stream s, std::string&& residual)> hijack;
 
   static HttpResponse json(int status, const std::string& body) {
     HttpResponse r;
@@ -62,6 +78,11 @@ class HttpServer {
 
   HttpServer() = default;
   ~HttpServer() { stop(); }
+
+  // Serve HTTPS: load cert/key (PEM) before listen(). Throws when the
+  // files are unloadable or libssl is unavailable.
+  void enable_tls(const std::string& cert_file, const std::string& key_file);
+  bool tls_enabled() const { return tls_ctx_ != nullptr; }
 
   // Binds and listens; returns the bound port (useful with port=0).
   // Throws std::runtime_error on bind failure.
@@ -88,6 +109,7 @@ class HttpServer {
   // Atomic: stop() tears the fd down while accept_loop() reads it.
   std::atomic<int> listen_fd_{-1};
   int port_ = 0;
+  void* tls_ctx_ = nullptr;  // det::TlsCtx* when serving HTTPS
   Handler handler_;
   std::atomic<bool> running_{false};
   std::thread accept_thread_;
@@ -103,7 +125,15 @@ struct HttpClientResponse {
   bool ok() const { return status >= 200 && status < 300; }
 };
 
-// url like "http://127.0.0.1:8080"; path like "/api/v1/...".
+// CA bundle https:// clients verify against (empty = system defaults).
+// Process-wide: the master/agent/CLI each talk to ONE cluster; set once
+// at startup (DET_MASTER_CERT_FILE analogue of the reference's
+// certs.py).
+void set_https_ca_file(const std::string& path);
+
+// url like "http://127.0.0.1:8080" (or https://...); path like
+// "/api/v1/...". HTTPS connections verify the server chain against
+// set_https_ca_file (or system roots) and fail on mismatch.
 // timeout_s <= 0 means no timeout. Throws std::runtime_error on transport
 // errors (connect/read failure), not on HTTP error statuses.
 HttpClientResponse http_request(const std::string& method,

@@ -1,0 +1,217 @@
+#include "tls.h"
+
+#include <dlfcn.h>
+
+#include <cstddef>
+#include <iostream>
+#include <mutex>
+#include <stdexcept>
+
+namespace det {
+
+namespace {
+
+// ---- hand-declared OpenSSL 3 ABI (no dev headers in the image) ----------
+using SSL_CTX = void;
+using SSL = void;
+using SSL_METHOD = void;
+
+constexpr int kFiletypePem = 1;        // SSL_FILETYPE_PEM
+constexpr int kVerifyPeer = 1;         // SSL_VERIFY_PEER
+constexpr long kCtrlSetTlsextHostname = 55;  // SSL_CTRL_SET_TLSEXT_HOSTNAME
+constexpr int kTlsextNametypeHostName = 0;   // TLSEXT_NAMETYPE_host_name
+
+struct Api {
+  const SSL_METHOD* (*TLS_server_method)();
+  const SSL_METHOD* (*TLS_client_method)();
+  SSL_CTX* (*SSL_CTX_new)(const SSL_METHOD*);
+  int (*SSL_CTX_use_certificate_chain_file)(SSL_CTX*, const char*);
+  int (*SSL_CTX_use_PrivateKey_file)(SSL_CTX*, const char*, int);
+  int (*SSL_CTX_check_private_key)(const SSL_CTX*);
+  int (*SSL_CTX_load_verify_locations)(SSL_CTX*, const char*, const char*);
+  int (*SSL_CTX_set_default_verify_paths)(SSL_CTX*);
+  void (*SSL_CTX_set_verify)(SSL_CTX*, int, void*);
+  SSL* (*SSL_new)(SSL_CTX*);
+  int (*SSL_set_fd)(SSL*, int);
+  int (*SSL_accept)(SSL*);
+  int (*SSL_connect)(SSL*);
+  int (*SSL_read)(SSL*, void*, int);
+  int (*SSL_write)(SSL*, const void*, int);
+  int (*SSL_pending)(const SSL*);
+  int (*SSL_shutdown)(SSL*);
+  void (*SSL_free)(SSL*);
+  long (*SSL_ctrl)(SSL*, int, long, void*);
+  int (*SSL_set1_host)(SSL*, const char*);
+  bool ok = false;
+};
+
+Api load_api() {
+  Api a{};
+  void* h = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
+  if (h == nullptr) h = dlopen("libssl.so", RTLD_NOW | RTLD_GLOBAL);
+  if (h == nullptr) return a;
+  auto sym = [h](const char* name) { return dlsym(h, name); };
+  a.TLS_server_method = reinterpret_cast<const SSL_METHOD* (*)()>(
+      sym("TLS_server_method"));
+  a.TLS_client_method = reinterpret_cast<const SSL_METHOD* (*)()>(
+      sym("TLS_client_method"));
+  a.SSL_CTX_new =
+      reinterpret_cast<SSL_CTX* (*)(const SSL_METHOD*)>(sym("SSL_CTX_new"));
+  a.SSL_CTX_use_certificate_chain_file =
+      reinterpret_cast<int (*)(SSL_CTX*, const char*)>(
+          sym("SSL_CTX_use_certificate_chain_file"));
+  a.SSL_CTX_use_PrivateKey_file =
+      reinterpret_cast<int (*)(SSL_CTX*, const char*, int)>(
+          sym("SSL_CTX_use_PrivateKey_file"));
+  a.SSL_CTX_check_private_key = reinterpret_cast<int (*)(const SSL_CTX*)>(
+      sym("SSL_CTX_check_private_key"));
+  a.SSL_CTX_load_verify_locations =
+      reinterpret_cast<int (*)(SSL_CTX*, const char*, const char*)>(
+          sym("SSL_CTX_load_verify_locations"));
+  a.SSL_CTX_set_default_verify_paths = reinterpret_cast<int (*)(SSL_CTX*)>(
+      sym("SSL_CTX_set_default_verify_paths"));
+  a.SSL_CTX_set_verify = reinterpret_cast<void (*)(SSL_CTX*, int, void*)>(
+      sym("SSL_CTX_set_verify"));
+  a.SSL_new = reinterpret_cast<SSL* (*)(SSL_CTX*)>(sym("SSL_new"));
+  a.SSL_set_fd = reinterpret_cast<int (*)(SSL*, int)>(sym("SSL_set_fd"));
+  a.SSL_accept = reinterpret_cast<int (*)(SSL*)>(sym("SSL_accept"));
+  a.SSL_connect = reinterpret_cast<int (*)(SSL*)>(sym("SSL_connect"));
+  a.SSL_read = reinterpret_cast<int (*)(SSL*, void*, int)>(sym("SSL_read"));
+  a.SSL_write =
+      reinterpret_cast<int (*)(SSL*, const void*, int)>(sym("SSL_write"));
+  a.SSL_pending =
+      reinterpret_cast<int (*)(const SSL*)>(sym("SSL_pending"));
+  a.SSL_shutdown = reinterpret_cast<int (*)(SSL*)>(sym("SSL_shutdown"));
+  a.SSL_free = reinterpret_cast<void (*)(SSL*)>(sym("SSL_free"));
+  a.SSL_ctrl =
+      reinterpret_cast<long (*)(SSL*, int, long, void*)>(sym("SSL_ctrl"));
+  a.SSL_set1_host =
+      reinterpret_cast<int (*)(SSL*, const char*)>(sym("SSL_set1_host"));
+  a.ok = a.TLS_server_method != nullptr && a.TLS_client_method != nullptr &&
+         a.SSL_CTX_new != nullptr && a.SSL_new != nullptr &&
+         a.SSL_read != nullptr && a.SSL_write != nullptr;
+  return a;
+}
+
+Api& api() {
+  static Api a = load_api();
+  return a;
+}
+
+}  // namespace
+
+struct TlsCtx {
+  SSL_CTX* ctx = nullptr;
+  // Pinned-CA contexts (explicit ca_file, typically a self-signed cert
+  // that IS the server's identity) skip hostname matching — trust is the
+  // pin. System-root contexts must hostname-match, or any valid cert for
+  // any name would pass.
+  bool pinned = false;
+};
+
+bool tls_available() { return api().ok; }
+
+TlsCtx* tls_server_ctx(const std::string& cert_file,
+                       const std::string& key_file) {
+  Api& a = api();
+  if (!a.ok) throw std::runtime_error("libssl.so.3 not available");
+  SSL_CTX* ctx = a.SSL_CTX_new(a.TLS_server_method());
+  if (ctx == nullptr) throw std::runtime_error("SSL_CTX_new failed");
+  if (a.SSL_CTX_use_certificate_chain_file(ctx, cert_file.c_str()) != 1) {
+    throw std::runtime_error("cannot load TLS cert: " + cert_file);
+  }
+  if (a.SSL_CTX_use_PrivateKey_file(ctx, key_file.c_str(), kFiletypePem) !=
+      1) {
+    throw std::runtime_error("cannot load TLS key: " + key_file);
+  }
+  if (a.SSL_CTX_check_private_key != nullptr &&
+      a.SSL_CTX_check_private_key(ctx) != 1) {
+    throw std::runtime_error("TLS key does not match cert");
+  }
+  auto* out = new TlsCtx();
+  out->ctx = ctx;
+  return out;
+}
+
+TlsCtx* tls_client_ctx(const std::string& ca_file) {
+  Api& a = api();
+  if (!a.ok) throw std::runtime_error("libssl.so.3 not available");
+  SSL_CTX* ctx = a.SSL_CTX_new(a.TLS_client_method());
+  if (ctx == nullptr) throw std::runtime_error("SSL_CTX_new failed");
+  bool pinned = !ca_file.empty();
+  if (pinned) {
+    if (a.SSL_CTX_load_verify_locations(ctx, ca_file.c_str(), nullptr) != 1) {
+      throw std::runtime_error("cannot load CA bundle: " + ca_file);
+    }
+  } else if (a.SSL_CTX_set_default_verify_paths != nullptr) {
+    a.SSL_CTX_set_default_verify_paths(ctx);
+  }
+  // Verification is enforced at handshake time: a peer whose chain does
+  // not anchor in the configured CA fails SSL_connect.
+  a.SSL_CTX_set_verify(ctx, kVerifyPeer, nullptr);
+  auto* out = new TlsCtx();
+  out->ctx = ctx;
+  out->pinned = pinned;
+  return out;
+}
+
+void* tls_accept(TlsCtx* ctx, int fd) {
+  Api& a = api();
+  SSL* ssl = a.SSL_new(ctx->ctx);
+  if (ssl == nullptr) return nullptr;
+  a.SSL_set_fd(ssl, fd);
+  if (a.SSL_accept(ssl) != 1) {
+    a.SSL_free(ssl);
+    return nullptr;
+  }
+  return ssl;
+}
+
+void* tls_connect(TlsCtx* ctx, int fd, const std::string& sni_host) {
+  Api& a = api();
+  SSL* ssl = a.SSL_new(ctx->ctx);
+  if (ssl == nullptr) return nullptr;
+  a.SSL_set_fd(ssl, fd);
+  if (!sni_host.empty() && a.SSL_ctrl != nullptr) {
+    a.SSL_ctrl(ssl, kCtrlSetTlsextHostname, kTlsextNametypeHostName,
+               const_cast<char*>(sni_host.c_str()));
+  }
+  // System-root trust requires hostname matching: without it any valid
+  // certificate for ANY name passes and a MITM can impersonate the
+  // master. Pinned-CA contexts skip it (the pin is the trust anchor —
+  // deploy self-signed certs often carry only an IP SAN).
+  if (!ctx->pinned && !sni_host.empty()) {
+    if (a.SSL_set1_host == nullptr ||
+        a.SSL_set1_host(ssl, sni_host.c_str()) != 1) {
+      a.SSL_free(ssl);
+      return nullptr;
+    }
+  }
+  if (a.SSL_connect(ssl) != 1) {
+    a.SSL_free(ssl);
+    return nullptr;
+  }
+  return ssl;
+}
+
+ssize_t tls_read(void* ssl, char* buf, size_t n) {
+  return api().SSL_read(static_cast<SSL*>(ssl), buf, static_cast<int>(n));
+}
+
+ssize_t tls_write(void* ssl, const char* buf, size_t n) {
+  return api().SSL_write(static_cast<SSL*>(ssl), buf, static_cast<int>(n));
+}
+
+size_t tls_pending(void* ssl) {
+  if (api().SSL_pending == nullptr) return 0;
+  int n = api().SSL_pending(static_cast<SSL*>(ssl));
+  return n > 0 ? static_cast<size_t>(n) : 0;
+}
+
+void tls_free(void* ssl) {
+  if (ssl == nullptr) return;
+  api().SSL_shutdown(static_cast<SSL*>(ssl));
+  api().SSL_free(static_cast<SSL*>(ssl));
+}
+
+}  // namespace det

@@ -1,0 +1,41 @@
+// Minimal TLS layer over the system's libssl.so.3, loaded at runtime.
+//
+// The image ships the OpenSSL 3 RUNTIME libraries but no development
+// headers, so the needed entry points (a stable C ABI) are declared by
+// hand and resolved with dlopen/dlsym. Reference parity:
+// master TLS + cert verification (reference
+// harness/determined/common/api/certs.py, agent/internal/options TLS
+// options); here the master serves HTTPS, and the agent/CLI/harness
+// verify against a configured CA bundle.
+
+#pragma once
+
+#include <string>
+
+namespace det {
+
+// True when libssl.so.3 could be loaded; all other calls throw/fail when
+// it couldn't.
+bool tls_available();
+
+struct TlsCtx;  // opaque (wraps SSL_CTX)
+
+// Server context serving cert_file/key_file (PEM). Throws on error.
+TlsCtx* tls_server_ctx(const std::string& cert_file,
+                       const std::string& key_file);
+
+// Client context verifying peers against ca_file (PEM bundle), or the
+// system default paths when empty. Throws on error.
+TlsCtx* tls_client_ctx(const std::string& ca_file);
+
+// Wrap an accepted/connected TCP fd. Returns an SSL* handle, or nullptr
+// when the handshake fails (caller still owns/closes the fd).
+void* tls_accept(TlsCtx* ctx, int fd);
+void* tls_connect(TlsCtx* ctx, int fd, const std::string& sni_host);
+
+ssize_t tls_read(void* ssl, char* buf, size_t n);   // <=0 on EOF/error
+ssize_t tls_write(void* ssl, const char* buf, size_t n);
+size_t tls_pending(void* ssl);  // bytes buffered inside the SSL layer
+void tls_free(void* ssl);  // shutdown + free (does NOT close the fd)
+
+}  // namespace det

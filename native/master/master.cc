@@ -105,6 +105,8 @@ MasterConfig MasterConfig::from_json(const Json& j) {
   if (j["advertised_url"].is_string()) {
     c.advertised_url = j["advertised_url"].as_string();
   }
+  c.tls_cert_file = j["tls_cert_file"].as_string("");
+  c.tls_key_file = j["tls_key_file"].as_string("");
   const Json& k8s = j["kubernetes"];
   if (k8s.is_object()) {
     c.k8s.api_url = k8s["api_url"].as_string(c.k8s.api_url);
@@ -282,6 +284,17 @@ double Master::now() const {
 }
 
 int Master::start() {
+  if (cfg_.tls_cert_file.empty() != cfg_.tls_key_file.empty()) {
+    // Half-set pair = operator error; silently serving plaintext while
+    // they believe TLS is on would be far worse than refusing to boot.
+    throw std::runtime_error(
+        "tls_cert_file and tls_key_file must be set together");
+  }
+  if (!cfg_.tls_cert_file.empty()) {
+    server_.enable_tls(cfg_.tls_cert_file, cfg_.tls_key_file);
+    std::cerr << "master: serving HTTPS (cert " << cfg_.tls_cert_file << ")"
+              << std::endl;
+  }
   int port = server_.listen(cfg_.host, cfg_.port,
                             [this](const HttpRequest& r) { return handle(r); });
   running_ = true;

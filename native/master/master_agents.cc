@@ -654,8 +654,9 @@ Json Master::build_task_env_locked(Allocation& alloc,
   env["DET_MASTER"] =
       !cfg_.advertised_url.empty()
           ? cfg_.advertised_url
-          : "http://" + (cfg_.host == "0.0.0.0" ? "127.0.0.1" : cfg_.host) +
-                ":" + std::to_string(server_.port());
+          : std::string(server_.tls_enabled() ? "https://" : "http://") +
+                (cfg_.host == "0.0.0.0" ? "127.0.0.1" : cfg_.host) + ":" +
+                std::to_string(server_.port());
   env["DET_CLUSTER_ID"] = cfg_.cluster_id;
   env["DET_AGENT_ID"] = node_id;
   env["DET_TASK_ID"] = alloc.task_id;

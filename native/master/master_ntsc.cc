@@ -208,49 +208,61 @@ HttpResponse Master::handle_runs(const HttpRequest& req,
 // select()-based bidirectional pump (reference proxy/ws.go copyBytes /
 // tcp.go): forwards until either side closes or the master stops. Keeps
 // the task's idle clock fresh while bytes flow.
-void Master::tunnel_pump(int client_fd, int target_fd,
+void Master::tunnel_pump(Stream client, int target_fd,
                          const std::string& task_id) {
   char buf[16384];
   bool client_open = true, target_open = true;
   double last_touch = 0;
   while (tunnels_run_ && (client_open || target_open)) {
-    // poll(), not select(): with a thread per connection the master can
-    // legitimately hold >1024 fds, where FD_SET would write out of bounds.
+    // TLS buffers whole records: client bytes can sit inside the SSL
+    // layer with nothing readable on the fd, so poll() alone would hang.
+    bool client_buffered = client_open && client.pending() > 0;
+    int rc = 0;
     pollfd fds[2] = {};
-    fds[0].fd = client_fd;
+    fds[0].fd = client.fd;
     fds[0].events = client_open ? POLLIN : 0;
     fds[1].fd = target_fd;
     fds[1].events = target_open ? POLLIN : 0;
-    int rc = poll(fds, 2, 500 /* ms; wake to observe tunnels_run_ */);
-    if (rc < 0) break;
-    if (rc == 0) continue;
+    if (!client_buffered) {
+      // poll(), not select(): with a thread per connection the master can
+      // legitimately hold >1024 fds, where FD_SET would write OOB.
+      rc = poll(fds, 2, 500 /* ms; wake to observe tunnels_run_ */);
+      if (rc < 0) break;
+      if (rc == 0) continue;
+    }
     bool moved = false;
-    auto readable = [&](int fd) {
+    auto revents = [&](int fd) {
       for (const auto& p : fds) {
         if (p.fd == fd) return (p.revents & (POLLIN | POLLHUP | POLLERR)) != 0;
       }
       return false;
     };
-    auto pump_one = [&](int from, int to, bool* from_open) {
-      if (!*from_open || !readable(from)) return true;
-      ssize_t n = recv(from, buf, sizeof(buf), 0);
+    // client → target
+    if (client_open && (client_buffered || revents(client.fd))) {
+      ssize_t n = client.read(buf, sizeof(buf));
       if (n <= 0) {
-        *from_open = false;
-        shutdown(to, SHUT_WR);  // propagate half-close
-        return true;
+        client_open = false;
+        shutdown(target_fd, SHUT_WR);  // propagate half-close
+      } else {
+        moved = true;
+        Stream target{target_fd, nullptr};
+        if (!target.write_all(buf, static_cast<size_t>(n))) break;
       }
-      moved = true;
-      size_t off = 0;
-      while (off < static_cast<size_t>(n)) {
-        ssize_t w = send(to, buf + off, static_cast<size_t>(n) - off,
-                         MSG_NOSIGNAL);
-        if (w <= 0) return false;
-        off += static_cast<size_t>(w);
+    }
+    // target → client
+    if (target_open && revents(target_fd)) {
+      ssize_t n = recv(target_fd, buf, sizeof(buf), 0);
+      if (n <= 0) {
+        target_open = false;
+        if (client.ssl == nullptr) shutdown(client.fd, SHUT_WR);
+        // TLS has no half-close that keeps reads alive; rely on the
+        // client-side read returning 0 when we close after the loop.
+        if (client.ssl != nullptr) break;
+      } else {
+        moved = true;
+        if (!client.write_all(buf, static_cast<size_t>(n))) break;
       }
-      return true;
-    };
-    if (!pump_one(client_fd, target_fd, &client_open)) break;
-    if (!pump_one(target_fd, client_fd, &target_open)) break;
+    }
     if (moved) {
       double t = now();
       if (t - last_touch > 2.0) {  // throttle mu_ takes
@@ -382,20 +394,19 @@ HttpResponse Master::handle_proxy(const HttpRequest& req,
     // pseudo-upgrade itself, then pumps bytes to the task's port.
     HttpResponse r;
     r.hijack = [this, t_host, t_port, task_id, proxy_secret](
-                   int fd, std::string&& residual) {
+                   Stream s, std::string&& residual) {
       int target_fd = -1;
       try {
         target_fd = tcp_connect(t_host, t_port, 10.0);
       } catch (const std::exception& e) {
-        std::string err = std::string("HTTP/1.1 502 Bad Gateway\r\n"
-                                      "Content-Length: 0\r\n\r\n");
-        send(fd, err.data(), err.size(), MSG_NOSIGNAL);
+        s.write_all(std::string("HTTP/1.1 502 Bad Gateway\r\n"
+                                "Content-Length: 0\r\n\r\n"));
         return;
       }
       const char ok[] =
           "HTTP/1.1 101 Switching Protocols\r\n"
           "Upgrade: det-tcp\r\nConnection: Upgrade\r\n\r\n";
-      send(fd, ok, sizeof(ok) - 1, MSG_NOSIGNAL);
+      s.write_all(ok, sizeof(ok) - 1);
       // Authenticating handshake: the task-side TCP server only serves
       // connections that lead with the allocation's secret, so reaching
       // it requires coming through this (authz-gated) tunnel. Only the
@@ -408,7 +419,7 @@ HttpResponse Master::handle_proxy(const HttpRequest& req,
       if (!residual.empty()) {
         send(target_fd, residual.data(), residual.size(), MSG_NOSIGNAL);
       }
-      tunnel_pump(fd, target_fd, task_id);
+      tunnel_pump(s, target_fd, task_id);
     };
     return r;
   }
@@ -430,14 +441,13 @@ HttpResponse Master::handle_proxy(const HttpRequest& req,
     std::string head_str = head.str();
     HttpResponse r;
     r.hijack = [this, t_host, t_port, task_id, head_str](
-                   int fd, std::string&& residual) {
+                   Stream s, std::string&& residual) {
       int target_fd = -1;
       try {
         target_fd = tcp_connect(t_host, t_port, 10.0);
       } catch (const std::exception&) {
-        std::string err = std::string("HTTP/1.1 502 Bad Gateway\r\n"
-                                      "Content-Length: 0\r\n\r\n");
-        send(fd, err.data(), err.size(), MSG_NOSIGNAL);
+        s.write_all(std::string("HTTP/1.1 502 Bad Gateway\r\n"
+                                "Content-Length: 0\r\n\r\n"));
         return;
       }
       bool sent = send(target_fd, head_str.data(), head_str.size(),
@@ -446,7 +456,7 @@ HttpResponse Master::handle_proxy(const HttpRequest& req,
         send(target_fd, residual.data(), residual.size(), MSG_NOSIGNAL);
       }
       if (sent) {
-        tunnel_pump(fd, target_fd, task_id);  // closes target_fd
+        tunnel_pump(s, target_fd, task_id);  // closes target_fd
       } else {
         close(target_fd);
       }
