@@ -11,6 +11,7 @@ JAX_PLATFORMS=cpu; BENCH_ASHA_DEBUG=1 prints progress."""
 
 import json
 import os
+import statistics
 import subprocess
 import sys
 import tempfile
@@ -18,6 +19,110 @@ import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
+
+
+def _wait_experiment(cluster, token, eid, timeout=900):
+    deadline = time.time() + timeout
+    state = None
+    while time.time() < deadline:
+        e = cluster.api("GET", f"/api/v1/experiments/{eid}",
+                        token=token)["experiment"]
+        state = e["state"]
+        if state in ("COMPLETED", "ERROR", "CANCELED"):
+            break
+        if os.environ.get("BENCH_ASHA_DEBUG"):
+            print(f"  exp {eid}: state={state} progress={e.get('progress')}",
+                  file=sys.stderr)
+        time.sleep(1.0)
+    if state != "COMPLETED":
+        raise RuntimeError(f"experiment {eid} finished {state}")
+
+
+def run_compile_reuse(cluster, token, tmp) -> dict:
+    """Compile-bound trials (real jitted GPT-2 step), cache off vs on:
+    the persistent XLA compilation cache (agent-injected DET_XLA_CACHE_DIR)
+    lets identical-shape rung trials skip compile — the dominant cost of
+    short ASHA trials (SURVEY hard part b)."""
+    import determined_tpu.cli as cli
+
+    model_def = cli._tar_context(
+        os.path.join(REPO, "tests", "fixtures", "platform"))
+
+    def launch(cache_on: bool) -> dict:
+        config = {
+            "name": f"bench-asha-jit-{'cache' if cache_on else 'nocache'}",
+            "entrypoint": "python3 train_jit.py",
+            "searcher": {
+                "name": "random",
+                "metric": "val_loss",
+                "smaller_is_better": True,
+                "max_length": {"batches": 4},
+                "max_trials": 6,
+                "max_concurrent_trials": 2,
+            },
+            "hyperparameters": {
+                "lr": {"type": "log", "minval": -4, "maxval": -2},
+            },
+            "checkpoint_storage": {"type": "shared_fs",
+                                   "host_path": os.path.join(tmp, "ckpts")},
+            "resources": {"slots_per_trial": 1},
+            "max_restarts": 0,
+        }
+        if not cache_on:
+            # Empty override disables the agent-injected cache dir.
+            config["environment"] = {
+                "environment_variables": ["DET_XLA_CACHE_DIR="]}
+        t0 = time.time()
+        eid = cluster.api(
+            "POST", "/api/v1/experiments",
+            {"config": config, "model_definition": model_def,
+             "activate": True}, token=token)["id"]
+        _wait_experiment(cluster, token, eid)
+        wall = time.time() - t0
+        trials = cluster.api("GET", f"/api/v1/experiments/{eid}/trials",
+                             token=token)["trials"]
+        walls, compiles = [], []
+        for t in trials:
+            for m in cluster.api(
+                    "GET", f"/api/v1/trials/{t['id']}/metrics",
+                    token=token)["metrics"]:
+                if m["group_name"] == "validation":
+                    mm = m["metrics"]
+                    if "trial_wall_s" in mm:
+                        walls.append(float(mm["trial_wall_s"]))
+                        compiles.append(float(mm.get("compile_s", 0)))
+        return {"wall_s": wall, "n_trials": len(trials),
+                "trials_per_hour": len(trials) / wall * 3600,
+                "trial_walls": sorted(walls),
+                "compile_s": sorted(compiles)}
+
+    nocache = launch(cache_on=False)
+    cached = launch(cache_on=True)
+    # Warm trials = all but the cold compiles of the first wave; the
+    # median of the cached run vs the nocache median is the per-trial
+    # reuse factor (robust to the cold outliers).
+    per_trial = (statistics.median(nocache["trial_walls"]) /
+                 statistics.median(cached["trial_walls"])
+                 if cached["trial_walls"] and nocache["trial_walls"] else 0)
+    return {
+        "nocache_trials_per_hour": round(nocache["trials_per_hour"], 1),
+        "cached_trials_per_hour": round(cached["trials_per_hour"], 1),
+        "wall_speedup": round(cached["trials_per_hour"] /
+                              nocache["trials_per_hour"], 2),
+        "per_trial_speedup": round(per_trial, 2),
+        "nocache_median_trial_s": round(
+            statistics.median(nocache["trial_walls"]), 1)
+        if nocache["trial_walls"] else None,
+        "cached_median_trial_s": round(
+            statistics.median(cached["trial_walls"]), 1)
+        if cached["trial_walls"] else None,
+        "nocache_median_compile_s": round(
+            statistics.median(nocache["compile_s"]), 1)
+        if nocache["compile_s"] else None,
+        "cached_median_compile_s": round(
+            statistics.median(cached["compile_s"]), 1)
+        if cached["compile_s"] else None,
+    }
 
 
 def run() -> dict:
@@ -66,24 +171,12 @@ def run() -> dict:
             "POST", "/api/v1/experiments",
             {"config": config, "model_definition": model_def,
              "activate": True}, token=token)["id"]
-        deadline = time.time() + 900
-        state = None
-        while time.time() < deadline:
-            e = cluster.api("GET", f"/api/v1/experiments/{eid}",
-                            token=token)["experiment"]
-            state = e["state"]
-            if state in ("COMPLETED", "ERROR", "CANCELED"):
-                break
-            if os.environ.get("BENCH_ASHA_DEBUG"):
-                print(f"  state={state} progress={e.get('progress')}",
-                      file=sys.stderr)
-            time.sleep(1.0)
+        _wait_experiment(cluster, token, eid)
         elapsed = time.time() - t0
-        if state != "COMPLETED":
-            raise RuntimeError(f"asha experiment finished {state}")
         trials = cluster.api("GET", f"/api/v1/experiments/{eid}/trials",
                              token=token)["trials"]
         trials_per_hour = len(trials) / elapsed * 3600
+        compile_reuse = run_compile_reuse(cluster, token, tmp)
         return {
             "metric": "asha_trials_per_hour",
             "value": round(trials_per_hour, 1),
@@ -93,6 +186,10 @@ def run() -> dict:
                 "trials": len(trials),
                 "wall_seconds": round(elapsed, 1),
                 "max_concurrent": 8,
+                # Persistent XLA compilation cache (agent-injected
+                # DET_XLA_CACHE_DIR): compile-bound trials with cache
+                # off vs on.
+                "compile_reuse": compile_reuse,
             },
         }
     finally:

@@ -116,7 +116,9 @@ data:
         "image": "{task_image}",
         "slots_per_pod": {slots_per_pod},
         "max_pods": {max_pods},
-        "service_subdomain": "{subdomain}"
+        "service_subdomain": "{subdomain}",
+        "accelerator_type": "{accelerator}",
+        "topology": "{topology}"
       }}
     }}
 ---
@@ -201,7 +203,8 @@ def generate(
         "master.yaml": MASTER_YAML.format(
             namespace=namespace, cluster=cluster, task_image=task_image,
             master_image=master_image, slots_per_pod=slots_per_pod,
-            max_pods=max_pods, subdomain=subdomain),
+            max_pods=max_pods, subdomain=subdomain,
+            accelerator="tpu-v5-lite-podslice", topology=topology),
     }
     for name, content in files.items():
         with open(os.path.join(target_dir, name), "w") as f:

@@ -595,6 +595,12 @@ void start_task(const AgentOptions& opts, const Json& action) {
       // CA the agent uses (reference: cert propagated into containers).
       setenv("DET_MASTER_CERT_FILE", opts.master_cert_file.c_str(), 1);
     }
+    // Host-local persistent XLA compilation cache, shared across every
+    // trial this agent runs: identical-shape ASHA rung trials skip the
+    // retrace+compile that otherwise dominates short trials.
+    // overwrite=0: an expconf environment_variables override wins.
+    std::string xla_cache = opts.work_root + "/xla_cache";
+    setenv("DET_XLA_CACHE_DIR", xla_cache.c_str(), 0);
     // sh wrapper records the exit status to .det_status — that is what
     // lets a RESTARTED agent (which cannot waitpid an orphan) recover the
     // code. The in-container bootstrap (reference entrypoint.sh →

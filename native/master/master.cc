@@ -118,6 +118,8 @@ MasterConfig MasterConfig::from_json(const Json& j) {
     c.k8s.bearer_token = k8s["bearer_token"].as_string("");
     c.k8s.service_subdomain =
         k8s["service_subdomain"].as_string(c.k8s.service_subdomain);
+    c.k8s.accelerator_type = k8s["accelerator_type"].as_string("");
+    c.k8s.topology = k8s["topology"].as_string("");
     for (const auto& pool : k8s["pools"].as_array()) {
       if (pool.is_string()) c.k8s.pools.push_back(pool.as_string());
     }

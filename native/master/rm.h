@@ -126,6 +126,14 @@ struct KubernetesRmConfig {
   int slots_per_pod = 4;          // TPU chips per pod (node-pool shape)
   int max_pods = 64;              // capacity ceiling for scaling math
   std::string bearer_token;       // service-account token ("" = none)
+  // GKE TPU placement (reference rm/kubernetesrm/spec.go:106-126 node
+  // affinity): when set, task pods carry
+  // cloud.google.com/gke-tpu-accelerator + gke-tpu-topology
+  // nodeSelectors so a mixed-node-pool cluster can't land them on the
+  // wrong shape; multi-node allocations add a same-node-pool affinity
+  // hint so their pods share an ICI domain.
+  std::string accelerator_type;   // e.g. "tpu-v5-lite-podslice"
+  std::string topology;           // e.g. "2x4"
   // Headless-service subdomain for pod DNS: pods get spec.hostname +
   // spec.subdomain so <pod>.<subdomain>.<ns>.svc resolves (the deploy
   // tooling creates the matching clusterIP:None Service).

@@ -88,6 +88,10 @@ Json KubernetesResourceManager::pod_manifest(
   std::string chief = pod_name(alloc.id, 0) + "." + cfg_.service_subdomain;
   Json env_obj =
       hooks_.build_task_env(alloc, name, slot_ids, rank, num_nodes, chief);
+  // Node-local persistent XLA compilation cache, like the agent RM's
+  // work_root/xla_cache: pods are ephemeral, so the reuse lives in a
+  // hostPath shared by every det pod that lands on the node.
+  env_obj["DET_XLA_CACHE_DIR"] = "/det-xla-cache";
   Json env = Json::array();
   for (const auto& [k, v] : env_obj.as_object()) {
     Json e = Json::object();
@@ -100,6 +104,14 @@ Json KubernetesResourceManager::pod_manifest(
   container["name"] = "task";
   container["image"] = cfg_.image;
   container["env"] = env;
+  {
+    Json mount = Json::object();
+    mount["name"] = "det-xla-cache";
+    mount["mountPath"] = "/det-xla-cache";
+    Json mounts = Json::array();
+    mounts.push_back(mount);
+    container["volumeMounts"] = mounts;
+  }
   Json cmd = Json::array();
   for (const char* c : {"python3", "-m", "determined_tpu.exec.launch"}) {
     cmd.push_back(Json(c));
@@ -128,6 +140,53 @@ Json KubernetesResourceManager::pod_manifest(
   spec["restartPolicy"] = "Never";
   spec["hostname"] = name;
   spec["subdomain"] = cfg_.service_subdomain;
+  {
+    Json host_path = Json::object();
+    host_path["path"] = "/var/determined/xla-cache";
+    host_path["type"] = "DirectoryOrCreate";
+    Json vol = Json::object();
+    vol["name"] = "det-xla-cache";
+    vol["hostPath"] = host_path;
+    Json vols = Json::array();
+    vols.push_back(vol);
+    spec["volumes"] = vols;
+  }
+  // Topology-aware placement (reference spec.go:106-126): pin to the
+  // node pool whose TPU shape matches, or a mixed cluster can schedule
+  // task pods onto the wrong accelerator.
+  if (!cfg_.accelerator_type.empty() || !cfg_.topology.empty()) {
+    Json sel = Json::object();
+    if (!cfg_.accelerator_type.empty()) {
+      sel["cloud.google.com/gke-tpu-accelerator"] = cfg_.accelerator_type;
+    }
+    if (!cfg_.topology.empty()) {
+      sel["cloud.google.com/gke-tpu-topology"] = cfg_.topology;
+    }
+    spec["nodeSelector"] = sel;
+  }
+  if (num_nodes > 1) {
+    // Shared placement hint: a multi-node allocation's pods prefer one
+    // node pool (one ICI domain) — collectives ride ICI, not DCN.
+    Json term = Json::object();
+    Json label_sel = Json::object();
+    Json match = Json::object();
+    match["det-allocation"] = alloc.id;
+    label_sel["matchLabels"] = match;
+    Json pod_aff_term = Json::object();
+    pod_aff_term["labelSelector"] = label_sel;
+    pod_aff_term["topologyKey"] = "cloud.google.com/gke-nodepool";
+    Json weighted = Json::object();
+    weighted["weight"] = static_cast<int64_t>(100);
+    weighted["podAffinityTerm"] = pod_aff_term;
+    Json preferred = Json::array();
+    preferred.push_back(weighted);
+    Json pod_affinity = Json::object();
+    pod_affinity["preferredDuringSchedulingIgnoredDuringExecution"] =
+        preferred;
+    Json affinity = Json::object();
+    affinity["podAffinity"] = pod_affinity;
+    spec["affinity"] = affinity;
+  }
 
   Json pod = Json::object();
   pod["apiVersion"] = "v1";

@@ -1,0 +1,69 @@
+"""Compile-heavy Core-API trial: a REAL jitted GPT-2 train step per trial.
+
+The ASHA bench fixture for compile-reuse (SURVEY hard part b): short
+trials whose cost is dominated by jit retrace+compile, exactly the shape
+ASHA schedules by the dozen. With the agent-injected DET_XLA_CACHE_DIR
+(persistent XLA compilation cache) only the first trial on a host pays
+the compile; identical-shape successors load from cache.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    t_start = time.time()
+    import jax  # noqa: F401  (import cost is part of the trial)
+    import optax
+
+    from determined_tpu import core
+    from determined_tpu.models import gpt2
+    from determined_tpu.train import create_train_state, make_train_step
+
+    with core.init(async_checkpointing=False) as ctx:
+        hp = ctx.hparams
+        cfg = gpt2.Config(
+            vocab_size=512, n_positions=128, d_model=512, n_layer=6,
+            n_head=8, remat=False,
+        )
+        # HP-invariant compilation: inject_hyperparams makes the searched
+        # lr optimizer STATE (device data) instead of a baked-in constant,
+        # so every ASHA trial shares ONE compiled program and the
+        # persistent cache actually hits across trials. A plain
+        # optax.adamw(lr) would give each lr value its own cache key.
+        tx = optax.inject_hyperparams(optax.adamw)(
+            learning_rate=float(hp.get("lr", 1e-3)))
+        state = create_train_state(
+            lambda r: gpt2.init(r, cfg), tx, jax.random.PRNGKey(0))
+        step = make_train_step(
+            lambda p, b, r: gpt2.loss_fn(p, b, cfg), tx)
+        tokens = np.random.default_rng(0).integers(
+            0, 512, size=(8, 129)).astype(np.int32)
+        batch = {"tokens": tokens}
+
+        t_compile0 = time.time()
+        state, metrics = step(state, batch, jax.random.PRNGKey(0))
+        float(metrics["loss"])  # force execution
+        compile_s = time.time() - t_compile0
+
+        steps = 1
+        for op in ctx.searcher.operations():
+            while steps < op.length:
+                state, metrics = step(state, batch, jax.random.PRNGKey(steps))
+                steps += 1
+            val = float(metrics["loss"])
+            ctx.train.report_validation_metrics(
+                steps, {"val_loss": val, "compile_s": compile_s,
+                        "trial_wall_s": time.time() - t_start})
+            op.report_completed(val)
+        print(json.dumps({"compile_s": round(compile_s, 2),
+                          "wall_s": round(time.time() - t_start, 2),
+                          "cache_dir": os.environ.get("DET_XLA_CACHE_DIR")}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -108,6 +108,8 @@ def k8s_cluster(tmp_path, native_binaries):
             "image": "determined-tpu-task:test",
             "slots_per_pod": 2,
             "max_pods": 2,
+            "accelerator_type": "tpu-v5-lite-podslice",
+            "topology": "2x4",
         },
         "provisioner": {
             "webhook_url": fake.url + "/scaleup",
@@ -165,6 +167,26 @@ def test_pods_lifecycle_and_reconcile(k8s_cluster):
     assert manifest["metadata"]["namespace"] == "det-test"
     assert manifest["spec"]["containers"][0]["resources"]["limits"][
         "google.com/tpu"] == 2
+    # Topology-aware placement (VERDICT r4 #7): shape nodeSelectors pin
+    # the pod to the matching TPU node pool; the 2-node allocation also
+    # carries the same-node-pool affinity hint (one ICI domain).
+    sel = manifest["spec"]["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-accelerator"] == \
+        "tpu-v5-lite-podslice"
+    assert sel["cloud.google.com/gke-tpu-topology"] == "2x4"
+    aff = manifest["spec"]["affinity"]["podAffinity"][
+        "preferredDuringSchedulingIgnoredDuringExecution"][0]
+    assert aff["podAffinityTerm"]["topologyKey"] == \
+        "cloud.google.com/gke-nodepool"
+    assert aff["podAffinityTerm"]["labelSelector"]["matchLabels"][
+        "det-allocation"] == aid
+    # Node-local XLA compilation cache rides a hostPath (pods are
+    # ephemeral; the compile-reuse must survive them).
+    assert env["DET_XLA_CACHE_DIR"] == "/det-xla-cache"
+    assert manifest["spec"]["volumes"][0]["hostPath"]["path"] == \
+        "/var/determined/xla-cache"
+    assert manifest["spec"]["containers"][0]["volumeMounts"][0][
+        "mountPath"] == "/det-xla-cache"
 
     # Phase Running + podIP reconciles into allocation RUNNING with
     # rendezvous addresses.
