@@ -57,8 +57,10 @@ def run_compile_reuse(cluster, token, tmp) -> dict:
                 "metric": "val_loss",
                 "smaller_is_better": True,
                 "max_length": {"batches": 4},
-                "max_trials": 6,
-                "max_concurrent_trials": 2,
+                "max_trials": 5,
+                # Sequential: concurrent compile-heavy CPU trials
+                # oversubscribe the host and drown the reuse signal.
+                "max_concurrent_trials": 1,
             },
             "hyperparameters": {
                 "lr": {"type": "log", "minval": -4, "maxval": -2},

@@ -17,6 +17,7 @@ from typing import Any, Dict, Iterator, List, Optional
 
 from determined_tpu import expconf
 from determined_tpu.common.api import Session
+from determined_tpu.common.bindings import Bindings
 
 TERMINAL_STATES = {"COMPLETED", "CANCELED", "ERROR", "DELETED"}
 
@@ -24,6 +25,7 @@ TERMINAL_STATES = {"COMPLETED", "CANCELED", "ERROR", "DELETED"}
 class Checkpoint:
     def __init__(self, session: Session, data: Dict[str, Any]):
         self._session = session
+        self._api = Bindings(session)
         self.uuid = data["uuid"]
         self.trial_id = data.get("trial_id")
         self.steps_completed = data.get("steps_completed", 0)
@@ -43,19 +45,20 @@ class Checkpoint:
         return path
 
     def delete(self) -> None:
-        self._session.patch(
-            "/api/v1/checkpoints",
+        self._api.patch_checkpoints(
             body={"checkpoints": [{"uuid": self.uuid, "state": "DELETED"}]},
         )
 
     @classmethod
     def _get(cls, session: Session, uuid: str) -> "Checkpoint":
-        return cls(session, session.get(f"/api/v1/checkpoints/{uuid}")["checkpoint"])
+        return cls(session,
+                   Bindings(session).get_checkpoints_uuid(uuid)["checkpoint"])
 
 
 class Trial:
     def __init__(self, session: Session, data: Dict[str, Any]):
         self._session = session
+        self._api = Bindings(session)
         self.id = data["id"]
         self.experiment_id = data.get("experiment_id")
         self._refresh(data)
@@ -69,12 +72,12 @@ class Trial:
         self.searcher_metric_value = data.get("searcher_metric_value")
 
     def reload(self) -> "Trial":
-        self._refresh(self._session.get(f"/api/v1/trials/{self.id}")["trial"])
+        self._refresh(self._api.get_trials_id(self.id)["trial"])
         return self
 
     def iter_metrics(self, group: str = "training") -> Iterator[Dict[str, Any]]:
-        for m in self._session.get(
-            f"/api/v1/trials/{self.id}/metrics", params={"group": group}
+        for m in self._api.get_trials_id_metrics(
+            self.id, params={"group": group}
         )["metrics"]:
             yield m
 
@@ -87,8 +90,8 @@ class Trial:
     def logs(self, follow: bool = False) -> Iterator[str]:
         offset = 0
         while True:
-            resp = self._session.get(
-                f"/api/v1/tasks/trial-{self.id}/logs",
+            resp = self._api.get_tasks_id_logs(
+                f"trial-{self.id}",
                 params={"offset": offset, "follow": "true" if follow else "false"},
                 timeout=60.0,
             )
@@ -108,6 +111,7 @@ class Trial:
 class Experiment:
     def __init__(self, session: Session, data: Dict[str, Any]):
         self._session = session
+        self._api = Bindings(session)
         self.id = data["id"]
         self._refresh(data)
 
@@ -118,31 +122,31 @@ class Experiment:
         self.archived = bool(data.get("archived"))
 
     def reload(self) -> "Experiment":
-        self._refresh(self._session.get(f"/api/v1/experiments/{self.id}")["experiment"])
+        self._refresh(self._api.get_experiments_id(self.id)["experiment"])
         return self
 
     def activate(self) -> None:
-        self._session.post(f"/api/v1/experiments/{self.id}/activate")
+        self._api.post_experiments_id_activate(self.id)
 
     def pause(self) -> None:
-        self._session.post(f"/api/v1/experiments/{self.id}/pause")
+        self._api.post_experiments_id_pause(self.id)
 
     def cancel(self) -> None:
-        self._session.post(f"/api/v1/experiments/{self.id}/cancel")
+        self._api.post_experiments_id_cancel(self.id)
 
     def kill(self) -> None:
-        self._session.post(f"/api/v1/experiments/{self.id}/kill")
+        self._api.post_experiments_id_kill(self.id)
 
     def archive(self) -> None:
-        self._session.post(f"/api/v1/experiments/{self.id}/archive")
+        self._api.post_experiments_id_archive(self.id)
 
     def delete(self) -> None:
-        self._session.delete(f"/api/v1/experiments/{self.id}")
+        self._api.delete_experiments_id(self.id)
 
     def get_trials(self) -> List[Trial]:
         return [
             Trial(self._session, t)
-            for t in self._session.get(f"/api/v1/experiments/{self.id}/trials")["trials"]
+            for t in self._api.get_experiments_id_trials(self.id)["trials"]
         ]
 
     def await_first_trial(self, timeout: float = 120.0) -> Trial:
@@ -187,6 +191,7 @@ class Experiment:
 class ModelVersion:
     def __init__(self, session: Session, model_name: str, data: Dict[str, Any]):
         self._session = session
+        self._api = Bindings(session)
         self.model_name = model_name
         self.version = data["version"]
         self.checkpoint_uuid = data.get("checkpoint_uuid")
@@ -198,14 +203,15 @@ class ModelVersion:
 class Model:
     def __init__(self, session: Session, data: Dict[str, Any]):
         self._session = session
+        self._api = Bindings(session)
         self.name = data["name"]
         self.id = data.get("id")
         self.description = data.get("description", "")
         self.metadata = data.get("metadata") or {}
 
     def register_version(self, checkpoint_uuid: str) -> ModelVersion:
-        resp = self._session.post(
-            f"/api/v1/models/{self.name}/versions",
+        resp = self._api.post_models_name_versions(
+            self.name,
             body={"checkpoint_uuid": checkpoint_uuid, "metadata": {}},
         )
         return ModelVersion(self._session, self.name, resp["model_version"])
@@ -213,7 +219,7 @@ class Model:
     def get_versions(self) -> List[ModelVersion]:
         return [
             ModelVersion(self._session, self.name, v)
-            for v in self._session.get(f"/api/v1/models/{self.name}/versions")[
+            for v in self._api.get_models_name_versions(self.name)[
                 "model_versions"
             ]
         ]
@@ -230,10 +236,11 @@ class Determined:
     ):
         self.master = (master or os.environ.get("DET_MASTER",
                                                 "http://127.0.0.1:8080")).rstrip("/")
-        resp = Session(self.master).post(
-            "/api/v1/auth/login", body={"username": user, "password": password}
+        resp = Bindings(Session(self.master)).post_auth_login(
+            body={"username": user, "password": password}
         )
         self._session = Session(self.master, resp["token"])
+        self._api = Bindings(self._session)
 
     # -- experiments ---------------------------------------------------
     def create_experiment(
@@ -255,8 +262,7 @@ class Determined:
                         full = os.path.join(root, name)
                         tar.add(full, arcname=os.path.relpath(full, model_dir))
             model_def = base64.b64encode(buf.getvalue()).decode()
-        resp = self._session.post(
-            "/api/v1/experiments",
+        resp = self._api.post_experiments(
             body={
                 "config": config,
                 "model_definition": model_def,
@@ -269,43 +275,44 @@ class Determined:
     def get_experiment(self, experiment_id: int) -> Experiment:
         return Experiment(
             self._session,
-            self._session.get(f"/api/v1/experiments/{experiment_id}")["experiment"],
+            self._api.get_experiments_id(experiment_id)["experiment"],
         )
 
     def list_experiments(self) -> List[Experiment]:
         return [
             Experiment(self._session, e)
-            for e in self._session.get("/api/v1/experiments")["experiments"]
+            for e in self._api.get_experiments()["experiments"]
         ]
 
     def get_trial(self, trial_id: int) -> Trial:
-        return Trial(self._session, self._session.get(f"/api/v1/trials/{trial_id}")["trial"])
+        return Trial(self._session,
+                     self._api.get_trials_id(trial_id)["trial"])
 
     def get_checkpoint(self, uuid: str) -> Checkpoint:
         return Checkpoint._get(self._session, uuid)
 
     # -- model registry ------------------------------------------------
     def create_model(self, name: str, description: str = "") -> Model:
-        self._session.post(
-            "/api/v1/models",
+        self._api.post_models(
             body={"name": name, "description": description, "metadata": {},
                   "labels": []},
         )
         return self.get_model(name)
 
     def get_model(self, name: str) -> Model:
-        return Model(self._session, self._session.get(f"/api/v1/models/{name}")["model"])
+        return Model(self._session,
+                     self._api.get_models_name(name)["model"])
 
     def get_models(self) -> List[Model]:
         return [Model(self._session, m)
-                for m in self._session.get("/api/v1/models")["models"]]
+                for m in self._api.get_models()["models"]]
 
     # -- cluster -------------------------------------------------------
     def get_agents(self) -> List[Dict[str, Any]]:
-        return self._session.get("/api/v1/agents")["agents"]
+        return self._api.get_agents()["agents"]
 
     def get_master_info(self) -> Dict[str, Any]:
-        return self._session.get("/api/v1/master")
+        return self._api.get_master()
 
 
 # Module-level convenience singleton (reference client.py login/create_experiment).

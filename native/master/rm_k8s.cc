@@ -90,8 +90,12 @@ Json KubernetesResourceManager::pod_manifest(
       hooks_.build_task_env(alloc, name, slot_ids, rank, num_nodes, chief);
   // Node-local persistent XLA compilation cache, like the agent RM's
   // work_root/xla_cache: pods are ephemeral, so the reuse lives in a
-  // hostPath shared by every det pod that lands on the node.
-  env_obj["DET_XLA_CACHE_DIR"] = "/det-xla-cache";
+  // hostPath shared by every det pod that lands on the node. Default
+  // only — an expconf environment_variables override (including the
+  // documented `DET_XLA_CACHE_DIR=` disable) must win, as on the agent.
+  if (!env_obj.contains("DET_XLA_CACHE_DIR")) {
+    env_obj["DET_XLA_CACHE_DIR"] = "/det-xla-cache";
+  }
   Json env = Json::array();
   for (const auto& [k, v] : env_obj.as_object()) {
     Json e = Json::object();

@@ -35,6 +35,9 @@ async function api(method, path, body) {
   return text ? JSON.parse(text) : null;
 }
 
+// Generated path layer (webui/api_client.js, from proto/openapi.json).
+const API = makeApiClient(api);
+
 // ---------------------------------------------------------------- util
 
 function el(tag, attrs = {}, ...children) {
@@ -71,9 +74,8 @@ async function followStream(entities, cb) {
   let since = 0;
   while (myGen === gen) {
     try {
-      const out = await api("GET",
-        `/api/v1/stream?since=${since}&entities=${entities}` +
-        `&timeout_seconds=25`);
+      const out = await API.getStream(
+        { since, entities, timeout_seconds: 25 });
       if (myGen !== gen) return;
       if (out.dropped) { since = 0; cb(null); continue; }
       if (out.events.length) { since = out.latest_seq; cb(out.events); }
@@ -363,7 +365,7 @@ function renderLogin(err) {
 }
 
 async function pageExperiments() {
-  const { experiments } = await api("GET", "/api/v1/experiments");
+  const { experiments } = await API.getExperiments();
   view.textContent = "";
   view.append(el("h1", {}, "Experiments"));
   const rows = experiments.map((e) => el("tr", {
@@ -384,8 +386,8 @@ async function pageExperiments() {
 
 async function pageExperiment(id) {
   const [{ experiment }, { trials }] = await Promise.all([
-    api("GET", `/api/v1/experiments/${id}`),
-    api("GET", `/api/v1/experiments/${id}/trials`),
+    API.getExperimentsId(id),
+    API.getExperimentsIdTrials(id),
   ]);
   view.textContent = "";
   view.append(el("h1", {}, `Experiment ${id} `, stateBadge(experiment.state),
@@ -452,7 +454,7 @@ async function pageExperiment(id) {
   // series into the table view so nothing is dropped silently.
   if (trials.length >= 2) {
     const metricLists = await Promise.all(trials.slice(0, 12).map((t) =>
-      api("GET", `/api/v1/trials/${t.id}/metrics?group=validation`)));
+      API.getTrialsIdMetrics(t.id, { group: "validation" })));
     const series = [];
     trials.slice(0, 12).forEach((t, i) => {
       const pts = [];
@@ -479,7 +481,7 @@ async function pageExperiment(id) {
   // metric charts from the first trial (single/first-trial view; the data
   // is per-trial at /api/v1/trials/{id}/metrics)
   if (trials.length) {
-    const { metrics } = await api("GET", `/api/v1/trials/${trials[0].id}/metrics`);
+    const { metrics } = await API.getTrialsIdMetrics(trials[0].id);
     const groups = {};
     for (const m of metrics) {
       for (const [k, v] of Object.entries(m.metrics || {})) {
@@ -512,8 +514,7 @@ async function pageExperiment(id) {
   }
 
   // Checkpoints (registry view; GC'd ones show as DELETED)
-  const { checkpoints } = await api(
-    "GET", `/api/v1/experiments/${id}/checkpoints`);
+  const { checkpoints } = await API.getExperimentsIdCheckpoints(id);
   if (checkpoints.length) {
     view.append(el("h2", {}, "Checkpoints"));
     view.append(el("table", {},
@@ -534,7 +535,7 @@ async function pageExperiment(id) {
 
 async function pageTrial(id) {
   const myGen = gen;
-  const { trial } = await api("GET", `/api/v1/trials/${id}`);
+  const { trial } = await API.getTrialsId(id);
   view.textContent = "";
   view.append(el("h1", {},
     el("a", { href: `#/experiments/${trial.experiment_id}` },
@@ -555,9 +556,8 @@ async function pageTrial(id) {
   const pump = async () => {
     while (myGen === gen) {
       const follow = followBox.checked;
-      const { logs } = await api("GET",
-        `/api/v1/tasks/trial-${id}/logs?offset=${offset}` +
-        `&follow=${follow}&timeout_seconds=20`);
+      const { logs } = await API.getTasksIdLogs(
+        `trial-${id}`, { offset, follow, timeout_seconds: 20 });
       if (myGen !== gen) return;
       for (const line of logs) {
         offset = Math.max(offset, line.id);
@@ -578,12 +578,12 @@ async function pageTrial(id) {
 }
 
 async function pageWorkspaces() {
-  const { workspaces } = await api("GET", "/api/v1/workspaces");
+  const { workspaces } = await API.getWorkspaces();
   view.textContent = "";
   view.append(el("h1", {}, "Workspaces"));
   for (const w of workspaces) {
     if (w.archived) continue;
-    const { projects } = await api("GET", `/api/v1/workspaces/${w.id}/projects`);
+    const { projects } = await API.getWorkspacesIdProjects(w.id);
     view.append(el("h2", {}, `${w.name} `,
       el("span", { class: "muted" }, `(id ${w.id})`)));
     view.append(el("table", {},
@@ -601,7 +601,7 @@ async function pageWorkspaces() {
 }
 
 async function pageModels() {
-  const { models } = await api("GET", "/api/v1/models");
+  const { models } = await API.getModels();
   view.textContent = "";
   view.append(el("h1", {}, "Model registry"));
   if (!models.length) {
@@ -610,8 +610,8 @@ async function pageModels() {
   }
   for (const m of models) {
     if (m.archived) continue;
-    const { model_versions } = await api(
-      "GET", `/api/v1/models/${encodeURIComponent(m.name)}/versions`);
+    const { model_versions } = await API.getModelsNameVersions(
+      encodeURIComponent(m.name));
     view.append(el("h2", {}, m.name,
       el("span", { class: "muted" }, `  ${m.description ?? ""}`)));
     view.append(el("table", {},
@@ -626,9 +626,9 @@ async function pageModels() {
 
 async function pageUsers() {
   const [{ users }, me, { assignments }] = await Promise.all([
-    api("GET", "/api/v1/users"),
-    api("GET", "/api/v1/me"),
-    api("GET", "/api/v1/rbac/assignments"),
+    API.getUsers(),
+    API.getMe(),
+    API.getRbacAssignments(),
   ]);
   const admin = me.user.role === "admin";
   view.textContent = "";
@@ -651,16 +651,16 @@ async function pageUsers() {
       el("td", {}, u.active ? "yes" : "no"),
       ...(admin ? [el("td", {},
         act(u.active ? "deactivate" : "activate", () =>
-          api("PATCH", `/api/v1/users/${u.id}`, { active: !u.active })),
+          API.patchUsersId(u.id, { active: !u.active })),
         " ",
         act("make viewer", () =>
-          api("PATCH", `/api/v1/users/${u.id}`, { role: "viewer" })),
+          API.patchUsersId(u.id, { role: "viewer" })),
         " ",
         act("make user", () =>
-          api("PATCH", `/api/v1/users/${u.id}`, { role: "user" })),
+          API.patchUsersId(u.id, { role: "user" })),
         " ",
         act("make admin", () =>
-          api("PATCH", `/api/v1/users/${u.id}`, { role: "admin" })))]
+          API.patchUsersId(u.id, { role: "admin" })))]
         : [])))));
   if (admin) {
     const name = el("input", { placeholder: "username" });
@@ -685,14 +685,14 @@ async function pageUsers() {
       el("td", {}, a.username ?? ""), el("td", {}, a.group_name ?? ""),
       el("td", {}, a.workspace_id ?? "global"),
       ...(admin ? [el("td", {}, act("revoke", () =>
-        api("DELETE", `/api/v1/rbac/assignments/${a.id}`)))] : [])))));
+        API.deleteRbacAssignmentsId(a.id)))] : [])))));
   if (!assignments.length) {
     view.append(el("p", { class: "muted" }, "no grants"));
   }
 }
 
 async function pageCluster() {
-  const { agents } = await api("GET", "/api/v1/agents");
+  const { agents } = await API.getAgents();
   view.textContent = "";
   view.append(el("h1", {}, "Cluster"));
   view.append(el("table", {},
@@ -712,7 +712,7 @@ async function pageCluster() {
 }
 
 async function pageJobs() {
-  const { jobs } = await api("GET", "/api/v1/job-queues");
+  const { jobs } = await API.getJobQueues();
   view.textContent = "";
   view.append(el("h1", {}, "Job queue"));
   view.append(el("table", {},
