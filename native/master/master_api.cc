@@ -98,18 +98,28 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
 
   // GET /api/v1/experiments — list.
   if (parts.size() == 1 && req.method == "GET") {
-    std::string where = "WHERE archived=0";
+    // Conditions assembled as a list: clobbering `where` while params
+    // still holds binds would make sqlite throw SQLITE_RANGE.
+    std::vector<std::string> conds;
     std::vector<Json> params;
+    if (req.query_param("archived") != "true") {
+      conds.push_back("archived=0");
+    }
     if (!req.query_param("project_id").empty()) {
-      where += " AND project_id=?";
+      conds.push_back("project_id=?");
       params.push_back(Json(to_id(req.query_param("project_id"))));
     }
-    if (req.query_param("archived") == "true") where = "WHERE 1=1";
+    std::string where = "WHERE 1=1";
+    for (const auto& c : conds) where += " AND " + c;
+    int64_t limit = to_id(req.query_param("limit", "200"));
+    int64_t offset = to_id(req.query_param("offset", "0"));
+    auto total_rows = db_.query(
+        "SELECT COUNT(*) AS n FROM experiments " + where, params);
     auto rows = db_.query(
         "SELECT id, state, config, progress, project_id, archived, "
         "start_time, end_time FROM experiments " + where +
-            " ORDER BY id DESC LIMIT " +
-            std::to_string(to_id(req.query_param("limit", "200"))),
+            " ORDER BY id DESC LIMIT " + std::to_string(limit) +
+            " OFFSET " + std::to_string(offset),
         params);
     Json exps = Json::array();
     for (auto& row : rows) {
@@ -121,6 +131,12 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
     }
     Json out = Json::object();
     out["experiments"] = exps;
+    Json pg = Json::object();
+    pg["total"] = total_rows.empty() ? Json(static_cast<int64_t>(0))
+                                     : total_rows[0]["n"];
+    pg["offset"] = offset;
+    pg["limit"] = limit;
+    out["pagination"] = pg;
     return json_resp(200, out);
   }
 

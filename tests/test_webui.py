@@ -237,3 +237,93 @@ def test_app_js_references_real_endpoints(cluster):
         except urllib.error.HTTPError as e:
             status = e.code
         assert status == 200, f"{p} -> {status}"
+
+
+def test_tasks_page_and_kill_flow(cluster):
+    """The Tasks page's API sequence: list → kill (per-kind route) →
+    state reflects the outcome (VERDICT r4 #9 NTSC/tasks page)."""
+    token = cluster.login()
+    tid = cluster.api("POST", "/api/v1/commands",
+                      {"config": {"entrypoint": "sleep 600"}},
+                      token=token)["id"]
+    tasks = cluster.api("GET", "/api/v1/tasks", token=token)["tasks"]
+    mine = [t for t in tasks if t["id"] == tid]
+    assert mine and mine[0]["type"] == "COMMAND"
+    # the kill button's route for COMMAND
+    cluster.api("POST", f"/api/v1/commands/{tid}/kill", token=token)
+    import time as _t
+    deadline = _t.time() + 30
+    while _t.time() < deadline:
+        t = cluster.api("GET", f"/api/v1/commands/{tid}", token=token)["task"]
+        if t["state"] in ("COMPLETED", "ERROR", "CANCELED"):
+            break
+        _t.sleep(0.2)
+    assert t["state"] in ("COMPLETED", "ERROR", "CANCELED")
+
+
+def test_admin_page_webhook_template_flow(cluster):
+    """The Admin page's API sequence: webhook + template CRUD."""
+    admin = cluster.login("admin")
+    hook = cluster.api("POST", "/api/v1/webhooks",
+                       {"url": "http://127.0.0.1:1/x",
+                        "triggers": [{"trigger_type":
+                                      "EXPERIMENT_STATE_CHANGE",
+                                      "condition": {"state": "COMPLETED"}}]},
+                       token=admin)
+    hid = hook.get("id") or hook.get("webhook", {}).get("id")
+    hooks = cluster.api("GET", "/api/v1/webhooks", token=admin)["webhooks"]
+    assert any(h["id"] == hid for h in hooks)
+    cluster.api("DELETE", f"/api/v1/webhooks/{hid}", token=admin)
+
+    cluster.api("POST", "/api/v1/templates",
+                {"name": "ui-tpl",
+                 "config": {"resources": {"slots_per_trial": 2}}},
+                token=admin)
+    tpls = cluster.api("GET", "/api/v1/templates", token=admin)["templates"]
+    assert any(t["name"] == "ui-tpl" for t in tpls)
+    cluster.api("DELETE", "/api/v1/templates/ui-tpl", token=admin)
+
+
+def test_experiments_pagination(cluster, tmp_path):
+    """Server-side pagination the experiments page rides: limit/offset +
+    total (VERDICT r4 #9: no list endpoint rendered whole)."""
+    token = None
+    for i in range(5):
+        cfg = _experiment_config(tmp_path)
+        cfg["name"] = f"pg-{i}"
+        _, token = _create_experiment(cluster, cfg, activate=False)
+    page1 = cluster.api("GET", "/api/v1/experiments?limit=2&offset=0",
+                        token=token)
+    assert len(page1["experiments"]) == 2
+    assert page1["pagination"]["total"] == 5
+    page3 = cluster.api("GET", "/api/v1/experiments?limit=2&offset=4",
+                        token=token)
+    assert len(page3["experiments"]) == 1
+    ids = {e["id"] for e in page1["experiments"]} | \
+        {e["id"] for e in page3["experiments"]}
+    assert len(ids) == 3  # pages don't overlap
+
+
+def test_model_version_detail_flow(cluster, tmp_path):
+    """Model registry version rows expand to the backing checkpoint —
+    the page's API sequence: versions → checkpoint detail."""
+    eid, token = _create_experiment(
+        cluster, _experiment_config(tmp_path), activate=True)
+    _wait_experiment(cluster, eid, token)
+    cps = cluster.api("GET", f"/api/v1/experiments/{eid}/checkpoints",
+                      token=token)["checkpoints"]
+    assert cps
+    cluster.api("POST", "/api/v1/models",
+                {"name": "ui-model", "description": "", "metadata": {},
+                 "labels": []}, token=token)
+    cluster.api("POST", "/api/v1/models/ui-model/versions",
+                {"checkpoint_uuid": cps[0]["uuid"], "metadata": {}},
+                token=token)
+    versions = cluster.api("GET", "/api/v1/models/ui-model/versions",
+                           token=token)["model_versions"]
+    assert versions
+    ck = cluster.api(
+        "GET", f"/api/v1/checkpoints/{versions[0]['checkpoint_uuid']}",
+        token=token)["checkpoint"]
+    assert ck["uuid"] == cps[0]["uuid"]
+    assert "steps_completed" in ck

@@ -333,6 +333,81 @@ function scatterChart(title, points, xLabel, yLabel) {
   return block;
 }
 
+
+// Parallel coordinates (reference HP-viz): one vertical axis per numeric
+// hyperparameter + the searcher metric; one polyline per scored trial,
+// best-metric trial drawn in the accent series color, others recessive.
+function parallelCoords(trials, hpNames, metricName, smallerBetter) {
+  const scored = trials.filter((t) => t.searcher_metric_value != null);
+  // dims from SCORED trials only: an hp numeric solely on unscored
+  // trials would give empty ranges (Infinity ticks, zero polylines).
+  const dims = hpNames.filter((h) =>
+    scored.some((t) => typeof (t.hparams || {})[h] === "number"));
+  if (dims.length < 1 || scored.length < 2) return null;
+  const axes = [...dims.map((h) => ({
+    name: h, get: (t) => (t.hparams || {})[h],
+  })), { name: metricName, get: (t) => t.searcher_metric_value }];
+  const W = 720, H = 260, M = { l: 40, r: 40, t: 28, b: 12 };
+  const NS = "http://www.w3.org/2000/svg";
+  const svg = document.createElementNS(NS, "svg");
+  svg.setAttribute("class", "chart");
+  svg.setAttribute("viewBox", `0 0 ${W} ${H}`);
+  const ax = (i) => M.l + (i / (axes.length - 1)) * (W - M.l - M.r);
+  const ranges = axes.map((a) => {
+    const vs = scored.map(a.get).filter((v) => typeof v === "number");
+    const lo = Math.min(...vs), hi = Math.max(...vs);
+    return { lo, hi: hi === lo ? lo + 1 : hi };
+  });
+  axes.forEach((a, i) => {
+    const line = document.createElementNS(NS, "line");
+    line.setAttribute("class", "gridline");
+    line.setAttribute("x1", ax(i)); line.setAttribute("x2", ax(i));
+    line.setAttribute("y1", M.t); line.setAttribute("y2", H - M.b);
+    svg.append(line);
+    const lab = document.createElementNS(NS, "text");
+    lab.setAttribute("class", "axis-label");
+    lab.setAttribute("x", ax(i)); lab.setAttribute("y", M.t - 8);
+    lab.setAttribute("text-anchor", "middle");
+    lab.textContent = a.name;
+    svg.append(lab);
+    for (const [v, anchor] of [[ranges[i].lo, H - M.b], [ranges[i].hi, M.t + 10]]) {
+      const tick = document.createElementNS(NS, "text");
+      tick.setAttribute("class", "axis-label");
+      tick.setAttribute("x", ax(i) + 4); tick.setAttribute("y", anchor);
+      tick.textContent = fmt(v);
+      svg.append(tick);
+    }
+  });
+  const best = (smallerBetter ? Math.min : Math.max)(
+    ...scored.map((t) => t.searcher_metric_value));
+  for (const t of scored) {
+    const pts = axes.map((a, i) => {
+      const v = a.get(t);
+      if (typeof v !== "number") return null;
+      const y = H - M.b -
+        ((v - ranges[i].lo) / (ranges[i].hi - ranges[i].lo)) * (H - M.t - M.b);
+      return `${ax(i).toFixed(1)},${y.toFixed(1)}`;
+    });
+    if (pts.some((s) => s === null)) continue;
+    const path = document.createElementNS(NS, "path");
+    const isBest = t.searcher_metric_value === best;
+    path.setAttribute("class", "series-line");
+    path.setAttribute("stroke", isBest ? seriesColor(0) : seriesColor(1));
+    path.setAttribute("stroke-opacity", isBest ? "1" : "0.35");
+    path.setAttribute("fill", "none");
+    path.setAttribute("d", "M" + pts.join("L"));
+    path.append(Object.assign(document.createElementNS(NS, "title"), {
+      textContent: `trial ${t.id}: ${fmt(t.searcher_metric_value)}` }));
+    svg.append(path);
+  }
+  const block = el("div", { class: "chart-block" },
+    el("div", { class: "chart-head" },
+      el("span", { class: "chart-title" },
+        `parallel coordinates (best trial highlighted)`)));
+  block.append(el("div", { class: "chart-wrap" }, svg));
+  return block;
+}
+
 // ---------------------------------------------------------------- pages
 
 function renderLogin(err) {
@@ -364,8 +439,13 @@ function renderLogin(err) {
   view.append(form);
 }
 
-async function pageExperiments() {
-  const { experiments } = await API.getExperiments();
+const PAGE_SIZE = 50;
+let expOffset = 0;  // survives stream-driven re-renders
+
+async function pageExperiments(offset = expOffset) {
+  expOffset = offset;
+  const { experiments, pagination } = await API.getExperiments(
+    { limit: PAGE_SIZE, offset });
   view.textContent = "";
   view.append(el("h1", {}, "Experiments"));
   const rows = experiments.map((e) => el("tr", {
@@ -382,6 +462,20 @@ async function pageExperiments() {
     el("tr", {}, ["ID", "Name", "State", "Progress", "Searcher", "Slots"]
       .map((h) => el("th", {}, h))), rows));
   if (!experiments.length) view.append(el("p", { class: "muted" }, "no experiments"));
+  const total = pagination?.total ?? experiments.length;
+  if (total > PAGE_SIZE) {
+    const newer = el("button", {
+      onclick: () => pageExperiments(Math.max(0, offset - PAGE_SIZE)) },
+      "\u2039 newer");
+    if (offset === 0) newer.disabled = true;
+    const older = el("button", {
+      onclick: () => pageExperiments(offset + PAGE_SIZE) }, "older \u203a");
+    if (offset + PAGE_SIZE >= total) older.disabled = true;
+    view.append(el("div", { class: "pager" }, newer,
+      el("span", { class: "muted" },
+        ` ${offset + 1}\u2013${offset + experiments.length} of ${total} `),
+      older));
+  }
 }
 
 async function pageExperiment(id) {
@@ -438,6 +532,10 @@ async function pageExperiment(id) {
   const scored = trials.filter((t) => t.searcher_metric_value != null);
   if (scored.length >= 2) {
     view.append(el("h2", {}, "Hyperparameter search"));
+    const pcChart = parallelCoords(
+      trials, hpNames, metricName,
+      experiment.config?.searcher?.smaller_is_better !== false);
+    if (pcChart) view.append(pcChart);
     for (const h of hpNames) {
       const pts = scored
         .filter((t) => typeof (t.hparams || {})[h] === "number")
@@ -617,10 +715,32 @@ async function pageModels() {
     view.append(el("table", {},
       el("tr", {}, ["Version", "Checkpoint", "Registered"]
         .map((h) => el("th", {}, h))),
-      model_versions.map((v) => el("tr", {},
-        el("td", {}, v.version),
-        el("td", { class: "muted" }, v.checkpoint_uuid),
-        el("td", { class: "muted" }, v.creation_time ?? "")))));
+      model_versions.map((v) => {
+        const row = el("tr", { class: "rowlink" },
+          el("td", {}, v.version),
+          el("td", { class: "muted" }, v.checkpoint_uuid),
+          el("td", { class: "muted" }, v.creation_time ?? ""));
+        row.addEventListener("click", async () => {
+          // Version detail: the backing checkpoint's metadata/resources,
+          // toggled inline (reference ModelVersionDetails page).
+          if (row.nextSibling?.classList?.contains("version-detail")) {
+            row.nextSibling.remove();
+            return;
+          }
+          const { checkpoint } = await API.getCheckpointsUuid(
+            v.checkpoint_uuid);
+          row.after(el("tr", { class: "version-detail" },
+            el("td", { colspan: 3 }, el("pre", { class: "config" },
+              JSON.stringify({
+                trial_id: checkpoint.trial_id,
+                steps_completed: checkpoint.steps_completed,
+                state: checkpoint.state,
+                metadata: checkpoint.metadata,
+                resources: checkpoint.resources,
+              }, null, 2)))));
+        });
+        return row;
+      })));
   }
 }
 
@@ -729,6 +849,119 @@ async function pageJobs() {
   if (!jobs.length) view.append(el("p", { class: "muted" }, "queue is empty"));
 }
 
+async function pageTasks() {
+  const { tasks } = await API.getTasks();
+  view.textContent = "";
+  view.append(el("h1", {}, "Tasks"));
+  const err = el("span", { class: "error" });
+  const killable = (t) =>
+    !["COMPLETED", "ERROR", "CANCELED"].includes(t.state);
+  const killPath = {
+    COMMAND: (id) => API.postCommandsIdKill(id),
+    NOTEBOOK: (id) => API.postNotebooksIdKill(id),
+    SHELL: (id) => API.postShellsIdKill(id),
+    TENSORBOARD: (id) => API.postTensorboardsIdKill(id),
+    GENERIC: (id) => API.postGenericTasksIdKill(id),
+  };
+  view.append(el("table", {},
+    el("tr", {}, ["ID", "Type", "State", "Started", "Ended", ""]
+      .map((h) => el("th", {}, h))),
+    tasks.map((t) => el("tr", {},
+      el("td", {}, el("a", { href: `#/tasks/${t.id}` }, t.id)),
+      el("td", {}, t.type),
+      el("td", {}, stateBadge(
+        ["COMPLETED", "ERROR", "CANCELED"].includes(t.state)
+          ? t.state : (t.allocation_state ?? t.state))),
+      el("td", { class: "muted" }, t.start_time ?? ""),
+      el("td", { class: "muted" }, t.end_time ?? ""),
+      el("td", {}, killable(t) && killPath[t.type] ? el("button", {
+        onclick: async () => {
+          try { await killPath[t.type](t.id); pageTasks(); }
+          catch (e) { err.textContent = `kill failed: ${e.message}`; }
+        } }, "kill") : "")))));
+  if (!tasks.length) view.append(el("p", { class: "muted" }, "no tasks"));
+  view.append(err);
+}
+
+async function pageTaskLogs(id) {
+  view.textContent = "";
+  view.append(el("h1", {}, `Task ${id}`));
+  const pre = el("pre", { class: "logpane" });
+  view.append(pre);
+  const myGen = gen;
+  let offset = 0;
+  while (myGen === gen) {
+    const { logs } = await API.getTasksIdLogs(
+      id, { offset, follow: true, timeout_seconds: 20 });
+    if (myGen !== gen) return;
+    for (const line of logs) {
+      offset = Math.max(offset, line.id);
+      pre.append(line.log + "\n");
+    }
+  }
+}
+
+async function pageAdmin() {
+  const [{ webhooks }, { templates }] = await Promise.all([
+    API.getWebhooks(), API.getTemplates()]);
+  view.textContent = "";
+  view.append(el("h1", {}, "Admin"));
+  const err = el("div", { class: "error" });
+
+  view.append(el("h2", {}, "Webhooks"));
+  view.append(el("table", {},
+    el("tr", {}, ["ID", "URL", "Triggers", ""].map((h) => el("th", {}, h))),
+    (webhooks ?? []).map((w) => el("tr", {},
+      el("td", {}, w.id),
+      el("td", { class: "muted" }, w.url),
+      el("td", {}, (w.triggers ?? []).map(
+        (t) => t.trigger_type ?? t).join(", ")),
+      el("td", {}, el("button", {
+        onclick: async () => {
+          try { await API.deleteWebhooksId(w.id); pageAdmin(); }
+          catch (e) { err.textContent = String(e.message); }
+        } }, "delete"))))));
+  const whUrl = el("input", { placeholder: "https://hook.example/path" });
+  view.append(el("div", {}, whUrl, el("button", {
+    onclick: async () => {
+      try {
+        await API.postWebhooks({
+          url: whUrl.value,
+          triggers: [{ trigger_type: "EXPERIMENT_STATE_CHANGE",
+                       condition: { state: "COMPLETED" } }] });
+        pageAdmin();
+      } catch (e) { err.textContent = String(e.message); }
+    } }, "add webhook (COMPLETED)")));
+
+  view.append(el("h2", {}, "Templates"));
+  view.append(el("table", {},
+    el("tr", {}, ["Name", "Config", ""].map((h) => el("th", {}, h))),
+    (templates ?? []).map((t) => el("tr", {},
+      el("td", {}, t.name),
+      el("td", {}, el("pre", { class: "config" },
+        JSON.stringify(t.config ?? {}, null, 1))),
+      el("td", {}, el("button", {
+        onclick: async () => {
+          try {
+            await API.deleteTemplatesName(encodeURIComponent(t.name));
+            pageAdmin();
+          }
+          catch (e) { err.textContent = String(e.message); }
+        } }, "delete"))))));
+  const tplName = el("input", { placeholder: "template name" });
+  const tplCfg = el("input", {
+    placeholder: '{"resources": {"slots_per_trial": 4}}' });
+  view.append(el("div", {}, tplName, tplCfg, el("button", {
+    onclick: async () => {
+      try {
+        await API.postTemplates({ name: tplName.value,
+                                  config: JSON.parse(tplCfg.value) });
+        pageAdmin();
+      } catch (e) { err.textContent = String(e.message); }
+    } }, "add template")));
+  view.append(err);
+}
+
 // --------------------------------------------------------------- router
 
 async function route() {
@@ -759,6 +992,10 @@ async function route() {
     }
     const t = hash.match(/^#\/trials\/(\d+)/);
     if (t) return await pageTrial(t[1]);
+    const tk = hash.match(/^#\/tasks\/([\w\-]+)/);
+    if (tk) return await pageTaskLogs(tk[1]);
+    if (hash.startsWith("#/tasks")) return await pageTasks();
+    if (hash.startsWith("#/admin")) return await pageAdmin();
     if (hash.startsWith("#/workspaces")) return await pageWorkspaces();
     if (hash.startsWith("#/models")) return await pageModels();
     if (hash.startsWith("#/users")) return await pageUsers();
