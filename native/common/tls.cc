@@ -214,4 +214,26 @@ void tls_free(void* ssl) {
   api().SSL_free(static_cast<SSL*>(ssl));
 }
 
+std::string sha256_hex(const std::string& data) {
+  using Sha256Fn = unsigned char* (*)(const unsigned char*, size_t,
+                                      unsigned char*);
+  static Sha256Fn sha = [] {
+    void* h = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (h == nullptr) h = dlopen("libcrypto.so", RTLD_NOW | RTLD_GLOBAL);
+    return h ? reinterpret_cast<Sha256Fn>(dlsym(h, "SHA256")) : nullptr;
+  }();
+  if (sha == nullptr) throw std::runtime_error("libcrypto unavailable");
+  unsigned char digest[32];
+  sha(reinterpret_cast<const unsigned char*>(data.data()), data.size(),
+      digest);
+  static const char* hex = "0123456789abcdef";
+  std::string out;
+  out.reserve(64);
+  for (unsigned char b : digest) {
+    out += hex[b >> 4];
+    out += hex[b & 0xf];
+  }
+  return out;
+}
+
 }  // namespace det

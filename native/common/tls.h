@@ -38,4 +38,8 @@ ssize_t tls_write(void* ssl, const char* buf, size_t n);
 size_t tls_pending(void* ssl);  // bytes buffered inside the SSL layer
 void tls_free(void* ssl);  // shutdown + free (does NOT close the fd)
 
+// SHA-256 hex digest via the same runtime-loaded libcrypto (content
+// addressing for the model-def store). Throws if libcrypto is absent.
+std::string sha256_hex(const std::string& data);
+
 }  // namespace det

@@ -381,6 +381,21 @@ CREATE INDEX idx_role_assignments_group ON role_assignments(group_id);
       {12, R"sql(
 ALTER TABLE tasks ADD COLUMN workspace_id INTEGER NOT NULL DEFAULT 1;
 )sql"},
+      // Content-addressed model-definition store (reference
+      // master/internal/cache caches model-def file trees): identical
+      // context tarballs — every trial of a sweep, repeated submits of
+      // the same code — are stored once and referenced by hash.
+      // experiments.model_def stays for pre-migration rows (read path
+      // falls back to it).
+      {13, R"sql(
+CREATE TABLE model_defs (
+  hash TEXT PRIMARY KEY,
+  blob BLOB NOT NULL,
+  refcount INTEGER NOT NULL DEFAULT 0,
+  created_at TEXT NOT NULL DEFAULT (datetime('now'))
+);
+ALTER TABLE experiments ADD COLUMN model_def_hash TEXT;
+)sql"},
   };
   return kMigrations;
 }

@@ -6,8 +6,12 @@
 // api_experiment.go, api_tasks.go) plus the experiment-management calls the
 // CLI/SDK need.
 
+#include <string.h>
+#include <zlib.h>
+
 #include <algorithm>
 #include <chrono>
+#include <deque>
 #include <iostream>
 
 #include "master.h"
@@ -175,8 +179,17 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
     if (exp != nullptr && !is_terminal(exp->state)) {
       return json_resp(400, err_body("experiment still active"));
     }
-    db_.exec("UPDATE experiments SET state='DELETED', archived=1 WHERE id=?",
-             {Json(eid)});
+    // Release this experiment's claim on the content-addressed model-def
+    // blob; unreferenced blobs are purged.
+    db_.exec(
+        "UPDATE model_defs SET refcount = refcount - 1 WHERE hash = "
+        "(SELECT model_def_hash FROM experiments WHERE id=?)",
+        {Json(eid)});
+    db_.exec("DELETE FROM model_defs WHERE refcount <= 0");
+    db_.exec(
+        "UPDATE experiments SET state='DELETED', archived=1, "
+        "model_def_hash=NULL WHERE id=?",
+        {Json(eid)});
     experiments_.erase(eid);
     return json_resp(200, Json::object());
   }
@@ -293,11 +306,31 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
 
   // GET /api/v1/experiments/{id}/model_def
   if (parts.size() == 3 && parts[2] == "model_def" && req.method == "GET") {
-    auto rows = db_.query("SELECT model_def FROM experiments WHERE id=?",
-                          {Json(eid)});
+    auto rows = db_.query(
+        "SELECT COALESCE(md.blob, e.model_def) AS model_def "
+        "FROM experiments e LEFT JOIN model_defs md "
+        "ON md.hash = e.model_def_hash WHERE e.id=?",
+        {Json(eid)});
     if (rows.empty()) return json_resp(404, err_body("no such experiment"));
     Json out = Json::object();
     out["b64_tgz"] = rows[0]["model_def"];
+    return json_resp(200, out);
+  }
+
+  // GET /api/v1/experiments/{id}/file_tree — model-def file listing
+  // (reference master/internal/cache: unpacked model-def trees served to
+  // the UI; here listed from the tarball with an in-memory LRU by hash).
+  if (parts.size() == 3 && parts[2] == "file_tree" && req.method == "GET") {
+    auto rows = db_.query(
+        "SELECT e.model_def_hash AS h, "
+        "COALESCE(md.blob, e.model_def) AS model_def "
+        "FROM experiments e LEFT JOIN model_defs md "
+        "ON md.hash = e.model_def_hash WHERE e.id=?",
+        {Json(eid)});
+    if (rows.empty()) return json_resp(404, err_body("no such experiment"));
+    Json out = Json::object();
+    out["files"] = model_def_file_tree(rows[0]["h"].as_string(""),
+                                       rows[0]["model_def"].as_string(""));
     return json_resp(200, out);
   }
 
@@ -1150,8 +1183,10 @@ HttpResponse Master::handle_tasks(const HttpRequest& req,
   // (GetTaskContextDirectory; harness/determined/exec/prep_container.py).
   if (parts.size() == 3 && parts[2] == "context") {
     std::string sql =
-        "SELECT e.model_def FROM experiments e JOIN trials t ON "
-        "t.experiment_id = e.id WHERE t.id=?";
+        "SELECT COALESCE(md.blob, e.model_def) AS model_def "
+        "FROM experiments e JOIN trials t ON t.experiment_id = e.id "
+        "LEFT JOIN model_defs md ON md.hash = e.model_def_hash "
+        "WHERE t.id=?";
     int64_t trial_id = -1;
     if (task_id.rfind("trial-", 0) == 0) {
       trial_id = to_id(task_id.substr(6));
@@ -1198,6 +1233,151 @@ HttpResponse Master::handle_tasks(const HttpRequest& req,
   }
 
   return json_resp(404, err_body("not found"));
+}
+
+namespace {
+
+// Standard-alphabet base64 decode (model-def tarballs travel base64).
+std::string b64_decode(const std::string& in) {
+  static int8_t table[256];
+  static bool init = [] {
+    for (int i = 0; i < 256; ++i) table[i] = -1;
+    const char* alpha =
+        "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+    for (int i = 0; i < 64; ++i) {
+      table[static_cast<unsigned char>(alpha[i])] = static_cast<int8_t>(i);
+    }
+    return true;
+  }();
+  (void)init;
+  std::string out;
+  out.reserve(in.size() * 3 / 4);
+  int acc = 0, bits = 0;
+  for (unsigned char c : in) {
+    if (c == '=' || c == '\n' || c == '\r') continue;
+    int8_t v = table[c];
+    if (v < 0) continue;
+    acc = (acc << 6) | v;
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      out += static_cast<char>((acc >> bits) & 0xff);
+    }
+  }
+  return out;
+}
+
+// Inflate a gzip stream (zlib with gzip header detection).
+std::string gunzip(const std::string& gz, size_t max_out = 256u << 20) {
+  z_stream zs{};
+  if (inflateInit2(&zs, 16 + MAX_WBITS) != Z_OK) return "";
+  std::string out;
+  char buf[65536];
+  zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(gz.data()));
+  zs.avail_in = static_cast<uInt>(gz.size());
+  int rc = Z_OK;
+  while (rc == Z_OK && out.size() < max_out) {
+    zs.next_out = reinterpret_cast<Bytef*>(buf);
+    zs.avail_out = sizeof(buf);
+    rc = inflate(&zs, Z_NO_FLUSH);
+    if (rc == Z_OK || rc == Z_STREAM_END) {
+      out.append(buf, sizeof(buf) - zs.avail_out);
+    }
+  }
+  inflateEnd(&zs);
+  return rc == Z_STREAM_END ? out : "";
+}
+
+}  // namespace
+
+Json Master::model_def_file_tree(const std::string& hash,
+                                 const std::string& b64) {
+  // LRU by content hash: listing a sweep's shared tarball once, not per
+  // page view (reference master/internal/cache/file_cache.go).
+  static std::mutex cache_mu;
+  static std::map<std::string, Json> cache;
+  static std::deque<std::string> order;  // front = LRU victim
+  if (!hash.empty()) {
+    std::lock_guard<std::mutex> lock(cache_mu);
+    auto it = cache.find(hash);
+    if (it != cache.end()) {
+      // refresh recency
+      auto oit = std::find(order.begin(), order.end(), hash);
+      if (oit != order.end()) order.erase(oit);
+      order.push_back(hash);
+      return it->second;
+    }
+  }
+  std::string tar = gunzip(b64_decode(b64));
+  if (tar.empty() && !b64.empty()) {
+    // Corrupt, truncated, or over-limit archives must error loudly —
+    // a silently-empty (and cached!) listing hides real problems.
+    throw std::runtime_error("model definition tarball is not readable");
+  }
+  Json files = Json::array();
+  // POSIX tar: 512-byte header blocks; name at 0 (100), size octal at
+  // 124 (12), typeflag at 156, ustar path prefix at 345 (155); data
+  // padded to 512. PAX 'x' records override the NEXT entry's path; GNU
+  // 'L' records carry a longname the same way.
+  size_t off = 0;
+  std::string path_override;
+  while (off + 512 <= tar.size()) {
+    const char* h = tar.data() + off;
+    if (h[0] == '\0') break;  // end-of-archive zero block
+    std::string name(h, strnlen(h, 100));
+    std::string prefix(h + 345, strnlen(h + 345, 155));
+    char type = h[156];
+    long size = strtol(std::string(h + 124, 12).c_str(), nullptr, 8);
+    if (size < 0) break;
+    size_t data_off = off + 512;
+    size_t data_len = std::min(static_cast<size_t>(size),
+                               tar.size() - std::min(tar.size(), data_off));
+    if (type == 'x' || type == 'g') {
+      // PAX record: "len path=value\n" entries; keep a path override.
+      std::string rec(tar.data() + data_off, data_len);
+      size_t p = 0;
+      while (p < rec.size()) {
+        size_t sp = rec.find(' ', p);
+        size_t nl = rec.find('\n', p);
+        if (sp == std::string::npos || nl == std::string::npos) break;
+        std::string kv = rec.substr(sp + 1, nl - sp - 1);
+        if (type == 'x' && kv.rfind("path=", 0) == 0) {
+          path_override = kv.substr(5);
+        }
+        p = nl + 1;
+      }
+    } else if (type == 'L') {  // GNU longname
+      path_override.assign(tar.data() + data_off, data_len);
+      while (!path_override.empty() && path_override.back() == '\0') {
+        path_override.pop_back();
+      }
+    } else if (type == '0' || type == '\0') {  // regular file only
+      std::string path = !path_override.empty()
+                             ? path_override
+                             : (prefix.empty() ? name : prefix + "/" + name);
+      path_override.clear();
+      if (!path.empty()) {
+        Json f = Json::object();
+        f["path"] = path;
+        f["size"] = static_cast<int64_t>(size);
+        files.push_back(std::move(f));
+      }
+    } else {
+      path_override.clear();  // override applies only to the next entry
+    }
+    off += 512 + ((static_cast<size_t>(size) + 511) / 512) * 512;
+  }
+  if (!hash.empty()) {
+    std::lock_guard<std::mutex> lock(cache_mu);
+    if (cache.emplace(hash, files).second) {
+      order.push_back(hash);
+      while (order.size() > 16) {
+        cache.erase(order.front());
+        order.pop_front();
+      }
+    }
+  }
+  return files;
 }
 
 }  // namespace det

@@ -12,6 +12,7 @@
 #include <cmath>
 #include <iostream>
 
+#include "../common/tls.h"
 #include "master.h"
 
 namespace det {
@@ -116,11 +117,32 @@ int64_t Master::create_experiment_locked(const Json& config,
   std::string job_id = "job-" + random_hex(8);
   db_.exec("INSERT INTO jobs (id, type) VALUES (?, 'EXPERIMENT')",
            {Json(job_id)});
+  // Content-addressed model-def store (reference master/internal/cache
+  // role): identical context tarballs — every submit of a sweep script —
+  // are stored once; experiments reference the blob by hash.
+  std::string md_hash;
+  if (!model_def_b64.empty()) {
+    try {
+      md_hash = sha256_hex(model_def_b64);
+    } catch (const std::exception&) {
+      // libcrypto is optional (runtime dlopen, like TLS); without it the
+      // blob is stored inline per experiment, as before the store.
+    }
+  }
+  if (!md_hash.empty()) {
+    db_.exec(
+        "INSERT INTO model_defs (hash, blob, refcount) VALUES (?, ?, 1) "
+        "ON CONFLICT(hash) DO UPDATE SET refcount = refcount + 1",
+        {Json(md_hash), Json(model_def_b64)});
+  }
   int64_t eid = db_.insert(
-      "INSERT INTO experiments (state, config, original_config, model_def, "
-      "owner_id, project_id, job_id) VALUES ('PAUSED', ?, ?, ?, ?, ?, ?)",
-      {Json(config.dump()), Json(config.dump()), Json(model_def_b64),
-       Json(user_id), Json(project_id), Json(job_id)});
+      "INSERT INTO experiments (state, config, original_config, "
+      "model_def, model_def_hash, owner_id, project_id, job_id) "
+      "VALUES ('PAUSED', ?, ?, ?, ?, ?, ?, ?)",
+      {Json(config.dump()), Json(config.dump()),
+       md_hash.empty() ? Json(model_def_b64) : Json(""),
+       md_hash.empty() ? Json() : Json(md_hash), Json(user_id),
+       Json(project_id), Json(job_id)});
 
   ExperimentState exp;
   exp.id = eid;

@@ -66,6 +66,8 @@ ROUTES = [
      "List experiment checkpoints"),
     ("get", "/api/v1/experiments/{id}/model_def", "experiments",
      "Download the model definition tarball (base64)"),
+    ("get", "/api/v1/experiments/{id}/file_tree", "experiments",
+     "List the model definition's files (content-cached by tarball hash)"),
     ("get", "/api/v1/experiments/{id}/searcher_events", "experiments",
      "Custom-searcher event long-poll"),
     ("post", "/api/v1/experiments/{id}/searcher_operations", "experiments",

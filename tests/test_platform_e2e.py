@@ -631,3 +631,47 @@ def test_cli_workflow(cluster, tmp_path, monkeypatch, capsys):
     rc = cli.main(["-m", cluster.master_url, "agent", "list"])
     assert rc == 0
     assert "agent-0" in capsys.readouterr().out
+
+
+def test_model_def_content_store_and_file_tree(cluster, tmp_path):
+    """Content-addressed model-def store (reference master/internal/cache
+    role): identical context tarballs dedupe to one blob; trials still
+    fetch their context; file_tree lists the tarball's files; delete
+    releases the reference."""
+    cfg = _experiment_config(tmp_path)
+    e1, token = _create_experiment(cluster, cfg, activate=False)
+    e2, _ = _create_experiment(cluster, cfg, activate=False)
+
+    # Same tarball → the two experiments share one blob.
+    md1 = cluster.api("GET", f"/api/v1/experiments/{e1}/model_def",
+                      token=token)["b64_tgz"]
+    md2 = cluster.api("GET", f"/api/v1/experiments/{e2}/model_def",
+                      token=token)["b64_tgz"]
+    assert md1 == md2 and md1
+
+    tree = cluster.api("GET", f"/api/v1/experiments/{e1}/file_tree",
+                       token=token)["files"]
+    paths = {f["path"] for f in tree}
+    assert "train.py" in paths, paths
+    assert all(f["size"] >= 0 for f in tree)
+    # PAX/GNU metadata records must not leak as pseudo-files.
+    assert not any("PaxHeader" in p for p in paths), paths
+
+    # A run still gets its context after dedupe (activate e1, let the
+    # trial extract + complete).
+    cluster.api("POST", f"/api/v1/experiments/{e1}/activate", token=token)
+    _wait_experiment(cluster, e1, token)
+
+    # Cancel + delete e2: the blob must survive (e1 still references it).
+    cluster.api("POST", f"/api/v1/experiments/{e2}/cancel", token=token)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st = cluster.api("GET", f"/api/v1/experiments/{e2}",
+                         token=token)["experiment"]["state"]
+        if st in ("CANCELED", "COMPLETED", "ERROR"):
+            break
+        time.sleep(0.2)
+    cluster.api("DELETE", f"/api/v1/experiments/{e2}", token=token)
+    md1_after = cluster.api("GET", f"/api/v1/experiments/{e1}/model_def",
+                            token=token)["b64_tgz"]
+    assert md1_after == md1
