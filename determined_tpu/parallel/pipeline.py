@@ -138,11 +138,13 @@ def pipeline_apply(
             x_next = jax.lax.ppermute(y, axis, perm)
             return (x_next, outputs), None
 
-        # pvary: the carries are device-varying over the pipeline axis from
-        # tick 1 on; mark the zero-init the same way so the scan carry type
-        # is stable under varying-manual-axes checking.
-        outputs = jax.lax.pvary(jnp.zeros_like(micro_local), (axis,))
-        x0 = jax.lax.pvary(jnp.zeros_like(micro_local[0]), (axis,))
+        # pcast-to-varying: the carries are device-varying over the
+        # pipeline axis from tick 1 on; mark the zero-init the same way so
+        # the scan carry type is stable under varying-manual-axes checking.
+        outputs = jax.lax.pcast(
+            jnp.zeros_like(micro_local), (axis,), to="varying")
+        x0 = jax.lax.pcast(
+            jnp.zeros_like(micro_local[0]), (axis,), to="varying")
         (x_cur, outputs), _ = jax.lax.scan(
             tick, (x0, outputs), jnp.arange(total))
         # Only the last stage holds real outputs; replicate over the axis so

@@ -626,6 +626,22 @@ async function pageExperiment(id) {
         el("td", { class: "muted" }, c.report_time ?? "")))));
   }
 
+  // Model-definition file listing (content-cached server-side). The
+  // fetch is best-effort (unreadable tarball → 500); rendering stays
+  // OUTSIDE the catch so real UI bugs surface.
+  let fileTree = null;
+  try {
+    fileTree = (await API.getExperimentsIdFileTree(id)).files;
+  } catch (e) { console.warn("file_tree unavailable:", e.message); }
+  if (fileTree && fileTree.length) {
+    view.append(el("h2", {}, "Files"));
+    view.append(el("table", {},
+      el("tr", {}, ["Path", "Bytes"].map((h) => el("th", {}, h))),
+      fileTree.map((f) => el("tr", {},
+        el("td", { class: "muted" }, f.path),
+        el("td", {}, f.size)))));
+  }
+
   view.append(el("h2", {}, "Config"));
   view.append(el("pre", { class: "config" },
     JSON.stringify(experiment.config, null, 2)));
