@@ -326,7 +326,13 @@ def cmd_ntsc(session: Session, args) -> int:
         config["entrypoint"] = args.cmd
     if getattr(args, "experiment_ids", None):
         config["experiment_ids"] = args.experiment_ids
-    resp = session.post(f"/api/v1/{kind}", body={"config": config})
+    body: Dict[str, Any] = {"config": config}
+    if getattr(args, "context", None):
+        # Ship a context dir with the task (reference `det cmd run
+        # --context`); extracted into the workdir, startup-hook.sh runs
+        # before the entrypoint.
+        body["context"] = _tar_context(args.context)
+    resp = session.post(f"/api/v1/{kind}", body=body)
     print(f"Started {resp['id']} (allocation {resp['allocation_id']})")
     if kind in ("notebooks", "tensorboards"):
         # Wait briefly for the server address to be reported.
@@ -772,6 +778,8 @@ def build_parser() -> argparse.ArgumentParser:
         if cli_name == "tensorboard":
             start.add_argument("experiment_ids", type=int, nargs="+")
         start.add_argument("--config-file")
+        start.add_argument("--context", metavar="DIR",
+                           help="directory shipped to the task workdir")
         start.set_defaults(func=cmd_ntsc, kind=kind, action="start")
         nt.add_parser("list").set_defaults(func=cmd_ntsc, kind=kind,
                                            action="list")

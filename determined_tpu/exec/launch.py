@@ -108,6 +108,18 @@ def main() -> int:
     config = json.loads(os.environ.get("DET_EXPERIMENT_CONFIG", "{}"))
     apply_task_environment(env, config)
 
+    # startup-hook.sh from the context dir runs before the entrypoint
+    # (reference exec/prep_container.py + entrypoint.sh: dependency
+    # installs, data staging). A failing hook fails the task — running a
+    # trial against a half-prepared environment would be worse.
+    hook = os.path.join(workdir, "startup-hook.sh")
+    if os.path.exists(hook):
+        logger.info("running startup-hook.sh")
+        rc = subprocess.run(["sh", hook], env=env, cwd=workdir).returncode
+        if rc != 0:
+            logger.error("startup-hook.sh failed (exit %d)", rc)
+            return rc
+
     cmd = build_command(config)
     logger.info("launching entrypoint: %s", cmd)
     proc = subprocess.Popen(cmd, env=env, cwd=workdir)

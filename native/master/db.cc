@@ -396,6 +396,12 @@ CREATE TABLE model_defs (
 );
 ALTER TABLE experiments ADD COLUMN model_def_hash TEXT;
 )sql"},
+      // NTSC/generic tasks can ship a context directory too
+      // (reference `det cmd run --context`); stored content-addressed
+      // in model_defs like experiment model definitions.
+      {14, R"sql(
+ALTER TABLE tasks ADD COLUMN context_hash TEXT;
+)sql"},
   };
   return kMigrations;
 }

@@ -267,6 +267,17 @@ void Master::scheduler_loop() {
       db_.exec(
           "DELETE FROM user_sessions WHERE expires_at IS NOT NULL AND "
           "expires_at < datetime('now')");
+      // Context blobs of ended tasks: the terminal transitions release
+      // inline; this catches any path that missed (e.g. tasks orphaned
+      // by a master restart) so blobs can't accumulate forever.
+      db_.exec(
+          "UPDATE model_defs SET refcount = refcount - 1 WHERE hash IN "
+          "(SELECT context_hash FROM tasks WHERE end_time IS NOT NULL "
+          "AND context_hash IS NOT NULL)");
+      db_.exec(
+          "UPDATE tasks SET context_hash=NULL WHERE end_time IS NOT NULL "
+          "AND context_hash IS NOT NULL");
+      db_.exec("DELETE FROM model_defs WHERE refcount <= 0");
       if (cfg_.log_retention_days > 0) {
         int64_t n = sweep_task_logs(cfg_.log_retention_days);
         if (n > 0) {

@@ -1179,21 +1179,28 @@ HttpResponse Master::handle_tasks(const HttpRequest& req,
   if (parts.size() < 2) return json_resp(404, err_body("not found"));
   const std::string& task_id = parts[1];
 
-  // GET /api/v1/tasks/{id}/context — model-def tarball (base64)
+  // GET /api/v1/tasks/{id}/context — context tarball (base64)
   // (GetTaskContextDirectory; harness/determined/exec/prep_container.py).
+  // Trial tasks serve the experiment's model definition; NTSC/generic
+  // tasks serve their own uploaded context (`det cmd run --context`).
   if (parts.size() == 3 && parts[2] == "context") {
-    std::string sql =
-        "SELECT COALESCE(md.blob, e.model_def) AS model_def "
-        "FROM experiments e JOIN trials t ON t.experiment_id = e.id "
-        "LEFT JOIN model_defs md ON md.hash = e.model_def_hash "
-        "WHERE t.id=?";
-    int64_t trial_id = -1;
-    if (task_id.rfind("trial-", 0) == 0) {
-      trial_id = to_id(task_id.substr(6));
-    }
-    auto rows = db_.query(sql, {Json(trial_id)});
     Json out = Json::object();
-    out["b64_tgz"] = rows.empty() ? Json("") : rows[0]["model_def"];
+    out["b64_tgz"] = Json("");
+    if (task_id.rfind("trial-", 0) == 0) {
+      auto rows = db_.query(
+          "SELECT COALESCE(md.blob, e.model_def) AS model_def "
+          "FROM experiments e JOIN trials t ON t.experiment_id = e.id "
+          "LEFT JOIN model_defs md ON md.hash = e.model_def_hash "
+          "WHERE t.id=?",
+          {Json(to_id(task_id.substr(6)))});
+      if (!rows.empty()) out["b64_tgz"] = rows[0]["model_def"];
+    } else {
+      auto rows = db_.query(
+          "SELECT md.blob AS ctx FROM tasks tk "
+          "JOIN model_defs md ON md.hash = tk.context_hash WHERE tk.id=?",
+          {Json(task_id)});
+      if (!rows.empty()) out["b64_tgz"] = rows[0]["ctx"];
+    }
     return json_resp(200, out);
   }
 

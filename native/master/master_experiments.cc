@@ -120,27 +120,12 @@ int64_t Master::create_experiment_locked(const Json& config,
   // Content-addressed model-def store (reference master/internal/cache
   // role): identical context tarballs — every submit of a sweep script —
   // are stored once; experiments reference the blob by hash.
-  std::string md_hash;
-  if (!model_def_b64.empty()) {
-    try {
-      md_hash = sha256_hex(model_def_b64);
-    } catch (const std::exception&) {
-      // libcrypto is optional (runtime dlopen, like TLS); without it the
-      // blob is stored inline per experiment, as before the store.
-    }
-  }
-  if (!md_hash.empty()) {
-    db_.exec(
-        "INSERT INTO model_defs (hash, blob, refcount) VALUES (?, ?, 1) "
-        "ON CONFLICT(hash) DO UPDATE SET refcount = refcount + 1",
-        {Json(md_hash), Json(model_def_b64)});
-  }
+  std::string md_hash = store_context_blob_locked(model_def_b64);
   int64_t eid = db_.insert(
       "INSERT INTO experiments (state, config, original_config, "
       "model_def, model_def_hash, owner_id, project_id, job_id) "
-      "VALUES ('PAUSED', ?, ?, ?, ?, ?, ?, ?)",
+      "VALUES ('PAUSED', ?, ?, '', ?, ?, ?, ?)",
       {Json(config.dump()), Json(config.dump()),
-       md_hash.empty() ? Json(model_def_b64) : Json(""),
        md_hash.empty() ? Json() : Json(md_hash), Json(user_id),
        Json(project_id), Json(job_id)});
 
@@ -437,6 +422,34 @@ void Master::request_allocation_locked(ExperimentState& exp,
   cv_.notify_all();
 }
 
+std::string Master::store_context_blob_locked(const std::string& b64) {
+  if (b64.empty()) return "";
+  std::string hash;
+  try {
+    hash = sha256_hex(b64);
+  } catch (const std::exception&) {
+    // libcrypto is optional (runtime dlopen, like TLS): store under a
+    // random key — dedupe lost, feature intact.
+    hash = "raw-" + random_hex(16);
+  }
+  db_.exec(
+      "INSERT INTO model_defs (hash, blob, refcount) VALUES (?, ?, 1) "
+      "ON CONFLICT(hash) DO UPDATE SET refcount = refcount + 1",
+      {Json(hash), Json(b64)});
+  return hash;
+}
+
+void Master::release_task_context_locked(const std::string& task_id) {
+  // NTSC/generic tasks hold their context only while they can run; a
+  // terminal task releases its claim so blobs can't accumulate forever.
+  db_.exec(
+      "UPDATE model_defs SET refcount = refcount - 1 WHERE hash = "
+      "(SELECT context_hash FROM tasks WHERE id=?)",
+      {Json(task_id)});
+  db_.exec("UPDATE tasks SET context_hash=NULL WHERE id=?", {Json(task_id)});
+  db_.exec("DELETE FROM model_defs WHERE refcount <= 0");
+}
+
 void Master::finish_trial_locked(ExperimentState& exp, TrialState& trial,
                                  const std::string& state) {
   if (is_terminal(trial.state)) return;
@@ -526,6 +539,7 @@ void Master::on_allocation_exit_locked(Allocation& alloc) {
         "UPDATE tasks SET state=?, end_time=datetime('now') "
         "WHERE id=? AND end_time IS NULL",
         {Json(exit_code == 0 ? "COMPLETED" : "ERROR"), Json(alloc.task_id)});
+    release_task_context_locked(alloc.task_id);
     cv_.notify_all();
     return;
   }

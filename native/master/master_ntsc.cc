@@ -17,6 +17,7 @@
 #include <algorithm>
 #include <sstream>
 
+#include "../common/tls.h"
 #include "master.h"
 
 namespace det {
@@ -80,6 +81,7 @@ void Master::kill_task_tree_locked(const std::string& task_id) {
   db_.exec("UPDATE tasks SET state='CANCELED', end_time=datetime('now') "
            "WHERE id=? AND end_time IS NULL",
            {Json(task_id)});
+  release_task_context_locked(task_id);
   // Recurse into children (task trees, api_generic_tasks.go:432).
   auto children = db_.query(
       "SELECT id FROM tasks WHERE parent_id=? AND end_time IS NULL",
@@ -521,12 +523,17 @@ HttpResponse Master::handle_ntsc(const HttpRequest& req,
         return json_resp(404, err_body("no such parent task"));
       }
     }
+    // Optional context tarball (reference `det cmd run --context`):
+    // content-addressed in model_defs, same dedupe as experiments.
+    std::string ctx_hash =
+        store_context_blob_locked(body["context"].as_string(""));
     db_.exec(
         "INSERT INTO tasks (id, type, state, config, owner_id, parent_id, "
-        "workspace_id) VALUES (?, ?, 'ACTIVE', ?, ?, ?, ?)",
+        "workspace_id, context_hash) VALUES (?, ?, 'ACTIVE', ?, ?, ?, ?, ?)",
         {Json(task_id), Json(meta.type), Json(config.dump()), Json(uid),
          parent.empty() ? Json() : Json(parent),
-         Json(body["workspace_id"].as_int(1))});
+         Json(body["workspace_id"].as_int(1)),
+         ctx_hash.empty() ? Json() : Json(ctx_hash)});
 
     Allocation alloc;
     alloc.id = "alloc-" + task_id;

@@ -8,11 +8,17 @@ venv activation + PYTHONPATH extension before exec'ing the entrypoint."""
 
 import os
 import sys
+import time
 
 import pytest
 
 from determined_tpu.exec.launch import apply_task_environment
-from tests.test_platform_e2e import Devcluster, _wait_experiment, native_binaries  # noqa: F401
+from tests.test_platform_e2e import (  # noqa: F401
+    FIXTURES,
+    Devcluster,
+    _wait_experiment,
+    native_binaries,
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TASKENV_FIXTURES = os.path.join(REPO, "tests", "fixtures", "taskenv")
@@ -145,3 +151,138 @@ def test_task_environment_e2e(cluster, tmp_path):
         "GET", f"/api/v1/experiments/{resp['id']}/trials", token=token
     )["trials"]
     assert logs[0]["state"] == "COMPLETED"
+
+
+def test_startup_hook_runs_before_entrypoint(cluster, tmp_path):
+    """startup-hook.sh in the context dir runs before the entrypoint
+    (reference exec/prep_container.py); a failing hook fails the task."""
+    import shutil
+
+    ctx = tmp_path / "hookctx"
+    ctx.mkdir()
+    shutil.copy(os.path.join(FIXTURES, "train.py"), ctx / "train.py")
+    (ctx / "startup-hook.sh").write_text(
+        "echo hook-side-effect > hook_output.txt\n"
+        "echo startup-hook-ran-$((40+4))\n")
+    (ctx / "reader.py").write_text(
+        "print('hook says:', open('hook_output.txt').read().strip())\n")
+
+    token = cluster.login()
+    import determined_tpu.cli as cli
+
+    tid = cluster.api(
+        "POST", "/api/v1/commands",
+        {"config": {"entrypoint": "python3 reader.py"},
+         "context": cli._tar_context(str(ctx))}, token=token)["id"]
+    deadline = time.time() + 60
+    state = None
+    while time.time() < deadline:
+        t = cluster.api("GET", f"/api/v1/commands/{tid}", token=token)["task"]
+        state = t["state"]
+        if state in ("COMPLETED", "ERROR", "CANCELED"):
+            break
+        time.sleep(0.2)
+    assert state == "COMPLETED", state
+    logs = cluster.api("GET", f"/api/v1/tasks/{tid}/logs",
+                       token=token)["logs"]
+    text = "\n".join(line["log"] for line in logs)
+    assert "startup-hook-ran-44" in text       # hook output shipped
+    assert "hook says: hook-side-effect" in text  # entrypoint saw its work
+
+    # Failing hook → task fails, entrypoint never runs.
+    ctx2 = tmp_path / "hookctx2"
+    ctx2.mkdir()
+    (ctx2 / "startup-hook.sh").write_text("echo doomed; exit 3\n")
+    (ctx2 / "nope.py").write_text("print('must-not-run')\n")
+    tid2 = cluster.api(
+        "POST", "/api/v1/commands",
+        {"config": {"entrypoint": "python3 nope.py"},
+         "context": cli._tar_context(str(ctx2))}, token=token)["id"]
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        t = cluster.api("GET", f"/api/v1/commands/{tid2}",
+                        token=token)["task"]
+        if t["state"] in ("COMPLETED", "ERROR", "CANCELED"):
+            break
+        time.sleep(0.2)
+    assert t["state"] == "ERROR", t["state"]
+    logs2 = cluster.api("GET", f"/api/v1/tasks/{tid2}/logs",
+                        token=token)["logs"]
+    text2 = "\n".join(line["log"] for line in logs2)
+    assert "must-not-run" not in text2
+
+
+def test_cli_cmd_run_with_context(cluster, tmp_path):
+    """`det cmd run --context DIR …` ships the dir (reference parity)."""
+    import subprocess
+
+    ctx = tmp_path / "clictx"
+    ctx.mkdir()
+    (ctx / "data.txt").write_text("context-payload-99\n")
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        JAX_PLATFORMS="cpu",
+        HOME=cluster.tmpdir,
+    )
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(
+        [sys.executable, "-m", "determined_tpu.cli",
+         "-m", cluster.master_url, "cmd", "run", "--context", str(ctx),
+         "cat", "data.txt"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    tid = r.stdout.split("Started ")[1].split(" ")[0]
+    token = cluster.login()
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        t = cluster.api("GET", f"/api/v1/commands/{tid}", token=token)["task"]
+        if t["state"] in ("COMPLETED", "ERROR", "CANCELED"):
+            break
+        time.sleep(0.2)
+    assert t["state"] == "COMPLETED", t["state"]
+    logs = cluster.api("GET", f"/api/v1/tasks/{tid}/logs",
+                       token=token)["logs"]
+    assert any("context-payload-99" in line["log"] for line in logs)
+
+
+def test_task_context_released_on_terminal(cluster, tmp_path):
+    """A terminal task releases its content-store claim: blobs must not
+    accumulate per `det cmd run --context` invocation."""
+    import sqlite3
+
+    ctx = tmp_path / "relctx"
+    ctx.mkdir()
+    (ctx / "unique.txt").write_text(f"payload-{tmp_path}\n")
+    import determined_tpu.cli as cli
+
+    token = cluster.login()
+    tid = cluster.api(
+        "POST", "/api/v1/commands",
+        {"config": {"entrypoint": "cat unique.txt"},
+         "context": cli._tar_context(str(ctx))}, token=token)["id"]
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        t = cluster.api("GET", f"/api/v1/commands/{tid}", token=token)["task"]
+        if t["state"] in ("COMPLETED", "ERROR", "CANCELED"):
+            break
+        time.sleep(0.2)
+    assert t["state"] == "COMPLETED", t["state"]
+
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        con = sqlite3.connect(f"file:{cluster.db_path}?mode=ro", uri=True)
+        try:
+            row = con.execute(
+                "SELECT context_hash FROM tasks WHERE id=?", (tid,)
+            ).fetchone()
+            n_blobs = con.execute(
+                "SELECT COUNT(*) FROM model_defs WHERE refcount <= 0"
+            ).fetchone()[0]
+        finally:
+            con.close()
+        if row and row[0] is None and n_blobs == 0:
+            return
+        time.sleep(0.5)
+    raise AssertionError(f"context not released: hash={row}, "
+                         f"zombie blobs={n_blobs}")
