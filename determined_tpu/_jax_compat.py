@@ -1,0 +1,120 @@
+"""Shims for jax API drift around ambient meshes.
+
+The codebase targets the jax >= 0.5 ambient-mesh API
+(``jax.sharding.set_mesh`` / ``use_mesh`` / ``get_abstract_mesh``). On
+older jax (0.4.x) the same mechanism exists only as the physical mesh
+context (``with Mesh(...):`` installing
+``thread_resources.env.physical_mesh``), under different names.
+``install()`` grafts the missing names onto ``jax.sharding`` so every
+call site works on both, without pinning jax.
+
+Modules that touch these APIs (train.trainer, ops.ring_attention,
+ops.ulysses) call ``install()`` at import; tests get it from conftest.
+Idempotent and a no-op on jax versions that already ship the API.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+
+# True while tracing the body of a shim-wrapped (fully-manual) shard_map —
+# sharding constraints naming mesh axes are illegal there, and
+# shard_logical consults this to skip them. Always False on jax >= 0.5,
+# where the real partial-auto API is used and constraints are legal.
+_in_manual_body: contextvars.ContextVar[int] = contextvars.ContextVar(
+    "det_jax_compat_in_manual_body", default=0)
+
+
+def in_manual_shard_map() -> bool:
+    return _in_manual_body.get() > 0
+
+
+def install() -> None:
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        # Promoted out of jax.experimental in jax 0.5, with a reworked
+        # signature: axis_names= replaced auto= (as its complement) and
+        # varying-type checking (check_vma=) replaced check_rep=.
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+                      check_vma=None, check_rep=None, **kwargs):
+            # axis_names ⊂ mesh (partial-auto) is approximated with full
+            # manual: old partial-auto lowers axis_index to a PartitionId
+            # the SPMD partitioner rejects. Axes the specs don't mention
+            # see replicated blocks — numerically identical, redundant
+            # compute on those axes. (Full-fidelity partial-auto needs the
+            # jax >= 0.5 API, where this wrapper is never installed.)
+            if check_rep is None:
+                # Replication checking predates (and is stricter than) the
+                # vma discipline the call sites are written against.
+                check_rep = False
+
+            def body(*args, **kw):
+                token = _in_manual_body.set(_in_manual_body.get() + 1)
+                try:
+                    return f(*args, **kw)
+                finally:
+                    _in_manual_body.reset(token)
+
+            return _shard_map(body, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_rep,
+                              **kwargs)
+
+        jax.shard_map = shard_map
+    if not hasattr(jax.lax, "pcast"):
+        # pcast only casts between varying/invariant *types*; without the
+        # vma type system it is the identity on values.
+        jax.lax.pcast = lambda x, axis_name=None, *, to=None: x
+
+    sh = jax.sharding
+    if not hasattr(sh, "set_mesh"):
+
+        @contextlib.contextmanager
+        def set_mesh(mesh):
+            # jax < 0.5: entering the physical mesh is what installs the
+            # ambient mesh consulted by bare-PartitionSpec sharding
+            # constraints and by get_abstract_mesh below.
+            with mesh:
+                yield mesh
+
+        sh.set_mesh = set_mesh
+    if not hasattr(sh, "use_mesh"):
+        sh.use_mesh = sh.set_mesh
+    if not hasattr(sh, "get_abstract_mesh"):
+
+        def get_abstract_mesh():
+            from jax._src import mesh as mesh_lib
+
+            # The physical mesh stands in for the abstract one; callers
+            # only consult .empty and .shape, which both carry.
+            return mesh_lib.thread_resources.env.physical_mesh
+
+        sh.get_abstract_mesh = get_abstract_mesh
+
+    try:
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+    except ImportError:  # pallas not in this build
+        return
+    if not hasattr(pltpu, "force_tpu_interpret_mode"):
+
+        @contextlib.contextmanager
+        def force_tpu_interpret_mode():
+            # Older pallas has no global switch, only the per-call
+            # `interpret=` flag; flip its default for the scope.
+            orig = pl.pallas_call
+
+            def _interpreted(*args, **kwargs):
+                kwargs.setdefault("interpret", True)
+                return orig(*args, **kwargs)
+
+            pl.pallas_call = _interpreted
+            try:
+                yield
+            finally:
+                pl.pallas_call = orig
+
+        pltpu.force_tpu_interpret_mode = force_tpu_interpret_mode

@@ -10,12 +10,14 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import random
 import socket
 import ssl
 import time
 import urllib.error
 import urllib.parse
 import urllib.request
+import uuid
 from typing import Any, Dict, Optional
 
 
@@ -56,22 +58,48 @@ class APIError(Exception):
 
 
 class Session:
-    """Authenticated master session with retry on transient failures."""
+    """Authenticated master session with retry on transient failures.
+
+    Retry policy (chaos-hardened, see docs/chaos.md):
+      - capped exponential backoff with FULL jitter between attempts
+        (sleep ~ U(0, min(cap, base * 2**attempt)));
+      - a `Retry-After: <seconds>` response header sets the floor for the
+        next sleep; 429 is always retried;
+      - 502/503/504 are retried for every method (gateway-transient);
+        500 and other 5xx are retried only when the request is safe to
+        repeat — GETs, and POSTs carrying an idempotency key;
+      - POSTs sent with `idempotent=True` get an `X-Idempotency-Key`
+        header, generated once per logical request, so the master can
+        answer a retry from its replay cache instead of re-applying the
+        mutation (a re-sent metric report cannot double-count).
+    """
 
     def __init__(
         self,
         master_url: str,
         token: Optional[str] = None,
-        max_retries: int = 5,
+        max_retries: int = 8,
         timeout: float = 30.0,
+        backoff_base: float = 0.1,
+        backoff_cap: float = 5.0,
     ):
         self.master_url = master_url.rstrip("/")
         self.token = token
         self.max_retries = max_retries
         self.timeout = timeout
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
         self._ssl_ctx = (
             _https_context() if self.master_url.startswith("https://") else None
         )
+
+    def _backoff(self, attempt: int, retry_after: Optional[float]) -> None:
+        delay = random.uniform(
+            0.0, min(self.backoff_cap, self.backoff_base * (2.0 ** attempt))
+        )
+        if retry_after is not None:
+            delay = max(delay, retry_after)
+        time.sleep(delay)
 
     @classmethod
     def login(cls, master_url: str, user: str = "determined",
@@ -90,6 +118,7 @@ class Session:
         body: Optional[Dict[str, Any]] = None,
         params: Optional[Dict[str, Any]] = None,
         timeout: Optional[float] = None,
+        idempotent: bool = False,
     ) -> Any:
         url = self.master_url + path
         if params:
@@ -100,8 +129,17 @@ class Session:
         headers = {"Content-Type": "application/json"}
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
+        if idempotent and method not in ("GET", "HEAD"):
+            # One key per LOGICAL request: every retry below re-sends the
+            # same key, so the master replays rather than re-applies.
+            headers["X-Idempotency-Key"] = uuid.uuid4().hex
+        safe_to_repeat = method in ("GET", "HEAD") or idempotent
         last_exc: Optional[Exception] = None
+        retry_after: Optional[float] = None
         for attempt in range(self.max_retries):
+            if attempt:
+                self._backoff(attempt - 1, retry_after)
+            retry_after = None
             req = urllib.request.Request(url, data=data, headers=headers, method=method)
             try:
                 with urllib.request.urlopen(req, timeout=timeout or self.timeout,
@@ -110,7 +148,15 @@ class Session:
                     return json.loads(text) if text else None
             except urllib.error.HTTPError as e:
                 body_text = e.read().decode(errors="replace")
-                if e.code in (502, 503, 504) and attempt < self.max_retries - 1:
+                retryable = e.code == 429 or e.code in (502, 503, 504) or (
+                    500 <= e.code < 600 and safe_to_repeat
+                )
+                if retryable and attempt < self.max_retries - 1:
+                    ra = e.headers.get("Retry-After") if e.headers else None
+                    try:
+                        retry_after = float(ra) if ra else None
+                    except ValueError:
+                        retry_after = None
                     last_exc = e
                 else:
                     raise APIError(e.code, body_text, url) from None
@@ -121,7 +167,6 @@ class Session:
                 if isinstance(reason, ssl.SSLCertVerificationError):
                     raise reason from None
                 last_exc = e
-            time.sleep(min(2.0 ** attempt * 0.1, 5.0))
         raise ConnectionError(f"master unreachable at {url}: {last_exc}")
 
     def get(self, path: str, params: Optional[Dict[str, Any]] = None,
@@ -129,8 +174,10 @@ class Session:
         return self._request("GET", path, params=params, timeout=timeout)
 
     def post(self, path: str, body: Optional[Dict[str, Any]] = None,
-             params: Optional[Dict[str, Any]] = None) -> Any:
-        return self._request("POST", path, body=body, params=params)
+             params: Optional[Dict[str, Any]] = None,
+             idempotent: bool = False) -> Any:
+        return self._request("POST", path, body=body, params=params,
+                             idempotent=idempotent)
 
     def patch(self, path: str, body: Optional[Dict[str, Any]] = None) -> Any:
         return self._request("PATCH", path, body=body)

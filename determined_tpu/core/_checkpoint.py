@@ -324,4 +324,6 @@ class CheckpointContext:
                 record["resources"] = self._storage.list_files(storage_id)
             except Exception:
                 pass
-        self._session.post("/api/v1/checkpoints", body=record)
+        # idempotent: a retried report must not double-register the
+        # checkpoint or re-bump the trial's resume pointer.
+        self._session.post("/api/v1/checkpoints", body=record, idempotent=True)

@@ -45,6 +45,9 @@ class _JaxTransport:
         buf = np.zeros(maxlen, np.uint8)
         buf[: len(payload)] = np.frombuffer(payload, np.uint8)
         gathered = np.asarray(multihost_utils.process_allgather(buf))
+        # Older jax returns the lone buffer un-stacked in single-process
+        # runs; normalize to [n_processes, maxlen] either way.
+        gathered = gathered.reshape(len(lengths), -1)
         return [
             gathered[i, : int(lengths[i])].tobytes() for i in range(len(lengths))
         ]

@@ -86,9 +86,12 @@ class SearcherContext:
             self.completed_metrics.append((op.length, metric))
             return
         if self._dist is None or self._dist.is_chief:
+            # idempotent: replaying a completed-op report would pop the
+            # next pending op and advance the searcher twice.
             self._session.post(
                 f"/api/v1/trials/{self._trial_id}/searcher/completed_operation",
                 body={"length": op.length, "searcher_metric": float(metric)},
+                idempotent=True,
             )
 
     def operations(self, auto_ack: bool = True) -> Iterator[SearcherOperation]:

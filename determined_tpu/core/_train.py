@@ -73,9 +73,12 @@ class TrainContext:
             store.append(record)
             logger.info("[%s] step=%d %s", group, steps_completed, metrics)
         else:
+            # idempotent: a retry after a lost response must not
+            # double-count this report (master-side replay cache).
             self._session.post(
                 f"/api/v1/trials/{self._trial_id}/metrics",
                 body=record,
+                idempotent=True,
             )
 
     def report_training_metrics(self, steps_completed: int, metrics: Dict[str, Any]) -> None:

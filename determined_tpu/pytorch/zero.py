@@ -163,19 +163,38 @@ class ZeroOneEngine:
         """The all-gather leg of ZeRO-1: owners publish their updated
         params. Flat-bucketed per (owner, dtype, device) for the same
         launch-latency reason as the gradient path — one broadcast per
-        parameter would dominate step time on a 290-tensor model."""
+        parameter would dominate step time on a 290-tensor model.
+        Buckets flush at the same ~32MB cap as _allreduce_grads: an
+        uncapped torch.cat materializes a contiguous copy of ~1/world of
+        ALL parameters per bucket every optimizer step (plus the
+        copy-back), a transient spike of hundreds of MB at larger
+        configs. Flush order is deterministic and identical on all ranks
+        (same module walk, same sizes), which the collectives require."""
+        LIMIT = 32 << 20
         with torch.no_grad():
             buckets: Dict[Any, List[torch.nn.Parameter]] = {}
-            for p, owner in zip(self._params, self._owners):
-                buckets.setdefault((owner, p.dtype, p.device), []).append(p)
-            for (owner, _, _), ps in sorted(
-                    buckets.items(), key=lambda kv: str(kv[0])):
+            sizes: Dict[Any, int] = {}
+
+            def flush(key: Any) -> None:
+                ps = buckets.pop(key, [])
+                sizes.pop(key, 0)
+                if not ps:
+                    return
                 flat = torch.cat([p.data.reshape(-1) for p in ps])
-                dist.broadcast(flat, src=owner)
+                dist.broadcast(flat, src=key[0])
                 off = 0
                 for p in ps:
                     p.data.copy_(flat[off:off + p.numel()].view_as(p))
                     off += p.numel()
+
+            for p, owner in zip(self._params, self._owners):
+                key = (owner, p.dtype, p.device)
+                buckets.setdefault(key, []).append(p)
+                sizes[key] = sizes.get(key, 0) + p.numel() * p.element_size()
+                if sizes[key] >= LIMIT:
+                    flush(key)
+            for key in sorted(list(buckets), key=str):
+                flush(key)
 
     # -- engine-sharded checkpoints -----------------------------------
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None) -> None:

@@ -21,11 +21,14 @@ from typing import Any, Dict, Iterable, Iterator, Optional
 import jax
 import numpy as np
 
+from determined_tpu import _jax_compat
 from determined_tpu import core as core_mod
 from determined_tpu.parallel.mesh import create_mesh
 from determined_tpu.train.state import TrainState, create_train_state
 from determined_tpu.train.step import make_eval_step, make_train_step
 from determined_tpu.train.trial import JaxTrial
+
+_jax_compat.install()  # jax.sharding.set_mesh on jax < 0.5
 
 logger = logging.getLogger("determined_tpu.train")
 

@@ -36,6 +36,7 @@
 #include <thread>
 #include <vector>
 
+#include "../common/faultpoint.h"
 #include "../common/http.h"
 #include "../common/json.h"
 
@@ -496,6 +497,13 @@ void finish_task(const AgentOptions& opts, std::shared_ptr<Task> task,
   std::string path = "/api/v1/agents/" + opts.id + "/allocations/" +
                      task->allocation_id + "/state";
   while (g_running) {
+    if (FAULT_POINT("agent.exit_report.drop") ==
+        det::faults::Action::kDrop) {
+      std::cerr << "agent: faultpoint dropped exit report for "
+                << task->container_id << std::endl;
+      std::this_thread::sleep_for(std::chrono::seconds(2));
+      continue;
+    }
     try {
       auto r = master_call(opts.master_url, "POST", path, done.dump(), 10.0);
       if (r.ok() || r.status == 404) break;
@@ -784,9 +792,51 @@ bool register_with_master(const AgentOptions& opts, bool reconnect) {
   }
 }
 
+// Reconnect after the master forgot us (404 = it restarted): re-register
+// with capped exponential backoff + jitter so a herd of agents doesn't
+// hammer a master that is still restoring, then re-report RUNNING for
+// every live task — the restored master holds those allocations in state
+// RESTORED and needs the claim to re-adopt them instead of declaring
+// them lost at the reclaim deadline. One reconnect at a time: the
+// heartbeat and action loops can both observe the 404.
+std::atomic<bool> g_reconnecting{false};
+
+void reconnect_master(const AgentOptions& opts) {
+  if (g_reconnecting.exchange(true)) return;
+  unsigned seed = static_cast<unsigned>(
+      std::chrono::steady_clock::now().time_since_epoch().count());
+  for (int attempt = 0; g_running; ++attempt) {
+    if (register_with_master(opts, true)) break;
+    agent_login(opts.master_url, /*use_env_token=*/true);
+    double base = std::min(30.0, 1.0 * (1 << std::min(attempt, 5)));
+    double jitter = (rand_r(&seed) % 1000) / 1000.0 * base;
+    std::this_thread::sleep_for(
+        std::chrono::milliseconds(static_cast<int>(1000 * jitter)));
+  }
+  std::vector<std::shared_ptr<Task>> live;
+  {
+    std::lock_guard<std::mutex> lock(g_mu);
+    for (auto& [cid, t] : g_tasks) {
+      if (!t->exited) live.push_back(t);
+    }
+  }
+  for (auto& t : live) {
+    Json body = Json::object();
+    body["container_id"] = t->container_id;
+    body["state"] = "RUNNING";
+    body["daemon_addr"] = opts.addr;
+    report_state(opts, t->allocation_id, body);
+  }
+  g_reconnecting = false;
+}
+
 void heartbeat_loop(const AgentOptions& opts) {
   while (g_running) {
     std::this_thread::sleep_for(std::chrono::seconds(10));
+    if (FAULT_POINT("agent.heartbeat.drop") == det::faults::Action::kDrop) {
+      std::cerr << "agent: faultpoint dropped heartbeat" << std::endl;
+      continue;
+    }
     Json body = Json::object();
     Json running = Json::array();
     {
@@ -799,7 +849,7 @@ void heartbeat_loop(const AgentOptions& opts) {
                            "/api/v1/agents/" + opts.id + "/heartbeat",
                            body.dump(), 10.0);
       if (r.status == 404) {
-        register_with_master(opts, true);  // master restarted
+        reconnect_master(opts);  // master restarted
       } else if (r.ok()) {
         Json doc = Json::parse_or_null(r.body);
         for (const auto& aid : doc["kill_allocations"].as_array()) {
@@ -899,6 +949,7 @@ int main(int argc, char** argv) {
   }
 
   signal(SIGPIPE, SIG_IGN);
+  det::faults::arm_from_env();  // DET_FAULTS chaos points (docs/chaos.md)
 
   // Install the bootstrap credential (env first, then token file), adopt
   // any tasks that survived a previous agent incarnation, then register
@@ -928,7 +979,7 @@ int main(int argc, char** argv) {
       auto r = master_call(opts.master_url, "GET", actions_path, "",
                            opts.poll_timeout_s + 10.0);
       if (r.status == 404) {
-        register_with_master(opts, true);
+        reconnect_master(opts);
         continue;
       }
       if (!r.ok()) {

@@ -1,0 +1,236 @@
+// faultpoint.cc — see faultpoint.h for the model.
+
+#include "faultpoint.h"
+
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <random>
+#include <thread>
+
+namespace det {
+namespace faults {
+
+std::atomic<int> g_armed{0};
+
+namespace {
+
+// The catalogue of compiled-in points (docs/chaos.md documents these; the
+// debug route lists them so tests can discover what is injectable).
+struct KnownPoint {
+  const char* name;
+  const char* where;
+  const char* description;
+};
+const KnownPoint kKnown[] = {
+    {"api.response.5xx", "master",
+     "fail an API request with HTTP 500 before it is processed"},
+    {"api.response.drop", "master",
+     "process an API request, then drop the connection without replying"},
+    {"db.write.delay", "master",
+     "sleep inside every DB write (use mode delay-<ms>)"},
+    {"master.allocation.exit.crash", "master",
+     "kill the master at the top of allocation-exit handling (mode crash)"},
+    {"agent.heartbeat.drop", "agent", "skip sending a heartbeat"},
+    {"agent.exit_report.drop", "agent",
+     "drop an exit-report delivery attempt (the agent retries)"},
+};
+
+struct FaultState {
+  std::string mode;       // as armed, e.g. "error", "delay-250"
+  Action action = Action::kNone;
+  double delay_ms = 0;
+  bool crash = false;
+  long remaining = -1;    // -1 = unlimited
+  double probability = 0; // 0 = always
+  long fired = 0;
+};
+
+std::mutex g_mu;
+std::map<std::string, FaultState>& registry() {
+  static std::map<std::string, FaultState> r;
+  return r;
+}
+
+std::mt19937_64& rng_locked() {
+  static std::mt19937_64 rng = [] {
+    const char* s = getenv("DET_FAULTS_SEED");
+    return std::mt19937_64(s != nullptr ? strtoull(s, nullptr, 10)
+                                        : 0x44455421ULL);
+  }();
+  return rng;
+}
+
+bool parse_mode(const std::string& mode, FaultState* st, std::string* err) {
+  st->mode = mode;
+  if (mode == "error") {
+    st->action = Action::kError;
+  } else if (mode == "drop") {
+    st->action = Action::kDrop;
+  } else if (mode == "crash") {
+    st->crash = true;
+  } else if (mode.rfind("delay-", 0) == 0) {
+    st->delay_ms = atof(mode.c_str() + 6);
+    if (st->delay_ms <= 0) {
+      if (err != nullptr) *err = "delay mode needs delay-<ms>, got " + mode;
+      return false;
+    }
+  } else {
+    if (err != nullptr) {
+      *err = "unknown mode '" + mode + "' (error|drop|crash|delay-<ms>)";
+    }
+    return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+Action fire(const char* point) {
+  double delay_ms = 0;
+  bool crash = false;
+  Action action = Action::kNone;
+  {
+    std::lock_guard<std::mutex> lock(g_mu);
+    auto it = registry().find(point);
+    if (it == registry().end()) return Action::kNone;
+    FaultState& st = it->second;
+    if (st.probability > 0) {
+      std::uniform_real_distribution<double> u(0.0, 1.0);
+      if (u(rng_locked()) >= st.probability) return Action::kNone;
+    }
+    st.fired++;
+    delay_ms = st.delay_ms;
+    crash = st.crash;
+    action = st.action;
+    if (st.remaining > 0 && --st.remaining == 0) {
+      registry().erase(it);
+      g_armed.store(static_cast<int>(registry().size()),
+                    std::memory_order_relaxed);
+    }
+  }
+  if (delay_ms > 0) {
+    std::this_thread::sleep_for(
+        std::chrono::milliseconds(static_cast<int64_t>(delay_ms)));
+  }
+  if (crash) {
+    fprintf(stderr, "faultpoint: crash injected at %s\n", point);
+    fflush(stderr);
+    _exit(137);
+  }
+  return action;
+}
+
+bool arm(const std::string& point, const std::string& mode, long count,
+         double probability, std::string* err) {
+  if (point.empty()) {
+    if (err != nullptr) *err = "fault point name required";
+    return false;
+  }
+  FaultState st;
+  if (!parse_mode(mode, &st, err)) return false;
+  st.remaining = count > 0 ? count : -1;
+  st.probability = probability;
+  std::lock_guard<std::mutex> lock(g_mu);
+  registry()[point] = st;
+  g_armed.store(static_cast<int>(registry().size()),
+                std::memory_order_relaxed);
+  return true;
+}
+
+bool disarm(const std::string& point) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  bool erased = registry().erase(point) > 0;
+  g_armed.store(static_cast<int>(registry().size()),
+                std::memory_order_relaxed);
+  return erased;
+}
+
+void disarm_all() {
+  std::lock_guard<std::mutex> lock(g_mu);
+  registry().clear();
+  g_armed.store(0, std::memory_order_relaxed);
+}
+
+bool arm_from_spec(const std::string& spec, std::string* err) {
+  size_t start = 0;
+  while (start < spec.size()) {
+    size_t comma = spec.find(',', start);
+    if (comma == std::string::npos) comma = spec.size();
+    std::string entry = spec.substr(start, comma - start);
+    start = comma + 1;
+    if (entry.empty()) continue;
+    size_t c1 = entry.find(':');
+    if (c1 == std::string::npos) {
+      if (err != nullptr) *err = "bad fault spec '" + entry + "'";
+      return false;
+    }
+    size_t c2 = entry.find(':', c1 + 1);
+    std::string point = entry.substr(0, c1);
+    std::string mode = c2 == std::string::npos
+                           ? entry.substr(c1 + 1)
+                           : entry.substr(c1 + 1, c2 - c1 - 1);
+    long count = 0;
+    double probability = 0;
+    if (c2 != std::string::npos) {
+      std::string param = entry.substr(c2 + 1);
+      if (!param.empty() && param.back() == '%') {
+        probability = atof(param.c_str()) / 100.0;
+      } else if (param.find('.') != std::string::npos) {
+        probability = atof(param.c_str());
+      } else {
+        count = atol(param.c_str());
+      }
+      if (probability < 0 || probability > 1) {
+        if (err != nullptr) *err = "probability out of [0,1]: " + param;
+        return false;
+      }
+    }
+    if (!arm(point, mode, count, probability, err)) return false;
+  }
+  return true;
+}
+
+void arm_from_env() {
+  const char* spec = getenv("DET_FAULTS");
+  if (spec == nullptr || *spec == '\0') return;
+  std::string err;
+  if (!arm_from_spec(spec, &err)) {
+    fprintf(stderr, "faultpoint: DET_FAULTS rejected: %s\n", err.c_str());
+  } else {
+    fprintf(stderr, "faultpoint: armed from DET_FAULTS=%s\n", spec);
+  }
+}
+
+Json list() {
+  Json points = Json::array();
+  for (const auto& k : kKnown) {
+    points.push_back(Json(JsonObject{{"name", Json(k.name)},
+                                     {"where", Json(k.where)},
+                                     {"description", Json(k.description)}}));
+  }
+  Json armed = Json::array();
+  {
+    std::lock_guard<std::mutex> lock(g_mu);
+    for (const auto& [point, st] : registry()) {
+      armed.push_back(Json(JsonObject{
+          {"point", Json(point)},
+          {"mode", Json(st.mode)},
+          {"remaining", Json(static_cast<int64_t>(st.remaining))},
+          {"probability", Json(st.probability)},
+          {"fired", Json(static_cast<int64_t>(st.fired))},
+      }));
+    }
+  }
+  Json out = Json::object();
+  out["points"] = points;
+  out["armed"] = armed;
+  return out;
+}
+
+}  // namespace faults
+}  // namespace det

@@ -1,0 +1,71 @@
+// faultpoint.h — deterministic fault injection for the native services.
+//
+// Named fault points are compiled into the master/agent hot paths as a
+// single relaxed atomic load + predictable branch (a no-op unless
+// something is armed). Points are armed from the environment
+// (DET_FAULTS=point:mode[:param],...) at process start, or at runtime
+// through the master's admin-gated POST /api/v1/debug/faults route, so
+// e2e chaos tests can flip failures on mid-run.
+//
+// Modes:
+//   error      the call site fails the operation (e.g. an HTTP 500)
+//   drop       the call site swallows the operation (skip a heartbeat,
+//              drop a response on the floor after processing)
+//   delay-<ms> sleep <ms> inside fire(), then proceed normally
+//   crash      _exit(137) inside fire() — a SIGKILL-shaped death at a
+//              chosen point (e.g. master.allocation.exit.crash)
+//
+// The optional param is either an integer count (fire that many times,
+// then auto-disarm) or a probability ("0.3" or "30%": each hit fires
+// with that chance). Probability draws come from a PRNG seeded by
+// DET_FAULTS_SEED (default fixed) so chaos runs are reproducible.
+
+#pragma once
+
+#include <atomic>
+#include <string>
+
+#include "json.h"
+
+namespace det {
+namespace faults {
+
+enum class Action {
+  kNone,   // not armed / did not fire — proceed normally
+  kError,  // fail the operation
+  kDrop,   // swallow the operation
+};
+
+// Number of currently-armed points; the unarmed fast path is one relaxed
+// load of this.
+extern std::atomic<int> g_armed;
+inline bool any_armed() { return g_armed.load(std::memory_order_relaxed) != 0; }
+
+// Slow path (armed only): applies delay/crash modes internally and
+// returns the action the call site must honor. Decrements counted arms.
+Action fire(const char* point);
+
+// Arm `point` with `mode` ("error" | "drop" | "crash" | "delay-<ms>").
+// count > 0 fires that many times then disarms; count <= 0 is unlimited.
+// probability in (0, 1] gates each hit; 0 means "always".
+bool arm(const std::string& point, const std::string& mode, long count,
+         double probability, std::string* err);
+bool disarm(const std::string& point);
+void disarm_all();
+
+// DET_FAULTS grammar: point:mode[:param][,point:mode[:param]...]
+// param = integer count, or probability as "0.3" / "30%".
+bool arm_from_spec(const std::string& spec, std::string* err);
+void arm_from_env();  // reads DET_FAULTS; logs and ignores bad entries
+
+// {"points": [{"name","where","description"}...],
+//  "armed": [{"point","mode","remaining","probability","fired"}...]}
+Json list();
+
+}  // namespace faults
+}  // namespace det
+
+// Evaluates to faults::Action. One atomic load when nothing is armed.
+#define FAULT_POINT(name)                               \
+  (::det::faults::any_armed() ? ::det::faults::fire(name) \
+                              : ::det::faults::Action::kNone)

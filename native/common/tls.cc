@@ -49,6 +49,8 @@ Api load_api() {
   Api a{};
   void* h = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
   if (h == nullptr) h = dlopen("libssl.so", RTLD_NOW | RTLD_GLOBAL);
+  // OpenSSL 1.1 exports every symbol this API surface touches.
+  if (h == nullptr) h = dlopen("libssl.so.1.1", RTLD_NOW | RTLD_GLOBAL);
   if (h == nullptr) return a;
   auto sym = [h](const char* name) { return dlsym(h, name); };
   a.TLS_server_method = reinterpret_cast<const SSL_METHOD* (*)()>(
@@ -220,6 +222,7 @@ std::string sha256_hex(const std::string& data) {
   static Sha256Fn sha = [] {
     void* h = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
     if (h == nullptr) h = dlopen("libcrypto.so", RTLD_NOW | RTLD_GLOBAL);
+    if (h == nullptr) h = dlopen("libcrypto.so.1.1", RTLD_NOW | RTLD_GLOBAL);
     return h ? reinterpret_cast<Sha256Fn>(dlsym(h, "SHA256")) : nullptr;
   }();
   if (sha == nullptr) throw std::runtime_error("libcrypto unavailable");

@@ -3,6 +3,7 @@
 #include <functional>
 #include <stdexcept>
 
+#include "../common/faultpoint.h"
 #include "sqlite3.h"  // vendored header; libsqlite3 linked from system
 
 namespace det {
@@ -101,6 +102,9 @@ std::vector<Row> Db::query(const std::string& sql,
 }
 
 int64_t Db::exec(const std::string& sql, const std::vector<Json>& params) {
+  // Chaos: stall writes (arm db.write.delay with mode delay-<ms>) to
+  // surface handlers that hold latency-sensitive paths across the DB.
+  FAULT_POINT("db.write.delay");
   std::lock_guard<std::recursive_mutex> lock(mu_);
   query(sql, params);
   return sqlite3_changes(db_);
@@ -401,6 +405,20 @@ ALTER TABLE experiments ADD COLUMN model_def_hash TEXT;
       // in model_defs like experiment model definitions.
       {14, R"sql(
 ALTER TABLE tasks ADD COLUMN context_hash TEXT;
+)sql"},
+      // Crash-recovery hardening: (a) replay cache for POSTs carrying
+      // X-Idempotency-Key — a retried metric/checkpoint report after a
+      // lost response is answered from here instead of re-applied;
+      // (b) full placement per allocation so restore-on-boot can re-adopt
+      // live runs instead of unconditionally restarting them.
+      {15, R"sql(
+CREATE TABLE idempotency_keys (
+  key TEXT PRIMARY KEY,
+  status INTEGER NOT NULL,
+  body TEXT NOT NULL DEFAULT '',
+  created_at TEXT NOT NULL DEFAULT (datetime('now'))
+);
+ALTER TABLE allocations ADD COLUMN resources TEXT NOT NULL DEFAULT '[]';
 )sql"},
   };
   return kMigrations;

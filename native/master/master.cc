@@ -5,6 +5,7 @@
 #include "master.h"
 
 #include <fcntl.h>
+#include <sys/random.h>
 #include <sys/stat.h>
 #include <unistd.h>
 
@@ -17,14 +18,51 @@
 #include <sstream>
 #include <thread>
 
+#include "../common/faultpoint.h"
+
 namespace det {
 
 std::string random_hex(size_t nbytes) {
-  static thread_local std::mt19937_64 rng(std::random_device{}());
+  // CSPRNG-backed: every caller is security-sensitive to some degree
+  // (session tokens, DET_PROXY_SECRET — the sole barrier on the shell
+  // task's 0.0.0.0 server). MT19937 output is reconstructable from
+  // observed tokens, so the kernel entropy pool is the only acceptable
+  // source; /dev/urandom covers kernels without getrandom(2).
   static const char* hex = "0123456789abcdef";
+  std::string bytes(nbytes, '\0');
+  size_t got = 0;
+  while (got < nbytes) {
+    ssize_t n = getrandom(&bytes[got], nbytes - got, 0);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    got += static_cast<size_t>(n);
+  }
+  if (got < nbytes) {
+    std::ifstream ur("/dev/urandom", std::ios::binary);
+    if (ur.read(&bytes[got], static_cast<std::streamsize>(nbytes - got))) {
+      got = nbytes;
+    }
+  }
+  if (got < nbytes) {
+    // Last resort on exotic systems: keep the master alive, but say
+    // loudly that its secrets are weak.
+    static std::atomic<bool> warned{false};
+    if (!warned.exchange(true)) {
+      std::cerr << "master: WARNING no CSPRNG available (getrandom and "
+                   "/dev/urandom failed); secrets fall back to mt19937"
+                << std::endl;
+    }
+    static thread_local std::mt19937_64 rng(std::random_device{}());
+    for (; got < nbytes; ++got) {
+      bytes[got] = static_cast<char>(rng() & 0xff);
+    }
+  }
   std::string out;
+  out.reserve(nbytes * 2);
   for (size_t i = 0; i < nbytes; ++i) {
-    unsigned byte = static_cast<unsigned>(rng() & 0xff);
+    unsigned byte = static_cast<unsigned char>(bytes[i]);
     out += hex[byte >> 4];
     out += hex[byte & 0xf];
   }
@@ -155,6 +193,7 @@ MasterConfig MasterConfig::from_json(const Json& j) {
 }
 
 Master::Master(MasterConfig cfg) : cfg_(std::move(cfg)), db_(cfg_.db_path) {
+  faults::arm_from_env();  // DET_FAULTS chaos points (docs/chaos.md)
   db_.migrate();
   // Resource-manager backend behind the rm.h seam (reference
   // rm/resource_manager_iface.go): built-in agent RM, or pods on k8s.
@@ -328,7 +367,26 @@ void Master::stop() {
 
 HttpResponse Master::handle(const HttpRequest& req) {
   auto t0 = Clock::now();
-  HttpResponse resp = route(req);
+  // Chaos injection brackets the whole API surface. The debug route is
+  // exempt so a test can always list/disarm faults mid-storm.
+  bool debug_route = req.path.rfind("/api/v1/debug/", 0) == 0;
+  if (!debug_route &&
+      FAULT_POINT("api.response.5xx") == faults::Action::kError) {
+    HttpResponse injected = HttpResponse::json(
+        500, "{\"error\":\"injected fault: api.response.5xx\"}");
+    std::lock_guard<std::mutex> lock(api_stats_.mu);
+    api_stats_.requests_by_status[500]++;
+    return injected;
+  }
+  HttpResponse resp = route_idempotent(req);
+  if (!debug_route && !resp.hijack &&
+      FAULT_POINT("api.response.drop") == faults::Action::kDrop) {
+    // The request WAS processed; the reply is lost. The client's retry
+    // must be deduplicated, not re-applied — exactly the failure the
+    // idempotency-key table exists for. An empty hijacker writes no
+    // response; the server closes the connection right after.
+    resp.hijack = [](Stream, std::string&&) {};
+  }
   {
     std::lock_guard<std::mutex> lock(api_stats_.mu);
     api_stats_.requests_by_status[resp.status]++;
@@ -337,6 +395,83 @@ HttpResponse Master::handle(const HttpRequest& req) {
     api_stats_.seconds_count++;
   }
   return resp;
+}
+
+// POSTs carrying X-Idempotency-Key are replay-safe: the first execution
+// records its response; a retry (after an injected 500, a dropped reply,
+// or a real network cut) returns the recorded response instead of
+// re-applying the mutation — a re-sent metric report cannot double-count
+// and a re-sent checkpoint report cannot double-register. Keys are
+// scoped to the authenticated user so one caller can never replay
+// another's response, and swept after 24h (scheduler_loop).
+HttpResponse Master::route_idempotent(const HttpRequest& req) {
+  if (req.method != "POST") return route(req);
+  auto it = req.headers.find("x-idempotency-key");
+  if (it == req.headers.end() || it->second.empty() ||
+      it->second.size() > 128) {
+    return route(req);
+  }
+  int64_t uid = auth_user(req);
+  if (uid < 0) return route(req);  // will 401 on the normal path
+  const std::string key = std::to_string(uid) + ":" + it->second;
+  auto rows = db_.query(
+      "SELECT status, body FROM idempotency_keys WHERE key=?", {Json(key)});
+  if (!rows.empty()) {
+    HttpResponse r = HttpResponse::json(
+        static_cast<int>(rows[0]["status"].as_int(200)),
+        rows[0]["body"].as_string());
+    r.headers["x-idempotent-replay"] = "true";
+    return r;
+  }
+  HttpResponse r = route(req);
+  // 5xx responses are NOT recorded: the operation may not have applied,
+  // and the retry must re-execute it.
+  if (r.status < 500 && !r.hijack) {
+    db_.exec(
+        "INSERT OR REPLACE INTO idempotency_keys (key, status, body) "
+        "VALUES (?, ?, ?)",
+        {Json(key), Json(static_cast<int64_t>(r.status)), Json(r.body)});
+  }
+  return r;
+}
+
+// /api/v1/debug/faults — runtime chaos control (docs/chaos.md).
+//   GET            → {points: [...], armed: [...]}
+//   POST           → {point, mode, count?, probability?} arms; mode "off"
+//                    disarms; {spec: "p:m:c,..."} uses the DET_FAULTS
+//                    grammar. Admin only: arming faults is a cluster-wide
+//                    denial-of-service lever.
+HttpResponse Master::handle_debug(const HttpRequest& req,
+                                  const std::vector<std::string>& parts) {
+  if (parts.size() < 2 || parts[1] != "faults") return not_found();
+  if (req.method == "GET") return json_resp(200, faults::list());
+  if (req.method != "POST") return not_found();
+  if (!auth_ctx(req).admin) {
+    return json_resp(403, err_body("admin role required"));
+  }
+  Json body = Json::parse_or_null(req.body);
+  std::string err;
+  if (body["spec"].is_string()) {
+    if (!faults::arm_from_spec(body["spec"].as_string(), &err)) {
+      return json_resp(400, err_body(err));
+    }
+    return json_resp(200, faults::list());
+  }
+  const std::string point = body["point"].as_string();
+  const std::string mode = body["mode"].as_string();
+  if (mode == "off") {
+    if (point.empty()) {
+      faults::disarm_all();
+    } else {
+      faults::disarm(point);
+    }
+    return json_resp(200, faults::list());
+  }
+  if (!faults::arm(point, mode, body["count"].as_int(0),
+                   body["probability"].as_double(0.0), &err)) {
+    return json_resp(400, err_body(err));
+  }
+  return json_resp(200, faults::list());
 }
 
 HttpResponse Master::route(const HttpRequest& req) {
@@ -412,6 +547,21 @@ HttpResponse Master::route(const HttpRequest& req) {
       out["deleted"] = sweep_task_logs(days);
       return json_resp(200, out);
     }
+    if (root == "master" && rest.size() == 2 && rest[1] == "cleanup_blobs" &&
+        req.method == "POST") {
+      // Manual context-blob sweep (the hourly sweep's admin trigger; lets
+      // tests and operators reconcile refcounts without waiting an hour).
+      if (!auth_ctx(req).admin) {
+        return json_resp(403, err_body("admin role required"));
+      }
+      Json out = Json::object();
+      {
+        std::lock_guard<std::mutex> lock(mu_);
+        out["released"] = sweep_context_blobs_locked();
+      }
+      return json_resp(200, out);
+    }
+    if (root == "debug") return handle_debug(req, rest);
     if (root == "stream" && req.method == "GET") return handle_stream(req);
     if (root == "openapi" && req.method == "GET") {
       // The REST surface's schema source of truth

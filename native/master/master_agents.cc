@@ -106,12 +106,22 @@ HttpResponse Master::handle_agents_api(const HttpRequest& req,
     }
     // Reconnect-with-reattach (reference agent.go:330-362): tell the agent
     // which allocations it should still be running; it kills the rest.
+    // Also re-mark this agent's slots for live allocations — after a
+    // master restart the fresh slot table starts empty, and the scheduler
+    // must not double-book chips that a restored allocation still owns.
     Json keep = Json::array();
     for (const auto& [aid, alloc] : allocations_) {
       for (const auto& r : alloc.resources) {
         if (r.agent_id == id && r.state != "EXITED" &&
             alloc.state != "TERMINATED") {
           keep.push_back(Json(aid));
+          for (auto& s : a.slots) {
+            for (int sid : r.slot_ids) {
+              if (s.id == sid && s.allocation_id.empty()) {
+                s.allocation_id = aid;
+              }
+            }
+          }
         }
       }
     }
@@ -178,15 +188,34 @@ HttpResponse Master::handle_agents_api(const HttpRequest& req,
     }
     it->second.last_heartbeat = now();
     it->second.alive = true;
-    // Reconcile: agent-side allocations the master no longer tracks → kill.
+    // Reconcile: agent-side allocations the master no longer tracks → kill;
+    // RESTORED resources the agent claims as running → re-adopted.
     Json kill = Json::array();
+    bool reclaimed = false;
     for (const auto& rid : body["running"].as_array()) {
       const std::string& aid = rid.as_string();
       auto ait = allocations_.find(aid);
       if (ait == allocations_.end() || ait->second.state == "TERMINATED") {
         kill.push_back(Json(aid));
+        continue;
+      }
+      Allocation& alloc = ait->second;
+      if (alloc.restored_deadline <= 0) continue;
+      bool pending = false;
+      for (auto& r : alloc.resources) {
+        if (r.agent_id == agent_id && r.state == "RESTORED") {
+          r.state = "RUNNING";
+          reclaimed = true;
+        }
+        pending |= r.state == "RESTORED";
+      }
+      if (!pending) {
+        alloc.restored_deadline = 0;  // fully reclaimed
+        std::cerr << "master: allocation " << aid
+                  << " re-adopted across restart" << std::endl;
       }
     }
+    if (reclaimed) cv_.notify_all();
     Json out = Json::object();
     out["kill_allocations"] = kill;
     return json_resp(200, out);
@@ -222,7 +251,7 @@ void Master::apply_resource_state_locked(const std::string& alloc_id,
   auto it = allocations_.find(alloc_id);
   if (it == allocations_.end()) return;
   Allocation& alloc = it->second;
-  bool all_running = true, all_exited = true;
+  bool all_running = true, all_exited = true, any_restored = false;
   for (auto& r : alloc.resources) {
     if (r.agent_id == node_id) {
       r.state = state;
@@ -231,7 +260,9 @@ void Master::apply_resource_state_locked(const std::string& alloc_id,
     }
     all_running &= r.state == "RUNNING" || r.state == "EXITED";
     all_exited &= r.state == "EXITED";
+    any_restored |= r.state == "RESTORED";
   }
+  if (!any_restored) alloc.restored_deadline = 0;
   if (alloc.state == "ASSIGNED" && all_running) {
     alloc.state = "RUNNING";
     db_.exec("UPDATE allocations SET state='RUNNING' WHERE id=?",
@@ -260,6 +291,13 @@ void Master::scheduler_loop() {
     // or API handlers (the db has its own lock).
     if (now() - last_log_sweep > 3600) {
       last_log_sweep = now();
+      // Context blobs of ended tasks: the terminal transitions release
+      // inline; this catches any path that missed (tasks orphaned by a
+      // master restart). Runs BEFORE unlock — under mu_ it cannot
+      // interleave with on_allocation_exit_locked between a task's
+      // end_time UPDATE and its inline release (the double-decrement
+      // race), and it decrements once per ended-task row.
+      sweep_context_blobs_locked();
       lock.unlock();
       // Expired-session purge runs unconditionally: task containers mint
       // one 7-day token per launch, so the table grows forever without
@@ -267,17 +305,11 @@ void Master::scheduler_loop() {
       db_.exec(
           "DELETE FROM user_sessions WHERE expires_at IS NOT NULL AND "
           "expires_at < datetime('now')");
-      // Context blobs of ended tasks: the terminal transitions release
-      // inline; this catches any path that missed (e.g. tasks orphaned
-      // by a master restart) so blobs can't accumulate forever.
+      // Idempotency keys outlive any plausible client retry window long
+      // before 24h.
       db_.exec(
-          "UPDATE model_defs SET refcount = refcount - 1 WHERE hash IN "
-          "(SELECT context_hash FROM tasks WHERE end_time IS NOT NULL "
-          "AND context_hash IS NOT NULL)");
-      db_.exec(
-          "UPDATE tasks SET context_hash=NULL WHERE end_time IS NOT NULL "
-          "AND context_hash IS NOT NULL");
-      db_.exec("DELETE FROM model_defs WHERE refcount <= 0");
+          "DELETE FROM idempotency_keys WHERE created_at < "
+          "datetime('now', '-1 day')");
       if (cfg_.log_retention_days > 0) {
         int64_t n = sweep_task_logs(cfg_.log_retention_days);
         if (n > 0) {
@@ -315,6 +347,31 @@ void Master::check_agents_locked() {
       alloc.exit_reason = "idle timeout";
       kill_allocation_locked(alloc);
     }
+  }
+  // Restored allocations nobody reclaimed in time are lost: fail their
+  // unclaimed resources so the normal exit→restart-from-checkpoint path
+  // runs (reference task/allocation.go:850 restoreResourceFailure).
+  for (auto& [aid, alloc] : allocations_) {
+    if (alloc.restored_deadline <= 0 || t < alloc.restored_deadline ||
+        alloc.state == "TERMINATED") {
+      continue;
+    }
+    alloc.restored_deadline = 0;
+    bool lost = alloc.resources.empty();  // pre-migration row: no detail
+    for (auto& r : alloc.resources) {
+      if (r.state == "RESTORED") {
+        r.state = "EXITED";
+        r.exit_code = 137;
+        lost = true;
+      }
+    }
+    if (!lost) continue;
+    alloc.exit_reason = "not reclaimed after master restart";
+    std::cerr << "master: allocation " << aid << " lost across restart"
+              << std::endl;
+    bool all_exited = true;
+    for (auto& r : alloc.resources) all_exited &= r.state == "EXITED";
+    if (all_exited) on_allocation_exit_locked(alloc);
   }
   // Backend upkeep: dead-agent sweep (agent RM) / pod reconcile (k8s RM).
   rm_->tick(t);
@@ -462,10 +519,24 @@ void Master::schedule_locked() {
         auto tit = exp->trials.find(alloc.request_id);
         if (tit != exp->trials.end()) tit->second.allocation_id = alloc.id;
       }
+      // Persist the full placement so restore-on-boot can re-adopt the
+      // allocation (which agents, which chips, which containers).
+      Json resources = Json::array();
+      for (const auto& r : alloc.resources) {
+        Json slot_ids = Json::array();
+        for (int sid : r.slot_ids) {
+          slot_ids.push_back(Json(static_cast<int64_t>(sid)));
+        }
+        resources.push_back(Json(JsonObject{
+            {"agent_id", Json(r.agent_id)},
+            {"container_id", Json(r.container_id)},
+            {"slot_ids", slot_ids}}));
+      }
       db_.exec(
-          "UPDATE allocations SET state='ASSIGNED', agent_id=? WHERE id=?",
+          "UPDATE allocations SET state='ASSIGNED', agent_id=?, resources=? "
+          "WHERE id=?",
           {Json(alloc.resources.empty() ? "" : alloc.resources[0].agent_id),
-           Json(alloc.id)});
+           Json(resources.dump()), Json(alloc.id)});
       cv_.notify_all();
     } else {
       still_pending.push_back(aid);

@@ -1334,6 +1334,12 @@ Json Master::model_def_file_tree(const std::string& hash,
     std::string name(h, strnlen(h, 100));
     std::string prefix(h + 345, strnlen(h + 345, 155));
     char type = h[156];
+    if (static_cast<unsigned char>(h[124]) & 0x80) {
+      // GNU/PAX base-256 (binary) size encoding, used for entries >=
+      // 8 GiB: strtol would read 0 and desynchronize the 512-byte block
+      // walk into a garbage listing. Reject loudly instead.
+      throw std::runtime_error("model definition tarball is not readable");
+    }
     long size = strtol(std::string(h + 124, 12).c_str(), nullptr, 8);
     if (size < 0) break;
     size_t data_off = off + 512;

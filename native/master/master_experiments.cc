@@ -12,6 +12,7 @@
 #include <cmath>
 #include <iostream>
 
+#include "../common/faultpoint.h"
 #include "../common/tls.h"
 #include "master.h"
 
@@ -450,6 +451,32 @@ void Master::release_task_context_locked(const std::string& task_id) {
   db_.exec("DELETE FROM model_defs WHERE refcount <= 0");
 }
 
+int64_t Master::sweep_context_blobs_locked() {
+  // Catch-all for ended tasks whose inline release never ran (tasks
+  // orphaned by a master restart). Two invariants the old bulk form
+  // broke: (a) a blob claimed by N ended tasks must lose N claims, not
+  // one — the correlated COUNT(*) decrement releases once per task row;
+  // (b) the sweep runs under mu_ and decrements+NULLs in one
+  // transaction, so it can never interleave with the inline
+  // release_task_context_locked between a task's end_time UPDATE and its
+  // release (the double-decrement that purged blobs still claimed by a
+  // live experiment's model-def on the same hash).
+  int64_t released = 0;
+  db_.tx([&] {
+    db_.exec(
+        "UPDATE model_defs SET refcount = refcount - "
+        "(SELECT COUNT(*) FROM tasks WHERE end_time IS NOT NULL "
+        "AND context_hash = model_defs.hash) "
+        "WHERE hash IN (SELECT context_hash FROM tasks "
+        "WHERE end_time IS NOT NULL AND context_hash IS NOT NULL)");
+    released = db_.exec(
+        "UPDATE tasks SET context_hash=NULL WHERE end_time IS NOT NULL "
+        "AND context_hash IS NOT NULL");
+    db_.exec("DELETE FROM model_defs WHERE refcount <= 0");
+  });
+  return released;
+}
+
 void Master::finish_trial_locked(ExperimentState& exp, TrialState& trial,
                                  const std::string& state) {
   if (is_terminal(trial.state)) return;
@@ -512,6 +539,7 @@ void Master::maybe_complete_experiment_locked(ExperimentState& exp) {
 // ---------------------------------------------------------------------------
 
 void Master::on_allocation_exit_locked(Allocation& alloc) {
+  FAULT_POINT("master.allocation.exit.crash");
   alloc.state = "TERMINATED";
   int exit_code = 0;
   for (const auto& r : alloc.resources) {
@@ -698,10 +726,12 @@ void Master::restore_experiments() {
         t.close_requested = tj["close_requested"].as_bool();
         t.searcher_done = tj["searcher_done"].as_bool();
         t.restarts = tj["restarts"].as_int();
-        // In-flight runs died with the old master; bump run id so the next
-        // allocation resumes from the checkpoint (no process reattach for
-        // trial runs in v1; agents reattach at the allocation level).
-        t.run_id = tj["run_id"].as_int() + 1;
+        // run_id restored as-is: a run whose allocation is re-adopted
+        // from the DB (restore_allocations_locked) is still the SAME
+        // container run; the bump happens only when a new container must
+        // actually start (re-queue below, or the lost-allocation path in
+        // on_allocation_exit_locked).
+        t.run_id = tj["run_id"].as_int();
         t.steps_completed = tj["steps_completed"].as_int();
         t.latest_checkpoint = tj["latest_checkpoint"].as_string();
         t.cancel_retries = tj["cancel_retries"].as_bool();
@@ -712,21 +742,95 @@ void Master::restore_experiments() {
       }
     }
     experiments_[eid] = std::move(exp);
-    ExperimentState& e = experiments_[eid];
+  }
+  // Re-adopt allocations that were live when the old master died BEFORE
+  // re-queuing anything: a trial whose container still runs on its agent
+  // must not get a second, competing container.
+  restore_allocations_locked();
+  for (auto& [eid, e] : experiments_) {
     if (e.state == "ACTIVE") {
       if (e.trials.empty()) {
         process_ops_locked(e, e.searcher->initial_operations());
       } else {
         for (auto& [rid, trial] : e.trials) {
-          if (!is_terminal(trial.state) &&
+          if (!is_terminal(trial.state) && trial.allocation_id.empty() &&
               (!trial.pending_ops.empty() || trial.close_requested)) {
-            trial.allocation_id.clear();
+            // No adoptable allocation: the in-flight run died with the
+            // old master. Bump run_id so the fresh container resumes
+            // from the latest checkpoint.
+            trial.run_id += 1;
+            db_.exec("UPDATE trials SET run_id=? WHERE id=?",
+                     {Json(trial.run_id), Json(trial.id)});
             request_allocation_locked(e, trial);
           }
         }
       }
     }
     maybe_complete_experiment_locked(e);
+  }
+}
+
+void Master::restore_allocations_locked() {
+  // DB rows in a live state become in-memory allocations whose resources
+  // start as "RESTORED". Their agents re-claim them via the heartbeat
+  // `running` list / re-register keep-list / a RUNNING state report;
+  // anything unclaimed by the deadline is declared lost in
+  // check_agents_locked and takes the normal exit→restart path. This is
+  // the DB-vs-heartbeat reconciliation: orphans get killed by their
+  // agent's reconcile (unknown → kill), live runs are re-adopted.
+  auto rows = db_.query(
+      "SELECT id, task_id, trial_id, resource_pool, slots, resources "
+      "FROM allocations WHERE end_time IS NULL AND "
+      "state IN ('ASSIGNED', 'RUNNING')");
+  double deadline = now() + std::max(cfg_.agent_timeout_s, 15.0);
+  for (auto& row : rows) {
+    Allocation alloc;
+    alloc.id = row["id"].as_string();
+    alloc.task_id = row["task_id"].as_string();
+    alloc.trial_id = row["trial_id"].as_int(-1);
+    alloc.resource_pool = row["resource_pool"].as_string(cfg_.default_pool);
+    alloc.slots = static_cast<int>(row["slots"].as_int(0));
+    alloc.submitted_at = now();
+    alloc.state = "RUNNING";
+    alloc.restored_deadline = deadline;
+    Json resources = Json::parse_or_null(row["resources"].as_string("[]"));
+    for (const auto& r : resources.as_array()) {
+      AllocResource res;
+      res.agent_id = r["agent_id"].as_string();
+      res.container_id = r["container_id"].as_string();
+      for (const auto& sid : r["slot_ids"].as_array()) {
+        res.slot_ids.push_back(static_cast<int>(sid.as_int()));
+      }
+      res.state = "RESTORED";
+      alloc.resources.push_back(std::move(res));
+    }
+    // Bind to the restored trial (if any); NTSC allocations restore too —
+    // a late exit report or the lost-deadline then settles their task row.
+    TrialState* trial = nullptr;
+    ExperimentState* exp = nullptr;
+    if (alloc.trial_id >= 0) {
+      trial = find_trial_locked(alloc.trial_id, &exp);
+      if (trial == nullptr || is_terminal(trial->state) ||
+          !trial->allocation_id.empty()) {
+        continue;  // stale row; nothing to adopt
+      }
+      alloc.experiment_id = exp->id;
+      alloc.request_id = trial->request_id;
+      alloc.owner_id = exp->owner_id;
+      alloc.priority = exp->priority;
+      trial->allocation_id = alloc.id;
+    } else {
+      // NTSC: only adopt tasks that are not already settled.
+      auto trows = db_.query(
+          "SELECT owner_id FROM tasks WHERE id=? AND end_time IS NULL",
+          {Json(alloc.task_id)});
+      if (trows.empty()) continue;
+      alloc.owner_id = trows[0]["owner_id"].as_int(1);
+    }
+    std::cerr << "master: restored allocation " << alloc.id << " ("
+              << alloc.resources.size() << " resource(s)) awaiting agent "
+              << "reclaim" << std::endl;
+    allocations_[alloc.id] = std::move(alloc);
   }
 }
 

@@ -17,6 +17,10 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+from determined_tpu import _jax_compat  # noqa: E402
+
+_jax_compat.install()  # jax.sharding.set_mesh & co on jax < 0.5
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
@@ -36,3 +40,10 @@ def rng():
 @pytest.fixture()
 def np_rng():
     return np.random.default_rng(0)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running e2e (excluded from the tier-1 time budget)",
+    )
