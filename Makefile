@@ -1,0 +1,25 @@
+# Repo-level targets. The native services build via native/Makefile.
+
+PY ?= python
+export JAX_PLATFORMS ?= cpu
+
+.PHONY: lint test native native-test clean
+
+# The dogfood gate (docs/preflight.md): the platform's own models and
+# examples must pass the platform's own static analyzer. Fails on any
+# unsuppressed DTL finding; suppressions are in-line `# det: noqa[DTLnnn]`
+# comments so they stay reviewable.
+lint:
+	$(PY) -m determined_tpu.analysis determined_tpu examples
+
+test:
+	$(PY) -m pytest tests/ -q -m 'not slow'
+
+native:
+	$(MAKE) -C native
+
+native-test:
+	$(MAKE) -C native test
+
+clean:
+	$(MAKE) -C native clean

@@ -1,0 +1,54 @@
+"""Static trial preflight analysis — shard/HBM/recompile diagnostics
+before any TPU time is spent.
+
+The platform delegates all compute to user code, so a bad trial (state not
+donated, an implicitly replicated embedding, a host sync inside the jitted
+step) traditionally fails only *after* the scheduler has allocated a pod
+slice — the most expensive possible place to discover it.  This package
+finds those trials at `experiment create` time, on CPU, in milliseconds:
+
+  - engine 1 (`abstract`): `jax.eval_shape` traces of the trial's train
+    state and step under the *declared* mesh — per-device HBM footprint and
+    the DTL00x rules — without touching a device.
+  - engine 2 (`astlint`): an AST walk of trial/model-def source for host
+    syncs, Python RNG / wall-clock reads and shape-dependent branching
+    inside traced functions — the DTL1xx rules.
+  - config cross-field checks (`config_rules`): the DTL2xx rules, also
+    enforced natively by the master at experiment create.
+
+Surfaces: `det preflight <config> [context_dir]`, the master-side create
+gate, `python -m determined_tpu.analysis <paths>` (make lint), and pytest
+(tests/test_preflight.py).  Every rule is suppressible via the config
+(`preflight: {suppress: [DTLnnn]}`) or a `# det: noqa[DTLnnn]` comment.
+See docs/preflight.md for the full rule table.
+"""
+
+from determined_tpu.analysis.diagnostics import (  # noqa: F401
+    Diagnostic,
+    Report,
+    filter_suppressed,
+)
+from determined_tpu.analysis.rules import RULES, Rule  # noqa: F401
+from determined_tpu.analysis.config_rules import check_config  # noqa: F401
+
+# The engines import jax; load them lazily (PEP 562) so importing
+# `determined_tpu.analysis.config_rules` from expconf/CLI stays cheap.
+_LAZY = {
+    "analyze_trial": ("determined_tpu.analysis.abstract", "analyze_trial"),
+    "lint_paths": ("determined_tpu.analysis.astlint", "lint_paths"),
+    "lint_source": ("determined_tpu.analysis.astlint", "lint_source"),
+    "preflight": ("determined_tpu.analysis._preflight", "preflight"),
+    "preflight_trial": ("determined_tpu.analysis._preflight",
+                        "preflight_trial"),
+    "should_fail": ("determined_tpu.analysis._preflight", "should_fail"),
+    "gate_mode": ("determined_tpu.analysis._preflight", "gate_mode"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,43 @@
+"""Tree lint entry point — `python -m determined_tpu.analysis [paths...]`.
+
+Runs the AST engine (DTL1xx) over source trees; exits 1 on any unsuppressed
+finding. This is what `make lint` at the repo root runs over determined_tpu/
+and examples/ so the platform's own models stay clean against its own rules
+(the dogfood gate).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from determined_tpu.analysis import astlint
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m determined_tpu.analysis",
+                                description=__doc__)
+    p.add_argument("paths", nargs="*", default=["determined_tpu", "examples"],
+                   help="files or directories to lint (default: "
+                        "determined_tpu examples)")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+
+    diags = astlint.lint_paths(args.paths or ["determined_tpu", "examples"])
+    active = [d for d in diags if not d.suppressed]
+    if args.as_json:
+        print(json.dumps([d.to_dict() for d in diags], indent=2))
+    else:
+        for d in diags:
+            tag = f"{d.level} {d.code}"
+            if d.suppressed:
+                tag += " (suppressed)"
+            print(f"{d.location()}: {tag}: {d.message}")
+        n_sup = len(diags) - len(active)
+        print(f"lint: {len(active)} finding(s), {n_sup} suppressed")
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

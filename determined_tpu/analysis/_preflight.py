@@ -1,0 +1,201 @@
+"""Preflight orchestration: config -> Report.
+
+Three layers, cheapest first:
+  1. config cross-field checks (DTL2xx) — dict only
+  2. AST lint over the model-def directory (DTL1xx) — source only
+  3. abstract trace of the trial (DTL0xx) — requires importing the trial
+     class; degrades to a note (never a crash) when the trial can't be
+     loaded, so `det preflight` is useful even on partial checkouts.
+
+Trial discovery: every `*.py` in the context dir is scanned (AST, not
+imported) for JaxTrial subclasses; matching modules are imported and the
+class instantiated with a TrialContext built from the config's
+hyperparameters. A trial whose __init__ needs real data should keep it
+lazy (build_training_data) — that is already the platform idiom.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+from determined_tpu.analysis import abstract as abstract_mod
+from determined_tpu.analysis import astlint, config_rules
+from determined_tpu.analysis.diagnostics import Report, filter_suppressed
+
+# Config block recognised by both this analyzer and the native master:
+#   preflight:
+#     gate: error | warn | off        (default warn: never hard-fail)
+#     suppress: [DTL001, ...]
+#     hbm_gb_per_device: 16           (enables DTL004)
+GATE_MODES = ("error", "warn", "off")
+
+
+def _preflight_block(config: Dict[str, Any]) -> Dict[str, Any]:
+    block = config.get("preflight")
+    return block if isinstance(block, dict) else {}
+
+
+def _hparam_values(hparams: Dict[str, Any]) -> Dict[str, Any]:
+    """Collapse hparam specs to representative values for TrialContext.
+
+    Search specs (int/double/log/categorical) take a sample from their
+    range — the analyzer needs *a* valid instantiation, not the tuned one;
+    shapes and sharding do not depend on where in the range it lands (and
+    when they do, e.g. a searched layer width, any sample is as
+    representative as any other).
+    """
+    out: Dict[str, Any] = {}
+    for k, v in (hparams or {}).items():
+        if isinstance(v, dict) and isinstance(v.get("type"), str):
+            t = v["type"]
+            if t == "const":
+                out[k] = v.get("val")
+            elif t in ("int", "double", "log") and "minval" in v:
+                out[k] = v["minval"]
+            elif t == "categorical" and v.get("vals"):
+                out[k] = v["vals"][0]
+            else:
+                out[k] = v
+        elif isinstance(v, dict) and k != "mesh" and v and \
+                all(isinstance(sv, dict) for sv in v.values()):
+            out[k] = _hparam_values(v)  # nested hparam group
+        else:
+            out[k] = v
+    return out
+
+
+def find_trial_classes(context_dir: str) -> List[Tuple[str, str]]:
+    """[(py_path, class_name)] for JaxTrial subclasses, via AST only."""
+    out: List[Tuple[str, str]] = []
+    for path in sorted(astlint.iter_py_files([context_dir])):
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for b in node.bases:
+                name = b.attr if isinstance(b, ast.Attribute) else getattr(
+                    b, "id", "")
+                if name == "JaxTrial":
+                    out.append((path, node.name))
+    return out
+
+
+def load_trial(
+    path: str, class_name: str, hparams: Dict[str, Any], n_devices: int
+) -> Any:
+    """Import `path` and instantiate `class_name` with a TrialContext."""
+    from determined_tpu.train.trial import TrialContext
+
+    mod_name = f"_det_preflight_{os.path.splitext(os.path.basename(path))[0]}"
+    spec = importlib.util.spec_from_file_location(mod_name, path)
+    assert spec is not None and spec.loader is not None
+    module = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(path)))
+    try:
+        spec.loader.exec_module(module)
+    finally:
+        sys.path.pop(0)
+    cls = getattr(module, class_name)
+    ctx = TrialContext(hparams=_hparam_values(hparams), n_devices=n_devices)
+    return cls(ctx)
+
+
+def preflight_trial(
+    trial: Any,
+    n_devices: int,
+    batch: Any = None,
+    suppress: Optional[List[str]] = None,
+    hbm_budget_bytes: Optional[int] = None,
+    source_file: Optional[str] = None,
+) -> Report:
+    """Run both engines over an in-memory trial instance (test entry point)."""
+    report = Report()
+    ast_diags = []
+    if source_file is None:
+        mod = sys.modules.get(type(trial).__module__)
+        source_file = getattr(mod, "__file__", None)
+    if source_file and os.path.exists(source_file):
+        with open(source_file, encoding="utf-8") as f:
+            ast_diags = astlint.lint_source(f.read(), filename=source_file)
+        report.extend(ast_diags)
+    excused = any(d.code == "DTL101" and not d.suppressed for d in ast_diags)
+    diags, hbm, notes = abstract_mod.analyze_trial(
+        trial, n_devices, batch=batch, hbm_budget_bytes=hbm_budget_bytes,
+        source_file=source_file, trace_failure_excused=excused)
+    report.extend(diags)
+    report.hbm = hbm
+    report.notes.extend(notes)
+    report.diagnostics = filter_suppressed(report.diagnostics, suppress or [])
+    return report
+
+
+def preflight(
+    config: Dict[str, Any],
+    context_dir: Optional[str] = None,
+    load_trials: bool = True,
+) -> Report:
+    """Full preflight of an experiment config (+ optional model-def dir)."""
+    from determined_tpu import expconf
+
+    config = expconf.shim(config)
+    block = _preflight_block(config)
+    suppress = [str(c) for c in block.get("suppress", []) or []]
+    hbm_budget = None
+    if block.get("hbm_gb_per_device"):
+        hbm_budget = int(float(block["hbm_gb_per_device"]) * 2**30)
+
+    report = Report()
+    report.extend(config_rules.check_config(config))
+
+    slots = (config.get("resources") or {}).get("slots_per_trial", 1)
+    n_devices = slots if isinstance(slots, int) and slots > 0 else 1
+
+    if context_dir:
+        report.extend(astlint.lint_paths([context_dir]))
+        if load_trials:
+            classes = find_trial_classes(context_dir)
+            if not classes:
+                report.notes.append(
+                    "no JaxTrial subclass found in the context directory; "
+                    "abstract (HBM/sharding) analysis skipped")
+            for path, class_name in classes:
+                try:
+                    trial = load_trial(
+                        path, class_name,
+                        config.get("hyperparameters") or {}, n_devices)
+                except Exception as e:
+                    report.notes.append(
+                        f"could not load {class_name} from {path}: "
+                        f"{type(e).__name__}: {e}; abstract analysis skipped")
+                    continue
+                excused = any(
+                    d.code == "DTL101" and d.file == path and not d.suppressed
+                    for d in report.diagnostics)
+                diags, hbm, notes = abstract_mod.analyze_trial(
+                    trial, n_devices, hbm_budget_bytes=hbm_budget,
+                    source_file=path, trace_failure_excused=excused)
+                report.extend(diags)
+                report.hbm = hbm
+                report.notes.extend(notes)
+
+    report.diagnostics = filter_suppressed(report.diagnostics, suppress)
+    return report
+
+
+def gate_mode(config: Dict[str, Any]) -> str:
+    mode = _preflight_block(config).get("gate", "warn")
+    return mode if mode in GATE_MODES else "warn"
+
+
+def should_fail(config: Dict[str, Any], report: Report) -> bool:
+    """The master-side gate contract: hard-fail only on error-level rules,
+    and only when the config opted in with `preflight: {gate: error}`."""
+    return gate_mode(config) == "error" and bool(report.errors)

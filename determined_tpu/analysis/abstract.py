@@ -1,0 +1,287 @@
+"""Engine 1 — abstract trace.
+
+Everything here runs on `jax.eval_shape` / `jax.make_jaxpr`: shapes and
+dtypes only, no device buffers, no compiles. A preflight of a 70B-param
+trial costs the same few hundred milliseconds as an MNIST one, which is what
+lets the master run it inline at experiment create.
+
+Produces:
+  - a per-device HBM footprint breakdown (params, optimizer state, grads,
+    donation overhead, batch, forward-activation upper bound), each leaf
+    divided by the product of the mesh axes its PartitionSpec shards over
+  - DTL001 state-not-donated, DTL002 implicit-replication,
+    DTL003 batch-mesh-mismatch, DTL004 hbm-over-budget,
+    DTL005 abstract-trace-failed
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec
+
+from determined_tpu.analysis.diagnostics import Diagnostic
+from determined_tpu.analysis.rules import RULES
+from determined_tpu.parallel.mesh import AXIS_ORDER
+from determined_tpu.train.state import TrainState
+
+# Leaves at or above this size with no sharded dimension trigger DTL002.
+LARGE_LEAF_BYTES = 16 * 1024 * 1024
+
+
+def _abstract(x: Any) -> Any:
+    """Pytree of arrays/scalars → pytree of ShapeDtypeStruct."""
+
+    def one(v):
+        arr = np.asarray(v) if not hasattr(v, "shape") else v
+        dtype = getattr(arr, "dtype", np.dtype(np.float32))
+        return jax.ShapeDtypeStruct(np.shape(arr), dtype)
+
+    return jax.tree_util.tree_map(one, x)
+
+
+def _leaf_bytes(leaf: Any) -> int:
+    return int(math.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+
+
+def _spec_factor(spec: Optional[PartitionSpec], sizes: Dict[str, int]) -> int:
+    """How many ways a leaf with this PartitionSpec is split."""
+    if spec is None:
+        return 1
+    factor = 1
+    for entry in spec:
+        if entry is None:
+            continue
+        axes = (entry,) if isinstance(entry, str) else tuple(entry)
+        for a in axes:
+            factor *= sizes.get(a, 1)
+    return factor
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        key = getattr(p, "key", None)
+        if key is None:
+            key = getattr(p, "idx", None)
+        if key is None:
+            key = getattr(p, "name", str(p))
+        parts.append(str(key))
+    return "/".join(parts) or "<root>"
+
+
+def analyze_trial(
+    trial: Any,
+    n_devices: int,
+    batch: Any = None,
+    hbm_budget_bytes: Optional[int] = None,
+    large_leaf_bytes: int = LARGE_LEAF_BYTES,
+    source_file: Optional[str] = None,
+    trace_failure_excused: bool = False,
+) -> Tuple[List[Diagnostic], Dict[str, Any], List[str]]:
+    """Analyze a JaxTrial instance against its declared mesh.
+
+    `batch`: one global batch (arrays or ShapeDtypeStructs); pulled from
+    `trial.build_training_data()` when omitted. `trace_failure_excused`
+    silences DTL005 when an AST finding (e.g. DTL101) already explains why
+    the step cannot trace.
+
+    Returns (diagnostics, hbm breakdown, notes).
+    """
+    diags: List[Diagnostic] = []
+    notes: List[str] = []
+    hbm: Dict[str, Any] = {}
+
+    mesh_cfg = trial.mesh_config().resolve(n_devices)
+    sizes = dict(zip(AXIS_ORDER, mesh_cfg.sizes()))
+    rules = trial.sharding_rules()
+
+    # -- abstract state: params + optimizer state -----------------------
+    rng = jax.ShapeDtypeStruct((2,), np.uint32)
+
+    try:
+        tx = trial.optimizer()
+
+        def init_state(r):
+            params = trial.init_params(r)
+            return TrainState(
+                step=jax.numpy.zeros((), jax.numpy.int32),
+                params=params,
+                opt_state=tx.init(params),
+            )
+
+        shapes = jax.eval_shape(init_state, jax.random.PRNGKey(0))
+    except Exception as e:  # init itself must trace for any HBM analysis
+        diags.append(RULES["DTL005"].diag(
+            f"state initialization failed to trace abstractly: "
+            f"{type(e).__name__}: {e}", file=source_file))
+        return diags, hbm, notes
+
+    axes = trial.param_logical_axes()
+    if axes is not None:
+        from determined_tpu.train.state import param_specs
+
+        pspecs = param_specs(axes, rules)
+    else:
+        pspecs = jax.tree_util.tree_map(lambda _: PartitionSpec(),
+                                        shapes.params)
+
+    flat_params = jax.tree_util.tree_flatten_with_path(shapes.params)[0]
+    flat_specs, _ = jax.tree_util.tree_flatten(
+        pspecs, is_leaf=lambda x: isinstance(x, PartitionSpec))
+    if len(flat_specs) != len(flat_params):
+        notes.append(
+            "param_logical_axes() structure does not match params; treating "
+            "all parameters as replicated")
+        flat_specs = [PartitionSpec()] * len(flat_params)
+
+    params_bytes = 0
+    params_pd = 0  # per device
+    shape_to_factor: Dict[Tuple, int] = {}
+    for (path, leaf), spec in zip(flat_params, flat_specs):
+        b = _leaf_bytes(leaf)
+        factor = _spec_factor(spec, sizes)
+        params_bytes += b
+        params_pd += b // factor
+        shape_to_factor.setdefault((leaf.shape, str(leaf.dtype)), factor)
+        if n_devices > 1 and factor == 1 and b >= large_leaf_bytes:
+            diags.append(RULES["DTL002"].diag(
+                f"parameter '{_path_str(path)}' "
+                f"({'x'.join(map(str, leaf.shape))} {leaf.dtype}, "
+                f"{b / 2**20:.1f} MiB) has no sharded dimension and is "
+                f"replicated on all {n_devices} devices; annotate its "
+                "param_logical_axes() (e.g. 'embed'/'vocab') to shard it",
+                file=source_file))
+
+    opt_bytes = 0
+    opt_pd = 0
+    for leaf in jax.tree_util.tree_leaves(shapes.opt_state):
+        b = _leaf_bytes(leaf)
+        factor = shape_to_factor.get((leaf.shape, str(leaf.dtype)), 1)
+        opt_bytes += b
+        opt_pd += b // factor
+
+    # Gradients are transient but alive together with params + opt state at
+    # the update; they shard like params.
+    grads_pd = params_pd
+
+    donated = bool(getattr(trial, "donate_state", True))
+    donation_extra_pd = 0 if donated else params_pd + opt_pd
+    if not donated:
+        diags.append(RULES["DTL001"].diag(
+            f"trial sets donate_state=False: the previous step's params + "
+            f"optimizer state stay alive across the update "
+            f"(+{(params_pd + opt_pd) / 2**20:.1f} MiB/device); set "
+            "donate_state=True unless the host reuses the old state",
+            file=source_file))
+
+    # -- batch ----------------------------------------------------------
+    batch_pd = 0
+    abstract_batch = None
+    if batch is None:
+        try:
+            batch = next(iter(trial.build_training_data()))
+        except Exception as e:
+            notes.append(f"could not draw a batch from "
+                         f"build_training_data(): {type(e).__name__}: {e}")
+            batch = None
+    if batch is not None:
+        abstract_batch = _abstract(batch)
+        batch_axes = rules.mesh_axes("batch")
+        if isinstance(batch_axes, str):
+            batch_axes = (batch_axes,)
+        bprod = math.prod(sizes.get(a, 1) for a in batch_axes or ())
+        bad: List[str] = []
+        batch_bytes = 0
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                abstract_batch)[0]:
+            batch_bytes += _leaf_bytes(leaf)
+            if leaf.shape and leaf.shape[0] % bprod != 0:
+                bad.append(f"'{_path_str(path)}' [{leaf.shape[0]}, ...]")
+        batch_pd = batch_bytes // max(1, bprod)
+        hbm["batch_bytes"] = batch_pd
+        if bad:
+            diags.append(RULES["DTL003"].diag(
+                f"global batch dims {', '.join(bad)} are not divisible by "
+                f"the mesh batch axes {tuple(batch_axes)} = {bprod} "
+                f"(mesh {dict((a, s) for a, s in sizes.items() if s > 1)}); "
+                "pad the loader batch or fix global_batch_size",
+                file=source_file))
+
+    # -- forward activations (upper bound, pre-fusion) ------------------
+    acts_pd = None
+    if abstract_batch is not None:
+        try:
+            if getattr(trial, "stateful", False):
+                extra = _abstract(trial.init_extra())
+                jaxpr = jax.make_jaxpr(
+                    lambda p, e, b, r: trial.loss(p, e, b, r))(
+                        shapes.params, extra, abstract_batch, rng)
+            else:
+                jaxpr = jax.make_jaxpr(
+                    lambda p, b, r: trial.loss(p, b, r))(
+                        shapes.params, abstract_batch, rng)
+            total = 0
+            for eqn in jaxpr.jaxpr.eqns:
+                for v in eqn.outvars:
+                    aval = getattr(v, "aval", None)
+                    if aval is None or not hasattr(aval, "shape"):
+                        continue
+                    try:
+                        itemsize = np.dtype(aval.dtype).itemsize
+                    except TypeError:
+                        # Extended dtypes (typed PRNG keys etc.) are not
+                        # numpy dtypes and are negligible HBM anyway.
+                        continue
+                    total += int(math.prod(aval.shape)) * itemsize
+            batch_axes = rules.mesh_axes("batch")
+            if isinstance(batch_axes, str):
+                batch_axes = (batch_axes,)
+            bprod = math.prod(sizes.get(a, 1) for a in batch_axes or ())
+            acts_pd = total // max(1, bprod)
+            hbm["activations_upper_bound_bytes"] = acts_pd
+        except Exception as e:
+            if not trace_failure_excused:
+                diags.append(RULES["DTL005"].diag(
+                    f"loss failed to trace abstractly "
+                    f"({type(e).__name__}: {e}); activation footprint "
+                    "unknown — fix the trace error (often a host sync or "
+                    "data-dependent Python control flow)",
+                    file=source_file))
+            else:
+                notes.append(
+                    "activation estimate unavailable: loss does not trace "
+                    "(already reported by an AST rule)")
+
+    hbm.update({
+        "params_bytes": params_pd,
+        "opt_state_bytes": opt_pd,
+        "grads_bytes": grads_pd,
+        "donation_extra_bytes": donation_extra_pd,
+        "params_total_bytes": params_bytes,
+        "opt_state_total_bytes": opt_bytes,
+        "mesh": {a: s for a, s in sizes.items()},
+        "n_devices": n_devices,
+        "donated": donated,
+    })
+    total_pd = (params_pd + opt_pd + grads_pd + donation_extra_pd + batch_pd)
+    hbm["total_bytes"] = total_pd
+
+    if hbm_budget_bytes:
+        hbm["budget_bytes"] = int(hbm_budget_bytes)
+        if total_pd > hbm_budget_bytes:
+            diags.append(RULES["DTL004"].diag(
+                f"estimated per-device HBM lower bound "
+                f"{total_pd / 2**30:.2f} GiB exceeds the configured budget "
+                f"{hbm_budget_bytes / 2**30:.2f} GiB "
+                f"(params {params_pd / 2**30:.2f} + opt {opt_pd / 2**30:.2f} "
+                f"+ grads {grads_pd / 2**30:.2f} "
+                f"+ non-donated {donation_extra_pd / 2**30:.2f} "
+                f"+ batch {batch_pd / 2**30:.2f}); shard more axes, donate "
+                "state, or use a bigger slice",
+                file=source_file))
+
+    return diags, hbm, notes

@@ -1,0 +1,367 @@
+"""Engine 2 — AST lint of trial / model-def source.
+
+Finds host-side constructs inside *traced* functions: code that runs under
+`jax.jit`/`eval_shape` tracing, where a host sync stalls the device pipeline
+every step, Python RNG / wall-clock values get baked in at trace time, and
+shape-dependent branching forces a recompile per distinct shape.
+
+What counts as traced (the roots):
+  - methods named loss / loss_pipelined / evaluate / evaluate_pipelined /
+    init_params on classes whose bases mention JaxTrial
+  - functions decorated with (or wrapped by a call to) jit / jax.jit,
+    including functools.partial(jax.jit, ...)
+  - module-level functions named loss_fn* / apply* (the pure-model idiom
+    used by determined_tpu.models)
+plus the same-module call-graph closure of those roots: a helper called
+from a traced function is linted as traced.
+
+Torch / Keras / DeepSpeed trials are never traced by JAX, so their
+`.item()` calls are fine and their classes are not roots.
+
+Suppression: a trailing `# det: noqa[DTL101]` (or bare `# det: noqa`)
+comment suppresses findings on that line; suppressed findings are still
+reported, marked suppressed, so `--json` consumers can audit them.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from determined_tpu.analysis.diagnostics import Diagnostic
+from determined_tpu.analysis.rules import RULES
+
+TRACED_METHODS = {
+    "loss", "loss_pipelined", "evaluate", "evaluate_pipelined", "init_params",
+}
+TRACED_BASES = {"JaxTrial"}
+TRACED_NAME_PREFIXES = ("loss_fn", "apply")
+JIT_NAMES = {"jit", "pjit"}
+
+_NOQA_RE = re.compile(
+    r"#\s*det:\s*noqa(?:\[(?P<codes>[A-Z0-9,\s]+)\])?", re.IGNORECASE)
+
+# Host-sync callees (DTL101).
+_HOST_SYNC_METHODS = {"item", "block_until_ready", "tolist"}
+_NP_MODULES = {"np", "numpy", "onp"}
+
+# Python RNG callees (DTL102): stdlib `random.` and `np.random.`.
+_PY_RNG_FUNCS = {
+    "random", "randint", "uniform", "randrange", "choice", "choices",
+    "shuffle", "sample", "gauss", "normalvariate", "betavariate", "random_sample",
+}
+_NP_RNG_FUNCS = _PY_RNG_FUNCS | {"randn", "rand", "default_rng", "normal",
+                                 "integers", "permutation"}
+
+# Wall-clock callees (DTL103).
+_CLOCK_FUNCS = {"time", "perf_counter", "monotonic", "process_time", "clock"}
+
+
+def parse_noqa(source: str) -> Dict[int, Optional[Set[str]]]:
+    """line -> None (suppress all) | set of codes."""
+    out: Dict[int, Optional[Set[str]]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _NOQA_RE.search(line)
+        if not m:
+            continue
+        codes = m.group("codes")
+        if codes is None:
+            out[i] = None
+        else:
+            out[i] = {c.strip().upper() for c in codes.split(",") if c.strip()}
+    return out
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """Attribute/Name chain -> 'a.b.c' (None for anything else)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """True for `jit`, `jax.jit`, `partial(jax.jit, ...)` expressions."""
+    d = _dotted(node)
+    if d is not None and (d in JIT_NAMES or d.split(".")[-1] in JIT_NAMES):
+        return True
+    if isinstance(node, ast.Call):
+        f = _dotted(node.func)
+        if f is not None and f.split(".")[-1] == "partial" and node.args:
+            return _is_jit_expr(node.args[0])
+        # jax.jit(fn, ...) used as a decorator factory
+        return _is_jit_expr(node.func)
+    return False
+
+
+class _ModuleIndex(ast.NodeVisitor):
+    """Collect functions, methods, traced roots and a same-module call graph."""
+
+    def __init__(self):
+        self.functions: Dict[str, ast.AST] = {}  # qualname -> FunctionDef
+        self.roots: Set[str] = set()
+        self.calls: Dict[str, Set[str]] = {}  # qualname -> called qualnames
+        self._class_stack: List[Tuple[str, bool]] = []  # (name, is_jax_trial)
+
+    # -- classes --------------------------------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        is_jax = any(
+            (_dotted(b) or "").split(".")[-1] in TRACED_BASES
+            for b in node.bases
+        )
+        # Subclass-of-subclass within the same module counts too.
+        if not is_jax:
+            for b in node.bases:
+                base = (_dotted(b) or "").split(".")[-1]
+                if any(c == base and j for c, j in self._class_stack):
+                    is_jax = True
+        self._class_stack.append((node.name, is_jax))
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    # -- functions ------------------------------------------------------
+    def _qual(self, name: str) -> str:
+        if self._class_stack:
+            return f"{self._class_stack[-1][0]}.{name}"
+        return name
+
+    def _handle_function(self, node) -> None:
+        qual = self._qual(node.name)
+        self.functions[qual] = node
+        in_jax_class = bool(self._class_stack) and self._class_stack[-1][1]
+        if in_jax_class and node.name in TRACED_METHODS:
+            self.roots.add(qual)
+        if not self._class_stack and node.name.startswith(TRACED_NAME_PREFIXES):
+            self.roots.add(qual)
+        if any(_is_jit_expr(d) for d in node.decorator_list):
+            self.roots.add(qual)
+        self.calls[qual] = self._collect_calls(node)
+        # Do NOT generic_visit: nested defs belong to this function's body
+        # and are linted as part of it — EXCEPT the factory idiom
+        # `def make_x(): def step(...): ...; return jax.jit(step)`, where
+        # the nested def is the traced root and the enclosing factory runs
+        # on host. Register jit-wrapped nested defs as their own roots.
+        jit_wrapped: Set[str] = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call) and _is_jit_expr(n.func) and n.args:
+                d = _dotted(n.args[0])
+                if d is not None and "." not in d:
+                    jit_wrapped.add(d)
+        if jit_wrapped:
+            for n in ast.walk(node):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and n is not node and n.name in jit_wrapped:
+                    nested_qual = f"{qual}.<locals>.{n.name}"
+                    self.functions[nested_qual] = n
+                    self.roots.add(nested_qual)
+                    self.calls[nested_qual] = self._collect_calls(n)
+
+    visit_FunctionDef = _handle_function
+    visit_AsyncFunctionDef = _handle_function
+
+    def _collect_calls(self, node) -> Set[str]:
+        cls = self._class_stack[-1][0] if self._class_stack else None
+        out: Set[str] = set()
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            d = _dotted(n.func)
+            if d is None:
+                continue
+            if d.startswith("self.") and cls is not None:
+                out.add(f"{cls}.{d[5:]}")
+            elif "." not in d:
+                out.add(d)
+            # `jax.jit(fn)` anywhere marks fn as a root.
+            if _is_jit_expr(n.func):
+                for a in n.args[:1]:
+                    ad = _dotted(a)
+                    if ad is not None:
+                        out.add(ad)  # treated as called-from-traced below
+        return out
+
+    # module-level `g = jax.jit(f)` marks f as a root
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if isinstance(node.value, ast.Call) and _is_jit_expr(node.value.func):
+            for a in node.value.args[:1]:
+                d = _dotted(a)
+                if d is not None:
+                    self.roots.add(d)
+        self.generic_visit(node)
+
+
+def _traced_closure(index: _ModuleIndex) -> Set[str]:
+    seen: Set[str] = set()
+    frontier = [r for r in index.roots if r in index.functions]
+    while frontier:
+        fn = frontier.pop()
+        if fn in seen:
+            continue
+        seen.add(fn)
+        for callee in index.calls.get(fn, ()):
+            if callee in index.functions and callee not in seen:
+                frontier.append(callee)
+            # `Class.method` calls recorded as bare names can't collide with
+            # module functions here; unknown callees are simply skipped.
+    return seen
+
+
+class _RuleWalker(ast.NodeVisitor):
+    def __init__(self, filename: str, func_qual: str):
+        self.filename = filename
+        self.func_qual = func_qual
+        self.findings: List[Tuple[str, int, str]] = []  # (code, line, msg)
+
+    def _add(self, code: str, node: ast.AST, msg: str) -> None:
+        self.findings.append((code, getattr(node, "lineno", 0), msg))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        d = _dotted(node.func)
+        last = d.split(".")[-1] if d else None
+
+        # DTL101 — host sync.
+        if isinstance(node.func, ast.Attribute) and not node.args and \
+                node.func.attr in _HOST_SYNC_METHODS:
+            self._add("DTL101", node,
+                      f".{node.func.attr}() inside traced "
+                      f"'{self.func_qual}' forces a device->host sync "
+                      "(or fails to trace); compute on device and fetch at "
+                      "report boundaries")
+        elif d is not None and last == "device_get":
+            self._add("DTL101", node,
+                      f"jax.device_get inside traced '{self.func_qual}' "
+                      "forces a device->host sync; fetch at report "
+                      "boundaries instead")
+        elif d is not None and d.split(".")[0] in _NP_MODULES and \
+                last in ("asarray", "array"):
+            if node.args and not isinstance(
+                    node.args[0], (ast.Constant, ast.List, ast.Tuple)):
+                self._add("DTL101", node,
+                          f"{d}() on a traced value inside '{self.func_qual}' "
+                          "pulls it to the host (TracerArrayConversionError "
+                          "under jit); use jnp instead")
+
+        # DTL102 — Python RNG.
+        if d is not None and "." in d:
+            head, tail = d.split(".", 1)
+            if head == "random" and tail in _PY_RNG_FUNCS:
+                self._add("DTL102", node,
+                          f"random.{tail}() inside traced '{self.func_qual}' "
+                          "is evaluated once at trace time; use jax.random "
+                          "with a threaded key")
+            elif head in _NP_MODULES and tail.startswith("random.") and \
+                    tail.split(".")[-1] in _NP_RNG_FUNCS:
+                self._add("DTL102", node,
+                          f"{d}() inside traced '{self.func_qual}' is "
+                          "evaluated once at trace time; use jax.random "
+                          "with a threaded key")
+
+        # DTL103 — wall clock.
+        if d in {f"time.{f}" for f in _CLOCK_FUNCS} or \
+                d in ("datetime.now", "datetime.datetime.now",
+                      "datetime.utcnow", "datetime.datetime.utcnow"):
+            self._add("DTL103", node,
+                      f"{d}() inside traced '{self.func_qual}' is read once "
+                      "at trace time, not per step")
+
+        self.generic_visit(node)
+
+    def _shape_dependent(self, test: ast.AST) -> Optional[str]:
+        for n in ast.walk(test):
+            if isinstance(n, ast.Attribute) and n.attr in ("shape", "ndim"):
+                return f".{n.attr}"
+            if isinstance(n, ast.Call):
+                d = _dotted(n.func)
+                if d == "len":
+                    return "len()"
+                if d == "getattr" and len(n.args) >= 2 and isinstance(
+                        n.args[1], ast.Constant) and n.args[1].value in (
+                            "shape", "ndim"):
+                    return f"getattr(..., '{n.args[1].value}')"
+        return None
+
+    def _check_branch(self, node) -> None:
+        why = self._shape_dependent(node.test)
+        if why is not None:
+            kind = "while" if isinstance(node, ast.While) else "if"
+            self._add("DTL104", node,
+                      f"`{kind}` on {why} inside traced '{self.func_qual}': "
+                      "each distinct shape compiles a separate executable "
+                      "(recompile hazard); keep shapes static or use "
+                      "jax.lax.cond/select")
+        self.generic_visit(node)
+
+    visit_If = _check_branch
+    visit_While = _check_branch
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        why = self._shape_dependent(node.test)
+        if why is not None:
+            self._add("DTL104", node,
+                      f"conditional expression on {why} inside traced "
+                      f"'{self.func_qual}' (recompile hazard)")
+        self.generic_visit(node)
+
+
+def lint_source(
+    source: str, filename: str = "<string>"
+) -> List[Diagnostic]:
+    """Lint one module's source; returns diagnostics (suppressed included)."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [Diagnostic(code="DTL101", level="error", engine="ast",
+                           message=f"source does not parse: {e}",
+                           file=filename, line=e.lineno or 0)]
+    noqa = parse_noqa(source)
+    index = _ModuleIndex()
+    index.visit(tree)
+    traced = _traced_closure(index)
+
+    diags: List[Diagnostic] = []
+    for qual in sorted(traced):
+        walker = _RuleWalker(filename, qual)
+        node = index.functions[qual]
+        # Visit the body only: decorators/defaults run at def time, on host.
+        for stmt in node.body:
+            walker.visit(stmt)
+        for code, line, msg in walker.findings:
+            rule = RULES[code]
+            d = rule.diag(msg, file=filename, line=line)
+            codes = noqa.get(line, "absent")
+            if codes is None or (codes != "absent" and code in codes):
+                d.suppressed = True
+                d.suppressed_by = "noqa"
+            diags.append(d)
+    return diags
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if not d.startswith(".") and d != "__pycache__"]
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def lint_paths(paths: Sequence[str]) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        diags.extend(lint_source(source, filename=path))
+    return diags

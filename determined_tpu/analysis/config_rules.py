@@ -1,0 +1,108 @@
+"""Config cross-field checks (DTL2xx).
+
+These run over the experiment-config dict alone — no trial code needed —
+which is why the native master re-implements exactly this set in
+native/master/preflight.cc and gates experiment creation on it. Keep the
+two in lockstep: every rule added here must be added there (and to
+docs/preflight.md).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List
+
+from determined_tpu.analysis.diagnostics import Diagnostic
+from determined_tpu.analysis.rules import RULES
+from determined_tpu.parallel.mesh import AXIS_ORDER
+
+# Axes the batch shards over (LogicalRules DEFAULT_RULES "batch" entry).
+BATCH_AXES = ("data", "fsdp")
+
+
+def _length_batches(v: Any) -> int:
+    if isinstance(v, (int, float)):
+        return int(v)
+    if isinstance(v, dict):
+        for unit in ("batches", "records", "epochs"):
+            if unit in v:
+                return int(v[unit])
+    return 0
+
+
+def resolve_batch_axes_product(config: Dict[str, Any]) -> int:
+    """data*fsdp resolved against slots_per_trial, mirroring
+    MeshConfig.resolve (omitted `data` = -1 absorbs remaining chips).
+    Returns 0 when the mesh is unresolvable (other validation reports it).
+    """
+    hp = config.get("hyperparameters") or {}
+    mesh = hp.get("mesh") or {}
+    if not isinstance(mesh, dict):
+        return 0
+    res = config.get("resources") or {}
+    slots = res.get("slots_per_trial", 1)
+    if not isinstance(slots, int) or slots <= 0:
+        return 0
+    sizes = {a: 1 for a in AXIS_ORDER}
+    unknown = []
+    for a, v in mesh.items():
+        if a not in sizes or isinstance(v, bool) or not isinstance(v, int):
+            return 0
+        if v == -1:
+            unknown.append(a)
+        elif v > 0:
+            sizes[a] = v
+        else:
+            return 0
+    if "data" not in mesh:
+        unknown.append("data")
+    if len(unknown) > 1:
+        return 0
+    fixed = math.prod(sizes[a] for a in AXIS_ORDER if a not in unknown)
+    if unknown:
+        if slots % fixed != 0:
+            return 0
+        sizes[unknown[0]] = slots // fixed
+    elif fixed != slots:
+        return 0
+    return sizes["data"] * sizes["fsdp"]
+
+
+def check_config(config: Dict[str, Any]) -> List[Diagnostic]:
+    """DTL201 + DTL202 over a (shimmed) experiment config."""
+    diags: List[Diagnostic] = []
+    if not isinstance(config, dict):
+        return diags
+
+    # DTL201 — global_batch_size vs mesh batch axes.
+    hp = config.get("hyperparameters") or {}
+    gbs = hp.get("global_batch_size") if isinstance(hp, dict) else None
+    if isinstance(gbs, dict):  # hparam spec {type: const, val: N}
+        gbs = gbs.get("val") if gbs.get("type") == "const" else None
+    if isinstance(gbs, int) and gbs > 0:
+        bprod = resolve_batch_axes_product(config)
+        if bprod > 1 and gbs % bprod != 0:
+            diags.append(RULES["DTL201"].diag(
+                f"hyperparameters.global_batch_size={gbs} is not divisible "
+                f"by the mesh batch axes data x fsdp = {bprod} (resolved "
+                f"against resources.slots_per_trial="
+                f"{(config.get('resources') or {}).get('slots_per_trial', 1)})"))
+
+    # DTL202 — ASHA budget vs rungs.
+    searcher = config.get("searcher")
+    if isinstance(searcher, dict) and searcher.get("name") in (
+            "async_halving", "sync_halving"):
+        max_length = _length_batches(searcher.get("max_length"))
+        num_rungs = searcher.get("num_rungs") or 0
+        divisor = searcher.get("divisor") or 4
+        if max_length > 0 and isinstance(num_rungs, int) and num_rungs > 1 \
+                and isinstance(divisor, (int, float)) and divisor > 1:
+            bottom = max_length / (divisor ** (num_rungs - 1))
+            if bottom < 1:
+                diags.append(RULES["DTL202"].diag(
+                    f"searcher.max_length={max_length} < divisor^(num_rungs-1)"
+                    f"={int(divisor)}^{num_rungs - 1}="
+                    f"{int(divisor ** (num_rungs - 1))}: the bottom rung "
+                    "would train for zero batches and the top rungs are "
+                    "unreachable; lower num_rungs or raise max_length"))
+    return diags

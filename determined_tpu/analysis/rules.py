@@ -1,0 +1,89 @@
+"""The DTL rule registry.
+
+Code ranges by engine:
+  DTL0xx — abstract trace (jax.eval_shape over the declared mesh)
+  DTL1xx — AST lint of trial / model-def source
+  DTL2xx — experiment-config cross-field checks (also enforced natively by
+           the master at experiment create; see native/master/preflight.cc)
+
+Levels: "error" rules describe trials that will waste or exhaust TPU HBM /
+compile time with certainty; "warning" rules describe likely-but-not-certain
+problems. The master-side gate hard-fails only error-level rules, and only
+when the experiment config opts in (`preflight: {gate: error}`).
+
+Every rule is suppressible:
+  - per line (AST rules):   `# det: noqa[DTL101]`  or  `# det: noqa`
+  - per experiment config:  `preflight: {suppress: [DTL001, ...]}`
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+from determined_tpu.analysis.diagnostics import Diagnostic
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    code: str
+    name: str
+    level: str  # default level: "error" | "warning"
+    engine: str  # "abstract" | "ast" | "config"
+    summary: str
+
+    def diag(self, message: str, **kw) -> Diagnostic:
+        return Diagnostic(code=self.code, message=message, level=self.level,
+                          engine=self.engine, **kw)
+
+
+_ALL = [
+    # -- engine 1: abstract trace ---------------------------------------
+    Rule("DTL001", "state-not-donated", "error", "abstract",
+         "train state is not donated to the jitted step; params + optimizer "
+         "state are held twice in HBM (old state alive while the new one is "
+         "computed) — ~2x the steady-state footprint"),
+    Rule("DTL002", "implicit-replication", "warning", "abstract",
+         "a large parameter leaf has no sharded dimension under the declared "
+         "mesh and is fully replicated on every device"),
+    Rule("DTL003", "batch-mesh-mismatch", "error", "abstract",
+         "the global batch produced by the data loader is not divisible by "
+         "the mesh's batch (data x fsdp) axes; GSPMD would pad or fail at "
+         "dispatch"),
+    Rule("DTL004", "hbm-over-budget", "error", "abstract",
+         "the estimated per-device HBM lower bound (params + optimizer state "
+         "+ grads + batch) exceeds the configured per-device HBM budget"),
+    Rule("DTL005", "abstract-trace-failed", "warning", "abstract",
+         "the train step could not be traced abstractly (jax.eval_shape "
+         "raised); HBM and sharding analysis is incomplete"),
+    # -- engine 2: AST lint ---------------------------------------------
+    Rule("DTL101", "host-sync-in-step", "error", "ast",
+         "host synchronization inside a traced function (jax.device_get / "
+         ".item() / .block_until_ready() / np.asarray on a traced value): "
+         "stalls the device pipeline every step, or fails to trace at all"),
+    Rule("DTL102", "python-rng-in-step", "warning", "ast",
+         "Python / numpy RNG inside a traced function: the value is baked in "
+         "at trace time and identical for every step — use jax.random with a "
+         "threaded key instead"),
+    Rule("DTL103", "wall-clock-in-step", "warning", "ast",
+         "wall-clock read inside a traced function: the value is baked in at "
+         "trace time, not read per step"),
+    Rule("DTL104", "shape-branch-in-step", "warning", "ast",
+         "Python branching on shapes inside a traced function: each distinct "
+         "shape compiles a new executable (recompile hazard on variable "
+         "batches/sequence lengths)"),
+    # -- config cross-field checks --------------------------------------
+    Rule("DTL201", "config-batch-mesh-mismatch", "error", "config",
+         "hyperparameters.global_batch_size is not divisible by the mesh's "
+         "batch (data x fsdp) axes resolved against resources.slots_per_trial"),
+    Rule("DTL202", "searcher-budget-rungs", "error", "config",
+         "searcher.max_length cannot populate the configured ASHA rungs "
+         "(max_length < divisor^(num_rungs-1)); top rungs would be "
+         "unreachable and the search degenerates"),
+]
+
+RULES: Dict[str, Rule] = {r.code: r for r in _ALL}
+
+
+def get(code: str) -> Rule:
+    return RULES[code]

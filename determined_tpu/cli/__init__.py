@@ -459,8 +459,25 @@ def cmd_shell(session: Session, args) -> int:
 
 
 # ---------------------------------------------------------------------------
-# admin / registry commands
+# preflight — static trial analysis, no master/session needed
 # ---------------------------------------------------------------------------
+
+
+def cmd_preflight(session, args) -> int:
+    """`det preflight <config> [context_dir]` — run the static analyzer
+    (docs/preflight.md) over an experiment config + model-def directory
+    and exit nonzero on unsuppressed error-level findings. Pure local
+    analysis: no master connection, no TPU time."""
+    from determined_tpu import analysis
+
+    config = _load_config_file(args.config)
+    report = analysis.preflight(config, context_dir=args.context_dir,
+                                load_trials=not args.no_trial)
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.to_text())
+    return 1 if report.errors else 0
 
 
 def cmd_deploy(session: Session, args) -> int:
@@ -920,6 +937,19 @@ def build_parser() -> argparse.ArgumentParser:
     dk.add_argument("--num-nodes", type=int, default=2)
     dk.set_defaults(func=cmd_deploy, target="gke")
 
+    pf = sub.add_parser(
+        "preflight",
+        help="static shard/HBM/recompile analysis of a trial config "
+             "before any TPU time is spent")
+    pf.add_argument("config")
+    pf.add_argument("context_dir", nargs="?", default=None)
+    pf.add_argument("--json", action="store_true",
+                    help="emit structured JSON instead of human text")
+    pf.add_argument("--no-trial", action="store_true",
+                    help="skip importing the trial class (config + AST "
+                         "lint only)")
+    pf.set_defaults(func=cmd_preflight)
+
     tp = sub.add_parser("template").add_subparsers(dest="subcommand", required=True)
     tp.add_parser("list").set_defaults(func=cmd_template, action="list")
     ts = tp.add_parser("set")
@@ -932,8 +962,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    # deploy commands manage the cluster itself — no session/login.
-    session = None if args.func is cmd_deploy else _login(args.master, args.user)
+    # deploy/preflight commands run locally — no session/login.
+    local = args.func in (cmd_deploy, cmd_preflight)
+    session = None if local else _login(args.master, args.user)
     try:
         return args.func(session, args)
     except APIError as e:

@@ -215,8 +215,51 @@ def validate(config: Dict[str, Any]) -> List[str]:
 
     _validate_environment(config.get("environment"), errors)
     _validate_log_policies(config.get("log_policies"), errors)
+    _validate_preflight(config.get("preflight"), errors)
 
     return errors
+
+
+def _validate_preflight(block: Any, errors: List[str]) -> None:
+    """`preflight:` — static-analyzer knobs (docs/preflight.md): the
+    master-side create gate, config-level rule suppression, and the HBM
+    budget that arms DTL004."""
+    if block is None:
+        return
+    if not isinstance(block, dict):
+        errors.append("preflight must be a mapping")
+        return
+    gate = block.get("gate")
+    if gate is not None and gate not in ("error", "warn", "off"):
+        errors.append("preflight.gate must be one of error|warn|off")
+    suppress = block.get("suppress")
+    if suppress is not None:
+        import re as _re
+
+        if not isinstance(suppress, list):
+            errors.append("preflight.suppress must be a list of rule codes")
+        else:
+            for c in suppress:
+                if not isinstance(c, str) or not _re.match(r"^DTL\d{3}$", c):
+                    errors.append(
+                        f"preflight.suppress entry {c!r} is not a DTLnnn "
+                        "rule code")
+    hbm = block.get("hbm_gb_per_device")
+    if hbm is not None and (
+        isinstance(hbm, bool) or not isinstance(hbm, (int, float)) or hbm <= 0
+    ):
+        errors.append("preflight.hbm_gb_per_device must be a positive number")
+
+
+def cross_field_diagnostics(config: Dict[str, Any]):
+    """The DTL2xx cross-field rules (batch/mesh divisibility, searcher
+    budget vs ASHA rungs) as structured diagnostics rather than bare
+    exceptions — the same set the native master enforces at experiment
+    create (native/master/preflight.cc). Returns a list of
+    analysis.Diagnostic."""
+    from determined_tpu.analysis import config_rules
+
+    return config_rules.check_config(shim(config))
 
 
 def _validate_log_policies(policies: Any, errors: List[str]) -> None:

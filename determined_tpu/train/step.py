@@ -135,7 +135,9 @@ def _constrain_batch(batch: Any, mesh: Optional[Mesh], rules: LogicalRules,
             else PartitionSpec(batch_axes))
 
     def constrain(x):
-        if getattr(x, "ndim", 0) < leading_dims:
+        # Branches on pytree STRUCTURE (rank), fixed per trial — not a
+        # per-shape recompile hazard.
+        if getattr(x, "ndim", 0) < leading_dims:  # det: noqa[DTL104]
             return x
         return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 

@@ -126,7 +126,8 @@ class Trainer:
                 return trial.loss_pipelined(params, batch, rng, mesh)
 
         self._train_step = make_train_step(
-            loss, tx, mesh=self.mesh, rules=self.rules, stateful=trial.stateful
+            loss, tx, mesh=self.mesh, rules=self.rules,
+            donate_state=trial.donate_state, stateful=trial.stateful,
         )
         has_eval = type(trial).evaluate is not JaxTrial.evaluate
         if pipelined and trial.supports_pipelined_eval():
@@ -261,16 +262,21 @@ class Trainer:
     def _validate(self, core, step: int) -> Dict[str, Any]:
         if self._eval_step is None:
             return {}
+        # Accumulate per-batch metrics ON DEVICE and fetch once at the end:
+        # a device_get per eval batch would serialize the eval loop on the
+        # host round-trip (the same DTL101 host-sync hazard the preflight
+        # analyzer flags in train steps).
         sums: Dict[str, Any] = {}
         count = 0
         for batch in self.trial.build_validation_data():
             m = self._eval_step(self.state, batch)
-            m = {k: float(np.asarray(jax.device_get(v))) for k, v in m.items()}
             for k, v in m.items():
-                sums[k] = sums.get(k, 0.0) + v
+                sums[k] = sums[k] + v if k in sums else v
             count += 1
         if count == 0:
             return {}
+        sums = {k: float(np.asarray(jax.device_get(v)))
+                for k, v in sums.items()}
         avg = {f"validation_{k}" if not k.startswith("validation_") else k: v / count
                for k, v in sums.items()}
         core.train.report_validation_metrics(step, avg)

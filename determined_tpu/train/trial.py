@@ -55,6 +55,12 @@ class JaxTrial(abc.ABC):
     # the stateful loss signature (see train.step.make_train_step).
     stateful = False
 
+    # Donate the TrainState to the jitted step so XLA reuses its buffers for
+    # the new state (params + optimizer state exist once in HBM, not twice).
+    # Set False only if the host must keep reading the pre-step state; the
+    # preflight analyzer flags that as DTL001 (docs/preflight.md).
+    donate_state = True
+
     def __init__(self, context: TrialContext):
         self.context = context
 

@@ -420,6 +420,12 @@ CREATE TABLE idempotency_keys (
 );
 ALTER TABLE allocations ADD COLUMN resources TEXT NOT NULL DEFAULT '[]';
 )sql"},
+      // Preflight diagnostics (native/master/preflight.cc + the Python
+      // analyzer) computed at experiment create, persisted so the API and
+      // WebUI can show why a config was flagged long after creation.
+      {16, R"sql(
+ALTER TABLE experiments ADD COLUMN preflight TEXT;
+)sql"},
   };
   return kMigrations;
 }

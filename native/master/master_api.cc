@@ -15,6 +15,7 @@
 #include <iostream>
 
 #include "master.h"
+#include "preflight.h"
 
 namespace det {
 
@@ -90,13 +91,26 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
       out["id"] = eid;
       return json_resp(200, out);
     }
+    // Preflight gate (docs/preflight.md): static config diagnostics,
+    // computed before any row exists. Hard-fails only when the config
+    // opted in (`preflight: {gate: error}`) AND an unsuppressed
+    // error-level rule fired — warn (default) persists the diagnostics
+    // on the record instead, so the cheapest rejection point still never
+    // surprises a config that did not ask for it.
+    Json pf = preflight_config(body["config"]);
+    if (preflight_should_fail(body["config"], pf)) {
+      Json err = err_body("experiment rejected by preflight gate");
+      err["preflight"] = pf;
+      return json_resp(400, err);
+    }
     int64_t eid = create_experiment_locked(
         body["config"], body["model_definition"].as_string(), uid,
-        body["project_id"].as_int(1), body["activate"].as_bool(true));
+        body["project_id"].as_int(1), body["activate"].as_bool(true), pf);
     Json out = Json::object();
     out["experiment"] = Json(JsonObject{
         {"id", Json(eid)}, {"state", Json(experiments_[eid].state)}});
     out["id"] = eid;
+    out["preflight"] = pf;
     return json_resp(200, out);
   }
 
@@ -151,11 +165,12 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
   if (parts.size() == 2 && req.method == "GET") {
     auto rows = db_.query(
         "SELECT id, state, config, progress, project_id, archived, notes, "
-        "start_time, end_time, job_id FROM experiments WHERE id=?",
+        "start_time, end_time, job_id, preflight FROM experiments WHERE id=?",
         {Json(eid)});
     if (rows.empty()) return json_resp(404, err_body("no such experiment"));
     Json e = row_to_json(rows[0]);
     e["config"] = Json::parse_or_null(e["config"].as_string());
+    e["preflight"] = Json::parse_or_null(e["preflight"].as_string("[]"));
     {
       std::lock_guard<std::mutex> lock(mu_);
       ExperimentState* exp = find_experiment_locked(eid);

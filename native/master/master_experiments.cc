@@ -104,7 +104,8 @@ std::vector<LogPolicy> compile_log_policies(const Json& config) {
 int64_t Master::create_experiment_locked(const Json& config,
                                          const std::string& model_def_b64,
                                          int64_t user_id, int64_t project_id,
-                                         bool activate) {
+                                         bool activate,
+                                         const Json& preflight) {
   // Minimal server-side validation; the Python expconf layer does full
   // schema validation/defaulting before submit (reference does both
   // master-side, pkg/schemas/expconf/parse.go).
@@ -124,11 +125,12 @@ int64_t Master::create_experiment_locked(const Json& config,
   std::string md_hash = store_context_blob_locked(model_def_b64);
   int64_t eid = db_.insert(
       "INSERT INTO experiments (state, config, original_config, "
-      "model_def, model_def_hash, owner_id, project_id, job_id) "
-      "VALUES ('PAUSED', ?, ?, '', ?, ?, ?, ?)",
+      "model_def, model_def_hash, owner_id, project_id, job_id, preflight) "
+      "VALUES ('PAUSED', ?, ?, '', ?, ?, ?, ?, ?)",
       {Json(config.dump()), Json(config.dump()),
        md_hash.empty() ? Json() : Json(md_hash), Json(user_id),
-       Json(project_id), Json(job_id)});
+       Json(project_id), Json(job_id),
+       preflight.is_array() ? Json(preflight.dump()) : Json()});
 
   ExperimentState exp;
   exp.id = eid;

@@ -1,0 +1,162 @@
+// Native preflight (see preflight.h). Mirrors
+// determined_tpu/analysis/config_rules.py rule-for-rule; if the two ever
+// disagree, the Python analyzer is the source of truth and this file is
+// the bug.
+
+#include "preflight.h"
+
+#include <cmath>
+#include <set>
+#include <string>
+#include <vector>
+
+namespace det {
+
+namespace {
+
+const char* kAxisOrder[] = {"data",   "pipeline", "fsdp",
+                            "expert", "context",  "tensor"};
+
+Json diag(const char* code, const char* level, const std::string& msg) {
+  Json d = Json::object();
+  d["code"] = code;
+  d["level"] = level;
+  d["message"] = msg;
+  d["engine"] = "config";
+  return d;
+}
+
+// data*fsdp resolved against slots_per_trial, mirroring
+// MeshConfig.resolve (omitted `data` = -1 absorbs remaining chips).
+// 0 = unresolvable (schema validation reports that separately).
+int64_t batch_axes_product(const Json& config) {
+  const Json& mesh = config["hyperparameters"]["mesh"];
+  int64_t slots = config["resources"]["slots_per_trial"].as_int(1);
+  if (slots <= 0) return 0;
+  if (!mesh.is_object()) {
+    // No mesh block: MeshConfig() defaults to pure data parallel over all
+    // chips -> batch axes product == slots.
+    return slots;
+  }
+  std::map<std::string, int64_t> sizes;
+  for (const char* a : kAxisOrder) sizes[a] = 1;
+  std::vector<std::string> unknown;
+  for (const auto& [axis, v] : mesh.as_object()) {
+    if (sizes.find(axis) == sizes.end() || !v.is_int()) return 0;
+    int64_t s = v.as_int();
+    if (s == -1) {
+      unknown.push_back(axis);
+    } else if (s > 0) {
+      sizes[axis] = s;
+    } else {
+      return 0;
+    }
+  }
+  if (mesh["data"].is_null()) unknown.push_back("data");
+  if (unknown.size() > 1) return 0;
+  int64_t fixed = 1;
+  for (const char* a : kAxisOrder) {
+    bool is_unknown = !unknown.empty() && unknown[0] == a;
+    if (!is_unknown) fixed *= sizes[a];
+  }
+  if (!unknown.empty()) {
+    if (fixed == 0 || slots % fixed != 0) return 0;
+    sizes[unknown[0]] = slots / fixed;
+  } else if (fixed != slots) {
+    return 0;
+  }
+  return sizes["data"] * sizes["fsdp"];
+}
+
+int64_t length_batches(const Json& v) {
+  if (v.is_number()) return v.as_int();
+  if (v.is_object()) {
+    for (const char* unit : {"batches", "records", "epochs"}) {
+      if (!v[unit].is_null()) return v[unit].as_int();
+    }
+  }
+  return 0;
+}
+
+}  // namespace
+
+Json preflight_config(const Json& config) {
+  Json out = Json::array();
+  if (!config.is_object()) return out;
+
+  // DTL201 — global_batch_size vs mesh batch axes.
+  Json gbs_node = config["hyperparameters"]["global_batch_size"];
+  if (gbs_node.is_object() &&
+      gbs_node["type"].as_string("") == "const") {
+    gbs_node = gbs_node["val"];
+  }
+  int64_t gbs = gbs_node.is_int() ? gbs_node.as_int() : 0;
+  if (gbs > 0) {
+    int64_t bprod = batch_axes_product(config);
+    if (bprod > 1 && gbs % bprod != 0) {
+      out.push_back(diag(
+          "DTL201", "error",
+          "hyperparameters.global_batch_size=" + std::to_string(gbs) +
+              " is not divisible by the mesh batch axes data x fsdp = " +
+              std::to_string(bprod) +
+              " (resolved against resources.slots_per_trial=" +
+              std::to_string(
+                  config["resources"]["slots_per_trial"].as_int(1)) +
+              ")"));
+    }
+  }
+
+  // DTL202 — ASHA budget vs rungs.
+  const Json& searcher = config["searcher"];
+  const std::string name = searcher["name"].as_string("");
+  if (name == "async_halving" || name == "sync_halving") {
+    int64_t max_length = length_batches(searcher["max_length"]);
+    int64_t num_rungs = searcher["num_rungs"].as_int(0);
+    double divisor = searcher["divisor"].as_double(4.0);
+    if (max_length > 0 && num_rungs > 1 && divisor > 1.0) {
+      double bottom =
+          static_cast<double>(max_length) / std::pow(divisor, num_rungs - 1);
+      if (bottom < 1.0) {
+        out.push_back(diag(
+            "DTL202", "error",
+            "searcher.max_length=" + std::to_string(max_length) +
+                " < divisor^(num_rungs-1)=" +
+                std::to_string(static_cast<int64_t>(divisor)) + "^" +
+                std::to_string(num_rungs - 1) + "=" +
+                std::to_string(static_cast<int64_t>(
+                    std::pow(divisor, num_rungs - 1))) +
+                ": the bottom rung would train for zero batches and the "
+                "top rungs are unreachable; lower num_rungs or raise "
+                "max_length"));
+      }
+    }
+  }
+
+  // Apply config-level suppressions (preflight.suppress: [DTLnnn, ...]).
+  const Json& suppress = config["preflight"]["suppress"];
+  if (suppress.is_array() && !suppress.as_array().empty()) {
+    std::set<std::string> codes;
+    for (const auto& c : suppress.as_array()) {
+      if (c.is_string()) codes.insert(c.as_string());
+    }
+    for (auto& d : out.mutable_array()) {
+      if (codes.count(d["code"].as_string())) {
+        d["suppressed"] = true;
+        d["suppressed_by"] = "config";
+      }
+    }
+  }
+  return out;
+}
+
+bool preflight_should_fail(const Json& config, const Json& diagnostics) {
+  if (config["preflight"]["gate"].as_string("warn") != "error") return false;
+  for (const auto& d : diagnostics.as_array()) {
+    if (d["level"].as_string("") == "error" && !d["suppressed"].as_bool(false)) {
+      return true;
+    }
+  }
+  return false;
+}
+
+}  // namespace det

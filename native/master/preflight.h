@@ -1,0 +1,26 @@
+// Native preflight — the master-side subset of the static trial analyzer
+// (determined_tpu/analysis/): the DTL2xx config cross-field rules, runnable
+// over the experiment-config JSON alone at experiment create, with no
+// Python in the loop. Keep in lockstep with
+// determined_tpu/analysis/config_rules.py and docs/preflight.md.
+
+#ifndef DET_MASTER_PREFLIGHT_H_
+#define DET_MASTER_PREFLIGHT_H_
+
+#include "../common/json.h"
+
+namespace det {
+
+// Runs the config rules (DTL201 batch/mesh divisibility, DTL202 searcher
+// budget vs ASHA rungs) and applies `preflight.suppress` from the config.
+// Returns a JSON array of {code, level, message[, suppressed]}.
+Json preflight_config(const Json& config);
+
+// The create gate: true only when the config opted in with
+// `preflight: {gate: "error"}` AND an unsuppressed error-level diagnostic
+// exists. Warn (default) and off never block creation.
+bool preflight_should_fail(const Json& config, const Json& diagnostics);
+
+}  // namespace det
+
+#endif  // DET_MASTER_PREFLIGHT_H_

@@ -18,6 +18,7 @@
 #include <vector>
 
 #include "../common/json.h"
+#include "../master/preflight.h"
 #include "../master/scheduler_fit.h"
 #include "../master/searcher.h"
 
@@ -335,6 +336,110 @@ static void test_round_robin_order() {
   CHECK(det::round_robin_order({1, 2, 1, 2}, 0) == (V{0, 1, 2, 3}));
 }
 
+// ----------------------------------------------------------- preflight
+
+static Json preflight_base_config() {
+  Json cfg = Json::object();
+  cfg["entrypoint"] = "python3 train.py";
+  Json searcher = Json::object();
+  searcher["name"] = "single";
+  searcher["metric"] = "loss";
+  Json ml = Json::object();
+  ml["batches"] = static_cast<int64_t>(64);
+  searcher["max_length"] = ml;
+  cfg["searcher"] = searcher;
+  cfg["hyperparameters"] = Json::object();
+  Json res = Json::object();
+  res["slots_per_trial"] = static_cast<int64_t>(8);
+  cfg["resources"] = res;
+  return cfg;
+}
+
+static void test_preflight_batch_mesh() {
+  // 8 slots, default mesh (pure DP) -> batch axes product 8.
+  Json cfg = preflight_base_config();
+  cfg["hyperparameters"]["global_batch_size"] = static_cast<int64_t>(30);
+  Json d = det::preflight_config(cfg);
+  CHECK_EQ(d.as_array().size(), static_cast<size_t>(1));
+  CHECK_EQ(d.as_array()[0]["code"].as_string(), "DTL201");
+  CHECK_EQ(d.as_array()[0]["level"].as_string(), "error");
+
+  // Divisible: clean.
+  cfg["hyperparameters"]["global_batch_size"] = static_cast<int64_t>(32);
+  CHECK(det::preflight_config(cfg).as_array().empty());
+
+  // Explicit mesh: data=2 x fsdp=2 x tensor=2 -> batch axes product 4.
+  Json mesh = Json::object();
+  mesh["data"] = static_cast<int64_t>(2);
+  mesh["fsdp"] = static_cast<int64_t>(2);
+  mesh["tensor"] = static_cast<int64_t>(2);
+  cfg["hyperparameters"]["mesh"] = mesh;
+  cfg["hyperparameters"]["global_batch_size"] = static_cast<int64_t>(6);
+  Json d2 = det::preflight_config(cfg);
+  CHECK_EQ(d2.as_array().size(), static_cast<size_t>(1));
+  CHECK_EQ(d2.as_array()[0]["code"].as_string(), "DTL201");
+  cfg["hyperparameters"]["global_batch_size"] = static_cast<int64_t>(8);
+  CHECK(det::preflight_config(cfg).as_array().empty());
+
+  // Unresolvable mesh (product mismatch) -> no DTL201 (schema layer's job).
+  mesh["tensor"] = static_cast<int64_t>(3);
+  cfg["hyperparameters"]["mesh"] = mesh;
+  cfg["hyperparameters"]["global_batch_size"] = static_cast<int64_t>(7);
+  CHECK(det::preflight_config(cfg).as_array().empty());
+
+  // const-hparam spec form {type: const, val: N} is unwrapped.
+  Json cfg2 = preflight_base_config();
+  Json spec = Json::object();
+  spec["type"] = "const";
+  spec["val"] = static_cast<int64_t>(30);
+  cfg2["hyperparameters"]["global_batch_size"] = spec;
+  Json d3 = det::preflight_config(cfg2);
+  CHECK_EQ(d3.as_array().size(), static_cast<size_t>(1));
+  CHECK_EQ(d3.as_array()[0]["code"].as_string(), "DTL201");
+}
+
+static void test_preflight_searcher_rungs() {
+  Json cfg = preflight_base_config();
+  cfg["searcher"]["name"] = "async_halving";
+  cfg["searcher"]["num_rungs"] = static_cast<int64_t>(5);
+  cfg["searcher"]["divisor"] = static_cast<int64_t>(4);
+  Json ml = Json::object();
+  ml["batches"] = static_cast<int64_t>(100);  // 100 < 4^4=256
+  cfg["searcher"]["max_length"] = ml;
+  Json d = det::preflight_config(cfg);
+  CHECK_EQ(d.as_array().size(), static_cast<size_t>(1));
+  CHECK_EQ(d.as_array()[0]["code"].as_string(), "DTL202");
+
+  ml["batches"] = static_cast<int64_t>(256);  // exactly enough
+  cfg["searcher"]["max_length"] = ml;
+  CHECK(det::preflight_config(cfg).as_array().empty());
+}
+
+static void test_preflight_suppress_and_gate() {
+  Json cfg = preflight_base_config();
+  cfg["hyperparameters"]["global_batch_size"] = static_cast<int64_t>(30);
+
+  // Default gate (warn): diagnostics never block.
+  Json d = det::preflight_config(cfg);
+  CHECK(!det::preflight_should_fail(cfg, d));
+
+  // gate: error -> unsuppressed error blocks.
+  Json pf = Json::object();
+  pf["gate"] = "error";
+  cfg["preflight"] = pf;
+  d = det::preflight_config(cfg);
+  CHECK(det::preflight_should_fail(cfg, d));
+
+  // Suppressed code is marked and no longer blocks.
+  Json sup = Json::array();
+  sup.push_back(Json("DTL201"));
+  cfg["preflight"]["suppress"] = sup;
+  d = det::preflight_config(cfg);
+  CHECK_EQ(d.as_array().size(), static_cast<size_t>(1));
+  CHECK(d.as_array()[0]["suppressed"].as_bool(false));
+  CHECK(!det::preflight_should_fail(cfg, d));
+}
+
 // -------------------------------------------------------------- driver
 
 int main() {
@@ -360,6 +465,9 @@ int main() {
       {"fit_no_fit", test_fit_no_fit},
       {"fit_zero_slot_aux", test_fit_zero_slot_aux},
       {"round_robin_order", test_round_robin_order},
+      {"preflight_batch_mesh", test_preflight_batch_mesh},
+      {"preflight_searcher_rungs", test_preflight_searcher_rungs},
+      {"preflight_suppress_and_gate", test_preflight_suppress_and_gate},
   };
   for (auto& t : tests) {
     int before = g_failures;

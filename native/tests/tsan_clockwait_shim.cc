@@ -1,0 +1,41 @@
+// ThreadSanitizer compatibility shim for condition-variable waits.
+//
+// libstdc++ (gcc >= 10, glibc >= 2.30) implements steady-clock
+// condition_variable waits with pthread_cond_clockwait, which this
+// toolchain's libtsan does not intercept. TSan then never observes the
+// wait's internal mutex unlock/relock, its lock bookkeeping corrupts, and
+// it emits a bogus "double lock of a mutex" on the next contended
+// acquisition plus phantom data races on correctly mutex-guarded state
+// (reproducible with a 20-line condition_variable::wait_for program).
+//
+// Linking this file into -fsanitize=thread test binaries replaces
+// pthread_cond_clockwait with an equivalent built on
+// pthread_cond_timedwait, which TSan does intercept: same blocking
+// semantics (deadline converted to CLOCK_REALTIME), correct bookkeeping.
+// Never link this into production binaries — only the tsan targets.
+
+#include <pthread.h>
+#include <time.h>
+
+extern "C" int pthread_cond_clockwait(pthread_cond_t* cond,
+                                      pthread_mutex_t* mutex,
+                                      clockid_t clock,
+                                      const struct timespec* abstime) {
+  struct timespec now_c, now_rt, rt;
+  clock_gettime(clock, &now_c);
+  clock_gettime(CLOCK_REALTIME, &now_rt);
+  // rt = now(REALTIME) + (abstime - now(clock)), normalized.
+  long nsec = abstime->tv_nsec - now_c.tv_nsec + now_rt.tv_nsec;
+  time_t sec = abstime->tv_sec - now_c.tv_sec + now_rt.tv_sec;
+  while (nsec >= 1000000000L) {
+    nsec -= 1000000000L;
+    sec += 1;
+  }
+  while (nsec < 0) {
+    nsec += 1000000000L;
+    sec -= 1;
+  }
+  rt.tv_sec = sec;
+  rt.tv_nsec = nsec;
+  return pthread_cond_timedwait(cond, mutex, &rt);
+}

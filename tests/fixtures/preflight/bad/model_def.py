@@ -1,0 +1,46 @@
+"""Preflight fixture: a trial with exactly three preflight defects.
+
+  - donate_state=False            -> DTL001 (state not donated, 2x HBM)
+  - a 32 MiB embedding with no
+    sharded dimension on an
+    8-chip mesh                   -> DTL002 (implicit replication)
+  - .item() inside the step       -> DTL101 (host sync in traced code)
+
+Everything else is deliberately clean: the batch divides the mesh batch
+axes, there is no Python RNG / wall clock / shape branching in the step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from determined_tpu.train import JaxTrial
+
+
+class BadTrial(JaxTrial):
+    donate_state = False  # DTL001
+
+    def init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {
+            # 32768 x 256 f32 = 32 MiB, no logical axes -> replicated.
+            "embedding": jax.random.normal(k1, (32768, 256)) * 0.02,
+            "head": jax.random.normal(k2, (256, 8)) * 0.02,
+        }
+
+    def loss(self, params, batch, rng):
+        x = params["embedding"][batch["tokens"]]
+        logits = jnp.mean(x, axis=1) @ params["head"]
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logp, batch["labels"][:, None], -1)[:, 0]
+        loss = jnp.mean(nll)
+        metrics = {"loss_scalar": loss.item()}  # DTL101
+        return loss, metrics
+
+    def build_training_data(self):
+        rng = np.random.default_rng(0)
+        while True:
+            yield {
+                "tokens": rng.integers(0, 32768, (64, 16)),
+                "labels": rng.integers(0, 8, (64,)).astype(np.int32),
+            }

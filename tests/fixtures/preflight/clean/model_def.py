@@ -1,0 +1,34 @@
+"""Preflight fixture: a trial every preflight rule passes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from determined_tpu.train import JaxTrial
+
+
+class CleanTrial(JaxTrial):
+    def init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {
+            "w1": jax.random.normal(k1, (64, 128)) * 0.05,
+            "w2": jax.random.normal(k2, (128, 8)) * 0.05,
+        }
+
+    def param_logical_axes(self):
+        return {"w1": ("embed", "mlp"), "w2": ("mlp", None)}
+
+    def loss(self, params, batch, rng):
+        h = jax.nn.relu(batch["x"] @ params["w1"])
+        logits = h @ params["w2"]
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logp, batch["labels"][:, None], -1)[:, 0]
+        return jnp.mean(nll), {}
+
+    def build_training_data(self):
+        rng = np.random.default_rng(0)
+        while True:
+            yield {
+                "x": rng.normal(size=(64, 64)).astype(np.float32),
+                "labels": rng.integers(0, 8, (64,)).astype(np.int32),
+            }

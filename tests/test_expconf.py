@@ -155,6 +155,96 @@ class TestLegacyShims:
         assert out["searcher"]["divisor"] == 4
 
 
+class TestPreflightBlock:
+    """The `preflight:` config block (docs/preflight.md) is schema-checked
+    like every other block."""
+
+    def test_valid_block(self):
+        c = base_config(preflight={"gate": "error",
+                                   "suppress": ["DTL001", "DTL201"],
+                                   "hbm_gb_per_device": 16})
+        assert expconf.validate(c) == []
+
+    def test_bad_gate(self):
+        c = base_config(preflight={"gate": "maybe"})
+        assert any("preflight.gate" in e for e in expconf.validate(c))
+
+    def test_bad_suppress_code(self):
+        c = base_config(preflight={"suppress": ["DTL1", 7]})
+        errs = expconf.validate(c)
+        assert sum("preflight.suppress" in e for e in errs) == 2
+
+    def test_bad_hbm(self):
+        c = base_config(preflight={"hbm_gb_per_device": -1})
+        assert any("hbm_gb_per_device" in e for e in expconf.validate(c))
+
+
+class TestCrossFieldDiagnostics:
+    """Cross-field checks surface as DTL rules (the same codes the native
+    master enforces at experiment create), not bare exceptions."""
+
+    def test_batch_mesh_divisible_clean(self):
+        c = base_config(
+            hyperparameters={"global_batch_size": 32},
+            resources={"slots_per_trial": 8},
+        )
+        assert expconf.cross_field_diagnostics(c) == []
+
+    def test_batch_mesh_mismatch_dtl201(self):
+        c = base_config(
+            hyperparameters={"global_batch_size": 30},
+            resources={"slots_per_trial": 8},
+        )
+        diags = expconf.cross_field_diagnostics(c)
+        assert [d.code for d in diags] == ["DTL201"]
+        assert diags[0].level == "error"
+        assert "30" in diags[0].message
+
+    def test_explicit_mesh_batch_axes(self):
+        # data=2 x fsdp=2 (tensor=2 is not a batch axis) -> product 4.
+        c = base_config(
+            hyperparameters={
+                "global_batch_size": 6,
+                "mesh": {"data": 2, "fsdp": 2, "tensor": 2},
+            },
+            resources={"slots_per_trial": 8},
+        )
+        assert [d.code for d in expconf.cross_field_diagnostics(c)] == [
+            "DTL201"]
+        c["hyperparameters"]["global_batch_size"] = 8
+        assert expconf.cross_field_diagnostics(c) == []
+
+    def test_const_hparam_spec_unwrapped(self):
+        c = base_config(
+            hyperparameters={
+                "global_batch_size": {"type": "const", "val": 30}},
+            resources={"slots_per_trial": 8},
+        )
+        assert [d.code for d in expconf.cross_field_diagnostics(c)] == [
+            "DTL201"]
+
+    def _asha(self, max_length, num_rungs=5, divisor=4):
+        return base_config(searcher={
+            "name": "async_halving", "metric": "loss",
+            "max_length": {"batches": max_length},
+            "num_rungs": num_rungs, "divisor": divisor,
+        })
+
+    def test_asha_budget_too_small_dtl202(self):
+        diags = expconf.cross_field_diagnostics(self._asha(100))
+        assert [d.code for d in diags] == ["DTL202"]
+        assert diags[0].level == "error"
+
+    def test_asha_budget_sufficient(self):
+        assert expconf.cross_field_diagnostics(self._asha(256)) == []
+
+    def test_asha_legacy_bare_int_length_shimmed(self):
+        c = self._asha(100)
+        c["searcher"]["max_length"] = 100  # legacy bare int
+        assert [d.code for d in expconf.cross_field_diagnostics(c)] == [
+            "DTL202"]
+
+
 def test_all_shipped_example_configs_validate():
     """Every yaml under examples/ must pass expconf.check — shipped
     configs rotting against schema changes is exactly what the reference's

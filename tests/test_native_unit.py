@@ -1,26 +1,91 @@
 """Drive the native C++ unit tests (plain + sanitizers) from pytest.
 
 Reference discipline: the Go master runs `go test -race -short`
-(master/Makefile:187); here `make -C native test / asan / tsan` build and
-run the same binary under ThreadSanitizer and AddressSanitizer+UBSan."""
+(master/Makefile:187). Here the same sources build plain and under
+ThreadSanitizer / AddressSanitizer+UBSan:
 
+  - test_native_units:  `make -C native test` — pure-logic units plus the
+    threaded master test (real Master hammered through handle() from many
+    threads), no sanitizer.
+  - test_native_tsan / test_native_asan: the fast pure-logic binary under
+    each sanitizer; builds are skipped cleanly when the toolchain cannot
+    produce sanitized binaries (no libtsan/libasan).
+  - test_master_threads_tsan (slow): the full threaded master under TSan —
+    the `go test -race` analogue. Needs tests/tsan_clockwait_shim.cc:
+    without it this toolchain's libtsan misses pthread_cond_clockwait
+    (libstdc++ steady-clock condition_variable waits) and corrupts its
+    lock bookkeeping into bogus "double lock" reports.
+"""
+
+import functools
 import os
 import subprocess
+import tempfile
 
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
 
 
-def _make(target: str) -> subprocess.CompletedProcess:
+def _make(target: str, timeout: int = 600) -> subprocess.CompletedProcess:
     return subprocess.run(
-        ["make", "-C", os.path.join(REPO, "native"), target],
-        capture_output=True, text=True, timeout=600,
+        ["make", "-C", NATIVE, target],
+        capture_output=True, text=True, timeout=timeout,
     )
 
 
-@pytest.mark.parametrize("target", ["test", "asan", "tsan"])
-def test_native_units(target):
-    r = _make(target)
+def _run(binary: str, env=None) -> subprocess.CompletedProcess:
+    e = dict(os.environ)
+    e.update(env or {})
+    return subprocess.run(
+        [os.path.join(NATIVE, "bin", binary)],
+        capture_output=True, text=True, timeout=300, env=e,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _sanitizer_available(flag: str) -> bool:
+    """Can the toolchain link a -fsanitize=<flag> binary?"""
+    with tempfile.TemporaryDirectory() as d:
+        src = os.path.join(d, "probe.cc")
+        with open(src, "w") as f:
+            f.write("int main() { return 0; }\n")
+        r = subprocess.run(
+            [os.environ.get("CXX", "g++"), f"-fsanitize={flag}", "-o",
+             os.path.join(d, "probe"), src],
+            capture_output=True, timeout=120,
+        )
+        return r.returncode == 0
+
+
+def test_native_units():
+    r = _make("test")
     assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-3000:]
     assert "0 failures" in r.stdout
+
+
+def _sanitized_unit(flag: str, binary: str, env=None):
+    if not _sanitizer_available(flag):
+        pytest.skip(f"toolchain cannot build -fsanitize={flag} binaries")
+    r = _make(f"bin/{binary}")
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-3000:]
+    out = _run(binary, env=env)
+    assert out.returncode == 0, out.stdout[-3000:] + out.stderr[-3000:]
+    assert "0 failures" in out.stdout
+
+
+def test_native_tsan():
+    _sanitized_unit("thread", "test_native_tsan")
+
+
+def test_native_asan():
+    _sanitized_unit("address", "test_native_asan")
+
+
+@pytest.mark.slow
+def test_master_threads_tsan():
+    """The go-test -race analogue: real master, many concurrent clients,
+    under ThreadSanitizer (with the pthread_cond_clockwait shim)."""
+    _sanitized_unit("thread", "test_master_threads_tsan",
+                    env={"TSAN_OPTIONS": "halt_on_error=1"})

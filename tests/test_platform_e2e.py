@@ -675,3 +675,70 @@ def test_model_def_content_store_and_file_tree(cluster, tmp_path):
     md1_after = cluster.api("GET", f"/api/v1/experiments/{e1}/model_def",
                             token=token)["b64_tgz"]
     assert md1_after == md1
+
+
+def test_preflight_gate_and_persistence(tmp_path, native_binaries):
+    """The master-side preflight gate (docs/preflight.md): DTL2xx config
+    rules run natively at experiment create; diagnostics persist on the
+    record and surface through the API; `preflight: {gate: error}` rejects
+    with 400; suppression waives the gate. Master-only cluster — nothing
+    is scheduled."""
+    import urllib.error
+
+    c = Devcluster(str(tmp_path), native_binaries)
+    c.start_master()
+    try:
+        token = c.login()
+
+        def config(gate=None, suppress=None, gbs=30):
+            cfg = {
+                "name": "preflight-e2e",
+                "entrypoint": "python3 train.py",
+                "searcher": {"name": "single", "metric": "loss",
+                             "max_length": {"batches": 8}},
+                "resources": {"slots_per_trial": 8},
+                "hyperparameters": {"global_batch_size": gbs},
+            }
+            pf = {}
+            if gate:
+                pf["gate"] = gate
+            if suppress:
+                pf["suppress"] = suppress
+            if pf:
+                cfg["preflight"] = pf
+            return cfg
+
+        # Default gate (warn): created, diagnostics persisted + returned.
+        out = c.api("POST", "/api/v1/experiments",
+                    {"config": config(), "model_definition": "",
+                     "activate": False}, token=token)
+        assert [d["code"] for d in out["preflight"]] == ["DTL201"]
+        eid = out["id"]
+        got = c.api("GET", f"/api/v1/experiments/{eid}", token=token)
+        assert [d["code"] for d in got["experiment"]["preflight"]] == [
+            "DTL201"]
+
+        # gate: error -> 400 with diagnostics in the body.
+        try:
+            c.api("POST", "/api/v1/experiments",
+                  {"config": config(gate="error"), "model_definition": "",
+                   "activate": False}, token=token)
+            raise AssertionError("gated create unexpectedly succeeded")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            body = json.loads(e.read().decode())
+            assert [d["code"] for d in body["preflight"]] == ["DTL201"]
+
+        # Suppressing the code waives the gate.
+        out = c.api("POST", "/api/v1/experiments",
+                    {"config": config(gate="error", suppress=["DTL201"]),
+                     "model_definition": "", "activate": False}, token=token)
+        assert out["preflight"][0]["suppressed"] is True
+
+        # A clean config carries no diagnostics.
+        out = c.api("POST", "/api/v1/experiments",
+                    {"config": config(gbs=32), "model_definition": "",
+                     "activate": False}, token=token)
+        assert out["preflight"] == []
+    finally:
+        c.stop()
