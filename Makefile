@@ -3,7 +3,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint test native native-test clean
+.PHONY: lint test bench-input native native-test clean
 
 # The dogfood gate (docs/preflight.md): the platform's own models and
 # examples must pass the platform's own static analyzer. Fails on any
@@ -14,6 +14,11 @@ lint:
 
 test:
 	$(PY) -m pytest tests/ -q -m 'not slow'
+
+# Async input pipeline A/B: prefetch on/off step time + input_wait_ms
+# (docs/trial-api.md "Data loading and the async input pipeline").
+bench-input:
+	$(PY) bench.py --only input
 
 native:
 	$(MAKE) -C native

@@ -64,6 +64,25 @@ def install() -> None:
                               **kwargs)
 
         jax.shard_map = shard_map
+    if not hasattr(jax, "make_array_from_process_local_data"):
+        # Multi-host batch staging (data.prefetch.shard_batch): each
+        # process transfers only its local shard of the global batch. On
+        # jax builds predating the API, single-process semantics coincide
+        # with a plain sharded device_put; true multi-host on such builds
+        # would need make_array_from_single_device_arrays, which every
+        # supported 0.4.x already has — but so does this API, so the shim
+        # only ever serves single-process test environments.
+        def make_array_from_process_local_data(sharding, local_data,
+                                               global_shape=None):
+            if jax.process_count() > 1:  # pragma: no cover — old-jax guard
+                raise NotImplementedError(
+                    "jax.make_array_from_process_local_data is unavailable "
+                    "on this jax build; multi-host prefetch needs jax >= "
+                    "0.4.26")
+            return jax.device_put(local_data, sharding)
+
+        jax.make_array_from_process_local_data = (
+            make_array_from_process_local_data)
     if not hasattr(jax.lax, "pcast"):
         # pcast only casts between varying/invariant *types*; without the
         # vma type system it is the identity on values.

@@ -36,6 +36,10 @@ from determined_tpu.analysis.rules import RULES
 TRACED_METHODS = {
     "loss", "loss_pipelined", "evaluate", "evaluate_pipelined", "init_params",
 }
+# Data-loader roots (DTL105): not traced — linted for the opposite hazard,
+# host code that transfers to device itself (double-transfer with the async
+# input pipeline, which owns the device_put).
+DATA_LOADER_METHODS = {"build_training_data", "build_validation_data"}
 TRACED_BASES = {"JaxTrial"}
 TRACED_NAME_PREFIXES = ("loss_fn", "apply")
 JIT_NAMES = {"jit", "pjit"}
@@ -106,6 +110,7 @@ class _ModuleIndex(ast.NodeVisitor):
     def __init__(self):
         self.functions: Dict[str, ast.AST] = {}  # qualname -> FunctionDef
         self.roots: Set[str] = set()
+        self.data_roots: Set[str] = set()  # build_*_data methods (DTL105)
         self.calls: Dict[str, Set[str]] = {}  # qualname -> called qualnames
         self._class_stack: List[Tuple[str, bool]] = []  # (name, is_jax_trial)
 
@@ -137,6 +142,8 @@ class _ModuleIndex(ast.NodeVisitor):
         in_jax_class = bool(self._class_stack) and self._class_stack[-1][1]
         if in_jax_class and node.name in TRACED_METHODS:
             self.roots.add(qual)
+        if in_jax_class and node.name in DATA_LOADER_METHODS:
+            self.data_roots.add(qual)
         if not self._class_stack and node.name.startswith(TRACED_NAME_PREFIXES):
             self.roots.add(qual)
         if any(_is_jit_expr(d) for d in node.decorator_list):
@@ -309,6 +316,52 @@ class _RuleWalker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+_JNP_HEADS = {"jnp", "jax.numpy"}
+
+
+class _DataLoaderWalker(ast.NodeVisitor):
+    """DTL105 — device transfer inside build_training/validation_data."""
+
+    def __init__(self, func_qual: str):
+        self.func_qual = func_qual
+        self.findings: List[Tuple[str, int, str]] = []
+
+    def _add(self, node: ast.AST, msg: str) -> None:
+        self.findings.append(("DTL105", getattr(node, "lineno", 0), msg))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        d = _dotted(node.func)
+        if d is not None and d.split(".")[-1] == "device_put":
+            self._add(node,
+                      f"jax.device_put inside '{self.func_qual}': the async "
+                      "input pipeline already device_puts batches with the "
+                      "mesh batch sharding — this transfer is paid twice; "
+                      "yield host (numpy) batches instead")
+        self.generic_visit(node)
+
+    def _check_emitted(self, node, value: Optional[ast.AST]) -> None:
+        if not isinstance(value, ast.Call):
+            return
+        d = _dotted(value.func)
+        if d is None:
+            return
+        head = d.rsplit(".", 1)[0] if "." in d else ""
+        if head in _JNP_HEADS or d.startswith("jax.numpy."):
+            self._add(node,
+                      f"'{self.func_qual}' yields/returns a {d}(...) device "
+                      "array: the prefetch pipeline re-transfers it with the "
+                      "batch sharding (double transfer); build batches with "
+                      "numpy and let the pipeline own the device_put")
+
+    def visit_Yield(self, node: ast.Yield) -> None:
+        self._check_emitted(node, node.value)
+        self.generic_visit(node)
+
+    def visit_Return(self, node: ast.Return) -> None:
+        self._check_emitted(node, node.value)
+        self.generic_visit(node)
+
+
 def lint_source(
     source: str, filename: str = "<string>"
 ) -> List[Diagnostic]:
@@ -325,13 +378,9 @@ def lint_source(
     traced = _traced_closure(index)
 
     diags: List[Diagnostic] = []
-    for qual in sorted(traced):
-        walker = _RuleWalker(filename, qual)
-        node = index.functions[qual]
-        # Visit the body only: decorators/defaults run at def time, on host.
-        for stmt in node.body:
-            walker.visit(stmt)
-        for code, line, msg in walker.findings:
+
+    def _emit(findings) -> None:
+        for code, line, msg in findings:
             rule = RULES[code]
             d = rule.diag(msg, file=filename, line=line)
             codes = noqa.get(line, "absent")
@@ -339,6 +388,19 @@ def lint_source(
                 d.suppressed = True
                 d.suppressed_by = "noqa"
             diags.append(d)
+
+    for qual in sorted(traced):
+        walker = _RuleWalker(filename, qual)
+        node = index.functions[qual]
+        # Visit the body only: decorators/defaults run at def time, on host.
+        for stmt in node.body:
+            walker.visit(stmt)
+        _emit(walker.findings)
+    for qual in sorted(index.data_roots):
+        dl_walker = _DataLoaderWalker(qual)
+        for stmt in index.functions[qual].body:
+            dl_walker.visit(stmt)
+        _emit(dl_walker.findings)
     return diags
 
 

@@ -72,6 +72,12 @@ _ALL = [
          "Python branching on shapes inside a traced function: each distinct "
          "shape compiles a new executable (recompile hazard on variable "
          "batches/sequence lengths)"),
+    Rule("DTL105", "device-transfer-in-data-loader", "warning", "ast",
+         "build_training_data / build_validation_data transfers batches to "
+         "device itself (jax.device_put / jnp arrays): the async input "
+         "pipeline already shards and device_puts batches with the mesh "
+         "batch sharding, so the loader's transfer is paid twice — yield "
+         "host (numpy) batches, or disable prefetch for this trial"),
     # -- config cross-field checks --------------------------------------
     Rule("DTL201", "config-batch-mesh-mismatch", "error", "config",
          "hyperparameters.global_batch_size is not divisible by the mesh's "

@@ -137,6 +137,13 @@ class ProfilerContext:
         self._window_seconds = 0.0
         self._n_devices = 1
         self._peak = peak_flops_per_device()
+        # input-pipeline gauges (fed by the Trainer from DevicePrefetcher
+        # window sums): how long each step waited on input, how long the
+        # H2D copy took, and how full the prefetch queue ran.
+        self._input_wait_ms = 0.0
+        self._input_h2d_ms = 0.0
+        self._input_depth = 0.0
+        self._input_batches = 0
 
     def set_step(self, step: int) -> None:
         self._step = step
@@ -155,11 +162,31 @@ class ProfilerContext:
             self._window_steps += n_steps
             self._window_seconds += seconds
 
+    def observe_input(self, wait_ms_sum: float, h2d_ms_sum: float,
+                      depth_sum: float, n_batches: int) -> None:
+        """Called by the Trainer each metric flush with the input
+        pipeline's window sums (DevicePrefetcher.window_sums)."""
+        if not n_batches:
+            return
+        with self._lock:
+            self._input_wait_ms += wait_ms_sum
+            self._input_h2d_ms += h2d_ms_sum
+            self._input_depth += depth_sum
+            self._input_batches += n_batches
+
     def _utilization_window(self) -> Dict[str, Any]:
         with self._lock:
             steps, secs = self._window_steps, self._window_seconds
             self._window_steps, self._window_seconds = 0, 0.0
+            in_wait, in_h2d = self._input_wait_ms, self._input_h2d_ms
+            in_depth, in_n = self._input_depth, self._input_batches
+            self._input_wait_ms = self._input_h2d_ms = 0.0
+            self._input_depth, self._input_batches = 0.0, 0
         out: Dict[str, Any] = {}
+        if in_n:
+            out["input_wait_ms"] = in_wait / in_n
+            out["h2d_ms"] = in_h2d / in_n
+            out["prefetch_queue_depth"] = in_depth / in_n
         if steps and secs > 0:
             sps = steps / secs
             out["steps_per_second"] = sps

@@ -1,0 +1,15 @@
+"""determined_tpu.data — the async input pipeline.
+
+Keeps the host ahead of the accelerator: batches are pulled, sharded and
+transferred to HBM by a background thread so the jitted step never waits on
+host preprocessing or the H2D copy (see prefetch.py for the full design).
+The Trainer wires this in by default; trials opt out via the `prefetch:`
+expconf block or a `prefetch = False` trial attribute.
+"""
+
+from determined_tpu.data.prefetch import (  # noqa: F401
+    FAULT_POINT_QUEUE,
+    DevicePrefetcher,
+    PrefetchConfig,
+    shard_batch,
+)

@@ -216,8 +216,33 @@ def validate(config: Dict[str, Any]) -> List[str]:
     _validate_environment(config.get("environment"), errors)
     _validate_log_policies(config.get("log_policies"), errors)
     _validate_preflight(config.get("preflight"), errors)
+    _validate_prefetch(config.get("prefetch"), errors)
 
     return errors
+
+
+def _validate_prefetch(block: Any, errors: List[str]) -> None:
+    """`prefetch:` — the async input pipeline (determined_tpu/data): on by
+    default; trials opt out or tune the queue depth here."""
+    if block is None:
+        return
+    if isinstance(block, bool):
+        return  # bare bool == enabled switch
+    if not isinstance(block, dict):
+        errors.append("prefetch must be a bool or a mapping")
+        return
+    unknown = sorted(set(block) - {"enabled", "depth", "shard"})
+    if unknown:
+        errors.append(
+            f"prefetch: unknown keys {unknown}; valid: enabled, depth, shard")
+    for flag in ("enabled", "shard"):
+        if flag in block and not isinstance(block[flag], bool):
+            errors.append(f"prefetch.{flag} must be a bool")
+    depth = block.get("depth")
+    if depth is not None and (
+        isinstance(depth, bool) or not isinstance(depth, int) or depth < 1
+    ):
+        errors.append("prefetch.depth must be a positive int")
 
 
 def _validate_preflight(block: Any, errors: List[str]) -> None:
@@ -423,6 +448,10 @@ def apply_defaults(config: Dict[str, Any]) -> Dict[str, Any]:
     c.setdefault("reproducibility", {})
     c.setdefault("environment", {})
     c.setdefault("profiling", {"enabled": False})
+    pf = c.setdefault("prefetch", {})
+    if isinstance(pf, dict):
+        pf.setdefault("enabled", True)
+        pf.setdefault("depth", 2)
     return c
 
 

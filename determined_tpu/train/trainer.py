@@ -8,6 +8,10 @@ TPU specifics:
   - one jit compile per trial (static shapes); the op loop never retraces
   - metric device→host syncs are batched every `report_period` steps so the
     train loop stays ahead of the device (async dispatch)
+  - input is prefetched to device by a background thread (determined_tpu.
+    data): batches are sharded, transferred and resident on HBM before the
+    step that consumes them is dispatched, so host preprocessing + H2D
+    overlap the previous step's compute (opt-out via `prefetch:`)
   - checkpoints are async orbax saves off the critical path
   - on preemption: ack → save → exit 0 (scheduler restarts elsewhere)
 """
@@ -23,9 +27,10 @@ import numpy as np
 
 from determined_tpu import _jax_compat
 from determined_tpu import core as core_mod
+from determined_tpu.data import DevicePrefetcher, PrefetchConfig
 from determined_tpu.parallel.mesh import create_mesh
 from determined_tpu.train.state import TrainState, create_train_state
-from determined_tpu.train.step import make_eval_step, make_train_step
+from determined_tpu.train.step import batch_sharding, make_eval_step, make_train_step
 from determined_tpu.train.trial import JaxTrial
 
 _jax_compat.install()  # jax.sharding.set_mesh on jax < 0.5
@@ -61,6 +66,7 @@ class Trainer:
         self.state: Optional[TrainState] = None
         self._train_step = None
         self._eval_step = None
+        self._pf_cfg: Optional[PrefetchConfig] = None
 
     # -- setup ---------------------------------------------------------
 
@@ -155,12 +161,19 @@ class Trainer:
 
     # -- the loop --------------------------------------------------------
 
+    def _prefetch_config(self, core) -> PrefetchConfig:
+        expconf = None
+        if core is not None and core.info is not None and core.info.trial:
+            expconf = core.info.trial.config
+        return PrefetchConfig.resolve(self.trial, expconf)
+
     def fit(
         self,
         max_length: Optional[int] = None,
         validation_period: int = 0,
         checkpoint_period: int = 0,
         report_period: int = 10,
+        preempt_period: int = 10,
         seed: int = 0,
         profile: bool = False,
         resume_from: Optional[str] = None,
@@ -168,8 +181,11 @@ class Trainer:
         """Train through all searcher operations; returns final state.
 
         Lengths are in steps (batches). validation/checkpoint_period of 0 =
-        only at op boundaries. `resume_from` overrides the cluster's
-        latest-checkpoint (managed restarts pass it via DET_LATEST_CHECKPOINT).
+        only at op boundaries. `preempt_period` is the preemption-poll
+        cadence in steps — independent of `report_period`, so report_period=0
+        does not poll the master every step. `resume_from` overrides the
+        cluster's latest-checkpoint (managed restarts pass it via
+        DET_LATEST_CHECKPOINT).
         """
         core = self._ensure_core(max_length)
         seed = core.trial_seed or seed
@@ -185,9 +201,19 @@ class Trainer:
             )
             core.profiler.on()
 
-        data_iter = _repeat(self.trial.build_training_data)
+        self._pf_cfg = self._prefetch_config(core)
+        data_iter: Any = _repeat(self.trial.build_training_data)
+        prefetcher: Optional[DevicePrefetcher] = None
+        if self._pf_cfg.enabled:
+            sharding = (batch_sharding(self.mesh, self.rules)
+                        if self._pf_cfg.shard else None)
+            prefetcher = DevicePrefetcher(
+                data_iter, sharding=sharding, depth=self._pf_cfg.depth,
+                name="train")
+            data_iter = prefetcher
         rng = jax.random.PRNGKey(seed + 1)
         step = int(jax.device_get(self.state.step))
+        preempt_period = max(1, preempt_period)
         preempted = False
         last = None  # (step, device_metrics) of the newest step
         last_validated = last_checkpointed = step
@@ -198,50 +224,57 @@ class Trainer:
         def flush():
             nonlocal last, t_report, n_report
             if last is not None:
-                self._flush_metrics(core, last, t_report, n_report)
+                self._flush_metrics(core, last, t_report, n_report, prefetcher)
             last, t_report, n_report = None, time.time(), 0
 
-        with jax.sharding.set_mesh(self.mesh):
-            for op in core.searcher.operations():
-                while step < op.length and not preempted:
-                    batch = next(data_iter)
-                    rng, step_rng = jax.random.split(rng)
-                    self.state, metrics = self._train_step(self.state, batch, step_rng)
-                    step += 1
-                    n_report += 1
-                    last = (step, metrics)
+        try:
+            with jax.sharding.set_mesh(self.mesh):
+                for op in core.searcher.operations():
+                    while step < op.length and not preempted:
+                        batch = next(data_iter)
+                        rng, step_rng = jax.random.split(rng)
+                        self.state, metrics = self._train_step(self.state, batch, step_rng)
+                        step += 1
+                        n_report += 1
+                        last = (step, metrics)
 
-                    if report_period and step % report_period == 0:
-                        flush()
-                        core.profiler.set_step(step)
-                    if validation_period and step % validation_period == 0:
-                        last_val = self._validate(core, step)
-                        last_validated = step
-                    if checkpoint_period and step % checkpoint_period == 0:
-                        self._checkpoint(core, step)
-                        last_checkpointed = step
-                    if step % max(report_period, 1) == 0 and core.preempt.should_preempt():
-                        preempted = True
+                        if report_period and step % report_period == 0:
+                            flush()
+                            core.profiler.set_step(step)
+                        if validation_period and step % validation_period == 0:
+                            last_val = self._validate(core, step)
+                            last_validated = step
+                        if checkpoint_period and step % checkpoint_period == 0:
+                            self._checkpoint(core, step)
+                            last_checkpointed = step
+                        if step % preempt_period == 0 and core.preempt.should_preempt():
+                            preempted = True
 
-                flush()
+                    flush()
 
-                if preempted:
+                    if preempted:
+                        if last_checkpointed != step:
+                            self._checkpoint(core, step)
+                        logger.info("preempted at step %d; checkpoint saved", step)
+                        break
+
+                    val = last_val if last_validated == step else self._validate(core, step)
                     if last_checkpointed != step:
                         self._checkpoint(core, step)
-                    logger.info("preempted at step %d; checkpoint saved", step)
-                    break
-
-                val = last_val if last_validated == step else self._validate(core, step)
-                if last_checkpointed != step:
-                    self._checkpoint(core, step)
-                    last_checkpointed = step
-                if not op.completed:
-                    metric = (
-                        self.trial.searcher_metric(val)
-                        if val
-                        else float(jax.device_get(self.state.step))
-                    )
-                    op.report_completed(metric)
+                        last_checkpointed = step
+                    if not op.completed:
+                        metric = (
+                            self.trial.searcher_metric(val)
+                            if val
+                            else float(jax.device_get(self.state.step))
+                        )
+                        op.report_completed(metric)
+        finally:
+            # Preemption, op boundaries and mid-epoch iterator exceptions
+            # all pass through here: the prefetch thread must be joined, not
+            # orphaned, before the process checkpoints/exits.
+            if prefetcher is not None:
+                prefetcher.close()
 
         core.checkpoint.wait()
         if profile:
@@ -250,13 +283,24 @@ class Trainer:
 
     # -- helpers ---------------------------------------------------------
 
-    def _flush_metrics(self, core, last, t_start, n_steps) -> None:
+    def _flush_metrics(self, core, last, t_start, n_steps,
+                       prefetcher: Optional[DevicePrefetcher] = None) -> None:
         last_step, last_metrics = last
-        host = {k: np.asarray(jax.device_get(v)) for k, v in last_metrics.items()}
+        # One device_get for the whole metrics tree: per-key fetches would
+        # pay the host round-trip once per metric instead of once per flush.
+        host = {k: np.asarray(v)
+                for k, v in jax.device_get(last_metrics).items()}
         dt = time.time() - t_start
         if n_steps and dt > 0:
             host["steps_per_second"] = n_steps / dt
             core.profiler.observe_steps(n_steps, dt)
+        if prefetcher is not None:
+            wait, h2d, depth, n = prefetcher.window_sums()
+            if n:
+                host["input_wait_ms"] = wait / n
+                host["h2d_ms"] = h2d / n
+                host["prefetch_queue_depth"] = depth / n
+                core.profiler.observe_input(wait, h2d, depth, n)
         core.train.report_training_metrics(last_step, host)
 
     def _validate(self, core, step: int) -> Dict[str, Any]:
@@ -268,11 +312,24 @@ class Trainer:
         # analyzer flags in train steps).
         sums: Dict[str, Any] = {}
         count = 0
-        for batch in self.trial.build_validation_data():
-            m = self._eval_step(self.state, batch)
-            for k, v in m.items():
-                sums[k] = sums[k] + v if k in sums else v
-            count += 1
+        pf_cfg = self._pf_cfg or self._prefetch_config(core)
+        data: Any = self.trial.build_validation_data()
+        prefetcher: Optional[DevicePrefetcher] = None
+        if pf_cfg.enabled:
+            sharding = (batch_sharding(self.mesh, self.rules)
+                        if pf_cfg.shard else None)
+            prefetcher = DevicePrefetcher(
+                data, sharding=sharding, depth=pf_cfg.depth, name="val")
+            data = prefetcher
+        try:
+            for batch in data:
+                m = self._eval_step(self.state, batch)
+                for k, v in m.items():
+                    sums[k] = sums[k] + v if k in sums else v
+                count += 1
+        finally:
+            if prefetcher is not None:
+                prefetcher.close()
         if count == 0:
             return {}
         sums = {k: float(np.asarray(jax.device_get(v)))

@@ -61,6 +61,14 @@ class JaxTrial(abc.ABC):
     # preflight analyzer flags that as DTL001 (docs/preflight.md).
     donate_state = True
 
+    # Async input pipeline (determined_tpu.data): None inherits the
+    # experiment config's `prefetch:` block (default: on, depth 2). Set
+    # False to opt out (batches feed the step synchronously), or a dict
+    # like {"depth": 4} / {"shard": False} to tune it. Loaders must yield
+    # HOST (numpy) batches — the pipeline owns the device transfer; a
+    # loader that device_puts itself double-transfers (preflight DTL105).
+    prefetch = None
+
     def __init__(self, context: TrialContext):
         self.context = context
 

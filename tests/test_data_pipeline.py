@@ -1,0 +1,345 @@
+"""Async input pipeline (determined_tpu/data): correctness, lifecycle,
+chaos, and the ISSUE-3 acceptance contract.
+
+Fast tier-1 module: every test here runs on the virtual 8-device CPU slice
+in well under a second except the throughput acceptance test (~2s of
+deliberate sleeps).
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from determined_tpu import core
+from determined_tpu.common import faultpoint
+from determined_tpu.data import DevicePrefetcher, PrefetchConfig
+from determined_tpu.data.bench import ab_compare
+from determined_tpu.train import JaxTrial, Trainer
+from determined_tpu.train.trial import TrialContext
+
+
+def prefetch_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith(DevicePrefetcher.THREAD_PREFIX)]
+
+
+def batches(n=10, size=8):
+    rng = np.random.default_rng(0)
+    for i in range(n):
+        yield {"x": rng.normal(size=(size, 4)).astype(np.float32),
+               "i": np.full((size,), i, np.int32)}
+
+
+@pytest.fixture()
+def batch_mesh_sharding(devices):
+    mesh = Mesh(np.asarray(devices).reshape(8), ("data",))
+    return NamedSharding(mesh, PartitionSpec("data"))
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_threads():
+    """Every test in this module must leave zero prefetch threads."""
+    yield
+    faultpoint.disarm_all()
+    deadline = time.time() + 2.0
+    while prefetch_threads() and time.time() < deadline:
+        time.sleep(0.01)
+    assert prefetch_threads() == []
+
+
+# ---------------------------------------------------------------------------
+# ordering + determinism
+# ---------------------------------------------------------------------------
+
+
+class TestOrdering:
+    def test_order_bit_identical_to_sync(self):
+        sync = list(batches())
+        for depth in (1, 2, 4):
+            with DevicePrefetcher(batches(), depth=depth) as pf:
+                got = list(pf)
+            assert len(got) == len(sync)
+            for a, b in zip(got, sync):
+                np.testing.assert_array_equal(a["x"], b["x"])
+                np.testing.assert_array_equal(a["i"], b["i"])
+
+    def test_depth_does_not_change_order(self, batch_mesh_sharding):
+        seen = {}
+        for depth in (1, 3):
+            with DevicePrefetcher(batches(), sharding=batch_mesh_sharding,
+                                  depth=depth) as pf:
+                seen[depth] = [np.asarray(jax.device_get(b["x"]))
+                               for b in pf]
+        for a, b in zip(seen[1], seen[3]):
+            np.testing.assert_array_equal(a, b)
+
+    def test_batches_device_resident_and_sharded(self, batch_mesh_sharding):
+        with DevicePrefetcher(batches(n=3), sharding=batch_mesh_sharding) as pf:
+            out = list(pf)
+        for b in out:
+            assert isinstance(b["x"], jax.Array)
+            assert b["x"].sharding == batch_mesh_sharding
+            # resident: no transfer pending when the consumer gets it
+            assert b["x"].is_ready()
+
+    def test_window_metrics_flow(self, batch_mesh_sharding):
+        pf = DevicePrefetcher(batches(n=5), sharding=batch_mesh_sharding)
+        try:
+            list(pf)
+            m = pf.window_metrics()
+            assert set(m) == {"input_wait_ms", "h2d_ms",
+                              "prefetch_queue_depth"}
+            assert m["h2d_ms"] >= 0.0
+            # window resets after the read
+            assert pf.window_metrics() == {}
+        finally:
+            pf.close()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: exceptions, shutdown, chaos
+# ---------------------------------------------------------------------------
+
+
+class TestLifecycle:
+    def test_iterator_exception_propagates_to_consumer(self):
+        def flaky():
+            yield {"x": np.zeros(2, np.float32)}
+            yield {"x": np.ones(2, np.float32)}
+            raise RuntimeError("disk ate the shard")
+
+        pf = DevicePrefetcher(flaky())
+        try:
+            assert next(pf)["x"][0] == 0.0
+            assert next(pf)["x"][0] == 1.0
+            with pytest.raises(RuntimeError, match="disk ate the shard"):
+                next(pf)
+        finally:
+            pf.close()
+
+    def test_close_is_idempotent_and_joins(self):
+        pf = DevicePrefetcher(batches())
+        next(pf)
+        pf.close()
+        pf.close()
+        assert prefetch_threads() == []
+        with pytest.raises(StopIteration):
+            next(pf)
+
+    def test_close_unblocks_full_queue(self):
+        def infinite():
+            i = 0
+            while True:
+                yield {"x": np.full((2,), i, np.int32)}
+                i += 1
+
+        pf = DevicePrefetcher(infinite(), depth=2)
+        next(pf)  # producer now certainly running, queue refills
+        pf.close()  # must not deadlock on the full queue
+        assert prefetch_threads() == []
+
+    def test_fault_point_error_via_det_faults(self, monkeypatch):
+        monkeypatch.setenv("DET_FAULTS", "data.prefetch.queue:error:1")
+        faultpoint.reload_env()
+        pf = DevicePrefetcher(batches())
+        try:
+            with pytest.raises(faultpoint.FaultInjected,
+                               match="data.prefetch.queue"):
+                list(pf)
+        finally:
+            pf.close()
+
+    def test_fault_point_drop_skips_batches(self):
+        faultpoint.arm("data.prefetch.queue", "drop", count=2)
+        with DevicePrefetcher(batches(n=6)) as pf:
+            got = [b["i"][0] for b in pf]
+        assert got == [2, 3, 4, 5]
+
+    def test_fault_point_delay_slows_but_preserves_order(self):
+        faultpoint.arm("data.prefetch.queue", "delay-20", count=3)
+        with DevicePrefetcher(batches(n=4)) as pf:
+            got = [b["i"][0] for b in pf]
+        assert got == [0, 1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# trainer integration
+# ---------------------------------------------------------------------------
+
+
+class LinearTrial(JaxTrial):
+    """Tiny pure-linear trial: fast enough to fit multiple times per test."""
+
+    def __init__(self, context):
+        super().__init__(context)
+        self.loader_threads = set()
+
+    def init_params(self, rng):
+        return {"w": jax.random.normal(rng, (4, 2)) * 0.1}
+
+    def loss(self, params, batch, rng):
+        pred = batch["x"] @ params["w"]
+        return jax.numpy.mean((pred - batch["y"]) ** 2)
+
+    def build_training_data(self):
+        self.loader_threads.add(threading.current_thread().name)
+        rng = np.random.default_rng(7)
+        for _ in range(200):
+            yield {"x": rng.normal(size=(8, 4)).astype(np.float32),
+                   "y": rng.normal(size=(8, 2)).astype(np.float32)}
+
+    def build_validation_data(self):
+        rng = np.random.default_rng(8)
+        for _ in range(2):
+            yield {"x": rng.normal(size=(8, 4)).astype(np.float32),
+                   "y": rng.normal(size=(8, 2)).astype(np.float32)}
+
+    def evaluate(self, params, batch):
+        pred = batch["x"] @ params["w"]
+        return {"loss": jax.numpy.mean((pred - batch["y"]) ** 2)}
+
+
+def _fit(tmp_path, sub, max_length=6, trial_cls=LinearTrial, **fit_kw):
+    ctx = core.init(max_length=max_length,
+                    checkpoint_dir=str(tmp_path / sub / "ckpts"),
+                    async_checkpointing=False)
+    trial = trial_cls(TrialContext())
+    Trainer(trial, core_context=ctx).fit(report_period=2, **fit_kw)
+    ctx.close()
+    return trial, ctx
+
+
+class TestTrainerIntegration:
+    def test_prefetch_on_by_default_and_reports_metrics(self, tmp_path):
+        trial, ctx = _fit(tmp_path, "on")
+        # loader ran on the prefetch thread, not the step loop
+        assert any(n.startswith(DevicePrefetcher.THREAD_PREFIX)
+                   for n in trial.loader_threads)
+        reported = ctx.train.local_training_metrics
+        assert reported
+        assert "input_wait_ms" in reported[-1]["metrics"]
+        assert "h2d_ms" in reported[-1]["metrics"]
+        assert "prefetch_queue_depth" in reported[-1]["metrics"]
+        assert prefetch_threads() == []
+
+    def test_opt_out_via_trial_attribute(self, tmp_path):
+        class NoPrefetch(LinearTrial):
+            prefetch = False
+
+        trial, ctx = _fit(tmp_path, "off", trial_cls=NoPrefetch)
+        assert trial.loader_threads == {"MainThread"}
+        assert "input_wait_ms" not in ctx.train.local_training_metrics[-1]["metrics"]
+
+    def test_losses_bit_identical_prefetch_on_vs_off(self, tmp_path):
+        class NoPrefetch(LinearTrial):
+            prefetch = False
+
+        _, ctx_on = _fit(tmp_path, "a")
+        _, ctx_off = _fit(tmp_path, "b", trial_cls=NoPrefetch)
+        on = [m["metrics"]["loss"] for m in ctx_on.train.local_training_metrics]
+        off = [m["metrics"]["loss"] for m in ctx_off.train.local_training_metrics]
+        assert len(on) == len(off) > 0
+        np.testing.assert_array_equal(np.asarray(on), np.asarray(off))
+
+    def test_preemption_mid_prefetch_leaves_no_threads(self, tmp_path):
+        ctx = core.init(max_length=1000,
+                        checkpoint_dir=str(tmp_path / "pre" / "ckpts"),
+                        async_checkpointing=False)
+        ctx.preempt.force()
+        trial = LinearTrial(TrialContext())
+        state = Trainer(trial, core_context=ctx).fit(report_period=2)
+        assert int(jax.device_get(state.step)) < 1000
+        ctx.close()
+        assert prefetch_threads() == []
+
+    def test_mid_epoch_loader_exception_reaches_fit_and_cleans_up(self, tmp_path):
+        class Flaky(LinearTrial):
+            def build_training_data(self):
+                yield {"x": np.zeros((8, 4), np.float32),
+                       "y": np.zeros((8, 2), np.float32)}
+                raise RuntimeError("loader died mid-epoch")
+
+        ctx = core.init(max_length=50,
+                        checkpoint_dir=str(tmp_path / "flaky" / "ckpts"),
+                        async_checkpointing=False)
+        with pytest.raises(RuntimeError, match="loader died mid-epoch"):
+            Trainer(Flaky(TrialContext()), core_context=ctx).fit(report_period=2)
+        ctx.close()
+        assert prefetch_threads() == []
+
+    def test_validation_prefetches_and_closes(self, tmp_path):
+        trial, ctx = _fit(tmp_path, "val")
+        assert ctx.train.local_validation_metrics
+        assert prefetch_threads() == []
+
+
+# ---------------------------------------------------------------------------
+# config resolution
+# ---------------------------------------------------------------------------
+
+
+class TestPrefetchConfig:
+    def test_defaults(self):
+        cfg = PrefetchConfig.resolve()
+        assert cfg.enabled and cfg.depth == 2 and cfg.shard
+
+    def test_expconf_block(self):
+        cfg = PrefetchConfig.resolve(
+            expconf={"prefetch": {"enabled": False, "depth": 5}})
+        assert not cfg.enabled and cfg.depth == 5
+
+    def test_trial_attr_wins_over_expconf(self):
+        class T:
+            prefetch = {"depth": 7}
+
+        cfg = PrefetchConfig.resolve(T(), {"prefetch": {"depth": 3}})
+        assert cfg.depth == 7 and cfg.enabled
+
+    def test_bool_forms(self):
+        assert PrefetchConfig.from_block(False).enabled is False
+        assert PrefetchConfig.from_block(True).enabled is True
+        with pytest.raises(TypeError):
+            PrefetchConfig.from_block("yes")
+
+    def test_depth_floor(self):
+        assert PrefetchConfig.from_block({"depth": 0}).depth == 1
+
+
+# ---------------------------------------------------------------------------
+# the acceptance contract: slow host + fixed step -> steady-state step time
+# is ~compute, not compute+input
+# ---------------------------------------------------------------------------
+
+
+HOST_DELAY_S = 0.020
+STEP_S = 0.050
+N_STEPS = 12
+
+
+def slow_host_iter():
+    rng = np.random.default_rng(0)
+    for _ in range(N_STEPS):
+        time.sleep(HOST_DELAY_S)  # simulated host preprocessing
+        yield {"x": rng.normal(size=(8, 16)).astype(np.float32)}
+
+
+def test_throughput_prefetch_beats_sync(batch_mesh_sharding):
+    """ISSUE 3 acceptance: with a 20ms host iterator and a 50ms step,
+    prefetch overlaps input with compute — >=1.25x throughput over the
+    synchronous path, and reported input_wait_ms drops accordingly."""
+
+    def step_fn(batch):
+        time.sleep(STEP_S)  # stands in for dispatched device compute
+
+    result = ab_compare(slow_host_iter, step_fn,
+                        sharding=batch_mesh_sharding, depth=2)
+    # sync pays host+H2D inline (~70ms/step); prefetch hides it (~50ms).
+    assert result["speedup"] >= 1.25, result
+    # input wait collapses from ~HOST_DELAY to near-zero.
+    assert result["sync"]["input_wait_ms"] >= HOST_DELAY_S * 1e3 * 0.9, result
+    assert result["prefetch"]["input_wait_ms"] < HOST_DELAY_S * 1e3 * 0.5, result
+    assert result["input_wait_ms_delta"] > 0

@@ -179,6 +179,40 @@ class TestPreflightBlock:
         assert any("hbm_gb_per_device" in e for e in expconf.validate(c))
 
 
+class TestPrefetchBlock:
+    """The `prefetch:` config block (async input pipeline,
+    docs/trial-api.md): on by default, opt-out + depth knobs."""
+
+    def test_valid_block(self):
+        c = base_config(prefetch={"enabled": True, "depth": 4,
+                                  "shard": True})
+        assert expconf.validate(c) == []
+
+    def test_bare_bool(self):
+        assert expconf.validate(base_config(prefetch=False)) == []
+
+    def test_bad_depth(self):
+        for depth in (0, -1, 1.5, True, "two"):
+            c = base_config(prefetch={"depth": depth})
+            assert any("prefetch.depth" in e for e in expconf.validate(c)), depth
+
+    def test_bad_enabled(self):
+        c = base_config(prefetch={"enabled": "yes"})
+        assert any("prefetch.enabled" in e for e in expconf.validate(c))
+
+    def test_unknown_key(self):
+        c = base_config(prefetch={"buffers": 3})
+        assert any("unknown keys" in e for e in expconf.validate(c))
+
+    def test_defaults_applied(self):
+        out = expconf.apply_defaults(base_config())
+        assert out["prefetch"] == {"enabled": True, "depth": 2}
+
+    def test_defaults_keep_user_values(self):
+        out = expconf.apply_defaults(base_config(prefetch={"depth": 8}))
+        assert out["prefetch"] == {"enabled": True, "depth": 8}
+
+
 class TestCrossFieldDiagnostics:
     """Cross-field checks surface as DTL rules (the same codes the native
     master enforces at experiment create), not bare exceptions."""

@@ -45,6 +45,20 @@ class TestProfilerUtilization:
     def test_peak_table_covers_v5e(self):
         assert PEAK_BF16_FLOPS["TPU v5 lite"] == 197e12
 
+    def test_input_pipeline_gauges(self):
+        """DevicePrefetcher window sums flow through observe_input into the
+        profiling series as per-batch means."""
+        p = ProfilerContext(TrainContext(None))
+        p.observe_input(40.0, 8.0, 6.0, 4)   # two flushes accumulate
+        p.observe_input(20.0, 4.0, 2.0, 4)
+        m = p._utilization_window()
+        assert m["input_wait_ms"] == pytest.approx(7.5)   # 60/8
+        assert m["h2d_ms"] == pytest.approx(1.5)          # 12/8
+        assert m["prefetch_queue_depth"] == pytest.approx(1.0)  # 8/8
+        # window resets; zero-batch observations are ignored
+        p.observe_input(0.0, 0.0, 0.0, 0)
+        assert p._utilization_window() == {}
+
     def test_trainer_feeds_profiler(self, tmp_path):
         """Trainer.fit(profile=True) reports a profiling metric series."""
         from determined_tpu import core

@@ -277,6 +277,85 @@ class TestAstEngine:
         assert codes(lint_source(src, "t.py")) == []
 
 
+def _loader_src(body):
+    return (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "from determined_tpu.train import JaxTrial\n"
+        "class T(JaxTrial):\n"
+        "    def init_params(self, rng):\n"
+        "        return {}\n"
+        "    def loss(self, params, batch, rng):\n"
+        "        return batch\n"
+        "    def build_training_data(self):\n"
+        f"{body}"
+    )
+
+
+class TestDataLoaderRule:
+    """DTL105 — device transfer inside build_*_data double-transfers with
+    the async input pipeline (determined_tpu/data)."""
+
+    def test_dtl105_device_put_in_loader(self):
+        out = lint_source(_loader_src(
+            "        while True:\n"
+            "            yield jax.device_put({'x': np.zeros(4)})\n"), "t.py")
+        assert codes(out) == ["DTL105"]
+        assert "device_put" in out[0].message
+
+    def test_dtl105_jnp_yield(self):
+        assert codes(lint_source(_loader_src(
+            "        for _ in range(4):\n"
+            "            yield jnp.zeros((8, 4))\n"), "t.py")) == ["DTL105"]
+
+    def test_dtl105_validation_loader_return(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "from determined_tpu.train import JaxTrial\n"
+            "class T(JaxTrial):\n"
+            "    def build_validation_data(self):\n"
+            "        return jnp.zeros((2, 4))\n"
+        )
+        assert codes(lint_source(src, "t.py")) == ["DTL105"]
+
+    def test_dtl105_negative_numpy_loader(self):
+        assert codes(lint_source(_loader_src(
+            "        while True:\n"
+            "            yield {'x': np.zeros((8, 4), np.float32)}\n"),
+            "t.py")) == []
+
+    def test_dtl105_negative_device_put_outside_loader(self):
+        src = (
+            "import jax\n"
+            "def stage(batch):\n"
+            "    return jax.device_put(batch)\n"
+        )
+        assert codes(lint_source(src, "t.py")) == []
+
+    def test_dtl105_negative_torch_loader(self):
+        src = (
+            "import jax\n"
+            "class MyTrial(PyTorchTrial):\n"
+            "    def build_training_data(self):\n"
+            "        yield jax.device_put({'x': 1})\n"
+        )
+        assert codes(lint_source(src, "t.py")) == []
+
+    def test_dtl105_noqa_suppression(self):
+        out = lint_source(_loader_src(
+            "        while True:\n"
+            "            yield jax.device_put({'x': np.zeros(4)})"
+            "  # det: noqa[DTL105]\n"), "t.py")
+        assert codes(out) == []
+        assert [d.code for d in out if d.suppressed] == ["DTL105"]
+
+    def test_dtl105_level_is_warning(self):
+        out = lint_source(_loader_src(
+            "        yield jnp.zeros((8, 4))\n"), "t.py")
+        assert out[0].level == "warning"
+
+
 # ---------------------------------------------------------------------------
 # config rules (DTL201-DTL202) — python side; native mirror in
 # native/tests/test_native.cc

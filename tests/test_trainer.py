@@ -180,3 +180,27 @@ def test_preemption_checkpoints_and_stops(tmp_path):
     assert ctx.checkpoint.local_reported  # checkpointed on preemption
     assert ctx.searcher.completed_metrics == []  # op not completed
     ctx.close()
+
+
+class _PollCountingTrial(TinyGPT2Trial):
+    def mesh_config(self):
+        return MeshConfig()  # pure data-parallel: cheapest compile
+
+
+def test_preempt_poll_cadence_independent_of_report_period(tmp_path):
+    """The preemption poll runs every `preempt_period` steps regardless of
+    `report_period` — in particular report_period=0 must NOT poll the
+    master every step (the old `max(report_period, 1)` coupling)."""
+    for report_period, preempt_period, expect in ((0, 4, 4), (3, 2, 2)):
+        ctx = make_local_core(tmp_path, max_length=1000)
+        polls = []
+        orig = ctx.preempt.should_preempt
+        ctx.preempt.should_preempt = lambda *a, **k: (polls.append(1), orig())[1]
+        ctx.preempt.force()
+        trainer = Trainer(_PollCountingTrial(TrialContext()), core_context=ctx)
+        state = trainer.fit(report_period=report_period,
+                            preempt_period=preempt_period)
+        # first poll happens at step == preempt_period and already preempts
+        assert int(jax.device_get(state.step)) == expect
+        assert len(polls) == 1
+        ctx.close()
