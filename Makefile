@@ -3,7 +3,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint test bench-input native native-test clean
+.PHONY: lint test chaos bench-input native native-test clean
 
 # The dogfood gate (docs/preflight.md): the platform's own models and
 # examples must pass the platform's own static analyzer. Fails on any
@@ -14,6 +14,15 @@ lint:
 
 test:
 	$(PY) -m pytest tests/ -q -m 'not slow'
+
+# The -m slow chaos/recovery suite (docs/chaos.md, docs/checkpointing.md):
+# SIGKILL-mid-save lineage fallback, watchdog-driven restarts, master/agent
+# kills, 5xx storms. Bounded so a wedged recovery path fails the target
+# instead of hanging CI.
+CHAOS_TIMEOUT ?= 1800
+chaos:
+	timeout -k 30 $(CHAOS_TIMEOUT) $(PY) -m pytest \
+		tests/test_chaos.py tests/test_selfheal.py -q -m slow
 
 # Async input pipeline A/B: prefetch on/off step time + input_wait_ms
 # (docs/trial-api.md "Data loading and the async input pipeline").

@@ -105,4 +105,17 @@ def check_config(config: Dict[str, Any]) -> List[Diagnostic]:
                     f"{int(divisor ** (num_rungs - 1))}: the bottom rung "
                     "would train for zero batches and the top rungs are "
                     "unreachable; lower num_rungs or raise max_length"))
+
+    # DTL203 — restarts configured but nothing to restart from. Only an
+    # EXPLICIT min_checkpoint_period: 0 fires (key present): the default is
+    # also 0 batches and flagging every config would be pure noise.
+    if "min_checkpoint_period" in config:
+        mcp = _length_batches(config.get("min_checkpoint_period"))
+        mr = config.get("max_restarts", 5)
+        if mcp == 0 and isinstance(mr, int) and mr > 0:
+            diags.append(RULES["DTL203"].diag(
+                f"min_checkpoint_period: 0 with max_restarts={mr}: mid-op "
+                "failures can only restart from the previous op-boundary "
+                "checkpoint (or from scratch); set a periodic "
+                "min_checkpoint_period or max_restarts: 0"))
     return diags

@@ -86,6 +86,11 @@ _ALL = [
          "searcher.max_length cannot populate the configured ASHA rungs "
          "(max_length < divisor^(num_rungs-1)); top rungs would be "
          "unreachable and the search degenerates"),
+    Rule("DTL203", "restarts-without-checkpoints", "warning", "config",
+         "min_checkpoint_period is explicitly 0 (op-boundary checkpoints "
+         "only) while max_restarts > 0: a mid-op failure restarts from the "
+         "previous op boundary or from scratch — restarts are configured "
+         "but there is nothing recent to restart from"),
 ]
 
 RULES: Dict[str, Rule] = {r.code: r for r in _ALL}

@@ -1,7 +1,8 @@
 """Core API — framework-agnostic training services (reference
 harness/determined/core/)."""
 
-from determined_tpu.core._checkpoint import CheckpointContext  # noqa: F401
+from determined_tpu.core._checkpoint import CheckpointContext, state_id_step  # noqa: F401
+from determined_tpu.core._integrity import CorruptCheckpoint  # noqa: F401
 from determined_tpu.core._context import Context, init  # noqa: F401
 from determined_tpu.core._distributed import DistributedContext  # noqa: F401
 from determined_tpu.core._preempt import PreemptContext  # noqa: F401

@@ -21,16 +21,29 @@ import contextlib
 import json
 import logging
 import os
+import re
 import time
 from typing import Any, Dict, Iterator, List, Optional
 
 from determined_tpu.common.api import Session
+from determined_tpu.core import _integrity
+from determined_tpu.core._integrity import CorruptCheckpoint  # noqa: F401  (re-export)
 from determined_tpu.storage.base import StorageManager
 
 logger = logging.getLogger("determined_tpu.core")
 
 _STATE_SUBDIR = "state"  # orbax pytree lives here inside the checkpoint dir
 _METADATA_FILE = "metadata.json"
+
+# save_state ids are deterministic so all hosts agree without a broadcast —
+# and so lineage() can recover the step ordering from storage alone.
+_STATE_ID_RE = re.compile(r"^trial(\d+)-step(\d+)$")
+
+
+def state_id_step(storage_id: str) -> Optional[int]:
+    """Step number encoded in a save_state id (None for other ids)."""
+    m = _STATE_ID_RE.match(storage_id)
+    return int(m.group(2)) if m else None
 
 
 def _is_remote(path: str) -> bool:
@@ -62,6 +75,9 @@ class CheckpointContext:
         self._dist = distributed
         self._async = async_save
         self._checkpointer = None
+        # (storage_id, path, metadata) of an async save whose phase-2 commit
+        # (manifest + COMMIT marker + COMPLETED report) is still pending.
+        self._pending_commit: Optional[tuple] = None
         self.local_reported: List[Dict[str, Any]] = []
 
     # -- orbax plumbing ------------------------------------------------
@@ -92,7 +108,17 @@ class CheckpointContext:
         """Save a pytree of (possibly sharded) jax arrays; returns storage id.
 
         All hosts must call this (collective); each writes its own shards.
+
+        Two-phase commit (docs/checkpointing.md): the orbax save is phase 1
+        and may complete asynchronously; the checkpoint is reported PARTIAL
+        immediately and only flips to COMPLETED — manifest + COMMIT marker
+        written, registry updated, resume pointer advanced — once the save
+        is durable (at the next `wait()` / `save_state` / `close()`).
         """
+        # A previous async save still pending phase 2 commits first — orbax
+        # would block on it inside save() anyway, so this costs nothing
+        # extra and keeps at most one checkpoint in the PARTIAL window.
+        self.wait()
         # Deterministic id so all hosts agree without a broadcast.
         storage_id = f"trial{self._trial_id}-step{steps_completed}"
         path = self._array_path(storage_id)
@@ -120,24 +146,87 @@ class CheckpointContext:
                 "time": time.time(),
             }
         )
-        if self._is_chief() and not _is_remote(path):
-            with open(os.path.join(path, _METADATA_FILE), "w") as f:
-                json.dump(md, f)
+        if self._is_chief():
+            if not _is_remote(path):
+                with open(os.path.join(path, _METADATA_FILE), "w") as f:
+                    json.dump(md, f)
+            else:
+                # Remote (tensorstore-native) paths used to get NO metadata
+                # file at all — load_metadata returned {} and resume lost
+                # steps_completed. Stage it locally and upload.
+                self._upload_small_files(storage_id,
+                                         {_METADATA_FILE: json.dumps(md)})
+        self._report(storage_id, md, state="PARTIAL")
         if self._needs_staged_copy(path):
             # No tensorstore driver for this backend (azure): the orbax save
-            # landed in local staging — push it to the bucket, then drop the
-            # staging copy so periodic checkpointing doesn't fill /tmp. Every
-            # host uploads its own shard files (reference shard=True
+            # landed in local staging — commit it there, push everything
+            # (shards + manifest + COMMIT) to the bucket, then drop the
+            # staging copy so periodic checkpointing doesn't fill /tmp.
+            # Every host uploads its own shard files (reference shard=True
             # semantics).
             import shutil
 
             self.wait()
             try:
+                if self._is_chief():
+                    _integrity.commit(path, storage_id)
                 self._storage.upload(path, storage_id)
             finally:
                 shutil.rmtree(path, ignore_errors=True)
-        self._report(storage_id, md)
+            self._report(storage_id, md, state="COMPLETED")
+            return storage_id
+        self._pending_commit = (storage_id, path, md)
+        if not self._async:
+            self.wait()
         return storage_id
+
+    def _upload_small_files(self, storage_id: str,
+                            files: Dict[str, str]) -> None:
+        """Stage name->content strings into a tempdir and upload them into
+        the checkpoint (used for metadata/manifest/COMMIT on remote paths,
+        where there is no local directory to write into)."""
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            for name, content in files.items():
+                with open(os.path.join(td, name), "w") as f:
+                    f.write(content)
+            self._storage.upload(td, storage_id, list(files))
+
+    def _commit_pending(self) -> None:
+        """Phase 2 for the pending async save: manifest + COMMIT + the
+        COMPLETED report. Caller must have made the save durable (wait)."""
+        if self._pending_commit is None:
+            return
+        storage_id, path, md = self._pending_commit
+        self._pending_commit = None
+        if self._is_chief():
+            if not _is_remote(path):
+                _integrity.commit(path, storage_id)
+            else:
+                # Object stores expose no rename, but object creation is
+                # atomic; checksums would require re-downloading every
+                # shard, so the remote manifest records presence + size.
+                listing = {
+                    rel: size
+                    for rel, size in self._storage.list_files(storage_id).items()
+                    if rel not in (_integrity.MANIFEST_FILE,
+                                   _integrity.COMMIT_FILE)
+                }
+                manifest = {"version": 1,
+                            "files": {rel: {"size": size}
+                                      for rel, size in sorted(listing.items())}}
+                from determined_tpu.common import faultpoint
+
+                files = {_integrity.MANIFEST_FILE:
+                         json.dumps(manifest, sort_keys=True)}
+                if faultpoint.fire(_integrity.FAULT_COMMIT_DROP) is \
+                        faultpoint.Action.NONE:
+                    files[_integrity.COMMIT_FILE] = json.dumps(
+                        {"storage_id": storage_id,
+                         "n_files": len(listing)})
+                self._upload_small_files(storage_id, files)
+        self._report(storage_id, md, state="COMPLETED")
 
     def _needs_staged_copy(self, path: str) -> bool:
         return (
@@ -182,17 +271,99 @@ class CheckpointContext:
         if self._needs_staged_copy(path):
             # restore_path pulls a fresh copy from the bucket into staging
             # (never trusting this host's own stale/partial staging) and
-            # cleans up afterwards.
+            # cleans up afterwards. Verify the downloaded copy — the
+            # manifest + COMMIT came down with it.
             with self._storage.restore_path(storage_id) as local_path:
                 state_dir = os.path.join(local_path, _STATE_SUBDIR)
                 if not os.path.isdir(state_dir):
                     raise FileNotFoundError(
                         f"checkpoint {storage_id} has no array state in cloud storage"
                     )
+                _integrity.verify(local_path, storage_id)
                 return restorer.restore(state_dir, abstract)
-        if not _is_remote(path) and not os.path.isdir(path):
+        if _is_remote(path):
+            self._verify_remote(storage_id)
+            return restorer.restore(path + "/" + _STATE_SUBDIR, abstract)
+        if not os.path.isdir(path):
             raise FileNotFoundError(f"checkpoint {storage_id} not found at {path}")
+        _integrity.verify(path, storage_id)
         return restorer.restore(path + "/" + _STATE_SUBDIR, abstract)
+
+    def _verify_remote(self, storage_id: str) -> None:
+        """Integrity check for tensorstore-native (gs://) checkpoints:
+        download only the two protocol files and verify the bucket listing
+        against the manifest (presence + size; checksumming would download
+        every shard)."""
+        import tempfile
+
+        listing = self._storage.list_files(storage_id)
+        manifest = None
+        with tempfile.TemporaryDirectory() as td:
+            self._storage.download(
+                storage_id, td,
+                selector=lambda rel: rel == _integrity.MANIFEST_FILE)
+            mf = os.path.join(td, _integrity.MANIFEST_FILE)
+            if os.path.exists(mf):
+                try:
+                    with open(mf) as f:
+                        manifest = json.load(f)
+                except (OSError, ValueError):
+                    manifest = None
+        _integrity.verify_listing(listing, manifest, storage_id)
+
+    def verify(self, storage_id: str) -> bool:
+        """Standalone integrity check (no restore). True = manifest fully
+        verified; False = legacy checkpoint (predates the protocol);
+        raises CorruptCheckpoint / FileNotFoundError otherwise."""
+        path = self._array_path(storage_id)
+        if self._needs_staged_copy(path) or _is_remote(path):
+            self._verify_remote(storage_id)
+            return True
+        if not os.path.isdir(path):
+            raise FileNotFoundError(f"checkpoint {storage_id} not found at {path}")
+        return _integrity.verify(path, storage_id)
+
+    def lineage(self) -> List[str]:
+        """This trial's COMPLETED checkpoints, newest first — the fallback
+        chain `Trainer._restore` walks when the latest checkpoint is
+        corrupt or missing (Gemini-style known-good lineage).
+
+        Managed mode asks the master registry (which only marks a
+        checkpoint COMPLETED after the phase-2 commit report); local /
+        masterless mode reconstructs the lineage from in-process reports
+        plus the deterministic `trial{N}-step{M}` ids found in storage
+        (committed ones only), so a restarted local process still sees it.
+        """
+        if self._session is not None:
+            try:
+                resp = self._session.get(
+                    f"/api/v1/trials/{self._trial_id}/checkpoints",
+                    params={"state": "COMPLETED"})
+                return [c["uuid"] for c in resp.get("checkpoints", [])]
+            except Exception:
+                logger.warning("lineage query failed; falling back to "
+                               "storage scan", exc_info=True)
+        steps: Dict[str, int] = {}
+        for rec in self.local_reported:
+            if rec.get("state", "COMPLETED") != "COMPLETED":
+                continue
+            m = _STATE_ID_RE.match(rec["uuid"])
+            if m and int(m.group(1)) == self._trial_id:
+                steps[rec["uuid"]] = int(m.group(2))
+        base = getattr(self._storage, "base_path", None)
+        if base and os.path.isdir(base):
+            for name in os.listdir(base):
+                m = _STATE_ID_RE.match(name)
+                if not m or int(m.group(1)) != self._trial_id:
+                    continue
+                if name in steps:
+                    continue
+                # Only committed checkpoints join the lineage; an
+                # uncommitted dir is exactly what fallback must skip.
+                if os.path.exists(os.path.join(
+                        base, name, _integrity.COMMIT_FILE)):
+                    steps[name] = int(m.group(2))
+        return sorted(steps, key=steps.__getitem__, reverse=True)
 
     def load_metadata(self, storage_id: str) -> Dict[str, Any]:
         # Fetch only metadata.json — restore_path on a cloud backend would
@@ -214,10 +385,12 @@ class CheckpointContext:
         return {}
 
     def wait(self) -> None:
-        """Block until pending async saves are durable."""
+        """Block until pending async saves are durable AND committed
+        (manifest + COMMIT marker written, COMPLETED reported)."""
         c = self._checkpointer
         if c is not None and hasattr(c, "wait_until_finished"):
             c.wait_until_finished()
+        self._commit_pending()
 
     def close(self) -> None:
         self.wait()
@@ -305,6 +478,7 @@ class CheckpointContext:
         storage_id: str,
         metadata: Dict[str, Any],
         resources: Optional[Dict[str, int]] = None,
+        state: str = "COMPLETED",
     ) -> None:
         if not self._is_chief():
             return
@@ -315,8 +489,16 @@ class CheckpointContext:
             "metadata": metadata,
             "steps_completed": metadata.get("steps_completed", 0),
             "resources": resources or {},
+            "state": state,
         }
         if self._session is None:
+            # The phase-2 COMPLETED report updates the PARTIAL record in
+            # place, mirroring the master's INSERT OR REPLACE — one record
+            # per checkpoint either way.
+            for i, rec in enumerate(self.local_reported):
+                if rec["uuid"] == storage_id:
+                    self.local_reported[i] = record
+                    return
             self.local_reported.append(record)
             return
         if resources is None:

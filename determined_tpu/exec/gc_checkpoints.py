@@ -20,7 +20,17 @@ logger = logging.getLogger("determined_tpu.exec.gc")
 def main() -> int:
     logging.basicConfig(level=logging.INFO, format="gc: %(message)s")
     spec = json.loads(os.environ.get("DET_GC_SPEC", "{}"))
-    uuids = spec.get("uuids", [])
+    uuids = list(spec.get("uuids", []))
+    # Stale PARTIAL checkpoints (docs/checkpointing.md): saves whose
+    # phase-2 commit never landed, past the master's TTL. The master never
+    # includes a trial's newest PARTIAL — an in-flight async save may
+    # still be committing it — so everything here is safe to delete.
+    partial_uuids = [u for u in spec.get("partial_uuids", [])
+                     if u not in set(uuids)]
+    if partial_uuids:
+        logger.info("%d stale PARTIAL checkpoint(s) past TTL",
+                    len(partial_uuids))
+    uuids += partial_uuids
     if not uuids:
         logger.info("nothing to delete")
         return 0

@@ -217,8 +217,41 @@ def validate(config: Dict[str, Any]) -> List[str]:
     _validate_log_policies(config.get("log_policies"), errors)
     _validate_preflight(config.get("preflight"), errors)
     _validate_prefetch(config.get("prefetch"), errors)
+    _validate_health(config.get("health"), errors)
 
     return errors
+
+
+def _validate_health(block: Any, errors: List[str]) -> None:
+    """`health:` — the self-healing loop (docs/checkpointing.md): the
+    divergence sentinel's on_nan policy and the step watchdog timeout."""
+    if block is None:
+        return
+    if not isinstance(block, dict):
+        errors.append("health must be a mapping")
+        return
+    valid = {"on_nan", "rollback_window", "max_rollbacks", "step_timeout_sec"}
+    unknown = sorted(set(block) - valid)
+    if unknown:
+        errors.append(
+            f"health: unknown keys {unknown}; valid: {sorted(valid)}")
+    on_nan = block.get("on_nan")
+    if on_nan is not None and on_nan not in ("warn", "rollback", "fail"):
+        errors.append("health.on_nan must be one of warn|rollback|fail")
+    for key in ("rollback_window", "max_rollbacks"):
+        v = block.get(key)
+        if v is not None and (
+            isinstance(v, bool) or not isinstance(v, int) or v < 0
+        ):
+            errors.append(f"health.{key} must be a non-negative int")
+    if block.get("max_rollbacks") == 0:
+        errors.append("health.max_rollbacks must be >= 1")
+    v = block.get("step_timeout_sec")
+    if v is not None and (
+        isinstance(v, bool) or not isinstance(v, (int, float)) or v < 0
+    ):
+        errors.append("health.step_timeout_sec must be a non-negative "
+                      "number (0 disables the watchdog)")
 
 
 def _validate_prefetch(block: Any, errors: List[str]) -> None:
@@ -452,6 +485,12 @@ def apply_defaults(config: Dict[str, Any]) -> Dict[str, Any]:
     if isinstance(pf, dict):
         pf.setdefault("enabled", True)
         pf.setdefault("depth", 2)
+    health = c.setdefault("health", {})
+    if isinstance(health, dict):
+        health.setdefault("on_nan", "warn")
+        health.setdefault("rollback_window", 8)
+        health.setdefault("max_rollbacks", 3)
+        health.setdefault("step_timeout_sec", 0)
     return c
 
 

@@ -1,0 +1,75 @@
+"""Trial health — divergence sentinel + watchdog configuration.
+
+The self-healing loop's policy knobs (docs/checkpointing.md). The jitted
+train step folds an all-finite reduction over (loss, grad-norm) into its
+metrics — one fused logical-and on device, fetched with the regular
+per-flush metrics batch, so detection costs no extra host sync. What
+happens when it trips is configured here:
+
+    health:
+      on_nan: warn | rollback | fail   # default warn
+      rollback_window: 8               # batches skipped past the NaN
+      max_rollbacks: 3                 # rollback->fail escalation
+      step_timeout_sec: 0              # step watchdog; 0 = disabled
+
+A trial can override the experiment config with a `health` attribute
+(same precedence contract as `JaxTrial.prefetch`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+ON_NAN_POLICIES = ("warn", "rollback", "fail")
+
+
+class DivergenceError(RuntimeError):
+    """Raised when training diverges (non-finite loss/grads) under
+    `on_nan: fail`, or when `on_nan: rollback` exhausts `max_rollbacks`."""
+
+    def __init__(self, step: int, detail: str = ""):
+        msg = f"training diverged at step {step} (non-finite loss/gradients)"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+        self.step = step
+
+
+@dataclasses.dataclass
+class HealthConfig:
+    """Resolved `health:` knobs (trial attribute over expconf block)."""
+
+    on_nan: str = "warn"
+    rollback_window: int = 8
+    max_rollbacks: int = 3
+    step_timeout_sec: float = 0.0  # 0 = watchdog disabled
+
+    @classmethod
+    def from_block(cls, block: Any) -> "HealthConfig":
+        if block is None:
+            return cls()
+        if not isinstance(block, dict):
+            raise TypeError(
+                f"health config must be a mapping, got {type(block).__name__}")
+        on_nan = str(block.get("on_nan", "warn"))
+        if on_nan not in ON_NAN_POLICIES:
+            raise ValueError(
+                f"health.on_nan must be one of {ON_NAN_POLICIES}, "
+                f"got {on_nan!r}")
+        return cls(
+            on_nan=on_nan,
+            rollback_window=max(0, int(block.get("rollback_window", 8))),
+            max_rollbacks=max(1, int(block.get("max_rollbacks", 3))),
+            step_timeout_sec=float(block.get("step_timeout_sec", 0.0)),
+        )
+
+    @classmethod
+    def resolve(cls, trial: Any = None,
+                expconf: Optional[Dict[str, Any]] = None) -> "HealthConfig":
+        trial_attr = getattr(trial, "health", None)
+        if trial_attr is not None:
+            return cls.from_block(trial_attr)
+        if isinstance(expconf, dict) and expconf.get("health") is not None:
+            return cls.from_block(expconf.get("health"))
+        return cls()

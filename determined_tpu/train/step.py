@@ -67,7 +67,14 @@ def make_train_step(
         )
         gnorm = optax.global_norm(grads)
         new_state = state.apply_gradients(grads, tx, new_extra)
-        metrics = {"loss": loss, "grad_norm": gnorm, **aux}
+        # Divergence sentinel (train/health.py): grad_norm is already a
+        # reduction over every gradient leaf (NaN/Inf anywhere propagates
+        # into it), so one fused logical-and over (loss, grad_norm) covers
+        # the whole step. Rides the regular metrics fetch — no extra host
+        # sync, no extra collective.
+        all_finite = jnp.logical_and(jnp.isfinite(loss), jnp.isfinite(gnorm))
+        metrics = {"loss": loss, "grad_norm": gnorm,
+                   "all_finite": all_finite.astype(jnp.float32), **aux}
         return new_state, metrics
 
     return jax.jit(step, donate_argnums=(0,) if donate_state else ())
@@ -102,7 +109,10 @@ def make_multi_step(
         (loss, aux), grads = jax.value_and_grad(lfn, has_aux=True)(state.params)
         gnorm = optax.global_norm(grads)
         new_state = state.apply_gradients(grads, tx, None)
-        return new_state, {"loss": loss, "grad_norm": gnorm, **aux}
+        all_finite = jnp.logical_and(jnp.isfinite(loss), jnp.isfinite(gnorm))
+        return new_state, {"loss": loss, "grad_norm": gnorm,
+                           "all_finite": all_finite.astype(jnp.float32),
+                           **aux}
 
     def multi_step(state: TrainState, batches: Any, rng: jax.Array):
         batches = _constrain_batch(batches, mesh, rules, leading_dims=2)

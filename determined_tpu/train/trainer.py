@@ -27,11 +27,14 @@ import numpy as np
 
 from determined_tpu import _jax_compat
 from determined_tpu import core as core_mod
+from determined_tpu.common import faultpoint
 from determined_tpu.data import DevicePrefetcher, PrefetchConfig
 from determined_tpu.parallel.mesh import create_mesh
+from determined_tpu.train.health import DivergenceError, HealthConfig
 from determined_tpu.train.state import TrainState, create_train_state
 from determined_tpu.train.step import batch_sharding, make_eval_step, make_train_step
 from determined_tpu.train.trial import JaxTrial
+from determined_tpu.train.watchdog import StepWatchdog
 
 _jax_compat.install()  # jax.sharding.set_mesh on jax < 0.5
 
@@ -67,6 +70,9 @@ class Trainer:
         self._train_step = None
         self._eval_step = None
         self._pf_cfg: Optional[PrefetchConfig] = None
+        self._health_cfg: Optional[HealthConfig] = None
+        self._watchdog: Optional[StepWatchdog] = None
+        self._rollbacks = 0
 
     # -- setup ---------------------------------------------------------
 
@@ -167,6 +173,12 @@ class Trainer:
             expconf = core.info.trial.config
         return PrefetchConfig.resolve(self.trial, expconf)
 
+    def _health_config(self, core) -> HealthConfig:
+        expconf = None
+        if core is not None and core.info is not None and core.info.trial:
+            expconf = core.info.trial.config
+        return HealthConfig.resolve(self.trial, expconf)
+
     def fit(
         self,
         max_length: Optional[int] = None,
@@ -202,6 +214,8 @@ class Trainer:
             core.profiler.on()
 
         self._pf_cfg = self._prefetch_config(core)
+        health = self._health_cfg = self._health_config(core)
+        self._rollbacks = 0
         data_iter: Any = _repeat(self.trial.build_training_data)
         prefetcher: Optional[DevicePrefetcher] = None
         if self._pf_cfg.enabled:
@@ -221,36 +235,110 @@ class Trainer:
         t_report = time.time()
         n_report = 0
 
-        def flush():
+        # Step watchdog (train/watchdog.py): beaten at every metrics flush
+        # (a real host sync proving the device made progress); fires — stack
+        # dump, exit-reason report, nonzero exit — when nothing lands within
+        # health.step_timeout_sec. The timeout must cover the first step's
+        # jit compile; 0 disables.
+        watchdog = self._watchdog = StepWatchdog(
+            health.step_timeout_sec,
+            session=core.checkpoint._session,
+            allocation_id=core.checkpoint._allocation_id,
+        )
+
+        def flush() -> Optional[Dict[str, Any]]:
             nonlocal last, t_report, n_report
+            host = None
             if last is not None:
-                self._flush_metrics(core, last, t_report, n_report, prefetcher)
+                host = self._flush_metrics(
+                    core, last, t_report, n_report, prefetcher)
             last, t_report, n_report = None, time.time(), 0
+            watchdog.beat()
+            return host
+
+        def diverged(host: Optional[Dict[str, Any]]) -> bool:
+            return host is not None and float(host.get("all_finite", 1.0)) < 1.0
+
+        def handle_divergence() -> bool:
+            """Apply health.on_nan; True = state was rolled back (`step`
+            has been rewound and the data stream advanced)."""
+            nonlocal step, rng, last_validated, last_checkpointed
+            failed_step = step
+            if health.on_nan == "fail":
+                raise DivergenceError(failed_step)
+            if health.on_nan == "warn":
+                logger.warning(
+                    "divergence at step %d (non-finite loss/gradients); "
+                    "health.on_nan=warn — continuing", failed_step)
+                return False
+            # rollback: restore the last COMPLETED checkpoint, advance the
+            # data stream past the offending window, reseed the step rng.
+            if self._rollbacks >= health.max_rollbacks:
+                raise DivergenceError(
+                    failed_step,
+                    f"diverged again after {health.max_rollbacks} rollbacks")
+            self._rollbacks += 1
+            core.checkpoint.wait()  # commit pending: lineage must be current
+            restored = self._restore_chain(core.checkpoint.lineage())
+            if restored is None:
+                raise DivergenceError(
+                    failed_step, "health.on_nan=rollback but no COMPLETED "
+                    "checkpoint exists to roll back to")
+            step = int(jax.device_get(self.state.step))
+            # The data iterator keeps its position (already past the batches
+            # that produced the NaN); skipping rollback_window more batches
+            # moves the replayed window onto fresh data, and folding the
+            # rollback count into the rng changes dropout/noise on replay.
+            for _ in range(health.rollback_window):
+                next(data_iter)
+            rng = jax.random.fold_in(rng, self._rollbacks)
+            last_validated = last_checkpointed = step
+            logger.warning(
+                "divergence at step %d: rolled back to checkpoint %s "
+                "(step %d), skipped %d batches (rollback %d/%d)",
+                failed_step, restored, step, health.rollback_window,
+                self._rollbacks, health.max_rollbacks)
+            watchdog.beat()
+            return True
 
         try:
+            watchdog.start()
             with jax.sharding.set_mesh(self.mesh):
                 for op in core.searcher.operations():
-                    while step < op.length and not preempted:
-                        batch = next(data_iter)
-                        rng, step_rng = jax.random.split(rng)
-                        self.state, metrics = self._train_step(self.state, batch, step_rng)
-                        step += 1
-                        n_report += 1
-                        last = (step, metrics)
+                    while True:
+                        while step < op.length and not preempted:
+                            # Chaos (docs/chaos.md): a delay-mode arm here
+                            # models a wedged host/collective — exactly what
+                            # the watchdog exists to catch.
+                            faultpoint.fire("step.hang")
+                            batch = next(data_iter)
+                            rng, step_rng = jax.random.split(rng)
+                            self.state, metrics = self._train_step(self.state, batch, step_rng)
+                            step += 1
+                            n_report += 1
+                            last = (step, metrics)
 
-                        if report_period and step % report_period == 0:
-                            flush()
-                            core.profiler.set_step(step)
-                        if validation_period and step % validation_period == 0:
-                            last_val = self._validate(core, step)
-                            last_validated = step
-                        if checkpoint_period and step % checkpoint_period == 0:
-                            self._checkpoint(core, step)
-                            last_checkpointed = step
-                        if step % preempt_period == 0 and core.preempt.should_preempt():
-                            preempted = True
+                            if report_period and step % report_period == 0:
+                                host = flush()
+                                core.profiler.set_step(step)
+                                if diverged(host) and handle_divergence():
+                                    continue  # rolled back: step rewound
+                            if validation_period and step % validation_period == 0:
+                                last_val = self._validate(core, step)
+                                last_validated = step
+                                watchdog.beat()
+                            if checkpoint_period and step % checkpoint_period == 0:
+                                self._checkpoint(core, step)
+                                last_checkpointed = step
+                                watchdog.beat()
+                            if step % preempt_period == 0 and core.preempt.should_preempt():
+                                preempted = True
 
-                    flush()
+                        host = flush()
+                        if diverged(host) and not preempted \
+                                and handle_divergence():
+                            continue  # step rewound below op.length
+                        break
 
                     if preempted:
                         if last_checkpointed != step:
@@ -259,6 +347,7 @@ class Trainer:
                         break
 
                     val = last_val if last_validated == step else self._validate(core, step)
+                    watchdog.beat()
                     if last_checkpointed != step:
                         self._checkpoint(core, step)
                         last_checkpointed = step
@@ -271,8 +360,9 @@ class Trainer:
                         op.report_completed(metric)
         finally:
             # Preemption, op boundaries and mid-epoch iterator exceptions
-            # all pass through here: the prefetch thread must be joined, not
-            # orphaned, before the process checkpoints/exits.
+            # all pass through here: the watchdog and prefetch threads must
+            # be joined, not orphaned, before the process checkpoints/exits.
+            watchdog.stop()
             if prefetcher is not None:
                 prefetcher.close()
 
@@ -284,7 +374,8 @@ class Trainer:
     # -- helpers ---------------------------------------------------------
 
     def _flush_metrics(self, core, last, t_start, n_steps,
-                       prefetcher: Optional[DevicePrefetcher] = None) -> None:
+                       prefetcher: Optional[DevicePrefetcher] = None,
+                       ) -> Dict[str, Any]:
         last_step, last_metrics = last
         # One device_get for the whole metrics tree: per-key fetches would
         # pay the host round-trip once per metric instead of once per flush.
@@ -301,7 +392,13 @@ class Trainer:
                 host["h2d_ms"] = h2d / n
                 host["prefetch_queue_depth"] = depth / n
                 core.profiler.observe_input(wait, h2d, depth, n)
+        # The divergence sentinel's event channel: a non-finite step marks
+        # this flush's report so dashboards/webhooks see `divergence: 1`
+        # exactly where the loss went bad (train/health.py).
+        if float(host.get("all_finite", 1.0)) < 1.0:
+            host["divergence"] = 1.0
         core.train.report_training_metrics(last_step, host)
+        return host
 
     def _validate(self, core, step: int) -> Dict[str, Any]:
         if self._eval_step is None:
@@ -342,20 +439,62 @@ class Trainer:
     def _checkpoint(self, core, step: int) -> None:
         core.checkpoint.save_state(self.state, step)
 
-    def _restore(self, storage_id: str) -> None:
-        assert self.state is not None
-        try:
-            self.state = self.core.checkpoint.restore_state(storage_id, self.state)
-            logger.info(
-                "restored from checkpoint %s at step %d",
-                storage_id,
-                int(jax.device_get(self.state.step)),
-            )
-        except FileNotFoundError:
-            logger.warning("latest checkpoint %s missing; starting fresh", storage_id)
-        except Exception:
-            # A partial/corrupt checkpoint (e.g. process killed mid async
-            # commit) must not crash-loop the trial — start fresh instead.
+    def _restore(self, storage_id: str) -> Optional[str]:
+        """Restore `storage_id`, falling back through the COMPLETED lineage
+        when it is missing or fails integrity verification. Returns the
+        storage id actually restored, or None (fresh start — only when the
+        entire lineage is exhausted)."""
+        restored = self._restore_chain([storage_id])
+        if restored is None:
             logger.warning(
-                "checkpoint %s unreadable; starting fresh", storage_id, exc_info=True
-            )
+                "no restorable checkpoint in the lineage of %s; "
+                "starting fresh", storage_id)
+        return restored
+
+    def _restore_chain(self, candidates) -> Optional[str]:
+        """Try each candidate in order, extending with the registry lineage
+        after the first failure. Missing (FileNotFoundError) and corrupt
+        (CorruptCheckpoint) checkpoints fall through to the next candidate;
+        anything else is a programming error (sharding/shape mismatch, a
+        bug) and re-raises — silently discarding training progress on those
+        was the seed behavior this replaces."""
+        assert self.state is not None
+        queue = list(candidates)
+        tried = set()
+        extended = not queue  # empty input: nothing to extend from
+        while queue:
+            sid = queue.pop(0)
+            if sid in tried:
+                continue
+            tried.add(sid)
+            try:
+                self.state = self.core.checkpoint.restore_state(sid, self.state)
+                logger.info(
+                    "restored from checkpoint %s at step %d",
+                    sid, int(jax.device_get(self.state.step)))
+                return sid
+            except FileNotFoundError:
+                logger.warning(
+                    "checkpoint %s missing; walking lineage back", sid)
+            except core_mod.CorruptCheckpoint as e:
+                logger.warning(
+                    "checkpoint %s failed integrity verification (%s); "
+                    "walking lineage back", sid, e.reason)
+            if not extended:
+                extended = True
+                try:
+                    lineage = self.core.checkpoint.lineage()
+                except Exception:
+                    logger.warning("lineage unavailable", exc_info=True)
+                    continue
+                # Fallback only walks BACKWARD: a checkpoint newer than the
+                # one requested is never a substitute for it (an explicit
+                # resume_from points at a specific point in training).
+                limit = core_mod.state_id_step(sid)
+                for cand in lineage:
+                    cstep = core_mod.state_id_step(cand)
+                    if limit is not None and cstep is not None \
+                            and cstep > limit:
+                        continue
+                    queue.append(cand)
+        return None

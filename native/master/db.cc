@@ -426,6 +426,17 @@ ALTER TABLE allocations ADD COLUMN resources TEXT NOT NULL DEFAULT '[]';
       {16, R"sql(
 ALTER TABLE experiments ADD COLUMN preflight TEXT;
 )sql"},
+      // Checkpoint integrity / two-phase commit: the registry's `state`
+      // column now distinguishes PARTIAL (save reported, commit not yet
+      // durable) from COMPLETED (manifest + COMMIT verified). Lineage
+      // fallback and GC both query by (trial, state, step) — index it,
+      // and normalize any pre-protocol NULL/empty states to COMPLETED so
+      // old rows stay restorable.
+      {17, R"sql(
+UPDATE checkpoints SET state='COMPLETED' WHERE state IS NULL OR state='';
+CREATE INDEX idx_checkpoints_trial_state
+  ON checkpoints(trial_id, state, steps_completed);
+)sql"},
   };
   return kMigrations;
 }

@@ -521,6 +521,34 @@ HttpResponse Master::handle_trials(const HttpRequest& req,
     return json_resp(200, out);
   }
 
+  // GET /api/v1/trials/{id}/checkpoints[?state=COMPLETED] — the trial's
+  // checkpoint lineage, newest first. This is the fallback chain
+  // Trainer._restore walks when the latest checkpoint fails integrity
+  // verification (core/_checkpoint.py lineage()).
+  if (parts.size() == 3 && parts[2] == "checkpoints" &&
+      req.method == "GET") {
+    std::string state = req.query_param("state", "");
+    std::string sql =
+        "SELECT uuid, state, steps_completed, report_time, metadata "
+        "FROM checkpoints WHERE trial_id=?";
+    std::vector<Json> args{Json(tid)};
+    if (!state.empty()) {
+      sql += " AND state=?";
+      args.push_back(Json(state));
+    }
+    sql += " ORDER BY steps_completed DESC, report_time DESC";
+    auto rows = db_.query(sql, args);
+    Json cps = Json::array();
+    for (auto& row : rows) {
+      Json c = row_to_json(row);
+      c["metadata"] = Json::parse_or_null(c["metadata"].as_string());
+      cps.push_back(std::move(c));
+    }
+    Json out = Json::object();
+    out["checkpoints"] = cps;
+    return json_resp(200, out);
+  }
+
   // GET /api/v1/trials/{id}/progress (core/_searcher.py:88).
   if (parts.size() == 3 && parts[2] == "progress") {
     std::lock_guard<std::mutex> lock(mu_);
@@ -793,6 +821,23 @@ HttpResponse Master::handle_allocations(const HttpRequest& req,
     return json_resp(200, Json::object());
   }
 
+  // POST /api/v1/allocations/{id}/exit_reason {reason} — a task explaining
+  // its own imminent nonzero exit (step watchdog, divergence fail-stop):
+  // the agent's exit report carries only a code; this names the cause so
+  // operators see "step watchdog" rather than "exit 87".
+  if (parts.size() == 3 && parts[2] == "exit_reason" &&
+      req.method == "POST") {
+    Json body = Json::parse(req.body);
+    std::string reason = body["reason"].as_string("");
+    if (reason.empty()) return json_resp(400, err_body("reason required"));
+    db_.exec("UPDATE allocations SET exit_reason=? WHERE id=?",
+             {Json(reason), Json(aid)});
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = allocations_.find(aid);
+    if (it != allocations_.end()) it->second.exit_reason = reason;
+    return json_resp(200, Json::object());
+  }
+
   // GET /api/v1/allocations/{id}/rendezvous — blocks until every host's
   // task process is up, then returns ranked addresses
   // (task/rendezvous.go:94 try(); exec/prep_container.py:49).
@@ -976,15 +1021,24 @@ HttpResponse Master::handle_checkpoints(const HttpRequest& req,
       // Trial-less checkpoints have no scope to check grants against.
       return json_resp(403, err_body("viewer role is read-only"));
     }
+    // Two-phase commit (docs/checkpointing.md): the harness reports
+    // PARTIAL when the save starts and COMPLETED once the manifest +
+    // COMMIT marker are durable. Only COMPLETED advances the trial's
+    // resume pointer — a crash mid-save must leave latest_checkpoint on
+    // the last verified checkpoint, never on the torso of this one.
+    std::string state = body["state"].as_string("COMPLETED");
+    if (state != "COMPLETED" && state != "PARTIAL") {
+      return json_resp(400, err_body("state must be COMPLETED or PARTIAL"));
+    }
     db_.exec(
         "INSERT OR REPLACE INTO checkpoints (uuid, task_id, allocation_id, "
         "trial_id, state, resources, metadata, steps_completed) "
-        "VALUES (?, ?, ?, ?, 'COMPLETED', ?, ?, ?)",
+        "VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
         {Json(uuid), body["task_id"], body["allocation_id"],
-         trial_id >= 0 ? Json(trial_id) : Json(),
+         trial_id >= 0 ? Json(trial_id) : Json(), Json(state),
          Json(body["resources"].dump()), Json(body["metadata"].dump()),
          body["steps_completed"]});
-    if (trial_id >= 0) {
+    if (trial_id >= 0 && state == "COMPLETED") {
       db_.exec("UPDATE trials SET latest_checkpoint=? WHERE id=?",
                {Json(uuid), Json(trial_id)});
       std::lock_guard<std::mutex> lock(mu_);

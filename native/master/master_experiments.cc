@@ -306,7 +306,31 @@ void Master::launch_checkpoint_gc_locked(ExperimentState& exp) {
   for (const auto& ck : cks) {
     if (!keep.count(ck.uuid)) doomed.push_back(Json(ck.uuid));
   }
-  if (doomed.as_array().empty()) return;
+
+  // PARTIAL sweep: checkpoints whose phase-2 commit never landed (crash
+  // mid-async-save) are dead weight in storage — delete them once they
+  // are older than a TTL. Never the newest PARTIAL per trial: an
+  // in-flight async save may still be committing it, and deleting shards
+  // under a live orbax finalize would corrupt a checkpoint that was
+  // about to become COMPLETED.
+  int64_t partial_ttl =
+      storage["partial_ttl_seconds"].as_int(3600);  // 1h default
+  Json stale_partials = Json::array();
+  if (partial_ttl >= 0) {
+    auto prows = db_.query(
+        "SELECT c.uuid FROM checkpoints c JOIN trials t ON "
+        "c.trial_id = t.id WHERE t.experiment_id=? AND c.state='PARTIAL' "
+        "AND c.report_time < datetime('now', ?) "
+        "AND c.rowid <> (SELECT MAX(c2.rowid) FROM checkpoints c2 "
+        "WHERE c2.trial_id=c.trial_id AND c2.state='PARTIAL')",
+        {Json(exp.id),
+         Json("-" + std::to_string(partial_ttl) + " seconds")});
+    for (auto& row : prows) {
+      stale_partials.push_back(Json(row["uuid"].as_string()));
+    }
+  }
+
+  if (doomed.as_array().empty() && stale_partials.as_array().empty()) return;
 
   std::string task_id = "gc-exp" + std::to_string(exp.id) + "-" +
                         random_hex(4);
@@ -330,6 +354,7 @@ void Master::launch_checkpoint_gc_locked(ExperimentState& exp) {
   Json spec = Json::object();
   spec["checkpoint_storage"] = storage;
   spec["uuids"] = doomed;
+  spec["partial_uuids"] = stale_partials;
   alloc.extra_env["DET_GC_SPEC"] = Json(spec.dump());
   db_.exec(
       "INSERT INTO allocations (id, task_id, resource_pool, slots) "
@@ -340,7 +365,9 @@ void Master::launch_checkpoint_gc_locked(ExperimentState& exp) {
   pending_.push_back(aid);
   std::cerr << "master: checkpoint GC " << task_id << " for experiment "
             << exp.id << ": " << doomed.as_array().size()
-            << " checkpoint(s) outside retention" << std::endl;
+            << " checkpoint(s) outside retention, "
+            << stale_partials.as_array().size()
+            << " stale PARTIAL(s) past TTL" << std::endl;
 }
 
 void Master::process_ops_locked(ExperimentState& exp,

@@ -132,6 +132,23 @@ Json preflight_config(const Json& config) {
     }
   }
 
+  // DTL203 — restarts configured but nothing to restart from. Only an
+  // EXPLICIT min_checkpoint_period: 0 fires (key present): the default is
+  // also 0 batches and flagging every config would be pure noise.
+  if (!config["min_checkpoint_period"].is_null()) {
+    int64_t mcp = length_batches(config["min_checkpoint_period"]);
+    int64_t mr = config["max_restarts"].as_int(5);
+    if (mcp == 0 && mr > 0) {
+      out.push_back(diag(
+          "DTL203", "warning",
+          "min_checkpoint_period: 0 with max_restarts=" +
+              std::to_string(mr) +
+              ": mid-op failures can only restart from the previous "
+              "op-boundary checkpoint (or from scratch); set a periodic "
+              "min_checkpoint_period or max_restarts: 0"));
+    }
+  }
+
   // Apply config-level suppressions (preflight.suppress: [DTLnnn, ...]).
   const Json& suppress = config["preflight"]["suppress"];
   if (suppress.is_array() && !suppress.as_array().empty()) {

@@ -415,6 +415,33 @@ static void test_preflight_searcher_rungs() {
   CHECK(det::preflight_config(cfg).as_array().empty());
 }
 
+static void test_preflight_restarts_without_checkpoints() {
+  Json cfg = preflight_base_config();
+  // Explicit zero period + restarts (default max_restarts=5) -> DTL203.
+  Json mcp = Json::object();
+  mcp["batches"] = static_cast<int64_t>(0);
+  cfg["min_checkpoint_period"] = mcp;
+  Json d = det::preflight_config(cfg);
+  CHECK_EQ(d.as_array().size(), static_cast<size_t>(1));
+  CHECK_EQ(d.as_array()[0]["code"].as_string(), "DTL203");
+  CHECK_EQ(d.as_array()[0]["level"].as_string(), "warning");
+
+  // restarts off -> moot.
+  cfg["max_restarts"] = static_cast<int64_t>(0);
+  CHECK(det::preflight_config(cfg).as_array().empty());
+
+  // periodic checkpoints -> clean.
+  cfg["max_restarts"] = static_cast<int64_t>(3);
+  mcp["batches"] = static_cast<int64_t>(50);
+  cfg["min_checkpoint_period"] = mcp;
+  CHECK(det::preflight_config(cfg).as_array().empty());
+
+  // absent key (the default is also 0) must NOT fire.
+  Json clean = preflight_base_config();
+  clean["max_restarts"] = static_cast<int64_t>(3);
+  CHECK(det::preflight_config(clean).as_array().empty());
+}
+
 static void test_preflight_suppress_and_gate() {
   Json cfg = preflight_base_config();
   cfg["hyperparameters"]["global_batch_size"] = static_cast<int64_t>(30);
@@ -467,6 +494,8 @@ int main() {
       {"round_robin_order", test_round_robin_order},
       {"preflight_batch_mesh", test_preflight_batch_mesh},
       {"preflight_searcher_rungs", test_preflight_searcher_rungs},
+      {"preflight_restarts_without_checkpoints",
+       test_preflight_restarts_without_checkpoints},
       {"preflight_suppress_and_gate", test_preflight_suppress_and_gate},
   };
   for (auto& t : tests) {

@@ -94,6 +94,9 @@ ROUTES = [
     ("post", "/api/v1/trials/{id}/runner/metadata", "trials",
      "Runner heartbeat/state"),
     ("get", "/api/v1/trials/{id}/logs", "trials", "Trial log alias"),
+    ("get", "/api/v1/trials/{id}/checkpoints", "trials",
+     "Checkpoint lineage, newest first; ?state= filters (COMPLETED = the "
+     "restore-fallback chain)"),
     ("get", "/api/v1/allocations/{id}", "allocations", "Introspect"),
     ("get", "/api/v1/allocations/{id}/signals/preemption", "allocations",
      "Preemption long-poll"),
@@ -107,6 +110,9 @@ ROUTES = [
      "Register the task's proxy target (owner/agent)"),
     ("post", "/api/v1/allocations/{id}/ready", "allocations",
      "NotifyContainerRunning analogue"),
+    ("post", "/api/v1/allocations/{id}/exit_reason", "allocations",
+     "Task names the cause of its imminent nonzero exit (step watchdog, "
+     "divergence fail-stop)"),
     ("post", "/api/v1/checkpoints", "checkpoints", "Report checkpoint"),
     ("patch", "/api/v1/checkpoints", "checkpoints",
      "Batch state updates (GC)"),

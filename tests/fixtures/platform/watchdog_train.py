@@ -1,0 +1,69 @@
+"""Watchdog e2e fixture: hangs once, is killed by the step watchdog, and
+completes after the scheduler-driven restart.
+
+The first run of this trial arms the `step.hang` fault point (a 60s stall
+in the Trainer's hot loop — far past `health.step_timeout_sec`), so the
+watchdog fires: all-thread stack dump to stderr (the task log), exit-reason
+report, exit 87. The agent reports the nonzero exit, the master restarts
+the trial within `max_restarts`, and the second run — finding the marker
+file left by the first — trains straight through.
+"""
+
+import os
+import sys
+
+import numpy as np
+import optax
+
+
+def main() -> int:
+    from determined_tpu import core
+    from determined_tpu.common import faultpoint
+    from determined_tpu.parallel.mesh import MeshConfig
+    from determined_tpu.train import JaxTrial, Trainer
+    from determined_tpu.train.trial import TrialContext
+
+    marker = os.path.join(os.environ["WATCHDOG_MARKER_DIR"], "hung-once")
+    first_run = not os.path.exists(marker)
+    if first_run:
+        with open(marker, "w") as f:
+            f.write("armed")
+        faultpoint.arm("step.hang", "delay-60000", count=1)
+        print("watchdog fixture: first run, step.hang armed", flush=True)
+    else:
+        print("watchdog fixture: restarted run, no hang", flush=True)
+
+    class TinyTrial(JaxTrial):
+        health = {"step_timeout_sec": 3.0}
+        prefetch = False
+
+        def init_params(self, rng):
+            import jax
+
+            return {"w": jax.random.normal(rng, (4,))}
+
+        def loss(self, params, batch, rng):
+            import jax.numpy as jnp
+
+            return jnp.mean((params["w"] - batch["x"]) ** 2)
+
+        def optimizer(self):
+            return optax.sgd(0.1)
+
+        def mesh_config(self):
+            return MeshConfig()
+
+        def build_training_data(self):
+            rng = np.random.default_rng(0)
+            for _ in range(64):
+                yield {"x": rng.normal(size=(8, 4)).astype(np.float32)}
+
+    with core.init(async_checkpointing=False) as ctx:
+        trainer = Trainer(TinyTrial(TrialContext()), core_context=ctx)
+        trainer.fit(report_period=1)
+    print("watchdog fixture: trial complete", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,61 @@
+"""Subprocess half of the SIGKILL-mid-async-save chaos tests.
+
+Modes (argv[1]; argv[2] = checkpoint dir):
+
+  seed           train 2 steps; checkpoint trial0-step2 commits COMPLETED.
+  truncate-kill  resume from trial0-step2, train to 4; the step-4
+                 checkpoint's commit is chaos-truncated (torn shard AFTER
+                 its checksum was recorded, COMMIT still written), then the
+                 process SIGKILLs itself — a checkpoint the registry calls
+                 COMPLETED but only checksum verification can catch.
+  commit-crash   same resume, but the process dies (exit 137) INSIDE the
+                 phase-2 commit of the step-4 checkpoint: shards durable,
+                 no COMMIT marker — the classic killed-mid-async-save
+                 torso.
+
+The parent test then resumes from trial0-step4 and asserts the restore
+falls back to trial0-step2 with bit-identical state.
+"""
+
+import os
+import signal
+import sys
+
+
+def main() -> int:
+    mode, ckpt_dir = sys.argv[1], sys.argv[2]
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from determined_tpu import core
+    from determined_tpu.common import faultpoint
+    from determined_tpu.train import Trainer
+    from determined_tpu.train.trial import TrialContext
+    from trial_def import LinearTrial
+
+    if mode == "seed":
+        ctx = core.init(max_length=2, checkpoint_dir=ckpt_dir,
+                        async_checkpointing=True)
+        Trainer(LinearTrial(TrialContext()), core_context=ctx).fit(
+            report_period=1)
+        ctx.close()
+        return 0
+
+    if mode == "truncate-kill":
+        faultpoint.arm("checkpoint.write.truncate", "error", count=1)
+    elif mode == "commit-crash":
+        faultpoint.arm("checkpoint.commit.drop", "crash", count=1)
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
+
+    ctx = core.init(max_length=4, checkpoint_dir=ckpt_dir,
+                    async_checkpointing=True)
+    Trainer(LinearTrial(TrialContext()), core_context=ctx).fit(
+        report_period=1, resume_from="trial0-step2")
+    # commit-crash never reaches here: the crash fires inside the phase-2
+    # commit during fit's final wait(). truncate-kill falls through — the
+    # corrupt checkpoint has COMMITted — and dies the hard way.
+    os.kill(os.getpid(), signal.SIGKILL)
+    return 1  # unreachable
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,45 @@
+"""Tiny deterministic linear trial shared by the self-healing chaos tests.
+
+Imported both by tests/test_selfheal.py (in-process) and by
+crash_resume.py (the subprocess that gets SIGKILLed mid-save): the two
+sides must build bit-identical TrainState structures so restored states
+can be compared leaf-for-leaf.
+"""
+
+import numpy as np
+import optax
+
+from determined_tpu.parallel.mesh import MeshConfig
+from determined_tpu.train import JaxTrial
+
+
+class LinearTrial(JaxTrial):
+    prefetch = False  # deterministic batch consumption for the chaos tests
+
+    def init_params(self, rng):
+        import jax
+
+        return {"w": jax.random.normal(rng, (4,)) * 0.1}
+
+    def param_logical_axes(self):
+        # Replicated under the mesh — but THROUGH the mesh machinery, so
+        # the restore template carries a mesh sharding and a checkpoint
+        # written on one device layout restores onto another (the tests
+        # run both 1- and 8-device CPU slices over the same directory).
+        return {"w": (None,)}
+
+    def loss(self, params, batch, rng):
+        import jax.numpy as jnp
+
+        return jnp.mean((params["w"] - batch["x"]) ** 2)
+
+    def optimizer(self):
+        return optax.sgd(0.1)
+
+    def mesh_config(self):
+        return MeshConfig()
+
+    def build_training_data(self):
+        rng = np.random.default_rng(7)
+        for _ in range(64):
+            yield {"x": rng.normal(size=(8, 4)).astype(np.float32)}

@@ -316,6 +316,48 @@ def test_checkpoint_report_replay_does_not_double_register(master_only):
     assert trial["latest_checkpoint"] == "ck-chaos-1"
 
 
+def test_partial_checkpoint_never_becomes_resume_pointer(master_only):
+    """Two-phase commit at the registry (docs/checkpointing.md): a PARTIAL
+    report must not advance latest_checkpoint; the COMPLETED phase-2
+    report for the same uuid must; and the lineage endpoint filters by
+    state so Trainer fallback only ever sees verified checkpoints."""
+    c = master_only
+    token = c.login()
+    _, tid = _unmanaged_trial(c, token)
+    sess = Session(c.master_url, token=token, backoff_base=0.02)
+
+    def report(uuid, steps, state):
+        sess.post("/api/v1/checkpoints",
+                  body={"uuid": uuid, "trial_id": tid,
+                        "steps_completed": steps, "metadata": {},
+                        "resources": {}, "state": state},
+                  idempotent=True)
+
+    report("ck-good-2", 2, "PARTIAL")
+    report("ck-good-2", 2, "COMPLETED")
+    report("ck-partial-4", 4, "PARTIAL")  # phase 2 never lands (crash)
+
+    trial = sess.get(f"/api/v1/trials/{tid}")["trial"]
+    assert trial["latest_checkpoint"] == "ck-good-2", (
+        "a PARTIAL checkpoint must never become the resume pointer")
+    assert sess.get("/api/v1/checkpoints/ck-partial-4")["checkpoint"][
+        "state"] == "PARTIAL"
+
+    # Lineage endpoint: newest-first, state-filtered.
+    lineage = sess.get(f"/api/v1/trials/{tid}/checkpoints",
+                       params={"state": "COMPLETED"})["checkpoints"]
+    assert [ck["uuid"] for ck in lineage] == ["ck-good-2"]
+    everything = sess.get(f"/api/v1/trials/{tid}/checkpoints")["checkpoints"]
+    assert [ck["uuid"] for ck in everything] == ["ck-partial-4", "ck-good-2"]
+
+    # Bad state values are rejected, not stored.
+    try:
+        report("ck-bad", 6, "SHRUG")
+        raise AssertionError("invalid state should 400")
+    except APIError as e:
+        assert e.status == 400
+
+
 # ---------------------------------------------------------------------------
 # Context-blob sweep refcount regression (ADVICE.md #1, tier-1 safe).
 # ---------------------------------------------------------------------------

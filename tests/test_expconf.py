@@ -213,6 +213,51 @@ class TestPrefetchBlock:
         assert out["prefetch"] == {"enabled": True, "depth": 8}
 
 
+class TestHealthBlock:
+    """The `health:` config block (self-healing loop,
+    docs/checkpointing.md): divergence sentinel policy + step watchdog."""
+
+    def test_valid_block(self):
+        c = base_config(health={"on_nan": "rollback", "rollback_window": 4,
+                                "max_rollbacks": 2, "step_timeout_sec": 120})
+        assert expconf.validate(c) == []
+
+    def test_bad_on_nan(self):
+        c = base_config(health={"on_nan": "explode"})
+        assert any("health.on_nan" in e for e in expconf.validate(c))
+
+    def test_bad_window(self):
+        for w in (-1, 1.5, True, "many"):
+            c = base_config(health={"rollback_window": w})
+            assert any("rollback_window" in e for e in expconf.validate(c)), w
+
+    def test_zero_max_rollbacks_rejected(self):
+        c = base_config(health={"max_rollbacks": 0})
+        assert any("max_rollbacks" in e for e in expconf.validate(c))
+
+    def test_bad_timeout(self):
+        c = base_config(health={"step_timeout_sec": -5})
+        assert any("step_timeout_sec" in e for e in expconf.validate(c))
+
+    def test_unknown_key(self):
+        c = base_config(health={"watchdog": True})
+        assert any("unknown keys" in e for e in expconf.validate(c))
+
+    def test_not_a_mapping(self):
+        c = base_config(health=True)
+        assert any("health must be a mapping" in e for e in expconf.validate(c))
+
+    def test_defaults_applied(self):
+        out = expconf.apply_defaults(base_config())
+        assert out["health"] == {"on_nan": "warn", "rollback_window": 8,
+                                 "max_rollbacks": 3, "step_timeout_sec": 0}
+
+    def test_defaults_keep_user_values(self):
+        out = expconf.apply_defaults(base_config(health={"on_nan": "fail"}))
+        assert out["health"]["on_nan"] == "fail"
+        assert out["health"]["step_timeout_sec"] == 0
+
+
 class TestCrossFieldDiagnostics:
     """Cross-field checks surface as DTL rules (the same codes the native
     master enforces at experiment create), not bare exceptions."""

@@ -389,6 +389,24 @@ class TestConfigRules:
         c["searcher"]["max_length"] = {"batches": 256}
         assert check_config(c) == []
 
+    def test_dtl203_explicit_zero_with_restarts(self):
+        c = _config(min_checkpoint_period={"batches": 0}, max_restarts=3)
+        assert codes(check_config(c)) == ["DTL203"]
+        # default max_restarts (5) counts as "restarts configured"
+        c = _config(min_checkpoint_period={"batches": 0})
+        assert codes(check_config(c)) == ["DTL203"]
+
+    def test_dtl203_negative(self):
+        # absent key: the default is also 0 batches, but only an EXPLICIT
+        # zero is flagged (otherwise every config would warn)
+        assert check_config(_config(max_restarts=3)) == []
+        # periodic checkpoints configured: nothing to flag
+        c = _config(min_checkpoint_period={"batches": 50}, max_restarts=3)
+        assert check_config(c) == []
+        # restarts off: nothing to restart, rule moot
+        c = _config(min_checkpoint_period={"batches": 0}, max_restarts=0)
+        assert check_config(c) == []
+
 
 # ---------------------------------------------------------------------------
 # end-to-end: fixtures through preflight() and the det CLI

@@ -92,7 +92,10 @@ def test_resume_from_checkpoint(tmp_path):
     assert min(reported_steps) > 4
     ctx2.close()
 
-    # corrupt checkpoint must not crash-loop: falls back to fresh start
+    # corrupt checkpoint must not crash-loop: the restore walks the
+    # lineage BACKWARD from the requested id (never forward — step 8
+    # exists here but is newer), and with no older COMPLETED checkpoint
+    # it starts fresh (test_selfheal.py covers the fallback-hit case)
     import shutil
 
     ckpt_path = ctx2.checkpoint._storage.path_for(ckpt_id)
