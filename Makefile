@@ -15,14 +15,16 @@ lint:
 test:
 	$(PY) -m pytest tests/ -q -m 'not slow'
 
-# The -m slow chaos/recovery suite (docs/chaos.md, docs/checkpointing.md):
-# SIGKILL-mid-save lineage fallback, watchdog-driven restarts, master/agent
-# kills, 5xx storms. Bounded so a wedged recovery path fails the target
-# instead of hanging CI.
+# The -m slow chaos/recovery suite (docs/chaos.md, docs/checkpointing.md,
+# docs/cluster-ops.md "Preemption & drain"): SIGKILL-mid-save lineage
+# fallback, watchdog-driven restarts, master/agent kills, 5xx storms, and
+# the spot-preemption drain → emergency checkpoint → reschedule e2e.
+# Bounded so a wedged recovery path fails the target instead of hanging CI.
 CHAOS_TIMEOUT ?= 1800
 chaos:
 	timeout -k 30 $(CHAOS_TIMEOUT) $(PY) -m pytest \
-		tests/test_chaos.py tests/test_selfheal.py -q -m slow
+		tests/test_chaos.py tests/test_selfheal.py tests/test_preemption.py \
+		-q -m slow
 
 # Async input pipeline A/B: prefetch on/off step time + input_wait_ms
 # (docs/trial-api.md "Data loading and the async input pipeline").

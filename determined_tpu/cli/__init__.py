@@ -534,12 +534,14 @@ def cmd_agent_list(session: Session, args) -> int:
             "id": a["id"],
             "pool": a["resource_pool"],
             "alive": a["alive"],
+            "state": a.get("state", "ENABLED")
+            + (f" ({a['drain_reason']})" if a.get("drain_reason") else ""),
             "slots": len(a["slots"]),
             "used": sum(1 for s in a["slots"] if s.get("allocation_id")),
         }
         for a in agents
     ]
-    _print_table(rows, ["id", "pool", "alive", "slots", "used"])
+    _print_table(rows, ["id", "pool", "alive", "state", "slots", "used"])
     return 0
 
 

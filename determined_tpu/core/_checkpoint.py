@@ -78,6 +78,17 @@ class CheckpointContext:
         # (storage_id, path, metadata) of an async save whose phase-2 commit
         # (manifest + COMMIT marker + COMPLETED report) is still pending.
         self._pending_commit: Optional[tuple] = None
+        # Observed durable-save cost of the most recent checkpoint: the
+        # synchronous portion of save_state plus the BLOCKING portion of
+        # the wait that committed it. The Trainer budgets spot-preemption
+        # emergency checkpoints against this (docs/checkpointing.md).
+        # Under async overlap the blocking part shrinks (the write
+        # finished during training), so this underestimates a cold
+        # synchronous save — the safety factor in PreemptionConfig covers
+        # the gap, and the two-phase commit keeps a blown budget from ever
+        # becoming a restorable torso.
+        self.last_save_ms: Optional[float] = None
+        self._pending_sync_ms = 0.0
         self.local_reported: List[Dict[str, Any]] = []
 
     # -- orbax plumbing ------------------------------------------------
@@ -136,7 +147,9 @@ class CheckpointContext:
         state_dir = path + "/" + _STATE_SUBDIR
         if not _is_remote(path):
             os.makedirs(path, exist_ok=True)
+        t0 = time.monotonic()
         self._ckptr().save(state_dir, state, force=True)
+        self._pending_sync_ms = (time.monotonic() - t0) * 1000.0
         md = dict(metadata or {})
         md.update(
             {
@@ -166,6 +179,7 @@ class CheckpointContext:
             # semantics).
             import shutil
 
+            t0 = time.monotonic()
             self.wait()
             try:
                 if self._is_chief():
@@ -174,6 +188,8 @@ class CheckpointContext:
             finally:
                 shutil.rmtree(path, ignore_errors=True)
             self._report(storage_id, md, state="COMPLETED")
+            self.last_save_ms = (
+                self._pending_sync_ms + (time.monotonic() - t0) * 1000.0)
             return storage_id
         self._pending_commit = (storage_id, path, md)
         if not self._async:
@@ -387,10 +403,15 @@ class CheckpointContext:
     def wait(self) -> None:
         """Block until pending async saves are durable AND committed
         (manifest + COMMIT marker written, COMPLETED reported)."""
+        had_pending = self._pending_commit is not None
+        t0 = time.monotonic()
         c = self._checkpointer
         if c is not None and hasattr(c, "wait_until_finished"):
             c.wait_until_finished()
         self._commit_pending()
+        if had_pending:
+            self.last_save_ms = (
+                self._pending_sync_ms + (time.monotonic() - t0) * 1000.0)
 
     def close(self) -> None:
         self.wait()

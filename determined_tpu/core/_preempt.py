@@ -1,17 +1,27 @@
-"""PreemptContext — cooperative preemption (reference
+"""PreemptContext — cooperative + deadline preemption (reference
 harness/determined/core/_preempt.py:148; watcher thread :15 long-polls
 `GET /api/v1/allocations/{id}/signals/preemption`, api_trials.go:1179).
 
-The scheduler preempts a trial by raising its preemption flag; the training
-loop polls `should_preempt()` at step boundaries, checkpoints, and exits.
-Multi-host: only the chief polls the master; the decision is broadcast so all
-hosts leave their collectives in lockstep.
+Two flavors of preemption ride the same signal:
+
+  - **Cooperative** (scheduler-initiated: pause, higher-priority job): an
+    unbounded flag; the training loop polls `should_preempt()` at step
+    boundaries, checkpoints, and exits whenever it gets there.
+  - **Deadline** (infrastructure-initiated: GCE spot preemption, TPU
+    maintenance, SIGTERM to the agent): the signal carries
+    `deadline_seconds` — the node disappears when it lapses.
+    `preemption_deadline()` exposes the REMAINING grace so the Trainer can
+    budget an out-of-band emergency checkpoint (docs/checkpointing.md).
+
+Multi-host: only the chief polls the master; both the decision and the
+deadline are broadcast so all hosts leave their collectives in lockstep.
 """
 
 from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Optional
 
 from determined_tpu.common.api import Session
@@ -22,36 +32,88 @@ logger = logging.getLogger("determined_tpu.core")
 class _PreemptionWatcher(threading.Thread):
     """Daemon thread long-polling the master for the preemption signal."""
 
-    def __init__(self, session: Session, allocation_id: str, poll_timeout: int = 60):
+    def __init__(
+        self,
+        session: Session,
+        allocation_id: str,
+        poll_timeout: int = 60,
+        backoff_base: float = 0.5,
+        backoff_cap: float = 10.0,
+    ):
         super().__init__(daemon=True, name="preemption-watcher")
         self._session = session
         self._allocation_id = allocation_id
         self._poll_timeout = poll_timeout
+        self._backoff_base = backoff_base
+        self._backoff_cap = backoff_cap
         self._preempted = threading.Event()
-        self._stop = threading.Event()
+        self._stop_evt = threading.Event()
+        self._deadline: Optional[float] = None  # time.monotonic() absolute
+        self._reason: Optional[str] = None
 
     def run(self) -> None:
-        while not self._stop.is_set():
+        backoff = 0.0
+        while not self._stop_evt.is_set():
             try:
                 resp = self._session.get(
                     f"/api/v1/allocations/{self._allocation_id}/signals/preemption",
                     params={"timeout_seconds": self._poll_timeout},
                     timeout=self._poll_timeout + 30,
                 )
-                if resp and resp.get("preempt"):
+            except Exception:
+                if self._stop_evt.is_set():
+                    return
+                logger.debug("preemption poll failed; retrying", exc_info=True)
+                backoff = min(self._backoff_cap,
+                              max(self._backoff_base, backoff * 2))
+                self._stop_evt.wait(backoff)
+                continue
+            if isinstance(resp, dict):
+                backoff = 0.0
+                if resp.get("preempt"):
+                    deadline = resp.get("deadline_seconds")
+                    if deadline is not None:
+                        try:
+                            self._deadline = (
+                                time.monotonic() + max(0.0, float(deadline)))
+                        except (TypeError, ValueError):
+                            logger.warning(
+                                "unparseable preemption deadline %r; "
+                                "treating as unbounded", deadline)
+                    self._reason = resp.get("reason") or None
                     self._preempted.set()
                     return
-            except Exception:
-                if not self._stop.is_set():
-                    logger.debug("preemption poll failed; retrying", exc_info=True)
-                    self._stop.wait(5.0)
+                # A well-formed long-poll return without a signal (the
+                # master's wait timed out): re-poll immediately — that IS
+                # the long-poll protocol.
+                continue
+            # Successful but falsy/garbage response (master restarting
+            # behind a proxy, empty body): hot-looping here used to spin
+            # the poll at full rate — back off, capped.
+            backoff = min(self._backoff_cap, max(self._backoff_base, backoff * 2))
+            self._stop_evt.wait(backoff)
 
     @property
     def preempted(self) -> bool:
         return self._preempted.is_set()
 
-    def close(self) -> None:
-        self._stop.set()
+    @property
+    def deadline(self) -> Optional[float]:
+        """Absolute time.monotonic() deadline, set before `preempted`."""
+        return self._deadline
+
+    @property
+    def reason(self) -> Optional[str]:
+        return self._reason
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop and join (bounded). A watcher blocked in a live long-poll
+        returns at the poll timeout; the bound keeps close() from being
+        held hostage by it, at the cost of the daemon thread lingering
+        until then."""
+        self._stop_evt.set()
+        if self.ident is not None:
+            self.join(timeout=timeout)
 
 
 class PreemptContext:
@@ -66,6 +128,7 @@ class PreemptContext:
         self._dist = distributed
         self._watcher: Optional[_PreemptionWatcher] = None
         self._forced = False  # local-mode / test hook
+        self._forced_deadline: Optional[float] = None  # monotonic absolute
         if session is not None and allocation_id and (
             distributed is None or distributed.is_chief
         ):
@@ -80,6 +143,33 @@ class PreemptContext:
             self.acknowledge_preemption_signal()
         return flag
 
+    def preemption_deadline(self) -> Optional[float]:
+        """Seconds remaining in the termination grace window, or None for
+        an ordinary (unbounded) preemption / no preemption at all.
+
+        Counts DOWN between calls. Broadcast from the chief so every host
+        takes the same emergency-checkpoint decision (the save is a
+        collective)."""
+        remaining: Optional[float] = None
+        if self._forced_deadline is not None:
+            remaining = max(0.0, self._forced_deadline - time.monotonic())
+        elif self._watcher is not None and self._watcher.deadline is not None:
+            remaining = max(0.0, self._watcher.deadline - time.monotonic())
+        if self._dist is not None and self._dist.size > 1:
+            value = -1.0 if remaining is None else float(remaining)
+            value = float(self._dist.broadcast(value))
+            remaining = None if value < 0 else value
+        return remaining
+
+    def preemption_reason(self) -> Optional[str]:
+        """Why the preemption happened (e.g. "spot_preemption",
+        "host_maintenance"); None when unknown / not preempted."""
+        if self._watcher is not None and self._watcher.reason:
+            return self._watcher.reason
+        if self._forced:
+            return "forced"
+        return None
+
     def acknowledge_preemption_signal(self) -> None:
         """Tell the master we saw the signal and will checkpoint+exit
         (reference ack_preemption, _preempt.py:257)."""
@@ -93,9 +183,12 @@ class PreemptContext:
             except Exception:
                 logger.debug("ack_preemption failed", exc_info=True)
 
-    def force(self) -> None:
-        """Local/test hook: behave as if preempted."""
+    def force(self, deadline: Optional[float] = None) -> None:
+        """Local/test hook: behave as if preempted — with a termination
+        deadline `deadline` seconds out when given."""
         self._forced = True
+        if deadline is not None:
+            self._forced_deadline = time.monotonic() + deadline
 
     def close(self) -> None:
         if self._watcher is not None:

@@ -218,8 +218,42 @@ def validate(config: Dict[str, Any]) -> List[str]:
     _validate_preflight(config.get("preflight"), errors)
     _validate_prefetch(config.get("prefetch"), errors)
     _validate_health(config.get("health"), errors)
+    _validate_preemption(config.get("preemption"), errors)
 
     return errors
+
+
+def _validate_preemption(block: Any, errors: List[str]) -> None:
+    """`preemption:` — spot-survival knobs (docs/checkpointing.md): the
+    deadline-budgeted emergency checkpoint a trial takes when its node
+    receives an infrastructure termination notice."""
+    if block is None:
+        return
+    if isinstance(block, bool):
+        return  # bare bool == emergency_checkpoint switch
+    if not isinstance(block, dict):
+        errors.append("preemption must be a bool or a mapping")
+        return
+    valid = {"emergency_checkpoint", "budget_safety_factor",
+             "budget_margin_sec"}
+    unknown = sorted(set(block) - valid)
+    if unknown:
+        errors.append(
+            f"preemption: unknown keys {unknown}; valid: {sorted(valid)}")
+    ec = block.get("emergency_checkpoint")
+    if ec is not None and not isinstance(ec, bool):
+        errors.append("preemption.emergency_checkpoint must be a bool")
+    v = block.get("budget_safety_factor")
+    if v is not None and (
+        isinstance(v, bool) or not isinstance(v, (int, float)) or v < 1
+    ):
+        errors.append("preemption.budget_safety_factor must be a number >= 1")
+    v = block.get("budget_margin_sec")
+    if v is not None and (
+        isinstance(v, bool) or not isinstance(v, (int, float)) or v < 0
+    ):
+        errors.append(
+            "preemption.budget_margin_sec must be a non-negative number")
 
 
 def _validate_health(block: Any, errors: List[str]) -> None:
@@ -491,6 +525,11 @@ def apply_defaults(config: Dict[str, Any]) -> Dict[str, Any]:
         health.setdefault("rollback_window", 8)
         health.setdefault("max_rollbacks", 3)
         health.setdefault("step_timeout_sec", 0)
+    pre = c.setdefault("preemption", {})
+    if isinstance(pre, dict):
+        pre.setdefault("emergency_checkpoint", True)
+        pre.setdefault("budget_safety_factor", 1.5)
+        pre.setdefault("budget_margin_sec", 2.0)
     return c
 
 

@@ -12,7 +12,11 @@ from determined_tpu.train.step import (  # noqa: F401
     make_multi_step,
     make_train_step,
 )
-from determined_tpu.train.health import DivergenceError, HealthConfig  # noqa: F401
+from determined_tpu.train.health import (  # noqa: F401
+    DivergenceError,
+    HealthConfig,
+    PreemptionConfig,
+)
 from determined_tpu.train.trial import JaxTrial  # noqa: F401
 from determined_tpu.train.trainer import Trainer  # noqa: F401
 from determined_tpu.train.watchdog import StepWatchdog  # noqa: F401

@@ -73,3 +73,71 @@ class HealthConfig:
         if isinstance(expconf, dict) and expconf.get("health") is not None:
             return cls.from_block(expconf.get("health"))
         return cls()
+
+
+@dataclasses.dataclass
+class PreemptionConfig:
+    """Resolved `preemption:` knobs — the spot-survival emergency
+    checkpoint and its deadline budget (docs/checkpointing.md):
+
+        preemption:
+          emergency_checkpoint: true   # save out-of-band on a deadline
+          budget_safety_factor: 1.5    # estimate multiplier before skipping
+          budget_margin_sec: 2.0       # reserved for clean exit + reports
+
+    Trial attribute `preemption` overrides the expconf block (same
+    precedence contract as `JaxTrial.health` / `JaxTrial.prefetch`).
+    """
+
+    emergency_checkpoint: bool = True
+    budget_safety_factor: float = 1.5
+    budget_margin_sec: float = 2.0
+
+    @classmethod
+    def from_block(cls, block: Any) -> "PreemptionConfig":
+        if block is None:
+            return cls()
+        if isinstance(block, bool):
+            return cls(emergency_checkpoint=block)
+        if not isinstance(block, dict):
+            raise TypeError(
+                "preemption config must be a mapping or bool, got "
+                f"{type(block).__name__}")
+        return cls(
+            emergency_checkpoint=bool(block.get("emergency_checkpoint", True)),
+            budget_safety_factor=max(
+                1.0, float(block.get("budget_safety_factor", 1.5))),
+            budget_margin_sec=max(
+                0.0, float(block.get("budget_margin_sec", 2.0))),
+        )
+
+    @classmethod
+    def resolve(cls, trial: Any = None,
+                expconf: Optional[Dict[str, Any]] = None) -> "PreemptionConfig":
+        trial_attr = getattr(trial, "preemption", None)
+        if trial_attr is not None:
+            return cls.from_block(trial_attr)
+        if isinstance(expconf, dict) and expconf.get("preemption") is not None:
+            return cls.from_block(expconf.get("preemption"))
+        return cls()
+
+    def should_attempt_save(self, remaining_sec: Optional[float],
+                            last_save_ms: Optional[float]) -> bool:
+        """The deadline-budget decision: is an emergency checkpoint worth
+        starting, or would it produce an uncommitted torso?
+
+        `remaining_sec` is the grace left (None = unbounded — always
+        save); `last_save_ms` the observed durable-save cost (None = no
+        estimate yet — attempt optimistically: a blown budget still can't
+        corrupt restore, the two-phase commit just leaves a PARTIAL that
+        lineage fallback skips)."""
+        if not self.emergency_checkpoint:
+            return False
+        if remaining_sec is None:
+            return True
+        budget_ms = (remaining_sec - self.budget_margin_sec) * 1000.0
+        if budget_ms <= 0:
+            return False
+        if last_save_ms is None:
+            return True
+        return last_save_ms * self.budget_safety_factor <= budget_ms

@@ -30,7 +30,11 @@ from determined_tpu import core as core_mod
 from determined_tpu.common import faultpoint
 from determined_tpu.data import DevicePrefetcher, PrefetchConfig
 from determined_tpu.parallel.mesh import create_mesh
-from determined_tpu.train.health import DivergenceError, HealthConfig
+from determined_tpu.train.health import (
+    DivergenceError,
+    HealthConfig,
+    PreemptionConfig,
+)
 from determined_tpu.train.state import TrainState, create_train_state
 from determined_tpu.train.step import batch_sharding, make_eval_step, make_train_step
 from determined_tpu.train.trial import JaxTrial
@@ -71,6 +75,8 @@ class Trainer:
         self._eval_step = None
         self._pf_cfg: Optional[PrefetchConfig] = None
         self._health_cfg: Optional[HealthConfig] = None
+        self._preempt_cfg: Optional[PreemptionConfig] = None
+        self._preempt_period = 0
         self._watchdog: Optional[StepWatchdog] = None
         self._rollbacks = 0
 
@@ -179,6 +185,12 @@ class Trainer:
             expconf = core.info.trial.config
         return HealthConfig.resolve(self.trial, expconf)
 
+    def _preemption_config(self, core) -> PreemptionConfig:
+        expconf = None
+        if core is not None and core.info is not None and core.info.trial:
+            expconf = core.info.trial.config
+        return PreemptionConfig.resolve(self.trial, expconf)
+
     def fit(
         self,
         max_length: Optional[int] = None,
@@ -215,6 +227,7 @@ class Trainer:
 
         self._pf_cfg = self._prefetch_config(core)
         health = self._health_cfg = self._health_config(core)
+        self._preempt_cfg = self._preemption_config(core)
         self._rollbacks = 0
         data_iter: Any = _repeat(self.trial.build_training_data)
         prefetcher: Optional[DevicePrefetcher] = None
@@ -227,7 +240,7 @@ class Trainer:
             data_iter = prefetcher
         rng = jax.random.PRNGKey(seed + 1)
         step = int(jax.device_get(self.state.step))
-        preempt_period = max(1, preempt_period)
+        preempt_period = self._preempt_period = max(1, preempt_period)
         preempted = False
         last = None  # (step, device_metrics) of the newest step
         last_validated = last_checkpointed = step
@@ -327,6 +340,11 @@ class Trainer:
                                 last_val = self._validate(core, step)
                                 last_validated = step
                                 watchdog.beat()
+                                # The pass itself polls and cuts short on a
+                                # drain/deadline; pick the flag up here so a
+                                # long validation can't outlive the grace.
+                                if core.preempt.should_preempt():
+                                    preempted = True
                             if checkpoint_period and step % checkpoint_period == 0:
                                 self._checkpoint(core, step)
                                 last_checkpointed = step
@@ -341,13 +359,19 @@ class Trainer:
                         break
 
                     if preempted:
-                        if last_checkpointed != step:
-                            self._checkpoint(core, step)
-                        logger.info("preempted at step %d; checkpoint saved", step)
+                        self._preempt_checkpoint(core, step, last_checkpointed)
                         break
 
                     val = last_val if last_validated == step else self._validate(core, step)
                     watchdog.beat()
+                    if core.preempt.should_preempt():
+                        # Preemption arrived during the boundary validation
+                        # (which polls and returns early): checkpoint and
+                        # exit WITHOUT reporting the op completed — the
+                        # restart finishes it.
+                        preempted = True
+                        self._preempt_checkpoint(core, step, last_checkpointed)
+                        break
                     if last_checkpointed != step:
                         self._checkpoint(core, step)
                         last_checkpointed = step
@@ -418,12 +442,22 @@ class Trainer:
             prefetcher = DevicePrefetcher(
                 data, sharding=sharding, depth=pf_cfg.depth, name="val")
             data = prefetcher
+        preempt_period = max(1, self._preempt_period)
         try:
             for batch in data:
                 m = self._eval_step(self.state, batch)
                 for k, v in m.items():
                     sums[k] = sums[k] + v if k in sums else v
                 count += 1
+                # A long validation pass must not outlive a drain deadline:
+                # poll at the same cadence as the train loop and cut the
+                # pass short (partial averages are still reported).
+                if count % preempt_period == 0 and \
+                        core.preempt.should_preempt():
+                    logger.info(
+                        "preemption during validation after %d batches; "
+                        "cutting the pass short", count)
+                    break
         finally:
             if prefetcher is not None:
                 prefetcher.close()
@@ -438,6 +472,58 @@ class Trainer:
 
     def _checkpoint(self, core, step: int) -> None:
         core.checkpoint.save_state(self.state, step)
+
+    def _preempt_checkpoint(self, core, step: int,
+                            last_checkpointed: int) -> None:
+        """Preemption exit path (docs/checkpointing.md "Emergency
+        checkpoints").
+
+        Ordinary (unbounded) preemption: save at the current step and let
+        the fit() epilogue commit it. Deadline preemption (spot drain /
+        maintenance): the node dies in `preemption_deadline()` seconds —
+        take an out-of-band emergency checkpoint NOW and force the
+        two-phase COMMIT inside the grace window, *budgeted* against the
+        deadline using the last observed durable-save cost. When the
+        budget can't cover a durable COMMIT, skip the save entirely: a
+        clean exit restores from the previous COMPLETED checkpoint, which
+        beats burning the whole grace window writing a torso."""
+        deadline = core.preempt.preemption_deadline()
+        if deadline is None:
+            if last_checkpointed != step:
+                self._checkpoint(core, step)
+            logger.info("preempted at step %d; checkpoint saved", step)
+            return
+        cfg = self._preempt_cfg or PreemptionConfig()
+        t0 = time.monotonic()
+        estimate_ms = core.checkpoint.last_save_ms
+        attempt = last_checkpointed != step and cfg.should_attempt_save(
+            deadline, estimate_ms)
+        if attempt:
+            self._checkpoint(core, step)
+            core.checkpoint.wait()  # COMMIT must land inside the window
+        else:
+            if last_checkpointed != step:
+                logger.warning(
+                    "preemption deadline %.1fs cannot cover a durable save "
+                    "(last save %.0fms x%.1f safety + %.1fs margin); "
+                    "skipping the emergency checkpoint — restore will use "
+                    "the previous COMPLETED checkpoint",
+                    deadline, estimate_ms or 0.0, cfg.budget_safety_factor,
+                    cfg.budget_margin_sec)
+            # Commit whatever periodic save is still pending — that is the
+            # checkpoint the restart will land on.
+            core.checkpoint.wait()
+        grace_used_ms = (time.monotonic() - t0) * 1000.0
+        logger.info(
+            "deadline preemption (%s) at step %d: %s, grace used %.0fms of "
+            "%.1fs",
+            core.preempt.preemption_reason() or "unknown", step,
+            "emergency checkpoint committed" if attempt
+            else "emergency checkpoint skipped", grace_used_ms, deadline)
+        core.train.report_training_metrics(step, {
+            "preemption_grace_used_ms": grace_used_ms,
+            "preemption_emergency_checkpoint": 1.0 if attempt else 0.0,
+        })
 
     def _restore(self, storage_id: str) -> Optional[str]:
         """Restore `storage_id`, falling back through the COMPLETED lineage

@@ -61,6 +61,16 @@ struct AgentOptions {
   int slots_override = -1;  // DET_AGENT_SLOTS / --slots ("artificial")
   std::string slot_type = "auto";
   double poll_timeout_s = 20.0;
+  // Spot-capacity survival (docs/cluster-ops.md "Preemption & drain"):
+  // grace the agent advertises when IT is told to terminate (SIGTERM),
+  // and the pluggable termination-notice source. notice_source "gce"
+  // polls the GCE metadata preemption/maintenance endpoints; notice_file
+  // is a test/ops hook — when the file appears, its JSON
+  // {deadline_seconds, reason} is the notice.
+  double term_grace_s = 30.0;
+  std::string notice_source;  // "" = off | "gce"
+  std::string notice_file;
+  std::string gce_metadata_url = "http://metadata.google.internal";
 };
 
 struct Task {
@@ -95,6 +105,20 @@ struct Task {
 
 std::mutex g_mu;
 std::map<std::string, std::shared_ptr<Task>> g_tasks;  // by container_id
+
+// SIGTERM is a termination notice, not an exit: the handler only raises a
+// flag; the notice watcher turns it into a master notification and keeps
+// the task-log drain alive through the grace window.
+std::atomic<bool> g_sigterm{false};
+void handle_sigterm(int) { g_sigterm.store(true); }
+
+bool has_running_tasks() {
+  std::lock_guard<std::mutex> lock(g_mu);
+  for (const auto& [cid, t] : g_tasks) {
+    if (!t->exited) return true;
+  }
+  return false;
+}
 
 // ---- master session -----------------------------------------------------
 // All master routes require a Bearer token; the agent logs in at startup
@@ -863,6 +887,143 @@ void heartbeat_loop(const AgentOptions& opts) {
   }
 }
 
+// ---- termination-notice watcher -----------------------------------------
+//
+// Infrastructure gives seconds, not minutes: a GCE spot preemption or TPU
+// maintenance event (and a SIGTERM aimed at this daemon) means the whole
+// node disappears at a hard deadline. The watcher detects the notice from
+// one of the pluggable sources, POSTs it to the master — which marks the
+// agent DRAINING and pushes a deadline-extended preemption to every trial
+// on it — and then deliberately does NOT tear anything down: tasks get
+// the grace window to emergency-checkpoint and exit, and the log
+// shipper/exit reporters keep draining until the node actually dies.
+
+void post_preempt_notice(const AgentOptions& opts, double deadline_s,
+                         const std::string& reason) {
+  Json body = Json::object();
+  body["deadline_seconds"] = deadline_s;
+  body["reason"] = reason;
+  std::string path = "/api/v1/agents/" + opts.id + "/preempt_notice";
+  for (int attempt = 0; attempt < 5 && g_running; ++attempt) {
+    try {
+      auto r = master_call(opts.master_url, "POST", path, body.dump(), 5.0);
+      if (r.ok() || r.status == 404) return;
+    } catch (const std::exception&) {
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(500));
+  }
+  std::cerr << "agent: preempt notice undeliverable; master will fall back "
+               "to the heartbeat-timeout path" << std::endl;
+}
+
+// GCE metadata termination sources (reference: provisioner spot handling;
+// cloud.google.com/compute/docs/instances/preemptible#preemption):
+// instance/preempted flips to TRUE, maintenance-event to TERMINATE_*.
+// Returns the notice reason, or "" when no event is pending.
+std::string poll_gce_notice(const AgentOptions& opts) {
+  const std::map<std::string, std::string> hdrs = {
+      {"Metadata-Flavor", "Google"}};
+  try {
+    auto r = det::http_request(
+        "GET", opts.gce_metadata_url,
+        "/computeMetadata/v1/instance/preempted", "", 2.0, hdrs);
+    if (r.ok() && r.body.find("TRUE") != std::string::npos) {
+      return "spot_preemption";
+    }
+    r = det::http_request(
+        "GET", opts.gce_metadata_url,
+        "/computeMetadata/v1/instance/maintenance-event", "", 2.0, hdrs);
+    if (r.ok() && r.body.find("TERMINATE") != std::string::npos) {
+      return "host_maintenance";
+    }
+  } catch (const std::exception&) {
+    // not on GCE / metadata server unreachable: silently no notice
+  }
+  return "";
+}
+
+void notice_watch_loop(const AgentOptions& opts) {
+  double default_deadline = 30.0;
+  if (const char* p = getenv("DET_AGENT_PREEMPT_DEADLINE_S")) {
+    default_deadline = atof(p);
+  }
+  bool notified = false;
+  auto shutdown_at = std::chrono::steady_clock::time_point::max();
+  auto last_gce = std::chrono::steady_clock::now() - std::chrono::hours(1);
+  while (g_running) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(500));
+    if (std::chrono::steady_clock::now() >= shutdown_at) {
+      // SIGTERM grace window over: stop the loops and let main() return.
+      std::cerr << "agent: grace window closed; exiting" << std::endl;
+      g_running = false;
+      g_log_cv.notify_all();
+      break;
+    }
+    if (notified) {
+      // A SIGTERM'd agent whose tasks have all exited and whose log
+      // queue is drained has nothing left to protect — exit now instead
+      // of idling out the rest of the grace window (keeps
+      // `det deploy local down` snappy).
+      if (g_sigterm.load() && !has_running_tasks()) {
+        bool drained;
+        {
+          std::lock_guard<std::mutex> lock(g_log_mu);
+          drained = g_log_queue.empty() && g_log_pending.empty();
+        }
+        if (drained) {
+          std::cerr << "agent: SIGTERM drain complete; exiting" << std::endl;
+          g_running = false;
+          g_log_cv.notify_all();
+          break;
+        }
+      }
+      continue;
+    }
+    double deadline = -1;
+    std::string reason;
+    if (g_sigterm.load()) {
+      deadline = opts.term_grace_s;
+      reason = "agent_sigterm";
+    } else if (has_running_tasks() &&
+               FAULT_POINT("agent.preempt.notice") !=
+                   det::faults::Action::kNone) {
+      // Chaos (docs/chaos.md): deterministic spot kill. Gated on a
+      // running task so an env-armed point fires MID-TRIAL, which is the
+      // scenario worth testing, not at agent boot.
+      deadline = default_deadline;
+      reason = "spot_preemption";
+    } else if (!opts.notice_file.empty()) {
+      std::ifstream f(opts.notice_file);
+      if (f) {
+        std::stringstream ss;
+        ss << f.rdbuf();
+        Json j = Json::parse_or_null(ss.str());
+        deadline = j["deadline_seconds"].as_double(default_deadline);
+        reason = j["reason"].as_string("spot_preemption");
+      }
+    } else if (opts.notice_source == "gce" &&
+               std::chrono::steady_clock::now() - last_gce >
+                   std::chrono::seconds(5)) {
+      last_gce = std::chrono::steady_clock::now();
+      reason = poll_gce_notice(opts);
+      if (!reason.empty()) deadline = default_deadline;
+    }
+    if (deadline >= 0 && !reason.empty()) {
+      notified = true;
+      std::cerr << "agent: termination notice (" << reason << "), deadline "
+                << deadline << "s" << std::endl;
+      post_preempt_notice(opts, deadline, reason);
+      if (reason == "agent_sigterm") {
+        // The notice sources other than SIGTERM mean the NODE dies on its
+        // own; for SIGTERM we own the exit — after deadline + drain slack.
+        shutdown_at = std::chrono::steady_clock::now() +
+                      std::chrono::milliseconds(
+                          static_cast<int64_t>((deadline + 10.0) * 1000));
+      }
+    }
+  }
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -909,6 +1070,15 @@ int main(int argc, char** argv) {
       opts.slots_override = static_cast<int>(j["slots"].as_int());
     }
     if (j["slot_type"].is_string()) opts.slot_type = j["slot_type"].as_string();
+    if (j["term_grace_s"].is_number()) {
+      opts.term_grace_s = j["term_grace_s"].as_double();
+    }
+    if (j["notice_source"].is_string()) {
+      opts.notice_source = j["notice_source"].as_string();
+    }
+    if (j["notice_file"].is_string()) {
+      opts.notice_file = j["notice_file"].as_string();
+    }
   }
 
   if (const char* p = getenv("DET_MASTER")) opts.master_url = p;
@@ -918,6 +1088,16 @@ int main(int argc, char** argv) {
   if (const char* p = getenv("DET_AGENT_TOKEN_FILE")) opts.token_file = p;
   if (const char* p = getenv("DET_MASTER_CERT_FILE")) {
     opts.master_cert_file = p;
+  }
+  if (const char* p = getenv("DET_AGENT_TERM_GRACE_S")) {
+    opts.term_grace_s = atof(p);
+  }
+  if (const char* p = getenv("DET_AGENT_NOTICE_SOURCE")) {
+    opts.notice_source = p;
+  }
+  if (const char* p = getenv("DET_AGENT_NOTICE_FILE")) opts.notice_file = p;
+  if (const char* p = getenv("DET_AGENT_GCE_METADATA_URL")) {
+    opts.gce_metadata_url = p;
   }
 
   for (int i = 1; i < argc; ++i) {
@@ -934,12 +1114,16 @@ int main(int argc, char** argv) {
     else if (a == "--work-root") opts.work_root = next();
     else if (a == "--token-file") opts.token_file = next();
     else if (a == "--master-cert-file") opts.master_cert_file = next();
+    else if (a == "--term-grace") opts.term_grace_s = atof(next().c_str());
+    else if (a == "--notice-source") opts.notice_source = next();
+    else if (a == "--notice-file") opts.notice_file = next();
     else if (a == "--config") next();
     else if (a == "--help" || a == "-h") {
       std::cout << "determined-agent [--config agent.json] --master-url URL "
                    "[--id ID] [--resource-pool P] [--addr A] [--slots N] "
                    "[--slot-type tpu|cpu] [--work-root DIR] "
-                   "[--token-file PATH]\n";
+                   "[--token-file PATH] [--term-grace SECONDS] "
+                   "[--notice-source gce] [--notice-file PATH]\n";
       return 0;
     }
   }
@@ -949,6 +1133,10 @@ int main(int argc, char** argv) {
   }
 
   signal(SIGPIPE, SIG_IGN);
+  // SIGTERM = termination notice, handled by the notice watcher — the
+  // default (immediate death) would drop the grace window spot capacity
+  // explicitly grants.
+  signal(SIGTERM, handle_sigterm);
   det::faults::arm_from_env();  // DET_FAULTS chaos points (docs/chaos.md)
 
   // Install the bootstrap credential (env first, then token file), adopt
@@ -969,6 +1157,7 @@ int main(int argc, char** argv) {
   std::thread(shipper_loop, std::cref(opts)).detach();
   std::thread(heartbeat_loop, std::cref(opts)).detach();
   std::thread(registry_flusher, std::cref(opts)).detach();
+  std::thread(notice_watch_loop, std::cref(opts)).detach();
 
   // Action long-poll loop.
   std::string actions_path = "/api/v1/agents/" + opts.id +

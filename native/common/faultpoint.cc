@@ -38,6 +38,9 @@ const KnownPoint kKnown[] = {
     {"agent.heartbeat.drop", "agent", "skip sending a heartbeat"},
     {"agent.exit_report.drop", "agent",
      "drop an exit-report delivery attempt (the agent retries)"},
+    {"agent.preempt.notice", "agent",
+     "inject a spot/maintenance termination notice once a task is running "
+     "(deadline from DET_AGENT_PREEMPT_DEADLINE_S, default 30)"},
 };
 
 struct FaultState {

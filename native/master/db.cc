@@ -437,6 +437,19 @@ UPDATE checkpoints SET state='COMPLETED' WHERE state IS NULL OR state='';
 CREATE INDEX idx_checkpoints_trial_state
   ON checkpoints(trial_id, state, steps_completed);
 )sql"},
+      // Spot-capacity survival: infrastructure termination notices
+      // (POST /api/v1/agents/{id}/preempt_notice) are persisted so spot
+      // churn is auditable after the node is gone.
+      {18, R"sql(
+CREATE TABLE agent_notices (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  agent_id TEXT NOT NULL,
+  reason TEXT NOT NULL DEFAULT '',
+  deadline_seconds REAL NOT NULL DEFAULT 0,
+  created_at TEXT NOT NULL DEFAULT (datetime('now'))
+);
+CREATE INDEX idx_agent_notices_agent ON agent_notices(agent_id, id);
+)sql"},
   };
   return kMigrations;
 }

@@ -47,11 +47,24 @@ HttpResponse Master::handle_agents_api(const HttpRequest& req,
             {"allocation_id", Json(s.allocation_id)},
         }));
       }
+      // state: DRAINING (spot/maintenance notice) beats DISABLED (admin
+      // drain, every slot disabled) beats ENABLED — the three are distinct
+      // lifecycle stages (docs/cluster-ops.md "Preemption & drain").
+      bool all_disabled = !a.slots.empty();
+      for (const auto& s : a.slots) all_disabled &= !s.enabled;
+      std::string state =
+          a.draining ? "DRAINING" : (all_disabled ? "DISABLED" : "ENABLED");
       agents.push_back(Json(JsonObject{
           {"id", Json(id)},
           {"resource_pool", Json(a.resource_pool)},
           {"addr", Json(a.addr)},
           {"alive", Json(a.alive)},
+          {"state", Json(state)},
+          {"drain_reason", Json(a.drain_reason)},
+          {"drain_deadline_seconds",
+           Json(a.draining && a.drain_deadline > 0
+                    ? std::max(0.0, a.drain_deadline - now())
+                    : 0.0)},
           {"slots", slots},
       }));
     }
@@ -73,7 +86,7 @@ HttpResponse Master::handle_agents_api(const HttpRequest& req,
       (parts.size() >= 2 && parts[1] == "register") ||
       (parts.size() >= 3 &&
        (parts[2] == "actions" || parts[2] == "heartbeat" ||
-        parts[2] == "allocations"));
+        parts[2] == "allocations" || parts[2] == "preempt_notice"));
   if (agent_protocol && ctx.role != "agent" && !ctx.admin) {
     return json_resp(403, err_body("agent role required"));
   }
@@ -93,6 +106,11 @@ HttpResponse Master::handle_agents_api(const HttpRequest& req,
     a.last_heartbeat = now();
     a.alive = true;
     if (fresh) {
+      // A fresh boot is a new (or survived) machine: any spot/maintenance
+      // notice that applied to the previous incarnation is moot.
+      a.draining = false;
+      a.drain_reason.clear();
+      a.drain_deadline = 0;
       a.actions.clear();
       a.slots.clear();
       int i = 0;
@@ -149,8 +167,40 @@ HttpResponse Master::handle_agents_api(const HttpRequest& req,
     auto it = agents_.find(agent_id);
     if (it == agents_.end()) return json_resp(404, err_body("unknown agent"));
     for (auto& s : it->second.slots) s.enabled = enable;
+    if (enable) {
+      // Operator override: re-enabling also clears a DRAINING notice
+      // (e.g. a maintenance event that completed without a termination).
+      it->second.draining = false;
+      it->second.drain_reason.clear();
+      it->second.drain_deadline = 0;
+    }
     cv_.notify_all();
     return json_resp(200, Json::object());
+  }
+
+  // POST /api/v1/agents/{id}/preempt_notice {deadline_seconds, reason} —
+  // infrastructure termination notice (GCE spot preemption, TPU
+  // maintenance event, SIGTERM to the agent). The node disappears in
+  // deadline_seconds: mark the agent DRAINING (no new placements), push a
+  // deadline-extended preemption signal to every allocation on it so
+  // trials can take a budgeted emergency checkpoint, and persist the
+  // notice for post-mortems.
+  if (parts.size() == 3 && parts[2] == "preempt_notice" &&
+      req.method == "POST") {
+    Json body = Json::parse_or_null(req.body);
+    double deadline_s = body["deadline_seconds"].as_double(30.0);
+    if (deadline_s < 0) {
+      return json_resp(400, err_body("deadline_seconds must be >= 0"));
+    }
+    std::string reason = body["reason"].as_string("spot_preemption");
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = agents_.find(agent_id);
+    if (it == agents_.end()) return json_resp(404, err_body("unknown agent"));
+    drain_agent_locked(it->second, deadline_s, reason);
+    Json out = Json::object();
+    out["state"] = "DRAINING";
+    out["deadline_seconds"] = deadline_s;
+    return json_resp(200, out);
   }
 
   // GET /api/v1/agents/{id}/actions?timeout_seconds=N — long-poll drain.
@@ -373,6 +423,37 @@ void Master::check_agents_locked() {
     for (auto& r : alloc.resources) all_exited &= r.state == "EXITED";
     if (all_exited) on_allocation_exit_locked(alloc);
   }
+  // Draining agents whose termination deadline lapsed: anything still on
+  // them did not manage a clean preempt-exit in the grace window — fail
+  // those resources now (the same shape as the agent-lost path) so the
+  // trial restarts from its last COMPLETED checkpoint on remaining
+  // capacity instead of waiting for the heartbeat timeout after the node
+  // actually dies. Small slack covers exit reports in flight.
+  for (auto& [id, a] : agents_) {
+    if (!a.draining || a.drain_deadline <= 0 || t < a.drain_deadline + 5.0) {
+      continue;
+    }
+    a.drain_deadline = 0;  // fire once
+    for (auto& [aid, alloc] : allocations_) {
+      if (alloc.state == "TERMINATED") continue;
+      bool touched = false, all_exited = true;
+      for (auto& r : alloc.resources) {
+        if (r.agent_id == id && r.state != "EXITED") {
+          r.state = "EXITED";
+          r.exit_code = 137;
+          touched = true;
+        }
+        all_exited &= r.state == "EXITED";
+      }
+      if (!touched) continue;
+      alloc.exit_reason = a.drain_reason.empty()
+                              ? "spot deadline lapsed on agent " + id
+                              : a.drain_reason + ": deadline lapsed on " + id;
+      std::cerr << "master: allocation " << aid
+                << " lost to lapsed drain deadline on " << id << std::endl;
+      if (all_exited) on_allocation_exit_locked(alloc);
+    }
+  }
   // Backend upkeep: dead-agent sweep (agent RM) / pod reconcile (k8s RM).
   rm_->tick(t);
   // Provisioner: sustained unmet demand launches nodes; idle ones are
@@ -555,7 +636,9 @@ void Master::schedule_locked() {
     if (policy != "priority") continue;
     int free = 0;
     for (const auto& [id, a] : agents_) {
-      if (!a.alive || a.resource_pool != want.resource_pool) continue;
+      if (!a.alive || a.draining || a.resource_pool != want.resource_pool) {
+        continue;
+      }
       for (const auto& s : a.slots) {
         if (s.enabled && s.allocation_id.empty()) ++free;
       }
@@ -589,6 +672,7 @@ bool Master::try_fit_locked(Allocation& alloc) {
   std::vector<HostFreeView> views;
   for (auto& [id, a] : agents_) {
     if (!a.alive || a.resource_pool != alloc.resource_pool) continue;
+    if (a.draining) continue;  // node is going away: no new placements
     if (alloc.excluded_agents.count(id)) continue;  // exclude_node policy
     HostFreeView v;
     v.id = a.id;
@@ -693,7 +777,9 @@ class AgentResourceManager : public ResourceManager {
   ScalingSnapshot scaling(const std::string& pool) const override {
     ScalingSnapshot s;
     for (const auto& [id, a] : m_.agents_) {
-      if (!a.alive || a.resource_pool != pool) continue;
+      // Draining nodes are leaving: hiding them from the snapshot lets
+      // the provisioner see unmet demand and launch replacement capacity.
+      if (!a.alive || a.draining || a.resource_pool != pool) continue;
       s.agents.push_back(id);
       bool all_free = true;
       for (const auto& slot : a.slots) {
@@ -809,11 +895,52 @@ void Master::release_resources_locked(Allocation& alloc) {
 }
 
 void Master::preempt_allocation_locked(Allocation& alloc,
-                                       const std::string& why) {
-  if (alloc.preempting) return;
+                                       const std::string& why,
+                                       double deadline) {
+  if (alloc.preempting) {
+    // Already preempting: a deadline may only TIGHTEN (a spot notice
+    // arriving during a cooperative preempt turns it hard).
+    if (deadline > 0 &&
+        (alloc.preempt_deadline <= 0 || deadline < alloc.preempt_deadline)) {
+      alloc.preempt_deadline = deadline;
+      alloc.preempt_reason = why;
+      cv_.notify_all();
+    }
+    return;
+  }
   alloc.preempting = true;
+  alloc.preempt_deadline = deadline;
+  alloc.preempt_reason = why;
   alloc.exit_reason = why;
   cv_.notify_all();  // wakes the preemption long-poll watchers
+}
+
+void Master::drain_agent_locked(AgentState& agent, double deadline_seconds,
+                                const std::string& reason) {
+  double deadline = now() + deadline_seconds;
+  agent.draining = true;
+  agent.drain_reason = reason;
+  // Repeated notices only tighten the deadline (a maintenance notice
+  // followed by a spot kill must not EXTEND the grace window).
+  if (agent.drain_deadline <= 0 || deadline < agent.drain_deadline) {
+    agent.drain_deadline = deadline;
+  }
+  db_.exec(
+      "INSERT INTO agent_notices (agent_id, reason, deadline_seconds) "
+      "VALUES (?, ?, ?)",
+      {Json(agent.id), Json(reason), Json(deadline_seconds)});
+  std::cerr << "master: agent " << agent.id << " DRAINING (" << reason
+            << ", deadline " << deadline_seconds << "s)" << std::endl;
+  for (auto& [aid, alloc] : allocations_) {
+    if (alloc.state == "TERMINATED") continue;
+    for (const auto& r : alloc.resources) {
+      if (r.agent_id == agent.id && r.state != "EXITED") {
+        preempt_allocation_locked(alloc, reason, agent.drain_deadline);
+        break;
+      }
+    }
+  }
+  cv_.notify_all();
 }
 
 void Master::kill_allocation_locked(Allocation& alloc) {

@@ -809,6 +809,17 @@ HttpResponse Master::handle_allocations(const HttpRequest& req,
     Json out = Json::object();
     out["preempt"] = it == allocations_.end() || it->second.preempting ||
                      it->second.state == "TERMINATED";
+    // Deadline-extended preemption (spot/maintenance drain): the harness
+    // budgets its emergency checkpoint against the REMAINING seconds.
+    if (it != allocations_.end() && it->second.preempting) {
+      if (it->second.preempt_deadline > 0) {
+        out["deadline_seconds"] =
+            std::max(0.0, it->second.preempt_deadline - now());
+      }
+      if (!it->second.preempt_reason.empty()) {
+        out["reason"] = it->second.preempt_reason;
+      }
+    }
     return json_resp(200, out);
   }
 

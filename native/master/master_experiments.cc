@@ -637,10 +637,19 @@ void Master::on_allocation_exit_locked(Allocation& alloc) {
                {Json(trial.run_id), Json(trial.id)});
     } else if (exp->state == "ACTIVE") {
       // Clean exit with work left — preemption or pause/resume path;
-      // resume from the latest checkpoint.
+      // resume from the latest checkpoint. A DEADLINE preemption (spot /
+      // maintenance drain) additionally counts as a restart: the move was
+      // infra-driven, and recording it both surfaces spot churn and lets
+      // max_restarts bound a flapping pool.
       trial.run_id += 1;
-      db_.exec("UPDATE trials SET run_id=? WHERE id=?",
-               {Json(trial.run_id), Json(trial.id)});
+      if (alloc.preempt_deadline > 0) {
+        trial.restarts += 1;
+        db_.exec("UPDATE trials SET restarts=?, run_id=? WHERE id=?",
+                 {Json(trial.restarts), Json(trial.run_id), Json(trial.id)});
+      } else {
+        db_.exec("UPDATE trials SET run_id=? WHERE id=?",
+                 {Json(trial.run_id), Json(trial.id)});
+      }
       request_allocation_locked(*exp, trial);
     }
     // exp PAUSED: trial stays idle; activate re-queues it.

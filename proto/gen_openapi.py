@@ -51,6 +51,9 @@ ROUTES = [
     ("post", "/api/v1/agents/{id}/enable", "agents", "Enable slots (admin)"),
     ("post", "/api/v1/agents/{id}/disable", "agents",
      "Drain: disable slots (admin)"),
+    ("post", "/api/v1/agents/{id}/preempt_notice", "agents",
+     "Infrastructure termination notice: mark the agent DRAINING and push "
+     "a deadline preemption to its allocations (agent service account)"),
     ("get", "/api/v1/experiments", "experiments", "List experiments"),
     ("post", "/api/v1/experiments", "experiments",
      "Create experiment (managed, or unmanaged with unmanaged: true)"),

@@ -1,0 +1,72 @@
+"""Spot-survival e2e fixture: a real JaxTrial under the Trainer, slow
+enough (per-batch sleep) that a termination notice lands mid-run.
+
+Run 1 is drained by a spot notice: the deadline preemption makes the
+Trainer take an out-of-band emergency checkpoint (two-phase COMMIT inside
+the grace window) and exit 0. The scheduler requeues the trial away from
+the DRAINING agent; run 2 restores the emergency checkpoint and trains
+through. Logging is configured so the Trainer's restore / emergency-save
+lines land in the task log for the test's assertions.
+"""
+
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+import optax
+
+
+def main() -> int:
+    logging.basicConfig(
+        level=logging.INFO, stream=sys.stderr,
+        format="%(name)s: %(message)s")
+
+    from determined_tpu import core
+    from determined_tpu.parallel.mesh import MeshConfig
+    from determined_tpu.train import JaxTrial, Trainer
+    from determined_tpu.train.trial import TrialContext
+
+    step_sleep = float(os.environ.get("SPOT_STEP_SLEEP", "0.1"))
+
+    class SlowTrial(JaxTrial):
+        prefetch = False  # keep batch consumption deterministic
+
+        def init_params(self, rng):
+            import jax
+
+            return {"w": jax.random.normal(rng, (4,)) * 0.1}
+
+        def param_logical_axes(self):
+            return {"w": (None,)}
+
+        def loss(self, params, batch, rng):
+            import jax.numpy as jnp
+
+            return jnp.mean((params["w"] - batch["x"]) ** 2)
+
+        def optimizer(self):
+            return optax.sgd(0.1)
+
+        def mesh_config(self):
+            return MeshConfig()
+
+        def build_training_data(self):
+            rng = np.random.default_rng(7)
+            while True:
+                time.sleep(step_sleep)
+                yield {"x": rng.normal(size=(8, 4)).astype(np.float32)}
+
+    with core.init(async_checkpointing=False) as ctx:
+        trainer = Trainer(SlowTrial(TrialContext()), core_context=ctx)
+        # checkpoint_period=0 (op boundaries only): the ONLY mid-run
+        # checkpoint is the emergency one — the test can identify it, and
+        # the preempt poll can never land on a just-checkpointed step.
+        trainer.fit(report_period=1)
+    print("spot fixture: trial complete", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -258,6 +258,57 @@ class TestHealthBlock:
         assert out["health"]["step_timeout_sec"] == 0
 
 
+class TestPreemptionBlock:
+    """The `preemption:` config block (spot-survival emergency checkpoint,
+    docs/checkpointing.md "Emergency checkpoints")."""
+
+    def test_valid_block(self):
+        c = base_config(preemption={"emergency_checkpoint": True,
+                                    "budget_safety_factor": 2.0,
+                                    "budget_margin_sec": 5})
+        assert expconf.validate(c) == []
+
+    def test_bare_bool_is_valid(self):
+        assert expconf.validate(base_config(preemption=False)) == []
+
+    def test_bad_emergency_checkpoint(self):
+        c = base_config(preemption={"emergency_checkpoint": "yes"})
+        assert any("emergency_checkpoint" in e for e in expconf.validate(c))
+
+    def test_bad_safety_factor(self):
+        for v in (0, 0.5, True, "fast"):
+            c = base_config(preemption={"budget_safety_factor": v})
+            assert any("budget_safety_factor" in e
+                       for e in expconf.validate(c)), v
+
+    def test_bad_margin(self):
+        for v in (-1, True, "soon"):
+            c = base_config(preemption={"budget_margin_sec": v})
+            assert any("budget_margin_sec" in e
+                       for e in expconf.validate(c)), v
+
+    def test_unknown_key(self):
+        c = base_config(preemption={"grace": 30})
+        assert any("unknown keys" in e for e in expconf.validate(c))
+
+    def test_not_a_mapping(self):
+        c = base_config(preemption=[30])
+        assert any("preemption must be a bool or a mapping" in e
+                   for e in expconf.validate(c))
+
+    def test_defaults_applied(self):
+        out = expconf.apply_defaults(base_config())
+        assert out["preemption"] == {"emergency_checkpoint": True,
+                                     "budget_safety_factor": 1.5,
+                                     "budget_margin_sec": 2.0}
+
+    def test_defaults_keep_user_values(self):
+        out = expconf.apply_defaults(
+            base_config(preemption={"budget_margin_sec": 7}))
+        assert out["preemption"]["budget_margin_sec"] == 7
+        assert out["preemption"]["emergency_checkpoint"] is True
+
+
 class TestCrossFieldDiagnostics:
     """Cross-field checks surface as DTL rules (the same codes the native
     master enforces at experiment create), not bare exceptions."""

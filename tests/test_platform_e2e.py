@@ -61,6 +61,7 @@ class Devcluster:
         self.db_path = os.path.join(tmpdir, "master.db")
         self.master = None
         self.agent = None
+        self.extra_agents = []  # second+ agents (spot/drain tests)
         self.env = dict(
             os.environ,
             PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
@@ -84,8 +85,17 @@ class Devcluster:
         )
         _wait_http(self.master_url + "/api/v1/master")
 
-    def start_agent(self, agent_id="agent-0"):
-        self.agent = subprocess.Popen(
+    def start_agent(self, agent_id="agent-0", work_root=None, extra_env=None):
+        """Start an agent. The first live one is `self.agent` (restart
+        semantics of the older tests); further agents — multi-node drain /
+        spot tests — land in `self.extra_agents`. Returns the process."""
+        if work_root is None:
+            work_root = os.path.join(
+                self.tmpdir,
+                "agent-work" if agent_id == "agent-0" else f"work-{agent_id}")
+        env = dict(self.env)
+        env.update(extra_env or {})
+        proc = subprocess.Popen(
             [
                 os.path.join(self.binaries, "determined-agent"),
                 "--master-url", self.master_url,
@@ -93,19 +103,23 @@ class Devcluster:
                 "--slots", str(self.slots),
                 "--slot-type", "cpu",
                 "--addr", "127.0.0.1",
-                "--work-root", os.path.join(self.tmpdir, "agent-work"),
+                "--work-root", work_root,
                 # Agent service-account bootstrap token minted by the master.
                 "--token-file", self.db_path + ".agent_token",
             ],
-            env=self.env,
+            env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         )
+        if self.agent is None or self.agent.poll() is not None:
+            self.agent = proc
+        else:
+            self.extra_agents.append(proc)
         token = self.login()
         deadline = time.time() + 20
         while time.time() < deadline:
             agents = self.api("GET", "/api/v1/agents", token=token)["agents"]
             if any(a["id"] == agent_id and a["alive"] for a in agents):
-                return
+                return proc
             time.sleep(0.2)
         raise TimeoutError("agent did not register")
 
@@ -114,7 +128,7 @@ class Devcluster:
         self.master.wait()
 
     def stop(self):
-        for proc in (self.agent, self.master):
+        for proc in (*self.extra_agents, self.agent, self.master):
             if proc is not None and proc.poll() is None:
                 proc.kill()
                 proc.wait()

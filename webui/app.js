@@ -832,13 +832,16 @@ async function pageCluster() {
   view.textContent = "";
   view.append(el("h1", {}, "Cluster"));
   view.append(el("table", {},
-    el("tr", {}, ["Agent", "Pool", "Address", "Alive", "Slots (chips)"]
+    el("tr", {}, ["Agent", "Pool", "Address", "Alive", "State", "Slots (chips)"]
       .map((h) => el("th", {}, h))),
     agents.map((a) => el("tr", {},
       el("td", {}, a.id),
       el("td", {}, a.resource_pool),
       el("td", { class: "muted" }, a.addr),
       el("td", {}, a.alive ? "yes" : "no"),
+      el("td", a.state === "DRAINING" ? { title: a.drain_reason } : {},
+        a.state === "DRAINING" ? `draining (${a.drain_reason})`
+          : (a.state || "ENABLED").toLowerCase()),
       el("td", {}, el("span", { class: "slots" },
         a.slots.map((s) => el("span", {
           class: `slot ${s.allocation_id ? "busy" : ""} ${s.enabled ? "" : "disabled"}`,
