@@ -3,7 +3,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint test chaos bench-input native native-test clean
+.PHONY: lint test chaos bench-input bench-serve native native-test clean
 
 # The dogfood gate (docs/preflight.md): the platform's own models and
 # examples must pass the platform's own static analyzer. Fails on any
@@ -24,12 +24,20 @@ CHAOS_TIMEOUT ?= 1800
 chaos:
 	timeout -k 30 $(CHAOS_TIMEOUT) $(PY) -m pytest \
 		tests/test_chaos.py tests/test_selfheal.py tests/test_preemption.py \
+		tests/test_serving.py \
 		-q -m slow
 
 # Async input pipeline A/B: prefetch on/off step time + input_wait_ms
 # (docs/trial-api.md "Data loading and the async input pipeline").
 bench-input:
 	$(PY) bench.py --only input
+
+# Serving throughput/latency: continuous batching vs the sequential
+# one-request-at-a-time baseline on the same checkpoint
+# (docs/serving.md "Latency tuning"). Emits serve_tokens_per_s,
+# serve_p50_ms, serve_p99_ms.
+bench-serve:
+	$(PY) bench.py --only serve
 
 native:
 	$(MAKE) -C native

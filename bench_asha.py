@@ -127,6 +127,143 @@ def run_compile_reuse(cluster, token, tmp) -> dict:
     }
 
 
+def _api_raw(cluster, method, path, body=None, token=None, headers=None,
+             timeout=60.0):
+    """cluster.api with custom headers (X-Idempotency-Key) + wall timing."""
+    import urllib.request
+
+    req = urllib.request.Request(
+        cluster.master_url + path, method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json",
+                 **({"Authorization": f"Bearer {token}"} if token else {}),
+                 **(headers or {})})
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        out = json.loads(resp.read() or b"{}")
+    return out, (time.perf_counter() - t0) * 1e3
+
+
+def run_phase_breakdown(cluster, token, tmp, trial_id) -> dict:
+    """Per-phase master-side timings for the r5 ASHA regression hunt
+    (ROADMAP item 1): the four suspects measured in isolation against the
+    live master, so the next bench run can attribute the drop instead of
+    re-guessing. Instrumentation only — the fix is a later PR.
+
+      submit_preflight_ms    POST /api/v1/experiments (the create path
+                             runs the native preflight gate)
+      ckpt_partial_ms /      the two-phase checkpoint registry writes
+      ckpt_commit_ms         (PARTIAL report, then the COMPLETED flip)
+      idempotency_replay_ms  the same POST re-sent with the same
+                             X-Idempotency-Key — answered from the
+                             replay table, no re-execution
+      preempt_fanout_ms      pause → preemption long-poll delivery on a
+                             live allocation
+    """
+    import statistics as stats
+    import threading
+    import uuid
+
+    import determined_tpu.cli as cli
+
+    model_def = cli._tar_context(
+        os.path.join(REPO, "tests", "fixtures", "platform"))
+    out = {}
+
+    # 1) submit + preflight gate (paused: no scheduling noise).
+    config = {
+        "name": "bench-phase-submit",
+        "entrypoint": "python3 train.py",
+        "searcher": {"name": "single", "metric": "val_loss",
+                     "max_length": {"batches": 1}},
+        "hyperparameters": {"lr": 0.1},
+        "checkpoint_storage": {"type": "shared_fs",
+                               "host_path": os.path.join(tmp, "ckpts")},
+        "resources": {"slots_per_trial": 1},
+    }
+    submits = []
+    for _ in range(5):
+        _, ms = _api_raw(cluster, "POST", "/api/v1/experiments",
+                         {"config": config, "model_definition": model_def,
+                          "activate": False}, token=token)
+        submits.append(ms)
+    out["submit_preflight_ms"] = round(stats.median(submits), 2)
+
+    # 2) checkpoint two-phase commit: PARTIAL then COMPLETED, timed apart.
+    partials, commits, replays = [], [], []
+    for _ in range(5):
+        uid = f"bench-phase-{uuid.uuid4().hex[:8]}"
+        body = {"uuid": uid, "trial_id": trial_id, "steps_completed": 1,
+                "metadata": {}, "resources": {}, "state": "PARTIAL"}
+        _, ms = _api_raw(cluster, "POST", "/api/v1/checkpoints", body,
+                         token=token)
+        partials.append(ms)
+        body["state"] = "COMPLETED"
+        key = uuid.uuid4().hex
+        _, ms = _api_raw(cluster, "POST", "/api/v1/checkpoints", body,
+                         token=token, headers={"X-Idempotency-Key": key})
+        commits.append(ms)
+        # 3) replay lookup: the identical POST again — answered from the
+        # idempotency table.
+        _, ms = _api_raw(cluster, "POST", "/api/v1/checkpoints", body,
+                         token=token, headers={"X-Idempotency-Key": key})
+        replays.append(ms)
+    out["ckpt_partial_ms"] = round(stats.median(partials), 2)
+    out["ckpt_commit_ms"] = round(stats.median(commits), 2)
+    out["idempotency_replay_ms"] = round(stats.median(replays), 2)
+
+    # 4) preemption-signal fan-out: pause → long-poll delivery.
+    config = dict(config, name="bench-phase-preempt")
+    config["searcher"] = {"name": "single", "metric": "val_loss",
+                          "max_length": {"batches": 500}}
+    config["environment"] = {"TRIAL_STEP_SLEEP": "0.05"}
+    eid = cluster.api("POST", "/api/v1/experiments",
+                      {"config": config, "model_definition": model_def,
+                       "activate": True}, token=token)["id"]
+    alloc_id = None
+    deadline = time.time() + 60
+    while time.time() < deadline and alloc_id is None:
+        for j in cluster.api("GET", "/api/v1/job-queues",
+                             token=token)["jobs"]:
+            if j.get("experiment_id") == eid and \
+                    j.get("state") == "SCHEDULED":
+                a = cluster.api(
+                    "GET", f"/api/v1/allocations/{j['allocation_id']}",
+                    token=token)["allocation"]
+                if a.get("state") == "RUNNING":
+                    alloc_id = j["allocation_id"]
+        time.sleep(0.2)
+    if alloc_id is not None:
+        got = {}
+
+        def _poll():
+            try:
+                got["resp"], got["ms"] = _api_raw(
+                    cluster, "GET",
+                    f"/api/v1/allocations/{alloc_id}/signals/preemption"
+                    "?timeout_seconds=30", token=token, timeout=45)
+            except Exception as e:  # noqa: BLE001 — breakdown is advisory
+                got["error"] = str(e)
+
+        t = threading.Thread(target=_poll)
+        t.start()
+        time.sleep(0.3)  # the long-poll must be parked before the pause
+        t0 = time.perf_counter()
+        cluster.api("POST", f"/api/v1/experiments/{eid}/pause",
+                    token=token)
+        t.join(timeout=45)
+        if got.get("resp", {}).get("preempt"):
+            out["preempt_fanout_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 2)
+        else:
+            out["preempt_fanout_error"] = got.get(
+                "error", "no preempt signal delivered")
+    else:
+        out["preempt_fanout_error"] = "trial never reached RUNNING"
+    cluster.api("POST", f"/api/v1/experiments/{eid}/kill", token=token)
+    return out
+
+
 def run() -> dict:
     subprocess.run(["make", "-C", os.path.join(REPO, "native")],
                    check=True, capture_output=True)
@@ -179,6 +316,8 @@ def run() -> dict:
                              token=token)["trials"]
         trials_per_hour = len(trials) / elapsed * 3600
         compile_reuse = run_compile_reuse(cluster, token, tmp)
+        phase_breakdown = run_phase_breakdown(
+            cluster, token, tmp, trials[0]["id"] if trials else 1)
         return {
             "metric": "asha_trials_per_hour",
             "value": round(trials_per_hour, 1),
@@ -192,6 +331,12 @@ def run() -> dict:
                 # DET_XLA_CACHE_DIR): compile-bound trials with cache
                 # off vs on.
                 "compile_reuse": compile_reuse,
+                # Per-phase master-side timings (ROADMAP item 1: attribute
+                # the r5 asha_trials_per_hour regression — suspects are
+                # the submit/preflight gate, the checkpoint two-phase
+                # commit, the idempotency replay table, and the
+                # preemption-signal fan-out).
+                "phase_breakdown": phase_breakdown,
             },
         }
     finally:

@@ -480,6 +480,66 @@ def cmd_preflight(session, args) -> int:
     return 1 if report.errors else 0
 
 
+# ---------------------------------------------------------------------------
+# serve — inference serving from trained checkpoints (docs/serving.md)
+# ---------------------------------------------------------------------------
+
+
+def cmd_serve(session, args) -> int:
+    """`det serve <config> [context_dir]` — launch a serve replica;
+    `det serve status [id]` — list/inspect; `det serve kill <id>`.
+
+    `--local` runs the replica in-process against local checkpoint
+    storage (no master) — the dev loop for serving configs."""
+    target = args.target
+    if target == "status":
+        if args.extra:
+            resp = session.get(f"/api/v1/serving/{args.extra[0]}")
+            print(json.dumps(resp.get("task", resp), indent=2))
+            return 0
+        resp = session.get("/api/v1/serving")
+        rows = [
+            {
+                "id": t.get("id"),
+                "state": t.get("state"),
+                "allocation": t.get("allocation_state", ""),
+                "address": t.get("proxy_address", ""),
+                "restarts": t.get("restarts", 0),
+            }
+            for t in resp.get("serving", [])
+        ]
+        _print_table(rows, ["id", "state", "allocation", "address",
+                            "restarts"])
+        return 0
+    if target == "kill":
+        if not args.extra:
+            raise SystemExit("usage: det serve kill <task-id>")
+        session.post(f"/api/v1/serving/{args.extra[0]}/kill")
+        print(f"killed {args.extra[0]}")
+        return 0
+
+    # Launch path: <config> [context_dir].
+    config = expconf.check(_load_config_file(target))
+    if "serving" not in config:
+        raise SystemExit(
+            "config has no `serving:` block (docs/serving.md)")
+    if args.local:
+        from determined_tpu.serve import task as serve_task
+
+        os.environ["DET_SERVING_CONFIG"] = json.dumps(config)
+        return serve_task.main([])
+    context_dir = args.extra[0] if args.extra else None
+    body = {"config": config}
+    if context_dir:
+        body["context"] = _tar_context(context_dir)
+    resp = session.post("/api/v1/serving", body=body)
+    print(f"Created serving task {resp['id']} "
+          f"(allocation {resp.get('allocation_id')})")
+    print("  status:  det serve status")
+    print(f"  address: GET /api/v1/serving/{resp['id']} → proxy_address")
+    return 0
+
+
 def cmd_deploy(session: Session, args) -> int:
     from determined_tpu import deploy as deploy_mod
 
@@ -939,6 +999,21 @@ def build_parser() -> argparse.ArgumentParser:
     dk.add_argument("--num-nodes", type=int, default=2)
     dk.set_defaults(func=cmd_deploy, target="gke")
 
+    sv = sub.add_parser(
+        "serve",
+        help="high-throughput inference serving from trained checkpoints "
+             "(docs/serving.md)")
+    sv.add_argument(
+        "target",
+        help="serving config file to launch, or 'status' / 'kill'")
+    sv.add_argument(
+        "extra", nargs="*",
+        help="context dir (launch), or the serving task id (status/kill)")
+    sv.add_argument(
+        "--local", action="store_true",
+        help="run the replica in-process against local storage (no master)")
+    sv.set_defaults(func=cmd_serve)
+
     pf = sub.add_parser(
         "preflight",
         help="static shard/HBM/recompile analysis of a trial config "
@@ -964,8 +1039,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    # deploy/preflight commands run locally — no session/login.
-    local = args.func in (cmd_deploy, cmd_preflight)
+    # deploy/preflight (and serve --local) run locally — no session/login.
+    local = args.func in (cmd_deploy, cmd_preflight) or (
+        args.func is cmd_serve and getattr(args, "local", False))
     session = None if local else _login(args.master, args.user)
     try:
         return args.func(session, args)

@@ -135,12 +135,19 @@ def validate(config: Dict[str, Any]) -> List[str]:
     if not isinstance(config, dict):
         return ["config must be a mapping"]
 
-    if not config.get("entrypoint"):
+    serving = config.get("serving")
+    if serving is not None:
+        _validate_serving(serving, errors)
+
+    # Serving configs describe a deployment, not a training loop: the
+    # entrypoint defaults to the serve task and there is no searcher.
+    if not config.get("entrypoint") and serving is None:
         errors.append("entrypoint is required")
 
     searcher = config.get("searcher")
     if not isinstance(searcher, dict):
-        errors.append("searcher is required")
+        if serving is None:
+            errors.append("searcher is required")
     else:
         name = searcher.get("name")
         if name not in SEARCHER_NAMES:
@@ -286,6 +293,57 @@ def _validate_health(block: Any, errors: List[str]) -> None:
     ):
         errors.append("health.step_timeout_sec must be a non-negative "
                       "number (0 disables the watchdog)")
+
+
+def _validate_serving(block: Any, errors: List[str]) -> None:
+    """`serving:` — a `det serve` deployment (docs/serving.md): which
+    checkpoint to load, the model family/config to rebuild it into, and
+    the continuous-batcher capacity knobs."""
+    if not isinstance(block, dict):
+        errors.append("serving must be a mapping")
+        return
+    valid = {"checkpoint", "trial_id", "model", "model_config",
+             "max_batch_size", "max_seq_len", "kv_block_size",
+             "prefill_buckets", "queue_depth", "port", "seed",
+             "stats_log_period_s"}
+    unknown = sorted(set(block) - valid)
+    if unknown:
+        errors.append(
+            f"serving: unknown keys {unknown}; valid: {sorted(valid)}")
+    ckpt = block.get("checkpoint")
+    if ckpt is not None and not isinstance(ckpt, str):
+        errors.append(
+            "serving.checkpoint must be a checkpoint storage id or "
+            "'latest'")
+    model = block.get("model")
+    if model is not None and model not in ("gpt2",):
+        errors.append("serving.model must be one of: gpt2")
+    mc = block.get("model_config")
+    if mc is not None and not isinstance(mc, dict):
+        errors.append("serving.model_config must be a mapping")
+    for key in ("max_batch_size", "max_seq_len", "kv_block_size",
+                "queue_depth"):
+        v = block.get(key)
+        if v is not None and (
+            isinstance(v, bool) or not isinstance(v, int) or v < 1
+        ):
+            errors.append(f"serving.{key} must be a positive int")
+    for key in ("trial_id", "port", "seed"):
+        v = block.get(key)
+        if v is not None and (
+            isinstance(v, bool) or not isinstance(v, int) or v < 0
+        ):
+            errors.append(f"serving.{key} must be a non-negative int")
+    buckets = block.get("prefill_buckets")
+    if buckets is not None:
+        if (not isinstance(buckets, list) or not buckets or any(
+                isinstance(b, bool) or not isinstance(b, int) or b < 1
+                for b in buckets)):
+            errors.append(
+                "serving.prefill_buckets must be a non-empty list of "
+                "positive ints")
+        elif sorted(buckets) != buckets:
+            errors.append("serving.prefill_buckets must be ascending")
 
 
 def _validate_prefetch(block: Any, errors: List[str]) -> None:
@@ -496,6 +554,16 @@ def apply_defaults(config: Dict[str, Any]) -> Dict[str, Any]:
     res.setdefault("slots_per_trial", 1)
     res.setdefault("resource_pool", "default")
     res.setdefault("priority", 42)
+    if isinstance(c.get("serving"), dict):
+        s = c["serving"]
+        s.setdefault("checkpoint", "latest")
+        s.setdefault("model", "gpt2")
+        s.setdefault("max_batch_size", 8)
+        s.setdefault("max_seq_len", 256)
+        s.setdefault("kv_block_size", 16)
+        s.setdefault("queue_depth", 64)
+        # No searcher/validation machinery for a deployment config.
+        return c
     searcher = c.setdefault("searcher", {})
     searcher.setdefault("smaller_is_better", True)
     name = searcher.get("name")

@@ -1,0 +1,40 @@
+"""`det serve` — high-throughput inference serving from trained checkpoints.
+
+The subsystem that takes the platform past the checkpoint (ROADMAP item 2):
+a SERVING task type loads a COMPLETED, integrity-verified checkpoint,
+AOT-compiles bucketed prefill + single-token decode executables, and runs
+continuous token-level batching — sequences join at decode-step boundaries
+and retire without draining the batch, behind a bounded admission queue.
+
+Layout:
+  model.py      KV-cached GPT-2 prefill/decode steps (shape-static, AOT)
+  kv_cache.py   host-side KV block manager (admission accounting)
+  engine.py     checkpoint loading + compiled executables + device state
+  scheduler.py  bounded admission queue + the continuous batcher
+  http.py       HTTP front-end (generate/stats/health)
+  task.py       cluster entrypoint (drain lifecycle, proxy registration)
+
+Docs: docs/serving.md.
+"""
+
+from determined_tpu.serve.engine import ServingEngine, load_checkpoint_params
+from determined_tpu.serve.kv_cache import BlockManager, KVBlockError
+from determined_tpu.serve.scheduler import (
+    AdmissionQueue,
+    ContinuousBatcher,
+    Draining,
+    QueueFull,
+    Request,
+)
+
+__all__ = [
+    "AdmissionQueue",
+    "BlockManager",
+    "ContinuousBatcher",
+    "Draining",
+    "KVBlockError",
+    "QueueFull",
+    "Request",
+    "ServingEngine",
+    "load_checkpoint_params",
+]

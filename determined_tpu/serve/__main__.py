@@ -1,0 +1,8 @@
+"""`python -m determined_tpu.serve` — alias for the serve task entrypoint."""
+
+import sys
+
+from determined_tpu.serve.task import main
+
+if __name__ == "__main__":
+    sys.exit(main())

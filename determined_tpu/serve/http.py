@@ -1,0 +1,146 @@
+"""HTTP front-end for a serve replica.
+
+Small and dependency-free (http.server, like the exec task servers): one
+POST endpoint that blocks until the batcher completes the request, plus
+stats/health for load balancers and the master proxy.
+
+  POST /v1/generate   {"tokens": [...], "max_new_tokens": 16,
+                       "temperature": 0.0, "eos_id": null,
+                       "timeout_s": 120}
+      200 {"id", "tokens", "prompt_tokens", "latency_ms", "queue_ms"}
+      400 bad request (prompt too long for every bucket, bad body)
+      429 admission queue full            (Retry-After: 1)
+      503 draining — not admitting        (Retry-After: 5)
+      504 request accepted but not finished within timeout_s
+
+  GET /v1/stats       batcher + engine counters (occupancy, KV blocks,
+                      queue depth, compile times)
+  GET /healthz        {"status": "ok"|"draining"}
+
+The thread-per-request server is intentional: generate handlers spend
+their life blocked on a result event, so threads are cheap, and the
+batcher thread is the only device consumer regardless of fan-in.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+from determined_tpu.serve.scheduler import (
+    ContinuousBatcher,
+    Draining,
+    QueueFull,
+    Request,
+)
+
+logger = logging.getLogger("determined_tpu.serve")
+
+DEFAULT_REQUEST_TIMEOUT_S = 120.0
+
+
+def _make_handler(batcher: ContinuousBatcher):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet: task log carries ours
+            logger.debug("http: " + fmt, *args)
+
+        def _send(self, status: int, body: Dict[str, Any],
+                  headers: Optional[Dict[str, str]] = None) -> None:
+            data = json.dumps(body).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):  # noqa: N802 — http.server API
+            if self.path == "/healthz":
+                draining = batcher.queue.draining
+                self._send(200, {"status": "draining" if draining
+                                 else "ok"})
+                return
+            if self.path == "/v1/stats":
+                stats = batcher.stats()
+                stats["engine"] = batcher.engine.stats()
+                self._send(200, stats)
+                return
+            self._send(404, {"error": "not found"})
+
+        def do_POST(self):  # noqa: N802
+            if self.path != "/v1/generate":
+                self._send(404, {"error": "not found"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                req = Request(
+                    tokens=body["tokens"],
+                    max_new_tokens=int(body.get("max_new_tokens", 16)),
+                    temperature=float(body.get("temperature", 0.0)),
+                    eos_id=body.get("eos_id"),
+                )
+                timeout = float(
+                    body.get("timeout_s", DEFAULT_REQUEST_TIMEOUT_S))
+            except (KeyError, TypeError, ValueError) as e:
+                self._send(400, {"error": f"bad request: {e}"})
+                return
+            try:
+                batcher.submit(req)
+            except Draining as e:
+                self._send(503, {"error": str(e)}, {"Retry-After": "5"})
+                return
+            except QueueFull as e:
+                self._send(429, {"error": str(e)}, {"Retry-After": "1"})
+                return
+            except ValueError as e:
+                self._send(400, {"error": str(e)})
+                return
+            try:
+                self._send(200, req.result(timeout))
+            except TimeoutError:
+                self._send(504, {"error": "request timed out", "id": req.id})
+            except RuntimeError as e:
+                self._send(500, {"error": str(e), "id": req.id})
+
+    return Handler
+
+
+class ServingServer:
+    """ThreadingHTTPServer wrapper with deterministic lifecycle."""
+
+    def __init__(self, batcher: ContinuousBatcher, host: str = "0.0.0.0",
+                 port: int = 0):
+        self.batcher = batcher
+        self._httpd = ThreadingHTTPServer(
+            (host, port), _make_handler(batcher))
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "ServingServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="serve-http")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

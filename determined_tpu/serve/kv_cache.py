@@ -1,0 +1,129 @@
+"""KV-cache block manager — admission-control accounting for `det serve`.
+
+The device-side KV cache is a slot-dense tensor (one lane per concurrent
+sequence, engine.py); HBM *budgeting* over it is block-granular, vLLM
+style: the cache's token capacity is carved into fixed-size blocks and a
+sequence may only be admitted when enough free blocks exist to cover its
+worst case (prompt + max_new_tokens). Blocks return to the free pool the
+moment a sequence retires — without draining the batch — so the
+continuous batcher can immediately admit the next queued request.
+
+Host-side by design: the block map never reaches the device (the decode
+step indexes the dense cache by slot), so the accounting costs nothing on
+the hot path. A paged device layout (block-table gather in the attention
+kernel) can later slot in behind this same interface.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+
+class KVBlockError(ValueError):
+    """Inconsistent block-manager use (double free, unknown sequence)."""
+
+
+class BlockManager:
+    """Fixed pool of KV blocks; allocate on admit, free on retire.
+
+    Thread-safe: the batcher allocates at step boundaries while the HTTP
+    front-end reads `free_blocks` for stats.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks <= 0 or block_size <= 0:
+            raise ValueError("num_blocks and block_size must be positive")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self._owned: Dict[str, List[int]] = {}  # seq id -> block ids
+        self._ever_freed: set = set()  # block ids that have cycled back
+        # Lifetime counters (stats / tests): every block ever handed out
+        # and returned. reused grows once freed blocks start cycling back.
+        self.total_allocated = 0
+        self.total_freed = 0
+        self.total_reused = 0
+
+    def blocks_for_tokens(self, n_tokens: int) -> int:
+        """Blocks covering `n_tokens` (ceil division; 0 tokens → 0)."""
+        return (max(0, n_tokens) + self.block_size - 1) // self.block_size
+
+    @property
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.num_blocks - self.free_blocks
+
+    def can_allocate(self, n_tokens: int) -> bool:
+        return self.blocks_for_tokens(n_tokens) <= self.free_blocks
+
+    def allocate(self, seq_id: str, n_tokens: int) -> Optional[List[int]]:
+        """Reserve blocks for a sequence of up to `n_tokens` tokens.
+
+        Returns the block ids, or None when the pool can't cover it (the
+        caller keeps the request queued — backpressure, not failure).
+        """
+        need = self.blocks_for_tokens(n_tokens)
+        with self._lock:
+            if seq_id in self._owned:
+                raise KVBlockError(f"sequence {seq_id!r} already owns blocks")
+            if need > len(self._free):
+                return None
+            blocks = [self._free.pop() for _ in range(need)]
+            self._owned[seq_id] = blocks
+            self.total_allocated += need
+            self.total_reused += sum(1 for b in blocks if b in self._ever_freed)
+            return list(blocks)
+
+    def extend(self, seq_id: str, n_tokens: int) -> bool:
+        """Grow a sequence's reservation to cover `n_tokens` total. True on
+        success; False when the pool is exhausted (caller must retire or
+        reject)."""
+        with self._lock:
+            owned = self._owned.get(seq_id)
+            if owned is None:
+                raise KVBlockError(f"sequence {seq_id!r} owns no blocks")
+            need = self.blocks_for_tokens(n_tokens) - len(owned)
+            if need <= 0:
+                return True
+            if need > len(self._free):
+                return False
+            grown = [self._free.pop() for _ in range(need)]
+            owned.extend(grown)
+            self.total_allocated += need
+            self.total_reused += sum(1 for b in grown if b in self._ever_freed)
+            return True
+
+    def free(self, seq_id: str) -> int:
+        """Return a retired sequence's blocks to the pool; returns the
+        count. Double-free / unknown ids raise — an accounting bug must
+        surface, not silently skew capacity."""
+        with self._lock:
+            blocks = self._owned.pop(seq_id, None)
+            if blocks is None:
+                raise KVBlockError(f"sequence {seq_id!r} owns no blocks")
+            self._free.extend(reversed(blocks))
+            self._ever_freed.update(blocks)
+            self.total_freed += len(blocks)
+            return len(blocks)
+
+    def owned(self, seq_id: str) -> List[int]:
+        with self._lock:
+            return list(self._owned.get(seq_id, ()))
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "num_blocks": self.num_blocks,
+                "block_size": self.block_size,
+                "free_blocks": len(self._free),
+                "used_blocks": self.num_blocks - len(self._free),
+                "total_allocated": self.total_allocated,
+                "total_freed": self.total_freed,
+                "total_reused": self.total_reused,
+            }

@@ -1,0 +1,236 @@
+"""KV-cached GPT-2 inference steps (prefill + single-token decode).
+
+The training forward pass (models/gpt2.apply) recomputes attention over the
+whole context every call — O(S²) per generated token. Serving splits it the
+standard way:
+
+  - **prefill**: one full causal pass over the (bucket-padded) prompt,
+    writing every position's K/V into the sequence's cache lane and
+    returning the next-token logits. Compiled per prompt bucket, so a small
+    set of AOT executables covers every prompt length.
+  - **decode**: one token per active slot per call — each slot attends over
+    its cached K/V only. One compiled executable regardless of batch
+    composition; the continuous batcher joins/retires sequences purely by
+    editing host-side slot state.
+
+Cache layout is slot-dense: `[n_layer, slots, max_seq, heads, head_dim]`
+per K and V, stacked over layers exactly like the training params so both
+paths `lax.scan` the same block structure. Positions beyond a slot's
+current length hold stale bytes; the decode mask (`index <= position`)
+never admits a stale index before the step that overwrites it.
+
+Works for dense and MoE blocks (the MoE FFN routes per token, so a
+1-token decode step reuses ops/moe.moe_block unchanged). All functions are
+shape-static and jit/AOT-friendly; tier-1 exercises them on the CPU
+backend via the `_jax_compat` shims.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from determined_tpu.models.gpt2 import Config, _embed_tokens, _layer_norm
+from determined_tpu.parallel.sharding import LogicalRules, shard_logical
+
+
+def init_cache(
+    cfg: Config, slots: int, max_seq: int, dtype: Any = None
+) -> Dict[str, jax.Array]:
+    """Zeroed KV cache: {"k","v"}: [L, slots, max_seq, H, Dh]."""
+    if max_seq > cfg.n_positions:
+        raise ValueError(
+            f"max_seq {max_seq} exceeds the model's position table "
+            f"({cfg.n_positions})")
+    dt = dtype or cfg.dtype
+    shape = (cfg.n_layer, slots, max_seq, cfg.n_head, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def cache_bytes(cfg: Config, slots: int, max_seq: int,
+                dtype: Any = None) -> int:
+    """HBM footprint of the cache (both K and V) — admission budgeting."""
+    dt = jnp.dtype(dtype or cfg.dtype)
+    per = cfg.n_layer * slots * max_seq * cfg.n_head * cfg.head_dim
+    return 2 * per * dt.itemsize
+
+
+def _qkv(x, lp, cfg: Config):
+    """x: [B, S, D] → q, k, v: [B, S, H, Dh]."""
+    b, s, _ = x.shape
+    dt = cfg.dtype
+    qkv = jnp.einsum("bsd,de->bse", x, lp["qkv"]["kernel"].astype(dt)) + lp[
+        "qkv"]["bias"].astype(dt)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    shape = (b, s, cfg.n_head, cfg.head_dim)
+    return q.reshape(shape), k.reshape(shape), v.reshape(shape)
+
+
+def _mlp(y, lp, cfg: Config, rules: Optional[LogicalRules]):
+    """The block's FFN — dense or token-routed MoE, matching _block."""
+    dt = cfg.dtype
+    if cfg.num_experts > 1:
+        from determined_tpu.ops.moe import moe_block
+
+        down, _ = moe_block(
+            y, lp["moe"], cfg.num_experts, top_k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor, rules=rules,
+        )
+        return down
+    up = jnp.einsum("bsd,df->bsf", y, lp["mlp_up"]["kernel"].astype(dt)) + lp[
+        "mlp_up"]["bias"].astype(dt)
+    up = shard_logical(up, ("batch", "seq", "mlp"), rules)
+    up = jax.nn.gelu(up, approximate=True)
+    return (
+        jnp.einsum("bsf,fd->bsd", up, lp["mlp_down"]["kernel"].astype(dt))
+        + lp["mlp_down"]["bias"].astype(dt)
+    )
+
+
+def _finish(params, x, cfg: Config, rules: Optional[LogicalRules]):
+    """Final layernorm + LM head → logits [..., vocab]."""
+    x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"],
+                    cfg.layer_norm_eps)
+    logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(cfg.dtype))
+    return shard_logical(logits, ("batch", "seq", "vocab"), rules)
+
+
+# ---------------------------------------------------------------- prefill
+
+
+def prefill(
+    params: Dict[str, Any],
+    cache: Dict[str, jax.Array],
+    tokens: jax.Array,   # [bucket] int32, right-padded to the bucket size
+    length: jax.Array,   # scalar int32: real prompt length (<= bucket)
+    slot: jax.Array,     # scalar int32: cache lane to fill
+    cfg: Config,
+    rules: Optional[LogicalRules] = None,
+) -> Tuple[Dict[str, jax.Array], jax.Array]:
+    """Run the prompt through the model, filling cache lane `slot`.
+
+    Returns (cache', next_token_logits [vocab]). Padded positions compute
+    garbage K/V but the decode mask never reads an index the decode loop
+    has not since overwritten (module docstring).
+    """
+    s = tokens.shape[0]
+    dt = cfg.dtype
+    x = _embed_tokens(params, tokens[None], cfg, rules, dt)
+    x = x + params["wpe"].astype(dt)[:s][None]
+    x = shard_logical(x, ("batch", "seq", "embed"), rules)
+    causal = jnp.tril(jnp.ones((s, s), jnp.bool_))
+    valid = jnp.arange(s)[None, :] < length  # [1, S] key-side padding mask
+    mask = causal & valid
+
+    def body(carry, layer_in):
+        xx = carry
+        lp, k_lane, v_lane = layer_in
+        y = _layer_norm(xx, lp["ln1"]["scale"], lp["ln1"]["bias"],
+                        cfg.layer_norm_eps)
+        q, k, v = _qkv(y, lp, cfg)
+        # Write this layer's K/V for the whole prompt into the slot's lane.
+        k_lane = jax.lax.dynamic_update_slice(
+            k_lane, k.astype(k_lane.dtype), (slot, 0, 0, 0))
+        v_lane = jax.lax.dynamic_update_slice(
+            v_lane, v.astype(v_lane.dtype), (slot, 0, 0, 0))
+        scale = 1.0 / math.sqrt(cfg.head_dim)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        logits = jnp.where(mask[None, None], logits * scale,
+                           jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        attn = attn.reshape(xx.shape)
+        attn = (jnp.einsum("bsd,de->bse", attn,
+                           lp["attn_out"]["kernel"].astype(dt))
+                + lp["attn_out"]["bias"].astype(dt))
+        xx = xx + attn
+        y = _layer_norm(xx, lp["ln2"]["scale"], lp["ln2"]["bias"],
+                        cfg.layer_norm_eps)
+        xx = xx + _mlp(y, lp, cfg, rules)
+        xx = shard_logical(xx, ("batch", "seq", "embed"), rules)
+        return xx, (k_lane, v_lane)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"]))
+    logits = _finish(params, x, cfg, rules)  # [1, S, V]
+    last = jax.lax.dynamic_index_in_dim(
+        logits[0], jnp.maximum(length - 1, 0), axis=0, keepdims=False)
+    return {"k": new_k, "v": new_v}, last.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------- decode
+
+
+def decode_step(
+    params: Dict[str, Any],
+    cache: Dict[str, jax.Array],
+    tokens: jax.Array,     # [slots] int32: last emitted token per slot
+    positions: jax.Array,  # [slots] int32: index this step writes/attends at
+    cfg: Config,
+    rules: Optional[LogicalRules] = None,
+) -> Tuple[Dict[str, jax.Array], jax.Array]:
+    """One decode step for every slot → (cache', logits [slots, vocab]).
+
+    Inactive slots simply ride along (position 0, result discarded by the
+    batcher) — the executable never depends on which lanes are live, so
+    joining and retiring sequences costs zero recompiles.
+    """
+    slots = tokens.shape[0]
+    max_seq = cache["k"].shape[2]
+    dt = cfg.dtype
+    x = _embed_tokens(params, tokens[:, None], cfg, rules, dt)  # [slots,1,D]
+    pos_emb = jnp.take(params["wpe"].astype(dt), positions, axis=0)
+    x = x + pos_emb[:, None]
+    x = shard_logical(x, ("batch", "seq", "embed"), rules)
+    lane = jnp.arange(slots)
+    # index <= position admits the prompt, every prior decode step, and the
+    # K/V this very step writes — never a stale lane byte.
+    mask = jnp.arange(max_seq)[None] <= positions[:, None]  # [slots, max_seq]
+
+    def body(carry, layer_in):
+        xx = carry  # [slots, 1, D]
+        lp, k_lane, v_lane = layer_in
+        y = _layer_norm(xx, lp["ln1"]["scale"], lp["ln1"]["bias"],
+                        cfg.layer_norm_eps)
+        q, k, v = _qkv(y, lp, cfg)  # [slots, 1, H, Dh]
+        k_lane = k_lane.at[lane, positions].set(
+            k[:, 0].astype(k_lane.dtype))
+        v_lane = v_lane.at[lane, positions].set(
+            v[:, 0].astype(v_lane.dtype))
+        scale = 1.0 / math.sqrt(cfg.head_dim)
+        logits = jnp.einsum(
+            "bhd,bmhd->bhm", q[:, 0], k_lane).astype(jnp.float32)
+        logits = jnp.where(mask[:, None], logits * scale,
+                           jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        attn = jnp.einsum("bhm,bmhd->bhd", probs, v_lane)
+        attn = attn.reshape(slots, 1, -1)
+        attn = (jnp.einsum("bsd,de->bse", attn,
+                           lp["attn_out"]["kernel"].astype(dt))
+                + lp["attn_out"]["bias"].astype(dt))
+        xx = xx + attn
+        y = _layer_norm(xx, lp["ln2"]["scale"], lp["ln2"]["bias"],
+                        cfg.layer_norm_eps)
+        xx = xx + _mlp(y, lp, cfg, rules)
+        return xx, (k_lane, v_lane)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"]))
+    logits = _finish(params, x, cfg, rules)  # [slots, 1, V]
+    return {"k": new_k, "v": new_v}, logits[:, 0].astype(jnp.float32)
+
+
+def sample(
+    logits: jax.Array,        # [slots, vocab] fp32
+    temperature: jax.Array,   # [slots] fp32; 0 = greedy
+    rng: jax.Array,
+) -> jax.Array:
+    """Next token per slot: greedy at temperature 0, else categorical."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    drawn = jax.random.categorical(rng, logits / temp, axis=-1).astype(
+        jnp.int32)
+    return jnp.where(temperature > 0, drawn, greedy)

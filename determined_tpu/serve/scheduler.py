@@ -1,0 +1,426 @@
+"""Continuous token-level batcher + bounded admission queue.
+
+The serving analogue of the training input pipeline's producer/consumer
+discipline (data/prefetch.py): the HTTP front-end *produces* requests into
+a bounded queue (backpressure, never unbounded growth), and a single
+batcher thread *consumes* them into the decode loop — the device never
+waits on request plumbing, and request plumbing never races the device.
+
+The batching contract (Orca/vLLM-style continuous batching):
+
+  - **join at step boundaries**: new sequences are admitted (prefilled
+    into a free slot + KV blocks reserved) only between decode steps —
+    never mid-step, so running sequences see zero jitter from joins;
+  - **retire without drain**: a sequence that finishes frees its slot and
+    KV blocks immediately; remaining sequences keep decoding and the next
+    queued request joins at the very next boundary — the batch never
+    drains to refill;
+  - **drain semantics** (spot preemption / shutdown): `drain()` stops
+    admissions at the front door (submit raises Draining → HTTP 503) but
+    every accepted request — queued or mid-decode — still completes: an
+    accepted request is a promise (the zero-dropped-responses contract of
+    docs/cluster-ops.md's drain lifecycle).
+
+Chaos: `serving.request.drop` fires in submit() (docs/chaos.md) — drop
+sheds the request as if the queue were full; error fails the submit.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from determined_tpu.common import faultpoint
+from determined_tpu.serve.kv_cache import BlockManager
+
+logger = logging.getLogger("determined_tpu.serve")
+
+FAULT_POINT_DROP = "serving.request.drop"
+
+_req_counter = itertools.count()
+
+
+class QueueFull(RuntimeError):
+    """Admission queue at capacity — retry later (HTTP 429/503)."""
+
+
+class Draining(RuntimeError):
+    """Replica is draining — no new admissions (HTTP 503 + retry)."""
+
+
+class Request:
+    """One generation request: prompt tokens in, generated tokens out."""
+
+    def __init__(
+        self,
+        tokens,
+        max_new_tokens: int = 16,
+        temperature: float = 0.0,
+        eos_id: Optional[int] = None,
+        request_id: Optional[str] = None,
+    ):
+        self.id = request_id or f"req-{next(_req_counter)}"
+        self.tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if self.tokens.size == 0:
+            raise ValueError("prompt must contain at least one token")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.temperature = float(temperature)
+        self.eos_id = eos_id
+        self.out_tokens: List[int] = []
+        self.submitted_at = time.monotonic()
+        self.admitted_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.error: Optional[str] = None
+        self._done = threading.Event()
+
+    @property
+    def total_budget(self) -> int:
+        """Worst-case KV footprint in tokens (prompt + every new token)."""
+        return int(self.tokens.size) + self.max_new_tokens
+
+    def _finish(self, error: Optional[str] = None) -> None:
+        self.error = error
+        self.finished_at = time.monotonic()
+        self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Block until the request completes; raises on failure/timeout."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.id} not finished")
+        if self.error is not None:
+            raise RuntimeError(f"request {self.id} failed: {self.error}")
+        latency_ms = (self.finished_at - self.submitted_at) * 1e3
+        queue_ms = ((self.admitted_at or self.finished_at)
+                    - self.submitted_at) * 1e3
+        return {
+            "id": self.id,
+            "tokens": list(self.out_tokens),
+            "prompt_tokens": int(self.tokens.size),
+            "latency_ms": round(latency_ms, 3),
+            "queue_ms": round(queue_ms, 3),
+        }
+
+
+class AdmissionQueue:
+    """Bounded FIFO between the front-end and the batcher.
+
+    submit() applies backpressure (QueueFull) instead of buffering
+    unboundedly, and refuses outright while draining — the two failure
+    modes a load balancer can act on (retry elsewhere vs back off).
+    """
+
+    def __init__(self, maxsize: int = 64):
+        self.maxsize = max(1, int(maxsize))
+        self._dq: "collections.deque[Request]" = collections.deque()
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._draining = False
+        self.rejected_full = 0
+        self.rejected_draining = 0
+        self.dropped = 0  # serving.request.drop shed count
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._dq)
+
+    def submit(self, req: Request) -> Request:
+        action = faultpoint.fire(FAULT_POINT_DROP)
+        if action is faultpoint.Action.ERROR:
+            raise faultpoint.FaultInjected(FAULT_POINT_DROP)
+        with self._lock:
+            if self._draining:
+                self.rejected_draining += 1
+                raise Draining("replica is draining; not admitting")
+            if action is faultpoint.Action.DROP:
+                self.dropped += 1
+                raise QueueFull("request shed (serving.request.drop)")
+            if len(self._dq) >= self.maxsize:
+                self.rejected_full += 1
+                raise QueueFull(
+                    f"admission queue at capacity ({self.maxsize})")
+            self._dq.append(req)
+            self._nonempty.notify_all()
+        return req
+
+    def peek(self) -> Optional[Request]:
+        with self._lock:
+            return self._dq[0] if self._dq else None
+
+    def pop(self) -> Optional[Request]:
+        with self._lock:
+            return self._dq.popleft() if self._dq else None
+
+    def wait_nonempty(self, timeout: float) -> bool:
+        with self._lock:
+            if self._dq:
+                return True
+            self._nonempty.wait(timeout)
+            return bool(self._dq)
+
+    def drain(self) -> None:
+        with self._lock:
+            self._draining = True
+            self._nonempty.notify_all()
+
+    def undrain(self) -> None:
+        with self._lock:
+            self._draining = False
+
+
+class _Slot:
+    __slots__ = ("req", "position", "last_token")
+
+    def __init__(self, req: Request, position: int, last_token: int):
+        self.req = req
+        self.position = position  # index the NEXT decode step writes at
+        self.last_token = last_token
+
+
+class ContinuousBatcher:
+    """The decode loop: admit → step → retire, forever.
+
+    Owns the engine's host-side slot state and the KV block accounting.
+    `events` records (kind, request_id, step) tuples — ("admit"/"retire"
+    at the boundary they happened) — so tests can assert the
+    join-at-boundary / retire-without-drain ordering directly.
+    """
+
+    def __init__(
+        self,
+        engine,
+        queue: Optional[AdmissionQueue] = None,
+        block_manager: Optional[BlockManager] = None,
+        idle_wait_s: float = 0.02,
+    ):
+        self.engine = engine
+        self.queue = queue or AdmissionQueue()
+        bm = block_manager
+        if bm is None:
+            # Pool sized to the cache: slots lanes of max_seq tokens.
+            bm = BlockManager(
+                num_blocks=engine.slots * max(
+                    1, engine.max_seq_len // 16), block_size=16)
+        self.blocks = bm
+        self._idle_wait = idle_wait_s
+        self._slots: List[Optional[_Slot]] = [None] * engine.slots
+        self._stop_evt = threading.Event()
+        self._drained_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()  # events/counters only
+        self.events: List[Tuple[str, str, int]] = []
+        self.steps = 0
+        self.active_steps = 0      # steps with >= 1 active slot
+        self.occupancy_sum = 0     # sum of active slots over active steps
+        self.max_occupancy = 0
+        self.completed = 0
+        self.generated_tokens = 0
+        self.failed = 0
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "ContinuousBatcher":
+        if self._thread is not None:
+            return self
+        self.engine.compile()  # AOT everything before the first admit
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="serve-batcher")
+        self._thread.start()
+        return self
+
+    def submit(self, req: Request) -> Request:
+        # Validate against engine limits at the front door — a prompt no
+        # bucket covers would otherwise poison the batcher thread.
+        if self.engine.bucket_for(int(req.tokens.size)) is None:
+            raise ValueError(
+                f"prompt length {req.tokens.size} exceeds the largest "
+                f"prefill bucket ({self.engine.prefill_buckets[-1]})")
+        if req.total_budget > self.engine.max_seq_len:
+            raise ValueError(
+                f"prompt + max_new_tokens = {req.total_budget} exceeds "
+                f"max_seq_len ({self.engine.max_seq_len})")
+        return self.queue.submit(req)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop admitting; wait for queued + in-flight work to finish.
+
+        Returns True when fully drained within `timeout` (None = just
+        signal, don't wait)."""
+        self.queue.drain()
+        if timeout is None:
+            return self.idle()
+        return self._drained_evt.wait(timeout)
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Hard stop: fail outstanding requests and join the thread."""
+        self._stop_evt.set()
+        self.queue.drain()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        for slot in self._slots:
+            if slot is not None and not slot.req.done():
+                slot.req._finish("batcher stopped")
+        while True:
+            req = self.queue.pop()
+            if req is None:
+                break
+            req._finish("batcher stopped")
+
+    def idle(self) -> bool:
+        return self.queue.depth() == 0 and all(
+            s is None for s in self._slots)
+
+    def active_count(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    # -- the loop ------------------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            while not self._stop_evt.is_set():
+                self._admit()
+                active = [i for i, s in enumerate(self._slots)
+                          if s is not None]
+                if not active:
+                    if self.queue.draining and self.queue.depth() == 0:
+                        self._drained_evt.set()
+                        if self._stop_evt.wait(self._idle_wait):
+                            return
+                        continue
+                    self.queue.wait_nonempty(self._idle_wait)
+                    continue
+                self._drained_evt.clear()
+                self._step(active)
+        except BaseException as e:  # noqa: BLE001 — fail open requests
+            logger.exception("batcher loop failed")
+            msg = f"{type(e).__name__}: {e}"
+            for slot in self._slots:
+                if slot is not None:
+                    slot.req._finish(msg)
+                    self.failed += 1
+            self._slots = [None] * self.engine.slots
+            while True:
+                req = self.queue.pop()
+                if req is None:
+                    break
+                req._finish(msg)
+                self.failed += 1
+            self._drained_evt.set()
+
+    def _admit(self) -> None:
+        """Join queued requests at this step boundary while a free slot
+        AND enough KV blocks exist (block exhaustion keeps the request
+        queued — backpressure, not failure)."""
+        while True:
+            free = [i for i, s in enumerate(self._slots) if s is None]
+            if not free:
+                return
+            req = self.queue.peek()
+            if req is None:
+                return
+            blocks = self.blocks.allocate(req.id, req.total_budget)
+            if blocks is None:
+                return  # pool exhausted: wait for a retire
+            popped = self.queue.pop()
+            assert popped is req, "single-consumer queue invariant"
+            slot_id = free[0]
+            req.admitted_at = time.monotonic()
+            try:
+                first = self.engine.prefill_request(
+                    slot_id, req.tokens, req.temperature)
+            except Exception as e:
+                self.blocks.free(req.id)
+                req._finish(f"prefill failed: {type(e).__name__}: {e}")
+                self.failed += 1
+                continue
+            req.out_tokens.append(first)
+            with self._lock:
+                self.events.append(("admit", req.id, self.steps))
+            self.generated_tokens += 1
+            if self._finished(req, first):
+                self._retire(slot_id, req, admitted_only=True)
+                continue
+            self._slots[slot_id] = _Slot(
+                req, position=int(req.tokens.size), last_token=first)
+
+    def _step(self, active: List[int]) -> None:
+        slots = self.engine.slots
+        tokens = np.zeros((slots,), np.int32)
+        positions = np.zeros((slots,), np.int32)
+        temps = np.zeros((slots,), np.float32)
+        for i in active:
+            s = self._slots[i]
+            tokens[i] = s.last_token
+            positions[i] = s.position
+            temps[i] = s.req.temperature
+        next_tokens = self.engine.decode(tokens, positions, temps)
+        with self._lock:
+            self.steps += 1
+            self.active_steps += 1
+            self.occupancy_sum += len(active)
+            self.max_occupancy = max(self.max_occupancy, len(active))
+        for i in active:
+            s = self._slots[i]
+            tok = int(next_tokens[i])
+            s.req.out_tokens.append(tok)
+            self.generated_tokens += 1
+            s.position += 1
+            s.last_token = tok
+            if self._finished(s.req, tok):
+                self._retire(i, s.req)
+
+    @staticmethod
+    def _finished(req: Request, token: int) -> bool:
+        return (len(req.out_tokens) >= req.max_new_tokens
+                or (req.eos_id is not None and token == req.eos_id))
+
+    def _retire(self, slot_id: int, req: Request,
+                admitted_only: bool = False) -> None:
+        """Free the slot + KV blocks and complete the request — the rest
+        of the batch keeps decoding (no drain)."""
+        if not admitted_only:
+            self._slots[slot_id] = None
+        self.blocks.free(req.id)
+        req._finish()
+        with self._lock:
+            self.events.append(("retire", req.id, self.steps))
+            self.completed += 1
+
+    # -- stats ---------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            occ = (self.occupancy_sum / self.active_steps
+                   if self.active_steps else 0.0)
+            return {
+                "queue_depth": self.queue.depth(),
+                "queue_capacity": self.queue.maxsize,
+                "draining": self.queue.draining,
+                "active": self.active_count(),
+                "slots": self.engine.slots,
+                "steps": self.steps,
+                "mean_occupancy": round(occ, 3),
+                "max_occupancy": self.max_occupancy,
+                "completed": self.completed,
+                "failed": self.failed,
+                "generated_tokens": self.generated_tokens,
+                "rejected_full": self.queue.rejected_full,
+                "rejected_draining": self.queue.rejected_draining,
+                "dropped": self.queue.dropped,
+                "kv_blocks": self.blocks.stats(),
+            }

@@ -1,0 +1,217 @@
+"""`det serve` task entrypoint — one serve replica.
+
+Launched by the master as a SERVING task (`python3 -m
+determined_tpu.serve.task`; config travels in DET_SERVING_CONFIG), or
+locally via `det serve <config> --local`. Lifecycle:
+
+  1. build the model config (`serving.model` / `serving.model_config`),
+  2. load + integrity-verify a COMPLETED checkpoint (engine.py),
+  3. AOT-compile prefill buckets + decode, start the batcher + HTTP
+     front-end, report the proxy address to the master,
+  4. long-poll the allocation preemption signal (the same channel trials
+     use, core/_preempt.py): on a drain — spot notice, maintenance,
+     scheduler preemption — stop admitting, finish every accepted
+     request inside the grace window, and exit 0 so the master
+     reschedules the replica on surviving capacity
+     (docs/cluster-ops.md "Preemption & drain lifecycle").
+
+SIGTERM gets the same drain treatment, so `det deploy local down` and
+plain kills are graceful too.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger("determined_tpu.serve")
+
+DRAIN_SAFETY_MARGIN_S = 2.0
+
+
+def build_model(serving: Dict[str, Any]):
+    """serving.model/model_config → a models/* Config (gpt2 family)."""
+    import jax.numpy as jnp
+
+    from determined_tpu.models import gpt2
+
+    family = serving.get("model", "gpt2")
+    if family != "gpt2":
+        raise ValueError(
+            f"unknown serving.model {family!r}; supported: gpt2")
+    mc = dict(serving.get("model_config") or {})
+    size = mc.get("model_size", "small")
+    base = {
+        "tiny": gpt2.Config.tiny,
+        "small": gpt2.Config.small,
+        "medium": gpt2.Config.medium,
+        "large": gpt2.Config.large,
+    }[size]()
+    dtypes = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}
+    seq_len = int(mc.get("seq_len", base.n_positions))
+    # Every architecture dim is overridable: the config must reproduce
+    # the trained checkpoint's exact shapes or the engine's first trace
+    # fails loudly at startup (the intended failure mode for a mismatch).
+    return gpt2.Config(
+        vocab_size=int(mc.get("vocab_size", base.vocab_size)),
+        n_positions=max(int(mc.get("n_positions", base.n_positions)),
+                        seq_len),
+        d_model=int(mc.get("d_model", base.d_model)),
+        n_layer=int(mc.get("n_layer", base.n_layer)),
+        n_head=int(mc.get("n_head", base.n_head)),
+        dtype=dtypes[mc.get("dtype", "bfloat16")],
+        attention_impl="dot",  # decode attends over the KV cache directly
+        num_experts=int(mc.get("num_experts", 1)),
+        moe_top_k=int(mc.get("moe_top_k", 2)),
+    )
+
+
+def _trial_id_for(serving: Dict[str, Any]) -> int:
+    from determined_tpu.core._checkpoint import _STATE_ID_RE
+
+    ckpt = str(serving.get("checkpoint", "latest"))
+    m = _STATE_ID_RE.match(ckpt)
+    if m:
+        return int(m.group(1))
+    return int(serving.get("trial_id", 0))
+
+
+def build_replica(config: Dict[str, Any], session=None):
+    """Config → (engine, batcher). Shared by the cluster task, the local
+    CLI mode, tests, and the bench."""
+    from determined_tpu.core._checkpoint import CheckpointContext
+    from determined_tpu.serve.engine import (
+        ServingEngine, load_checkpoint_params)
+    from determined_tpu.serve.kv_cache import BlockManager
+    from determined_tpu.serve.scheduler import (
+        AdmissionQueue, ContinuousBatcher)
+    from determined_tpu.storage import from_config
+
+    serving = config.get("serving") or {}
+    cfg = build_model(serving)
+    storage = from_config(config.get("checkpoint_storage"))
+    ckpt_ctx = CheckpointContext(
+        session, storage, trial_id=_trial_id_for(serving), async_save=False)
+    params = load_checkpoint_params(
+        ckpt_ctx, str(serving.get("checkpoint", "latest")))
+
+    slots = int(serving.get("max_batch_size", 8))
+    max_seq = int(serving.get("max_seq_len", min(cfg.n_positions, 1024)))
+    engine = ServingEngine(
+        params, cfg,
+        slots=slots,
+        max_seq_len=max_seq,
+        prefill_buckets=serving.get("prefill_buckets"),
+        seed=int(serving.get("seed", 0)),
+    )
+    block_size = int(serving.get("kv_block_size", 16))
+    blocks = BlockManager(
+        num_blocks=slots * max(1, (engine.max_seq_len + block_size - 1)
+                               // block_size),
+        block_size=block_size,
+    )
+    queue = AdmissionQueue(maxsize=int(serving.get("queue_depth", 64)))
+    batcher = ContinuousBatcher(engine, queue=queue, block_manager=blocks)
+    return engine, batcher
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO, format="%(name)s: %(message)s")
+    argv = list(sys.argv[1:] if argv is None else argv)
+
+    raw = os.environ.get("DET_SERVING_CONFIG")
+    if raw is None and argv:
+        with open(argv[0]) as f:  # local mode: config file on the cli
+            raw = f.read()
+    if not raw:
+        print("no serving config (DET_SERVING_CONFIG or a config path)",
+              file=sys.stderr)
+        return 1
+    config = json.loads(raw) if raw.lstrip().startswith("{") else __import__(
+        "yaml").safe_load(raw)
+
+    master = os.environ.get("DET_MASTER")
+    allocation_id = os.environ.get("DET_ALLOCATION_ID")
+    session = None
+    if master and allocation_id:
+        from determined_tpu.common.api import Session
+
+        session = Session(master, os.environ.get("DET_SESSION_TOKEN"))
+
+    engine, batcher = build_replica(config, session=session)
+    batcher.start()  # compiles everything AOT before serving
+
+    from determined_tpu.serve.http import ServingServer
+
+    serving = config.get("serving") or {}
+    server = ServingServer(batcher, port=int(serving.get("port", 0)))
+    server.start()
+    addr = f"http://{socket.gethostname()}:{server.port}"
+    logger.info("serve replica up at %s (slots=%d, buckets=%s)",
+                addr, engine.slots, engine.prefill_buckets)
+
+    from determined_tpu.exec._util import report_proxy_address
+
+    report_proxy_address(addr)
+    if session is not None and allocation_id:
+        try:
+            session.post(f"/api/v1/allocations/{allocation_id}/ready")
+        except Exception:
+            logger.warning("ready report failed", exc_info=True)
+
+    # -- drain plumbing -------------------------------------------------
+    from determined_tpu.core._preempt import PreemptContext
+
+    preempt = PreemptContext(session, allocation_id)
+    drain_requested = threading.Event()
+
+    def _sigterm(signum, frame):
+        logger.info("SIGTERM: draining")
+        drain_requested.set()
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    signal.signal(signal.SIGINT, _sigterm)
+
+    stats_every = float(serving.get("stats_log_period_s", 30.0))
+    last_stats = time.monotonic()
+    try:
+        while not drain_requested.is_set():
+            if preempt.should_preempt():
+                logger.info(
+                    "preemption signal (%s): draining",
+                    preempt.preemption_reason() or "unspecified")
+                break
+            if stats_every and time.monotonic() - last_stats >= stats_every:
+                last_stats = time.monotonic()
+                logger.info("stats: %s", json.dumps(batcher.stats()))
+            time.sleep(0.5)
+
+        # Drain: stop admitting (HTTP 503), finish accepted work inside
+        # the grace window, then exit cleanly so the master reschedules.
+        deadline = preempt.preemption_deadline()
+        budget = (max(1.0, deadline - DRAIN_SAFETY_MARGIN_S)
+                  if deadline is not None else 60.0)
+        t0 = time.monotonic()
+        finished = batcher.drain(timeout=budget)
+        logger.info(
+            "drain %s in %.2fs (budget %.1fs): %s",
+            "complete" if finished else "TIMED OUT", time.monotonic() - t0,
+            budget, json.dumps(batcher.stats()))
+        # Clean exit either way — a blown budget means the node is about
+        # to die; rescheduling beats burning the rest of the grace.
+        return 0
+    finally:
+        server.stop()
+        batcher.stop()
+        preempt.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

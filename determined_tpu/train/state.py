@@ -50,6 +50,43 @@ def param_specs(param_logical_axes: Any, rules: Optional[LogicalRules] = None) -
     )
 
 
+def _lookup_axes(param_logical_axes: Any, path) -> Optional[tuple]:
+    """Logical-axis tuple at `path` (a tree_util key path) or None."""
+    node = param_logical_axes
+    for key in path:
+        name = getattr(key, "key", getattr(key, "idx", None))
+        if isinstance(node, dict) and name in node:
+            node = node[name]
+        elif isinstance(node, (list, tuple)) and isinstance(name, int) \
+                and not isinstance(node, tuple) and name < len(node):
+            node = node[name]
+        else:
+            return None
+    return node if isinstance(node, tuple) else None
+
+
+def aligned_param_specs(
+    params_shapes: Any,
+    param_logical_axes: Any,
+    rules: Optional[LogicalRules] = None,
+) -> Any:
+    """PartitionSpecs with the structure of the ACTUAL params tree.
+
+    `param_logical_axes` is a *partial* annotation: leaves it matches (by
+    key path) get their logical spec, everything else replicates. This keeps
+    a trial whose annotation tree drifts from its params tree (an override
+    of one but not the other) buildable — the annotation never dictates the
+    params structure, it only decorates it.
+    """
+    rules = rules or LogicalRules()
+
+    def spec_for(path, leaf):
+        axes = _lookup_axes(param_logical_axes, path)
+        return rules.spec(axes) if axes is not None else PartitionSpec()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params_shapes)
+
+
 def state_specs(
     init_fn: Callable[[jax.Array], Any],
     tx: optax.GradientTransformation,
@@ -65,7 +102,6 @@ def state_specs(
     param leaves by shape.
     """
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-    pspecs = param_specs(param_logical_axes, rules)
 
     def init_state(r):
         params = init_fn(r)
@@ -76,6 +112,10 @@ def state_specs(
         )
 
     shapes = jax.eval_shape(init_state, rng)
+    # Align the annotation to the ACTUAL params structure (partial
+    # annotation semantics: unmatched leaves replicate) — the specs tree
+    # must mirror shapes.params or out_shardings rejects the jit.
+    pspecs = aligned_param_specs(shapes.params, param_logical_axes, rules)
 
     flat_params, _ = jax.tree_util.tree_flatten(shapes.params)
     flat_pspecs, _ = jax.tree_util.tree_flatten(pspecs, is_leaf=lambda x: isinstance(x, PartitionSpec))

@@ -450,6 +450,12 @@ CREATE TABLE agent_notices (
 );
 CREATE INDEX idx_agent_notices_agent ON agent_notices(agent_id, id);
 )sql"},
+      // Serving tasks (`det serve`): a drained replica exits cleanly and
+      // is rescheduled onto surviving capacity; restarts counts those
+      // moves (spot churn visibility + the respawn bound).
+      {19, R"sql(
+ALTER TABLE tasks ADD COLUMN restarts INTEGER NOT NULL DEFAULT 0;
+)sql"},
   };
   return kMigrations;
 }

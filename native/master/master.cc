@@ -584,7 +584,8 @@ HttpResponse Master::route(const HttpRequest& req) {
     if (root == "task") return handle_task_logs(req);
     if (root == "tasks") return handle_tasks(req, rest);
     if (root == "commands" || root == "notebooks" || root == "shells" ||
-        root == "tensorboards" || root == "generic-tasks") {
+        root == "tensorboards" || root == "generic-tasks" ||
+        root == "serving") {
       return handle_ntsc(req, root, rest);
     }
     if (root == "runs") return handle_runs(req, rest);

@@ -591,6 +591,15 @@ void Master::on_allocation_exit_locked(Allocation& alloc) {
 
   ExperimentState* exp = find_experiment_locked(alloc.experiment_id);
   if (exp == nullptr) {
+    // Serving replicas survive their node: a preempt-exit off a draining
+    // agent (clean by contract — drain, finish in-flight, exit 0) or a
+    // node death respawns the replica on surviving capacity, bounded by
+    // max_restarts (docs/serving.md drain lifecycle).
+    if ((alloc.preempting || exit_code != 0) &&
+        requeue_serving_task_locked(alloc)) {
+      cv_.notify_all();
+      return;
+    }
     // Generic/NTSC task: terminal state follows the exit code.
     db_.exec(
         "UPDATE tasks SET state=?, end_time=datetime('now') "

@@ -62,6 +62,13 @@ NtscKind ntsc_kind(const std::string& kind) {
     // task trees with state propagation.
     return {"GENERIC", ""};
   }
+  if (kind == "serving") {
+    // `det serve` replicas (docs/serving.md): continuous-batching
+    // inference from a COMPLETED checkpoint. Unlike the interactive NTSC
+    // kinds a drained replica is RESCHEDULED, not finished
+    // (requeue_serving_task_locked).
+    return {"SERVING", "python3 -m determined_tpu.serve.task"};
+  }
   return {"COMMAND", ""};
 }
 
@@ -491,6 +498,55 @@ HttpResponse Master::handle_proxy(const HttpRequest& req,
   return out;
 }
 
+bool Master::requeue_serving_task_locked(const Allocation& old_alloc) {
+  // A serve replica that exited because its node drained (spot notice,
+  // maintenance) — or died with the node — is rescheduled onto surviving
+  // capacity, bounded by the config's max_restarts. Deliberately killed
+  // tasks (end_time set by kill_task_tree_locked) and non-SERVING tasks
+  // never respawn.
+  auto trows = db_.query(
+      "SELECT type, config, restarts, end_time FROM tasks WHERE id=?",
+      {Json(old_alloc.task_id)});
+  if (trows.empty()) return false;
+  if (trows[0]["type"].as_string() != "SERVING") return false;
+  if (!trows[0]["end_time"].as_string("").empty()) return false;
+  Json config = Json::parse_or_null(trows[0]["config"].as_string());
+  int64_t restarts = trows[0]["restarts"].as_int(0);
+  int64_t max_restarts = config["max_restarts"].as_int(5);
+  if (restarts >= max_restarts) return false;
+  db_.exec("UPDATE tasks SET restarts=? WHERE id=?",
+           {Json(restarts + 1), Json(old_alloc.task_id)});
+
+  Allocation alloc;
+  alloc.id = "alloc-" + old_alloc.task_id + "-r" +
+             std::to_string(restarts + 1);
+  alloc.task_id = old_alloc.task_id;
+  alloc.resource_pool = old_alloc.resource_pool;
+  alloc.slots = old_alloc.slots;
+  alloc.priority = old_alloc.priority;
+  alloc.submitted_at = now();
+  alloc.idle_timeout_s = old_alloc.idle_timeout_s;
+  alloc.last_activity = now();
+  alloc.owner_id = old_alloc.owner_id;
+  alloc.extra_env = old_alloc.extra_env;
+  alloc.excluded_agents = old_alloc.excluded_agents;
+  // Avoid the node that just drained: DRAINING exclusion usually covers
+  // it, but a fast agent re-register could race the respawn.
+  for (const auto& r : old_alloc.resources) {
+    alloc.excluded_agents.insert(r.agent_id);
+  }
+  db_.exec(
+      "INSERT INTO allocations (id, task_id, resource_pool, slots) "
+      "VALUES (?, ?, ?, ?)",
+      {Json(alloc.id), Json(alloc.task_id), Json(alloc.resource_pool),
+       Json(static_cast<int64_t>(alloc.slots))});
+  std::string aid = alloc.id;
+  allocations_[aid] = std::move(alloc);
+  pending_.push_back(aid);
+  cv_.notify_all();
+  return true;
+}
+
 HttpResponse Master::handle_ntsc(const HttpRequest& req,
                                  const std::string& kind,
                                  const std::vector<std::string>& parts) {
@@ -540,7 +596,10 @@ HttpResponse Master::handle_ntsc(const HttpRequest& req,
     alloc.task_id = task_id;
     alloc.resource_pool =
         config["resources"]["resource_pool"].as_string(cfg_.default_pool);
-    alloc.slots = static_cast<int>(config["resources"]["slots"].as_int(0));
+    // Serving configs go through expconf (which normalizes to
+    // slots_per_trial); raw NTSC configs say `slots`. Accept both.
+    alloc.slots = static_cast<int>(config["resources"]["slots"].as_int(
+        config["resources"]["slots_per_trial"].as_int(0)));
     alloc.priority = static_cast<int>(config["resources"]["priority"].as_int(42));
     alloc.submitted_at = now();
     alloc.idle_timeout_s = config["idle_timeout_s"].as_double(0);
@@ -558,6 +617,11 @@ HttpResponse Master::handle_ntsc(const HttpRequest& req,
     }
     alloc.extra_env["DET_ENTRYPOINT"] = Json(entrypoint);
     alloc.extra_env["DET_TASK_TYPE"] = Json(meta.type);
+    if (kind == "serving") {
+      // The replica rebuilds the engine purely from this config (model,
+      // checkpoint id, batcher capacity — determined_tpu/serve/task.py).
+      alloc.extra_env["DET_SERVING_CONFIG"] = Json(config.dump());
+    }
     if (config["experiment_ids"].is_array()) {
       alloc.extra_env["DET_EXPERIMENT_IDS"] =
           Json(config["experiment_ids"].dump());
@@ -585,18 +649,20 @@ HttpResponse Master::handle_ntsc(const HttpRequest& req,
   // GET list
   if (parts.size() == 1 && req.method == "GET") {
     auto rows = db_.query(
-        "SELECT id, type, state, config, start_time, end_time FROM tasks "
-        "WHERE type=? ORDER BY start_time DESC",
+        "SELECT id, type, state, config, restarts, start_time, end_time "
+        "FROM tasks WHERE type=? ORDER BY start_time DESC",
         {Json(meta.type)});
     Json tasks = Json::array();
     std::lock_guard<std::mutex> lock(mu_);
     for (auto& row : rows) {
       Json t = row_to_json(row);
       t["config"] = Json::parse_or_null(t["config"].as_string());
-      // Surface live allocation state + proxy address.
+      // Surface live allocation state + proxy address (+ drain-in-
+      // progress, so `det serve status` shows a replica mid-move).
       for (const auto& [aid, a] : allocations_) {
-        if (a.task_id == row["id"].as_string()) {
+        if (a.task_id == row["id"].as_string() && a.state != "TERMINATED") {
           t["allocation_state"] = a.state;
+          t["draining"] = a.preempting;
           if (!a.proxy_addresses.empty()) {
             t["proxy_address"] = a.proxy_addresses.begin()->second;
           }
@@ -637,8 +703,9 @@ HttpResponse Master::handle_ntsc(const HttpRequest& req,
       t["config"] = Json::parse_or_null(t["config"].as_string());
       std::lock_guard<std::mutex> lock(mu_);
       for (const auto& [aid, a] : allocations_) {
-        if (a.task_id == task_id) {
+        if (a.task_id == task_id && a.state != "TERMINATED") {
           t["allocation_state"] = a.state;
+          t["draining"] = a.preempting;
           if (!a.proxy_addresses.empty()) {
             t["proxy_address"] = a.proxy_addresses.begin()->second;
           }

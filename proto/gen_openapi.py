@@ -173,6 +173,18 @@ for kind in ("commands", "notebooks", "shells", "tensorboards",
          "Kill (propagates down the task tree)"),
     ]
 
+# Serving (`det serve`, docs/serving.md): same task-shaped lifecycle, its
+# own tag — replicas are rescheduled on drain rather than finished.
+ROUTES += [
+    ("get", "/api/v1/serving", "serving",
+     "List serving tasks (allocation state, proxy address, restarts)"),
+    ("post", "/api/v1/serving", "serving",
+     "Launch a serve replica (config.serving/resources/checkpoint_storage)"),
+    ("get", "/api/v1/serving/{id}", "serving", "Get serving task"),
+    ("post", "/api/v1/serving/{id}/kill", "serving",
+     "Kill the serving task (no respawn)"),
+]
+
 
 def build() -> dict:
     paths: dict = {}

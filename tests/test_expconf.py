@@ -309,6 +309,55 @@ class TestPreemptionBlock:
         assert out["preemption"]["emergency_checkpoint"] is True
 
 
+class TestServingBlock:
+    """`serving:` — a det serve deployment config (docs/serving.md)."""
+
+    def _config(self, **serving):
+        return {
+            "name": "serve-test",
+            "serving": {"checkpoint": "trial0-step2", **serving},
+            "checkpoint_storage": {"type": "shared_fs",
+                                   "host_path": "/tmp/x"},
+        }
+
+    def test_minimal_serving_config_valid(self):
+        # No entrypoint, no searcher: serving configs are deployments.
+        assert expconf.validate(self._config()) == []
+
+    def test_defaults_fill_capacity_knobs(self):
+        c = expconf.check(self._config())
+        s = c["serving"]
+        assert s["max_batch_size"] == 8
+        assert s["max_seq_len"] == 256
+        assert s["kv_block_size"] == 16
+        assert s["queue_depth"] == 64
+        assert s["model"] == "gpt2"
+        # and no searcher machinery was bolted on
+        assert "searcher" not in c
+
+    def test_unknown_keys_flagged(self):
+        errs = expconf.validate(self._config(batch_sise=4))
+        assert any("unknown keys" in e for e in errs)
+
+    def test_bad_values_flagged(self):
+        errs = expconf.validate(self._config(max_batch_size=0))
+        assert any("max_batch_size" in e for e in errs)
+        errs = expconf.validate(self._config(model="bert"))
+        assert any("serving.model" in e for e in errs)
+        errs = expconf.validate(self._config(prefill_buckets=[64, 32]))
+        assert any("ascending" in e for e in errs)
+        errs = expconf.validate(self._config(prefill_buckets=[]))
+        assert any("prefill_buckets" in e for e in errs)
+
+    def test_serving_must_be_mapping(self):
+        errs = expconf.validate({"name": "x", "serving": "yes"})
+        assert any("serving must be a mapping" in e for e in errs)
+
+    def test_trial_configs_still_require_searcher(self):
+        errs = expconf.validate({"name": "x", "entrypoint": "python3 t.py"})
+        assert any("searcher is required" in e for e in errs)
+
+
 class TestCrossFieldDiagnostics:
     """Cross-field checks surface as DTL rules (the same codes the native
     master enforces at experiment create), not bare exceptions."""

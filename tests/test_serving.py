@@ -1,0 +1,639 @@
+"""`det serve` — continuous batching, KV accounting, drain (docs/serving.md).
+
+Fast tier-1 tests pin the batcher core contracts: admission/backpressure,
+join-at-step-boundary + retire-without-drain ordering, KV block
+reuse/free accounting, decode-vs-full-forward equivalence (the KV cache
+produces bit-identical greedy generations), the ISSUE-6 acceptance burst
+(>= 32 concurrent requests, batch occupancy > 1), drain semantics
+(stop-admitting → finish in-flight, zero dropped), integrity-verified
+checkpoint loading with lineage fallback, and the HTTP front-end's
+status-code contract. The `-m slow` e2e drives a real devcluster:
+submit → serve through the master proxy → spot-notice drain → replica
+reschedule onto the survivor.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from determined_tpu import core
+from determined_tpu.common import faultpoint
+from determined_tpu.models import gpt2
+from determined_tpu.serve import (
+    AdmissionQueue,
+    BlockManager,
+    ContinuousBatcher,
+    Draining,
+    KVBlockError,
+    QueueFull,
+    Request,
+    ServingEngine,
+    load_checkpoint_params,
+)
+from determined_tpu.serve.scheduler import FAULT_POINT_DROP
+
+# Tiny f32 config: CPU-fast, and float32 keeps the cached-decode vs
+# full-forward argmax comparison exact (bf16 rounding could flip ties).
+TINY = gpt2.Config(
+    vocab_size=128, n_positions=64, d_model=32, n_layer=2, n_head=2,
+    dtype=jnp.float32, remat=False, attention_impl="dot",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultpoint.disarm_all()
+    yield
+    faultpoint.disarm_all()
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return gpt2.init(jax.random.PRNGKey(0), TINY)
+
+
+def make_engine(params, slots=4, max_seq=32, buckets=(8, 16, 32)):
+    return ServingEngine(params, TINY, slots=slots, max_seq_len=max_seq,
+                         prefill_buckets=list(buckets))
+
+
+def reference_greedy(params, prompt, n):
+    """Full-forward greedy generation — the ground truth the KV-cached
+    path must reproduce exactly."""
+    ctx = [int(t) for t in prompt]
+    out = []
+    for _ in range(n):
+        logits = gpt2.apply(params, jnp.asarray([ctx], jnp.int32), TINY)
+        tok = int(jnp.argmax(logits[0, -1]))
+        out.append(tok)
+        ctx.append(tok)
+    return out
+
+
+def make_batcher(engine, queue_size=64, num_blocks=None, block_size=8):
+    blocks = BlockManager(
+        num_blocks=num_blocks if num_blocks is not None
+        else engine.slots * (engine.max_seq_len // block_size),
+        block_size=block_size)
+    return ContinuousBatcher(
+        engine, queue=AdmissionQueue(queue_size), block_manager=blocks,
+        idle_wait_s=0.005)
+
+
+# ---------------------------------------------------------------------------
+# KV block manager: allocation, reuse/free accounting, failure modes.
+# ---------------------------------------------------------------------------
+
+
+def test_block_manager_allocate_free_roundtrip():
+    bm = BlockManager(num_blocks=8, block_size=4)
+    assert bm.blocks_for_tokens(1) == 1
+    assert bm.blocks_for_tokens(4) == 1
+    assert bm.blocks_for_tokens(5) == 2
+    blocks = bm.allocate("a", 10)  # 3 blocks
+    assert len(blocks) == 3 and bm.free_blocks == 5 and bm.used_blocks == 3
+    assert bm.free("a") == 3
+    assert bm.free_blocks == 8
+    assert bm.stats()["total_allocated"] == 3
+    assert bm.stats()["total_freed"] == 3
+
+
+def test_block_manager_exhaustion_is_backpressure_not_error():
+    bm = BlockManager(num_blocks=4, block_size=4)
+    assert bm.allocate("a", 16) is not None  # all 4 blocks
+    assert not bm.can_allocate(1)
+    assert bm.allocate("b", 1) is None       # exhausted: None, no raise
+    bm.free("a")
+    assert bm.allocate("b", 1) is not None   # freed capacity admits it
+
+
+def test_block_manager_reuse_accounting():
+    bm = BlockManager(num_blocks=4, block_size=4)
+    bm.allocate("a", 8)
+    bm.free("a")
+    bm.allocate("b", 8)  # reuses a's two blocks
+    assert bm.stats()["total_reused"] == 2
+
+
+def test_block_manager_extend():
+    bm = BlockManager(num_blocks=3, block_size=4)
+    bm.allocate("a", 4)
+    assert bm.extend("a", 8) is True    # +1 block
+    assert bm.extend("a", 8) is True    # already covered: no-op
+    assert bm.extend("a", 100) is False  # pool can't cover
+    assert bm.free("a") == 2
+
+
+def test_block_manager_misuse_raises():
+    bm = BlockManager(num_blocks=4, block_size=4)
+    bm.allocate("a", 4)
+    with pytest.raises(KVBlockError):
+        bm.allocate("a", 4)  # double allocate
+    bm.free("a")
+    with pytest.raises(KVBlockError):
+        bm.free("a")         # double free
+    with pytest.raises(KVBlockError):
+        bm.extend("ghost", 4)
+
+
+# ---------------------------------------------------------------------------
+# Admission queue: bounded backpressure, drain, chaos.
+# ---------------------------------------------------------------------------
+
+
+def _req(n_prompt=4, max_new=4, **kw):
+    return Request(np.arange(1, 1 + n_prompt, dtype=np.int32),
+                   max_new_tokens=max_new, **kw)
+
+
+def test_queue_backpressure():
+    q = AdmissionQueue(maxsize=2)
+    q.submit(_req())
+    q.submit(_req())
+    with pytest.raises(QueueFull):
+        q.submit(_req())
+    assert q.rejected_full == 1 and q.depth() == 2
+    q.pop()
+    q.submit(_req())  # capacity freed → admits again
+
+
+def test_queue_drain_stops_admissions():
+    q = AdmissionQueue(maxsize=4)
+    q.submit(_req())
+    q.drain()
+    with pytest.raises(Draining):
+        q.submit(_req())
+    assert q.rejected_draining == 1
+    assert q.depth() == 1  # accepted work stays queued
+    q.undrain()
+    q.submit(_req())
+
+
+def test_queue_fault_point_drop_and_error():
+    q = AdmissionQueue(maxsize=4)
+    faultpoint.arm(FAULT_POINT_DROP, "drop", count=1)
+    with pytest.raises(QueueFull, match="shed"):
+        q.submit(_req())
+    assert q.dropped == 1
+    faultpoint.arm(FAULT_POINT_DROP, "error", count=1)
+    with pytest.raises(faultpoint.FaultInjected):
+        q.submit(_req())
+    q.submit(_req())  # disarmed again: admits
+
+
+# ---------------------------------------------------------------------------
+# Engine: KV-cached decode == full forward; buckets.
+# ---------------------------------------------------------------------------
+
+
+def test_cached_decode_matches_full_forward(tiny_params):
+    eng = make_engine(tiny_params, slots=2)
+    eng.compile()
+    prompt = np.array([5, 9, 17, 3], np.int32)
+    first = eng.prefill_request(0, prompt)
+    out = [first]
+    tokens = np.zeros(2, np.int32)
+    positions = np.zeros(2, np.int32)
+    temps = np.zeros(2, np.float32)
+    pos, last = len(prompt), first
+    for _ in range(7):
+        tokens[0], positions[0] = last, pos
+        last = int(eng.decode(tokens, positions, temps)[0])
+        out.append(last)
+        pos += 1
+    assert out == reference_greedy(tiny_params, prompt, 8)
+
+
+def test_bucket_selection(tiny_params):
+    eng = make_engine(tiny_params, buckets=(8, 16, 32))
+    assert eng.bucket_for(1) == 8
+    assert eng.bucket_for(8) == 8
+    assert eng.bucket_for(9) == 16
+    assert eng.bucket_for(32) == 32
+    assert eng.bucket_for(33) is None
+
+
+def test_engine_compiles_every_bucket_aot(tiny_params):
+    eng = make_engine(tiny_params, buckets=(8, 16))
+    stats = eng.compile()
+    assert set(eng._compiled_prefill) == {8, 16}
+    assert stats["decode_s"] > 0 and "total_s" in stats
+
+
+# ---------------------------------------------------------------------------
+# Continuous batcher: the ISSUE-6 acceptance contracts.
+# ---------------------------------------------------------------------------
+
+
+def test_burst_completes_with_occupancy_above_one(tiny_params):
+    """Acceptance: a burst of >= 32 concurrent requests completes with
+    batch occupancy > 1, and every result is the exact full-forward
+    greedy generation (continuous batching changes scheduling, never
+    content)."""
+    eng = make_engine(tiny_params, slots=4)
+    b = make_batcher(eng).start()
+    try:
+        rng = np.random.default_rng(0)
+        reqs = [
+            b.submit(Request(
+                rng.integers(1, 100, size=int(rng.integers(2, 7))),
+                max_new_tokens=int(rng.integers(3, 10))))
+            for _ in range(32)
+        ]
+        results = [r.result(timeout=120) for r in reqs]
+        stats = b.stats()
+        assert stats["completed"] == 32
+        assert stats["mean_occupancy"] > 1.0, stats
+        assert stats["max_occupancy"] > 1
+        # Spot-check content against the reference (first + last).
+        for req, res in [(reqs[0], results[0]), (reqs[-1], results[-1])]:
+            assert res["tokens"] == reference_greedy(
+                tiny_params, req.tokens, req.max_new_tokens)
+    finally:
+        b.stop()
+
+
+def test_join_at_boundary_retire_without_drain(tiny_params):
+    """With 2 slots and 3 requests, the 3rd joins at the step boundary
+    where the 1st retires, while the 2nd keeps decoding — the batch
+    NEVER drains to refill."""
+    eng = make_engine(tiny_params, slots=2)
+    b = make_batcher(eng).start()
+    try:
+        r1 = b.submit(_req(n_prompt=3, max_new=2))
+        r2 = b.submit(_req(n_prompt=3, max_new=12))
+        r3 = b.submit(_req(n_prompt=3, max_new=2))
+        for r in (r1, r2, r3):
+            r.result(timeout=60)
+        ev = {(kind, rid): step for kind, rid, step in b.events}
+        # r1 and r2 joined before r3 (only 2 slots).
+        assert ev[("admit", r3.id)] >= ev[("retire", r1.id)]
+        # retire-without-drain: r2 was still mid-decode when r3 joined —
+        # its retirement happened strictly after r3's admission.
+        assert ev[("retire", r2.id)] > ev[("admit", r3.id)]
+    finally:
+        b.stop()
+
+
+def test_kv_blocks_gate_admission(tiny_params):
+    """Block exhaustion keeps requests queued (occupancy 1) until a
+    retire frees capacity — backpressure, not failure."""
+    eng = make_engine(tiny_params, slots=4)
+    # Pool covers exactly one worst-case sequence at a time.
+    b = make_batcher(eng, num_blocks=1, block_size=16)
+    b.start()
+    try:
+        reqs = [b.submit(_req(n_prompt=4, max_new=6)) for _ in range(3)]
+        for r in reqs:
+            r.result(timeout=60)
+        stats = b.stats()
+        assert stats["completed"] == 3
+        assert stats["max_occupancy"] == 1, (
+            "block pool for one sequence must serialize the batch")
+    finally:
+        b.stop()
+
+
+def test_kv_accounting_balances_after_load(tiny_params):
+    eng = make_engine(tiny_params, slots=4)
+    b = make_batcher(eng).start()
+    try:
+        reqs = [b.submit(_req(n_prompt=5, max_new=5)) for _ in range(12)]
+        for r in reqs:
+            r.result(timeout=60)
+        kv = b.stats()["kv_blocks"]
+        assert kv["used_blocks"] == 0
+        assert kv["free_blocks"] == kv["num_blocks"]
+        assert kv["total_freed"] == kv["total_allocated"] > 0
+        assert kv["total_reused"] > 0  # retired blocks cycled back in
+    finally:
+        b.stop()
+
+
+def test_drain_finishes_accepted_work_zero_dropped(tiny_params):
+    """Drain contract: stop admitting (Draining), but every accepted
+    request — queued or in-flight — completes successfully."""
+    eng = make_engine(tiny_params, slots=2)
+    b = make_batcher(eng).start()
+    try:
+        reqs = [b.submit(_req(n_prompt=4, max_new=10)) for _ in range(6)]
+        assert b.drain(timeout=None) in (True, False)  # signal only
+        with pytest.raises(Draining):
+            b.submit(_req())
+        assert b.drain(timeout=60) is True
+        results = [r.result(timeout=5) for r in reqs]  # none dropped
+        assert all(len(res["tokens"]) == 10 for res in results)
+        stats = b.stats()
+        assert stats["completed"] == 6 and stats["failed"] == 0
+        assert stats["rejected_draining"] == 1
+    finally:
+        b.stop()
+
+
+def test_submit_validates_against_engine_limits(tiny_params):
+    eng = make_engine(tiny_params, slots=2, max_seq=32, buckets=(8, 16))
+    b = make_batcher(eng)
+    with pytest.raises(ValueError, match="prefill bucket"):
+        b.submit(_req(n_prompt=20))  # no bucket covers 20
+    with pytest.raises(ValueError, match="max_seq_len"):
+        b.submit(_req(n_prompt=16, max_new=20))  # 36 > 32 budget
+
+
+def test_batcher_stop_fails_outstanding(tiny_params):
+    eng = make_engine(tiny_params, slots=2)
+    b = make_batcher(eng).start()
+    r = b.submit(_req(n_prompt=4, max_new=28))
+    b.stop()
+    with pytest.raises((RuntimeError, TimeoutError)):
+        r.result(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint loading: COMPLETED-verified, lineage fallback.
+# ---------------------------------------------------------------------------
+
+
+def _save_checkpoint(tmp_path, params, steps, extra_poison=None):
+    ctx = core.init(max_length=steps,
+                    checkpoint_dir=str(tmp_path / "ckpts"))
+    state = {"step": jnp.asarray(steps, jnp.int32), "params": params,
+             "opt_state": {"count": jnp.zeros((), jnp.int32)}}
+    sid = ctx.checkpoint.save_state(state, steps)
+    ctx.checkpoint.wait()
+    ctx.close()
+    return ctx, sid
+
+
+def test_load_checkpoint_params_roundtrip(tmp_path, tiny_params):
+    ctx, sid = _save_checkpoint(tmp_path, tiny_params, 2)
+    loaded = load_checkpoint_params(ctx.checkpoint, sid)
+    flat_a = jax.tree_util.tree_leaves(tiny_params)
+    flat_b = jax.tree_util.tree_leaves(loaded)
+    assert len(flat_a) == len(flat_b)
+    assert all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(flat_a, flat_b))
+
+
+def test_load_checkpoint_latest_resolves_lineage(tmp_path, tiny_params):
+    _save_checkpoint(tmp_path, tiny_params, 2)
+    ctx, _ = _save_checkpoint(tmp_path, tiny_params, 4)
+    loaded = load_checkpoint_params(ctx.checkpoint, "latest")
+    assert loaded is not None
+
+
+def test_load_checkpoint_corrupt_falls_back_through_lineage(
+        tmp_path, tiny_params):
+    """A torn latest checkpoint must never be served: verification fails
+    and the previous COMPLETED checkpoint loads instead."""
+    _save_checkpoint(tmp_path, tiny_params, 2)
+    ctx, sid4 = _save_checkpoint(tmp_path, tiny_params, 4)
+    path4 = ctx.checkpoint._storage.path_for(sid4)
+    victim = None
+    for root, _, files in os.walk(os.path.join(path4, "state")):
+        for f in files:
+            victim = os.path.join(root, f)
+    with open(victim, "r+b") as f:
+        f.truncate(max(0, os.path.getsize(victim) // 2))
+    loaded = load_checkpoint_params(ctx.checkpoint, sid4)
+    assert loaded is not None  # fell back to trial0-step2
+
+
+def test_load_checkpoint_nothing_completed_raises(tmp_path):
+    ctx = core.init(max_length=2, checkpoint_dir=str(tmp_path / "ckpts"))
+    with pytest.raises(FileNotFoundError):
+        load_checkpoint_params(ctx.checkpoint, "latest")
+    ctx.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP front-end: the status-code contract load balancers act on.
+# ---------------------------------------------------------------------------
+
+
+def _http(method, url, body=None, timeout=30.0):
+    req = urllib.request.Request(
+        url, method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+@pytest.fixture()
+def http_replica(tiny_params):
+    from determined_tpu.serve.http import ServingServer
+
+    eng = make_engine(tiny_params, slots=2)
+    b = make_batcher(eng).start()
+    server = ServingServer(b, host="127.0.0.1", port=0).start()
+    yield f"http://127.0.0.1:{server.port}", b
+    server.stop()
+    b.stop()
+
+
+def test_http_generate_stats_health(http_replica, tiny_params):
+    url, _ = http_replica
+    status, body = _http("POST", url + "/v1/generate",
+                         {"tokens": [5, 9, 17, 3], "max_new_tokens": 6})
+    assert status == 200
+    assert body["tokens"] == reference_greedy(
+        tiny_params, [5, 9, 17, 3], 6)
+    assert body["latency_ms"] >= body["queue_ms"] >= 0
+    status, stats = _http("GET", url + "/v1/stats")
+    assert status == 200 and stats["completed"] >= 1
+    assert stats["engine"]["prefill_buckets"]
+    status, health = _http("GET", url + "/healthz")
+    assert (status, health["status"]) == (200, "ok")
+
+
+def test_http_error_codes(http_replica):
+    url, batcher = http_replica
+    status, body = _http("POST", url + "/v1/generate", {"tokens": []})
+    assert status == 400
+    status, body = _http("POST", url + "/v1/generate",
+                         {"tokens": list(range(1, 30))})  # no bucket
+    assert status == 400
+    batcher.queue.drain()
+    status, body = _http("POST", url + "/v1/generate",
+                         {"tokens": [1, 2], "max_new_tokens": 2})
+    assert status == 503
+    status, health = _http("GET", url + "/healthz")
+    assert health["status"] == "draining"
+    batcher.queue.undrain()
+    status, _ = _http("POST", url + "/v1/generate",
+                      {"tokens": [1, 2], "max_new_tokens": 2})
+    assert status == 200
+
+
+# ---------------------------------------------------------------------------
+# Devcluster e2e (slow): submit → serve → drain → replica reschedule.
+# ---------------------------------------------------------------------------
+
+
+def _serving_config(tmp_path, sid="trial0-step2"):
+    return {
+        "name": "serve-e2e",
+        "serving": {
+            "checkpoint": sid,
+            "model": "gpt2",
+            "model_config": {"model_size": "tiny", "seq_len": 64,
+                             "dtype": "float32",
+                             "vocab_size": TINY.vocab_size,
+                             "n_positions": 64,
+                             "d_model": TINY.d_model,
+                             "n_layer": TINY.n_layer,
+                             "n_head": TINY.n_head},
+            "max_batch_size": 4,
+            "max_seq_len": 32,
+            "prefill_buckets": [8, 16],
+            "queue_depth": 32,
+        },
+        "resources": {"slots_per_trial": 1},
+        "checkpoint_storage": {
+            "type": "shared_fs",
+            "host_path": os.path.join(str(tmp_path), "ckpts"),
+        },
+    }
+
+
+@pytest.mark.slow
+def test_serve_drain_reschedule_e2e(tmp_path):
+    """Acceptance: a serve replica under load receives a spot notice —
+    it stops admitting, finishes every in-flight sequence inside the
+    grace window (zero dropped), exits cleanly, and the master
+    reschedules it onto the surviving agent (restarts >= 1, fresh proxy
+    address, serving again)."""
+    from tests.test_platform_e2e import NATIVE_BIN, Devcluster
+    import subprocess
+
+    subprocess.run(["make", "-C", os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "native")], check=True, capture_output=True)
+
+    # A checkpoint to serve. The tiny model must match the serve config;
+    # TINY here uses n_positions=64 to cover seq_len.
+    cfg = gpt2.Config(
+        vocab_size=TINY.vocab_size, n_positions=64, d_model=32,
+        n_layer=2, n_head=2, dtype=jnp.float32, remat=False,
+        attention_impl="dot")
+    params = gpt2.init(jax.random.PRNGKey(0), cfg)
+    ctx = core.init(max_length=2,
+                    checkpoint_dir=os.path.join(str(tmp_path), "ckpts"))
+    ctx.checkpoint.save_state(
+        {"step": jnp.asarray(2, jnp.int32), "params": params,
+         "opt_state": {"count": jnp.zeros((), jnp.int32)}}, 2)
+    ctx.checkpoint.wait()
+    ctx.close()
+
+    c = Devcluster(str(tmp_path), NATIVE_BIN, slots=1)
+    c.start_master()
+    notice_files = {}
+    for agent_id in ("serve-a", "serve-b"):
+        nf = os.path.join(str(tmp_path), f"notice-{agent_id}.json")
+        notice_files[agent_id] = nf
+        c.start_agent(agent_id, extra_env={"DET_AGENT_NOTICE_FILE": nf})
+    try:
+        token = c.login()
+        resp = c.api("POST", "/api/v1/serving",
+                     {"config": _serving_config(tmp_path)}, token=token)
+        tid = resp["id"]
+
+        def _task():
+            return c.api("GET", f"/api/v1/serving/{tid}",
+                         token=token)["task"]
+
+        # Wait for the replica to come up and register its address.
+        deadline = time.time() + 180
+        task = None
+        while time.time() < deadline:
+            task = _task()
+            if task.get("proxy_address"):
+                break
+            time.sleep(0.5)
+        assert task and task.get("proxy_address"), task
+
+        def generate(max_new=8, timeout=60):
+            return c.api(
+                "POST", f"/proxy/{tid}/v1/generate",
+                {"tokens": [5, 9, 17, 3], "max_new_tokens": max_new,
+                 "timeout_s": timeout},
+                token=token)
+
+        first = generate(max_new=4)
+        assert len(first["tokens"]) == 4
+
+        # Which agent hosts the replica? (serving allocation ids embed
+        # the task id: alloc-{task_id}[-rN])
+        jobs = c.api("GET", "/api/v1/job-queues", token=token)["jobs"]
+        alloc_id = next(j["allocation_id"] for j in jobs
+                        if tid in str(j.get("allocation_id", "")))
+        alloc = c.api("GET", f"/api/v1/allocations/{alloc_id}",
+                      token=token)["allocation"]
+        victim = alloc["resources"][0]["agent_id"]
+        survivor = "serve-b" if victim == "serve-a" else "serve-a"
+
+        # Load in flight while the notice lands: every accepted request
+        # must complete (zero dropped responses).
+        results, errors = [], []
+
+        def _loader():
+            for _ in range(4):
+                try:
+                    results.append(generate(max_new=16, timeout=90))
+                except Exception as e:  # 503s after drain are expected
+                    errors.append(str(e))
+
+        threads = [threading.Thread(target=_loader) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        with open(notice_files[victim], "w") as f:
+            json.dump({"deadline_seconds": 30,
+                       "reason": "spot_preemption"}, f)
+        for t in threads:
+            t.join(timeout=180)
+
+        # Every response that came back is complete; HTTP-level
+        # rejections (503 while draining) are allowed, dropped/truncated
+        # responses are not.
+        assert results, "no request completed during the drain window"
+        assert all(len(r["tokens"]) == 16 for r in results), results
+
+        # The replica reschedules onto the survivor with restarts >= 1
+        # and serves again from its new address.
+        deadline = time.time() + 180
+        moved = None
+        while time.time() < deadline:
+            task = _task()
+            if int(task.get("restarts") or 0) >= 1 and \
+                    task.get("allocation_state") == "RUNNING" and \
+                    task.get("proxy_address"):
+                jobs = c.api("GET", "/api/v1/job-queues",
+                             token=token)["jobs"]
+                for j in jobs:
+                    a = c.api("GET",
+                              f"/api/v1/allocations/{j['allocation_id']}",
+                              token=token)["allocation"]
+                    if a.get("task_id") == tid and a["state"] == "RUNNING":
+                        moved = a["resources"][0]["agent_id"]
+                if moved:
+                    break
+            time.sleep(0.5)
+        assert moved == survivor, (
+            f"replica did not reschedule onto {survivor}: task={task}")
+        again = generate(max_new=4)
+        assert len(again["tokens"]) == 4
+        c.api("POST", f"/api/v1/serving/{tid}/kill", {}, token=token)
+    finally:
+        c.stop()

@@ -881,6 +881,7 @@ async function pageTasks() {
     SHELL: (id) => API.postShellsIdKill(id),
     TENSORBOARD: (id) => API.postTensorboardsIdKill(id),
     GENERIC: (id) => API.postGenericTasksIdKill(id),
+    SERVING: (id) => API.postServingIdKill(id),
   };
   view.append(el("table", {},
     el("tr", {}, ["ID", "Type", "State", "Started", "Ended", ""]
@@ -899,6 +900,37 @@ async function pageTasks() {
           catch (e) { err.textContent = `kill failed: ${e.message}`; }
         } }, "kill") : "")))));
   if (!tasks.length) view.append(el("p", { class: "muted" }, "no tasks"));
+  view.append(err);
+}
+
+async function pageServing() {
+  const { serving } = await API.getServing();
+  view.textContent = "";
+  view.append(el("h1", {}, "Serving"));
+  const err = el("span", { class: "error" });
+  view.append(el("table", {},
+    el("tr", {}, ["ID", "State", "Address", "Restarts", "Started", ""]
+      .map((h) => el("th", {}, h))),
+    serving.map((t) => el("tr", {},
+      el("td", {}, el("a", { href: `#/tasks/${t.id}` }, t.id)),
+      el("td", {}, t.draining
+        ? stateBadge("DRAINING")
+        : stateBadge(
+          ["COMPLETED", "ERROR", "CANCELED"].includes(t.state)
+            ? t.state : (t.allocation_state ?? t.state))),
+      el("td", { class: "muted" }, t.proxy_address ?? ""),
+      el("td", {}, t.restarts ?? 0),
+      el("td", { class: "muted" }, t.start_time ?? ""),
+      el("td", {}, !["COMPLETED", "ERROR", "CANCELED"].includes(t.state)
+        ? el("button", {
+          onclick: async () => {
+            try { await API.postServingIdKill(t.id); pageServing(); }
+            catch (e) { err.textContent = `kill failed: ${e.message}`; }
+          } }, "kill") : "")))));
+  if (!serving.length) {
+    view.append(el("p", { class: "muted" },
+      "no serving tasks — launch one with `det serve <config>`"));
+  }
   view.append(err);
 }
 
@@ -1014,6 +1046,7 @@ async function route() {
     const tk = hash.match(/^#\/tasks\/([\w\-]+)/);
     if (tk) return await pageTaskLogs(tk[1]);
     if (hash.startsWith("#/tasks")) return await pageTasks();
+    if (hash.startsWith("#/serving")) return await pageServing();
     if (hash.startsWith("#/admin")) return await pageAdmin();
     if (hash.startsWith("#/workspaces")) return await pageWorkspaces();
     if (hash.startsWith("#/models")) return await pageModels();
