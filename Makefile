@@ -24,7 +24,7 @@ CHAOS_TIMEOUT ?= 1800
 chaos:
 	timeout -k 30 $(CHAOS_TIMEOUT) $(PY) -m pytest \
 		tests/test_chaos.py tests/test_selfheal.py tests/test_preemption.py \
-		tests/test_serving.py \
+		tests/test_serving.py tests/test_elastic.py \
 		-q -m slow
 
 # Async input pipeline A/B: prefetch on/off step time + input_wait_ms
@@ -38,6 +38,12 @@ bench-input:
 # serve_p50_ms, serve_p99_ms.
 bench-serve:
 	$(PY) bench.py --only serve
+
+# Elastic re-meshing: resize downtime (signal -> first post-resize step)
+# vs the restart-from-checkpoint requeue baseline for the same drain
+# (docs/elasticity.md). Emits elastic_resize_downtime_s.
+bench-elastic:
+	$(PY) bench.py --only elastic
 
 native:
 	$(MAKE) -C native

@@ -185,9 +185,50 @@ def preflight(
                 report.extend(diags)
                 report.hbm = hbm
                 report.notes.extend(notes)
+                report.extend(_elastic_hbm_diags(
+                    trial, config, n_devices, hbm_budget, path))
 
     report.diagnostics = filter_suppressed(report.diagnostics, suppress)
     return report
+
+
+def _elastic_hbm_diags(trial: Any, config: Dict[str, Any], preferred: int,
+                       hbm_budget: Optional[int],
+                       source_file: Optional[str]) -> List[Any]:
+    """DTL204's HBM leg: re-run the abstract-trace engine per candidate
+    mesh for every slot count in [min_slots, max_slots] (docs/elasticity.md)
+    — a shrink target whose per-device footprint blows the budget would
+    OOM exactly when the scheduler tries to save the trial from a drain.
+    Requires an armed budget (preflight.hbm_gb_per_device), like DTL004."""
+    from determined_tpu.analysis.rules import RULES
+    from determined_tpu.parallel.mesh import MeshConfig
+
+    res = config.get("resources") or {}
+    elastic = res.get("elastic") if isinstance(res, dict) else None
+    if hbm_budget is None or not isinstance(elastic, dict):
+        return []
+    mn = elastic.get("min_slots", 1)
+    mx = elastic.get("max_slots", preferred)
+    if not (isinstance(mn, int) and isinstance(mx, int) and 1 <= mn <= mx):
+        return []
+    try:
+        mesh_cfg = trial.mesh_config()
+    except Exception:
+        mesh_cfg = MeshConfig()
+    out = []
+    for k in range(mn, mx + 1):
+        if k == preferred:
+            continue  # the main analysis already covered the preferred size
+        if not mesh_cfg.resolvable(k):
+            continue  # the config rule reports unresolvable sizes
+        diags, _, _ = abstract_mod.analyze_trial(
+            trial, k, hbm_budget_bytes=hbm_budget, source_file=source_file)
+        for d in diags:
+            if d.code == "DTL004" and not d.suppressed:
+                out.append(RULES["DTL204"].diag(
+                    f"elastic size {k} (of [{mn}, {mx}]): {d.message}",
+                    file=source_file))
+    return out
 
 
 def gate_mode(config: Dict[str, Any]) -> str:

@@ -30,17 +30,21 @@ def _length_batches(v: Any) -> int:
     return 0
 
 
-def resolve_batch_axes_product(config: Dict[str, Any]) -> int:
+def resolve_batch_axes_product(config: Dict[str, Any],
+                               slots: Any = None) -> int:
     """data*fsdp resolved against slots_per_trial, mirroring
     MeshConfig.resolve (omitted `data` = -1 absorbs remaining chips).
     Returns 0 when the mesh is unresolvable (other validation reports it).
+    `slots` overrides resources.slots_per_trial — the DTL204 elastic check
+    re-resolves the same mesh at every candidate size.
     """
     hp = config.get("hyperparameters") or {}
     mesh = hp.get("mesh") or {}
     if not isinstance(mesh, dict):
         return 0
     res = config.get("resources") or {}
-    slots = res.get("slots_per_trial", 1)
+    if slots is None:
+        slots = res.get("slots_per_trial", 1)
     if not isinstance(slots, int) or slots <= 0:
         return 0
     sizes = {a: 1 for a in AXIS_ORDER}
@@ -105,6 +109,34 @@ def check_config(config: Dict[str, Any]) -> List[Diagnostic]:
                     f"{int(divisor ** (num_rungs - 1))}: the bottom rung "
                     "would train for zero batches and the top rungs are "
                     "unreachable; lower num_rungs or raise max_length"))
+
+    # DTL204 — elastic configs must be runnable at EVERY size in
+    # [min_slots, max_slots]: the scheduler may re-mesh the trial to any
+    # of them on a drain or a scale-up (docs/elasticity.md). Mesh
+    # resolvability + batch divisibility here; the HBM-per-size leg runs
+    # in preflight() with the abstract-trace engine per candidate mesh.
+    res = config.get("resources") or {}
+    elastic = res.get("elastic") if isinstance(res, dict) else None
+    if isinstance(elastic, dict):
+        spt = res.get("slots_per_trial", 1)
+        mn = elastic.get("min_slots", 1)
+        mx = elastic.get("max_slots", spt if isinstance(spt, int) else 0)
+        if isinstance(mn, int) and isinstance(mx, int) and 1 <= mn <= mx:
+            gbs_val = gbs if isinstance(gbs, int) and gbs > 0 else None
+            for k in range(mn, mx + 1):
+                bprod = resolve_batch_axes_product(config, slots=k)
+                if bprod == 0:
+                    diags.append(RULES["DTL204"].diag(
+                        f"elastic size {k} (of [{mn}, {mx}]): "
+                        "hyperparameters.mesh does not resolve at this slot "
+                        "count — the fixed axes product must divide every "
+                        "size the scheduler may shrink/grow the trial to"))
+                elif gbs_val is not None and gbs_val % bprod != 0:
+                    diags.append(RULES["DTL204"].diag(
+                        f"elastic size {k} (of [{mn}, {mx}]): "
+                        f"hyperparameters.global_batch_size={gbs_val} is not "
+                        f"divisible by the mesh batch axes data x fsdp = "
+                        f"{bprod} at this slot count"))
 
     # DTL203 — restarts configured but nothing to restart from. Only an
     # EXPLICIT min_checkpoint_period: 0 fires (key present): the default is

@@ -91,6 +91,13 @@ _ALL = [
          "only) while max_restarts > 0: a mid-op failure restarts from the "
          "previous op boundary or from scratch — restarts are configured "
          "but there is nothing recent to restart from"),
+    Rule("DTL204", "elastic-size-infeasible", "error", "config",
+         "an elastic config (resources.elastic) must be runnable at EVERY "
+         "slot count in [min_slots, max_slots]: the mesh must resolve, "
+         "global_batch_size must divide over the batch axes, and the "
+         "per-device HBM footprint must fit the budget at each size — a "
+         "size that fails only surfaces mid-drain, exactly when the "
+         "scheduler tries to shrink onto surviving capacity"),
 ]
 
 RULES: Dict[str, Rule] = {r.code: r for r in _ALL}

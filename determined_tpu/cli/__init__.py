@@ -191,12 +191,16 @@ def cmd_trial_list(session: Session, args) -> int:
             "state": t["state"],
             "batches": t.get("total_batches", 0),
             "metric": t.get("searcher_metric_value"),
+            # Elastic trials run at a scheduler-chosen size; show what the
+            # trial holds right now (docs/elasticity.md).
+            "slots": t.get("current_slots", ""),
             "restarts": t.get("restarts", 0),
             "checkpoint": t.get("latest_checkpoint") or "",
         }
         for t in trials
     ]
-    _print_table(rows, ["id", "state", "batches", "metric", "restarts", "checkpoint"])
+    _print_table(rows, ["id", "state", "batches", "metric", "slots",
+                        "restarts", "checkpoint"])
     return 0
 
 

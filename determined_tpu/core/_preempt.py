@@ -50,6 +50,9 @@ class _PreemptionWatcher(threading.Thread):
         self._stop_evt = threading.Event()
         self._deadline: Optional[float] = None  # time.monotonic() absolute
         self._reason: Optional[str] = None
+        # Elastic resize offer (docs/elasticity.md): target slot count the
+        # scheduler wants this trial resharded to. Rides the same signal.
+        self._resize_target: Optional[int] = None
 
     def run(self) -> None:
         backoff = 0.0
@@ -81,6 +84,19 @@ class _PreemptionWatcher(threading.Thread):
                                 "unparseable preemption deadline %r; "
                                 "treating as unbounded", deadline)
                     self._reason = resp.get("reason") or None
+                    if resp.get("resize"):
+                        target = resp.get("target_slots")
+                        try:
+                            target = int(target)
+                        except (TypeError, ValueError):
+                            target = 0
+                        if target > 0:
+                            self._resize_target = target
+                        else:
+                            logger.warning(
+                                "resize signal with unusable target_slots "
+                                "%r; treating as a plain preemption",
+                                resp.get("target_slots"))
                     self._preempted.set()
                     return
                 # A well-formed long-poll return without a signal (the
@@ -106,6 +122,11 @@ class _PreemptionWatcher(threading.Thread):
     def reason(self) -> Optional[str]:
         return self._reason
 
+    @property
+    def resize_target(self) -> Optional[int]:
+        """Requested slot count of a resize offer, set before `preempted`."""
+        return self._resize_target
+
     def close(self, timeout: float = 5.0) -> None:
         """Stop and join (bounded). A watcher blocked in a live long-poll
         returns at the poll timeout; the bound keeps close() from being
@@ -129,6 +150,7 @@ class PreemptContext:
         self._watcher: Optional[_PreemptionWatcher] = None
         self._forced = False  # local-mode / test hook
         self._forced_deadline: Optional[float] = None  # monotonic absolute
+        self._forced_resize: Optional[int] = None
         if session is not None and allocation_id and (
             distributed is None or distributed.is_chief
         ):
@@ -161,6 +183,23 @@ class PreemptContext:
             remaining = None if value < 0 else value
         return remaining
 
+    def resize_target(self) -> Optional[int]:
+        """Elastic resize offer (docs/elasticity.md): the slot count the
+        scheduler wants this trial resharded to, or None when the current
+        preemption (if any) is an ordinary one. Broadcast from the chief so
+        every host takes the same resize-vs-exit decision."""
+        target: Optional[int] = None
+        if self._forced_resize is not None:
+            target = self._forced_resize
+        elif self._watcher is not None and \
+                self._watcher.resize_target is not None:
+            target = self._watcher.resize_target
+        if self._dist is not None and self._dist.size > 1:
+            value = -1 if target is None else int(target)
+            value = int(self._dist.broadcast(value))
+            target = None if value <= 0 else value
+        return target
+
     def preemption_reason(self) -> Optional[str]:
         """Why the preemption happened (e.g. "spot_preemption",
         "host_maintenance"); None when unknown / not preempted."""
@@ -189,6 +228,30 @@ class PreemptContext:
         self._forced = True
         if deadline is not None:
             self._forced_deadline = time.monotonic() + deadline
+
+    def force_resize(self, target_slots: int,
+                     deadline: Optional[float] = None) -> None:
+        """Local/test hook: behave as if the scheduler offered a resize to
+        `target_slots` (with `deadline` seconds of grace when given)."""
+        self._forced_resize = int(target_slots)
+        self.force(deadline=deadline)
+
+    def reset(self) -> None:
+        """Re-arm after an in-process resize: the old signal was consumed
+        (the trial resharded and kept running), so clear the flags and
+        resume watching for the next one."""
+        self._forced = False
+        self._forced_deadline = None
+        self._forced_resize = None
+        if self._watcher is not None:
+            self._watcher.close()
+            self._watcher = None
+        if self._session is not None and self._allocation_id and (
+            self._dist is None or self._dist.is_chief
+        ):
+            self._watcher = _PreemptionWatcher(
+                self._session, self._allocation_id)
+            self._watcher.start()
 
     def close(self) -> None:
         if self._watcher is not None:

@@ -143,6 +143,9 @@ class DevicePrefetcher:
         self._stop = threading.Event()
         self._exc: Optional[BaseException] = None
         self._closed = False
+        # A batch the producer had fully staged when close()/detach() raced
+        # its queue put — detach() hands it back so no batch is ever lost.
+        self._overflow: Optional[Any] = None
         # metric window (drained by window_sums at report boundaries)
         self._mlock = threading.Lock()
         self._wait_ms_sum = 0.0
@@ -179,7 +182,10 @@ class DevicePrefetcher:
                     jax.block_until_ready(batch)
                 h2d_ms = (time.perf_counter() - t0) * 1e3
                 if not self._put((batch, h2d_ms)):
-                    return  # closed while the queue was full
+                    # Closed/detached while the queue was full: stash the
+                    # staged batch so detach() preserves data order.
+                    self._overflow = batch
+                    return
         except BaseException as e:  # re-raised in the consumer
             self._exc = e
         finally:
@@ -264,6 +270,41 @@ class DevicePrefetcher:
                 "prefetch thread %s did not exit within 5s (host iterator "
                 "stuck?); it is a daemon and will not block shutdown",
                 self._thread.name)
+
+    def detach(self) -> Tuple[list, Iterator[Any]]:
+        """Stop prefetching WITHOUT losing position: returns
+        (staged_batches, underlying_iterator) such that chaining the two
+        reproduces exactly the stream a continued consumer would have
+        seen. Used by elastic resize (docs/elasticity.md) to rebuild the
+        pipeline around a new mesh's batch sharding while preserving data
+        order; staged batches are device arrays sharded for the OLD mesh —
+        re-device_put reshards them.
+
+        The prefetcher is unusable afterwards (a fresh one wraps the
+        returned stream)."""
+        self._stop.set()
+        self._thread.join(timeout=10.0)
+        if self._thread.is_alive():
+            raise RuntimeError(
+                "prefetch producer did not stop; cannot detach without "
+                "risking a lost batch")
+        staged: list = []
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is _SENTINEL:
+                continue
+            staged.append(item[0])
+        if self._overflow is not None:
+            staged.append(self._overflow)
+            self._overflow = None
+        self._closed = True
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise exc
+        return staged, self._it
 
     def __enter__(self) -> "DevicePrefetcher":
         return self

@@ -108,6 +108,23 @@ def main() -> int:
     config = json.loads(os.environ.get("DET_EXPERIMENT_CONFIG", "{}"))
     apply_task_environment(env, config)
 
+    # Virtual-slot devclusters (JAX_PLATFORMS=cpu): make the task's visible
+    # JAX device count MATCH its allocated slot count, so the mesh resolves
+    # at the size the scheduler granted — on a real TPU-VM the runtime
+    # exposes the host's chips and this is a no-op. This is what lets an
+    # elastic re-placement at a new size (docs/elasticity.md) actually
+    # re-resolve the mesh instead of always seeing one CPU device.
+    try:
+        slot_ids = json.loads(env.get("DET_SLOT_IDS", "[]"))
+    except ValueError:
+        slot_ids = []
+    if (slot_ids and env.get("JAX_PLATFORMS", "") == "cpu"
+            and "xla_force_host_platform_device_count"
+            not in env.get("XLA_FLAGS", "")):
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={len(slot_ids)}")
+
     # startup-hook.sh from the context dir runs before the entrypoint
     # (reference exec/prep_container.py + entrypoint.sh: dependency
     # installs, data staging). A failing hook fails the task — running a

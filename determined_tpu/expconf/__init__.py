@@ -204,6 +204,7 @@ def validate(config: Dict[str, Any]) -> List[str]:
         spt = res.get("slots_per_trial", 1)
         if not isinstance(spt, int) or spt < 0:
             errors.append("resources.slots_per_trial must be a non-negative int")
+        _validate_elastic(res.get("elastic"), res, errors)
 
     storage = config.get("checkpoint_storage")
     if storage is not None:
@@ -261,6 +262,50 @@ def _validate_preemption(block: Any, errors: List[str]) -> None:
     ):
         errors.append(
             "preemption.budget_margin_sec must be a non-negative number")
+
+
+def _validate_elastic(block: Any, resources: Dict[str, Any],
+                      errors: List[str]) -> None:
+    """`resources.elastic:` — elastic re-meshing bounds (docs/elasticity.md).
+
+    An elastic trial's allocation size is a scheduler decision inside
+    [min_slots, max_slots]; `slots_per_trial` is the PREFERRED size. On
+    capacity loss the scheduler offers a shrink instead of a requeue; on
+    idle capacity it grows the trial back (resharding state through the
+    declared PartitionSpecs either way)."""
+    if block is None:
+        return
+    if not isinstance(block, dict):
+        errors.append("resources.elastic must be a mapping")
+        return
+    valid = {"min_slots", "max_slots"}
+    unknown = sorted(set(block) - valid)
+    if unknown:
+        errors.append(
+            f"resources.elastic: unknown keys {unknown}; valid: "
+            f"{sorted(valid)}")
+    for key in valid:
+        v = block.get(key)
+        if v is not None and (
+            isinstance(v, bool) or not isinstance(v, int) or v < 1
+        ):
+            errors.append(f"resources.elastic.{key} must be a positive int")
+            return
+    mn = block.get("min_slots", 1)
+    spt = resources.get("slots_per_trial", 1)
+    mx = block.get("max_slots", spt if isinstance(spt, int) else None)
+    if isinstance(mn, int) and isinstance(mx, int) and mn > mx:
+        errors.append("resources.elastic.min_slots > max_slots")
+        return
+    if isinstance(spt, int) and spt > 0:
+        if isinstance(mn, int) and spt < mn:
+            errors.append(
+                "resources.slots_per_trial (the preferred size) is below "
+                "resources.elastic.min_slots")
+        if isinstance(mx, int) and spt > mx:
+            errors.append(
+                "resources.slots_per_trial (the preferred size) exceeds "
+                "resources.elastic.max_slots")
 
 
 def _validate_health(block: Any, errors: List[str]) -> None:
@@ -554,6 +599,10 @@ def apply_defaults(config: Dict[str, Any]) -> Dict[str, Any]:
     res.setdefault("slots_per_trial", 1)
     res.setdefault("resource_pool", "default")
     res.setdefault("priority", 42)
+    if isinstance(res.get("elastic"), dict):
+        el = res["elastic"]
+        el.setdefault("min_slots", 1)
+        el.setdefault("max_slots", res["slots_per_trial"])
     if isinstance(c.get("serving"), dict):
         s = c["serving"]
         s.setdefault("checkpoint", "latest")

@@ -66,6 +66,16 @@ class MeshConfig:
             )
         return MeshConfig(**dict(zip(AXIS_ORDER, sizes)))
 
+    def resolvable(self, n_devices: int) -> bool:
+        """True when `resolve(n_devices)` would succeed — the elastic
+        feasibility check (preflight DTL204, Trainer resize) without the
+        exception control flow."""
+        try:
+            self.resolve(n_devices)
+            return True
+        except ValueError:
+            return False
+
     @staticmethod
     def from_dict(d: Mapping[str, int]) -> "MeshConfig":
         unknown = set(d) - set(AXIS_ORDER)

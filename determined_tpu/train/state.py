@@ -134,6 +134,51 @@ def state_specs(
     )
 
 
+def abstract_train_state(
+    init_fn: Callable[[jax.Array], Any],
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    param_logical_axes: Optional[Any] = None,
+    rules: Optional[LogicalRules] = None,
+    extra: Any = None,
+) -> Any:
+    """ShapeDtypeStruct TrainState template carrying `mesh` NamedShardings.
+
+    The restore-by-resharding target for elastic resize
+    (docs/elasticity.md): a checkpoint written under one mesh restores
+    straight into the layout this template declares for the NEW mesh —
+    tensorstore reshards on read — without paying a jitted random init
+    the restore immediately overwrites (which is what the restart path's
+    create_train_state+restore does)."""
+
+    def init_state(r):
+        params = init_fn(r)
+        return TrainState(
+            step=jax.numpy.zeros((), jax.numpy.int32),
+            params=params,
+            opt_state=tx.init(params),
+            extra=extra,
+        )
+
+    shapes = jax.eval_shape(init_state, jax.random.PRNGKey(0))
+    if param_logical_axes is not None:
+        specs = state_specs(init_fn, tx, param_logical_axes, rules)
+        specs = specs.replace(
+            extra=jax.tree_util.tree_map(lambda _: PartitionSpec(), extra)
+        )
+    else:
+        specs = jax.tree_util.tree_map(lambda _: PartitionSpec(), shapes)
+    flat_shapes, treedef = jax.tree_util.tree_flatten(shapes)
+    flat_specs, _ = jax.tree_util.tree_flatten(
+        specs, is_leaf=lambda x: isinstance(x, PartitionSpec))
+    leaves = [
+        jax.ShapeDtypeStruct(s.shape, s.dtype,
+                             sharding=NamedSharding(mesh, p))
+        for s, p in zip(flat_shapes, flat_specs)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def create_train_state(
     init_fn: Callable[[jax.Array], Any],
     tx: optax.GradientTransformation,

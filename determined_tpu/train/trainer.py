@@ -68,9 +68,14 @@ class Trainer:
         mesh_cfg = trial.mesh_config()
         if devices is None:
             devices = jax.devices()
+        # Full device list, kept past mesh construction: elastic resize
+        # re-resolves the mesh over a prefix of it (docs/elasticity.md).
+        self._devices = list(devices)
         self.mesh = create_mesh(mesh_cfg.resolve(len(devices)), devices)
         self.rules = trial.sharding_rules()
         self.state: Optional[TrainState] = None
+        self._tx = None
+        self._axes = None
         self._train_step = None
         self._eval_step = None
         self._pf_cfg: Optional[PrefetchConfig] = None
@@ -91,12 +96,27 @@ class Trainer:
 
     def _build(self, seed: int) -> None:
         trial = self.trial
-        tx = trial.optimizer()
-        axes = trial.param_logical_axes()
+        tx = self._tx = trial.optimizer()
+        axes = self._axes = trial.param_logical_axes()
         rng = jax.random.PRNGKey(seed)
 
+        self._check_mesh_support()
+        with jax.sharding.set_mesh(self.mesh):
+            self.state = create_train_state(
+                trial.init_params,
+                tx,
+                rng,
+                mesh=self.mesh if axes is not None else None,
+                param_logical_axes=axes,
+                rules=self.rules,
+                extra=trial.init_extra(),
+            )
+        self._build_steps()
+
+    def _check_mesh_support(self) -> None:
         # Config checks BEFORE state init — a misconfigured pipeline mesh
         # must fail in milliseconds, not after sharding a large model.
+        trial = self.trial
         pipelined = self.mesh.shape.get("pipeline", 1) > 1
         if pipelined:
             # A pipeline axis without a pipelined loss would silently run the
@@ -126,16 +146,14 @@ class Trainer:
                 "supports_expert_parallel(), or drop the expert axis"
             )
 
-        with jax.sharding.set_mesh(self.mesh):
-            self.state = create_train_state(
-                trial.init_params,
-                tx,
-                rng,
-                mesh=self.mesh if axes is not None else None,
-                param_logical_axes=axes,
-                rules=self.rules,
-                extra=trial.init_extra(),
-            )
+    def _build_steps(self) -> None:
+        """(Re)build the jitted train/eval steps for the CURRENT self.mesh.
+        Called at _build and again after an elastic re-mesh — the steps
+        close over the mesh, so a resize retraces them (once) while the
+        restored state is already laid out for the new mesh."""
+        trial = self.trial
+        tx = self._tx
+        pipelined = self.mesh.shape.get("pipeline", 1) > 1
         loss = trial.loss
         if pipelined:
             mesh = self.mesh
@@ -314,9 +332,13 @@ class Trainer:
             watchdog.beat()
             return True
 
+        import contextlib
+
+        self._mesh_stack = mesh_stack = contextlib.ExitStack()
         try:
             watchdog.start()
-            with jax.sharding.set_mesh(self.mesh):
+            with mesh_stack:
+                mesh_stack.enter_context(jax.sharding.set_mesh(self.mesh))
                 for op in core.searcher.operations():
                     while True:
                         while step < op.length and not preempted:
@@ -356,6 +378,23 @@ class Trainer:
                         if diverged(host) and not preempted \
                                 and handle_divergence():
                             continue  # step rewound below op.length
+                        if preempted:
+                            # Elastic resize (docs/elasticity.md): reshard
+                            # in place and keep training instead of
+                            # checkpoint-and-exit, when this process can
+                            # host the target size itself.
+                            target = core.preempt.resize_target()
+                            if target is not None and \
+                                    self._can_resize_in_process(target):
+                                step, data_iter, prefetcher = \
+                                    self._resize_in_process(
+                                        core, target, step,
+                                        last_checkpointed, data_iter,
+                                        prefetcher)
+                                last_checkpointed = step
+                                preempted = False
+                                watchdog.beat()
+                                continue  # resharded: keep training
                         break
 
                     if preempted:
@@ -488,10 +527,21 @@ class Trainer:
         clean exit restores from the previous COMPLETED checkpoint, which
         beats burning the whole grace window writing a torso."""
         deadline = core.preempt.preemption_deadline()
+        resize_target = core.preempt.resize_target()
         if deadline is None:
             if last_checkpointed != step:
                 self._checkpoint(core, step)
-            logger.info("preempted at step %d; checkpoint saved", step)
+            if resize_target is not None:
+                # Managed elastic resize without a drain deadline (grow
+                # offer): commit now and exit clean — the master re-places
+                # this allocation at target_slots, restarts untouched.
+                core.checkpoint.wait()
+                logger.info(
+                    "resize preemption to %d slots at step %d: emergency "
+                    "checkpoint committed; exiting for re-placement",
+                    resize_target, step)
+            else:
+                logger.info("preempted at step %d; checkpoint saved", step)
             return
         cfg = self._preempt_cfg or PreemptionConfig()
         t0 = time.monotonic()
@@ -514,16 +564,140 @@ class Trainer:
             # checkpoint the restart will land on.
             core.checkpoint.wait()
         grace_used_ms = (time.monotonic() - t0) * 1000.0
-        logger.info(
-            "deadline preemption (%s) at step %d: %s, grace used %.0fms of "
-            "%.1fs",
-            core.preempt.preemption_reason() or "unknown", step,
-            "emergency checkpoint committed" if attempt
-            else "emergency checkpoint skipped", grace_used_ms, deadline)
+        if resize_target is not None:
+            # Managed elastic shrink on a drain: same budget math, but the
+            # clean exit becomes an allocation-size transition master-side.
+            logger.info(
+                "resize preemption (%s) to %d slots at step %d: %s, grace "
+                "used %.0fms of %.1fs; exiting for re-placement",
+                core.preempt.preemption_reason() or "unknown", resize_target,
+                step,
+                "emergency checkpoint committed" if attempt
+                else "emergency checkpoint skipped", grace_used_ms, deadline)
+        else:
+            logger.info(
+                "deadline preemption (%s) at step %d: %s, grace used %.0fms "
+                "of %.1fs",
+                core.preempt.preemption_reason() or "unknown", step,
+                "emergency checkpoint committed" if attempt
+                else "emergency checkpoint skipped", grace_used_ms, deadline)
         core.train.report_training_metrics(step, {
             "preemption_grace_used_ms": grace_used_ms,
             "preemption_emergency_checkpoint": 1.0 if attempt else 0.0,
         })
+
+    # -- elastic resize (docs/elasticity.md) ---------------------------
+
+    def _can_resize_in_process(self, target: int) -> bool:
+        """Whether THIS process can serve the resize by resharding in
+        place. Cluster mode says no: the signal usually means this node is
+        going away, so the transition is master-side — budgeted checkpoint,
+        clean exit, same-allocation re-placement at the new size. Local
+        mode (tests, bench, masterless runs) reshards without exiting."""
+        if self.core is not None and self.core.info is not None:
+            return False
+        if target == self.mesh.size:
+            return False  # nothing to reshard
+        if target > len(self._devices):
+            return False
+        return self.trial.mesh_config().resolvable(target)
+
+    def _resize_in_process(self, core, target: int, step: int,
+                           last_checkpointed: int, data_iter,
+                           prefetcher: Optional[DevicePrefetcher]):
+        """The resize pipeline: deadline-budgeted COMPLETED checkpoint →
+        re-resolve the mesh for `target` slots → restore by RESHARDING
+        through the declared logical-axis PartitionSpecs → rebuild the
+        input pipeline around the new batch sharding (data order
+        preserved) → resume. Returns (step, data_iter, prefetcher).
+
+        Downtime is checkpoint + reshard + one retrace — never a queue
+        wait, and `restarts` is untouched."""
+        from determined_tpu.train.state import abstract_train_state
+
+        t0 = time.monotonic()
+        from_slots = self.mesh.size
+        deadline = core.preempt.preemption_deadline()
+        reason = core.preempt.preemption_reason() or "resize"
+        cfg = self._preempt_cfg or PreemptionConfig()
+
+        # 1) A COMPLETED checkpoint at (or as near as the budget allows to)
+        # the current step, committed before any device state is dropped.
+        core.checkpoint.wait()
+        restore_id = None
+        if last_checkpointed == step:
+            restore_id = f"trial{core.checkpoint._trial_id}-step{step}"
+        elif cfg.should_attempt_save(deadline, core.checkpoint.last_save_ms):
+            self._checkpoint(core, step)
+            core.checkpoint.wait()  # COMMIT inside the grace window
+            restore_id = f"trial{core.checkpoint._trial_id}-step{step}"
+        else:
+            lineage = core.checkpoint.lineage()
+            if not lineage:
+                raise RuntimeError(
+                    "resize offered but no COMPLETED checkpoint exists and "
+                    "the deadline cannot cover one; cannot reshard")
+            restore_id = lineage[0]
+            logger.warning(
+                "resize budget cannot cover a fresh save (deadline %.1fs, "
+                "last save %s ms); resharding from %s instead",
+                deadline or -1.0, core.checkpoint.last_save_ms, restore_id)
+
+        # 2) Re-resolve the mesh for the target size over a prefix of the
+        # device list (preflight DTL204 guarantees every size in
+        # [min_slots, max_slots] resolves for elastic configs).
+        new_mesh = create_mesh(
+            self.trial.mesh_config().resolve(target), self._devices[:target])
+        self._mesh_stack.close()
+        self.mesh = new_mesh
+        self._mesh_stack.enter_context(jax.sharding.set_mesh(new_mesh))
+        self._build_steps()
+
+        # 3) Restore by resharding: the template declares the NEW layout
+        # (aligned_param_specs under the new mesh); tensorstore reads each
+        # device's shard directly. No jitted random init is paid — the
+        # template is abstract.
+        self.state = abstract_train_state(
+            self.trial.init_params, self._tx, new_mesh, self._axes,
+            self.rules, extra=self.trial.init_extra())
+        restored = self._restore_chain([restore_id])
+        if restored is None:
+            raise RuntimeError(
+                f"resize to {target} slots failed: no restorable checkpoint "
+                f"in the lineage of {restore_id}")
+        step = int(jax.device_get(self.state.step))
+
+        # 4) Rebuild the input pipeline around the new batch sharding.
+        # detach() preserves position: staged batches (sharded for the old
+        # mesh) re-device_put onto the new one, then the untouched
+        # iterator continues — global batch and data order unchanged; only
+        # the per-device share moves.
+        if prefetcher is not None:
+            import itertools
+
+            staged, inner = prefetcher.detach()
+            stream = itertools.chain(staged, inner)
+            sharding = (batch_sharding(self.mesh, self.rules)
+                        if self._pf_cfg and self._pf_cfg.shard else None)
+            prefetcher = DevicePrefetcher(
+                stream, sharding=sharding,
+                depth=self._pf_cfg.depth if self._pf_cfg else 2,
+                name="train")
+            data_iter = prefetcher
+
+        # 5) Re-arm the preemption watcher: this signal is consumed.
+        core.preempt.reset()
+        downtime_ms = (time.monotonic() - t0) * 1000.0
+        logger.info(
+            "elastic resize (%s): %d -> %d slots at step %d, restored %s, "
+            "downtime %.0fms (no requeue, restarts unchanged)",
+            reason, from_slots, target, step, restored, downtime_ms)
+        core.train.report_training_metrics(step, {
+            "resize_from_slots": float(from_slots),
+            "resize_target_slots": float(target),
+            "resize_downtime_ms": downtime_ms,
+        })
+        return step, data_iter, prefetcher
 
     def _restore(self, storage_id: str) -> Optional[str]:
         """Restore `storage_id`, falling back through the COMPLETED lineage

@@ -456,6 +456,41 @@ CREATE INDEX idx_agent_notices_agent ON agent_notices(agent_id, id);
       {19, R"sql(
 ALTER TABLE tasks ADD COLUMN restarts INTEGER NOT NULL DEFAULT 0;
 )sql"},
+      // Elastic re-meshing: every allocation-size transition (shrink on
+      // drain, grow-back on idle capacity) is persisted so `det trial
+      // describe` / the WebUI can show how a trial's footprint moved
+      // through spot churn (docs/elasticity.md).
+      {20, R"sql(
+CREATE TABLE allocation_size_history (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  allocation_id TEXT NOT NULL,
+  trial_id INTEGER,
+  from_slots INTEGER NOT NULL,
+  to_slots INTEGER NOT NULL,
+  reason TEXT NOT NULL DEFAULT '',
+  created_at TEXT NOT NULL DEFAULT (datetime('now'))
+);
+CREATE INDEX idx_alloc_size_history ON allocation_size_history(allocation_id, id);
+)sql"},
+      // ASHA hot path (BENCH_r05 idempotency replay 1.5ms median): the
+      // replay lookup hits this table once per harness POST. Rebuild it
+      // WITHOUT ROWID so `WHERE key=?` is a single clustered b-tree seek
+      // (TEXT PRIMARY KEY on a rowid table costs an index seek PLUS a
+      // rowid hop), and index created_at so the hourly sweep's DELETE
+      // stops scanning the whole table under the shared db mutex.
+      {21, R"sql(
+CREATE TABLE idempotency_keys_v2 (
+  key TEXT PRIMARY KEY,
+  status INTEGER NOT NULL,
+  body TEXT NOT NULL DEFAULT '',
+  created_at TEXT NOT NULL DEFAULT (datetime('now'))
+) WITHOUT ROWID;
+INSERT INTO idempotency_keys_v2 (key, status, body, created_at)
+  SELECT key, status, body, created_at FROM idempotency_keys;
+DROP TABLE idempotency_keys;
+ALTER TABLE idempotency_keys_v2 RENAME TO idempotency_keys;
+CREATE INDEX idx_idempotency_created ON idempotency_keys(created_at);
+)sql"},
   };
   return kMigrations;
 }

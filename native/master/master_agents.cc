@@ -12,6 +12,7 @@
 #include <iostream>
 #include <thread>
 
+#include "../common/faultpoint.h"
 #include "master.h"
 #include "scheduler_fit.h"
 
@@ -301,6 +302,10 @@ void Master::apply_resource_state_locked(const std::string& alloc_id,
   auto it = allocations_.find(alloc_id);
   if (it == allocations_.end()) return;
   Allocation& alloc = it->second;
+  // An allocation between resize exit and re-placement has no resources;
+  // a stale state report must not vacuously satisfy all_exited below and
+  // terminate it.
+  if (alloc.resources.empty()) return;
   bool all_running = true, all_exited = true, any_restored = false;
   for (auto& r : alloc.resources) {
     if (r.agent_id == node_id) {
@@ -336,6 +341,10 @@ void Master::scheduler_loop() {
     if (!running_) return;
     check_agents_locked();
     schedule_locked();
+    // Elastic grow-back: runs every tick (schedule_locked early-returns
+    // on an empty queue, and an empty queue is exactly when idle
+    // capacity can be handed to under-sized elastic trials).
+    maybe_grow_elastic_locked();
     // Hourly task-log retention sweep (reference internal/logretention/).
     // Runs with mu_ RELEASED — a big DELETE must not stall the scheduler
     // or API handlers (the db has its own lock).
@@ -896,7 +905,7 @@ void Master::release_resources_locked(Allocation& alloc) {
 
 void Master::preempt_allocation_locked(Allocation& alloc,
                                        const std::string& why,
-                                       double deadline) {
+                                       double deadline, bool notify) {
   if (alloc.preempting) {
     // Already preempting: a deadline may only TIGHTEN (a spot notice
     // arriving during a cooperative preempt turns it hard).
@@ -904,7 +913,7 @@ void Master::preempt_allocation_locked(Allocation& alloc,
         (alloc.preempt_deadline <= 0 || deadline < alloc.preempt_deadline)) {
       alloc.preempt_deadline = deadline;
       alloc.preempt_reason = why;
-      cv_.notify_all();
+      if (notify) cv_.notify_all();
     }
     return;
   }
@@ -912,7 +921,7 @@ void Master::preempt_allocation_locked(Allocation& alloc,
   alloc.preempt_deadline = deadline;
   alloc.preempt_reason = why;
   alloc.exit_reason = why;
-  cv_.notify_all();  // wakes the preemption long-poll watchers
+  if (notify) cv_.notify_all();  // wakes the preemption long-poll watchers
 }
 
 void Master::drain_agent_locked(AgentState& agent, double deadline_seconds,
@@ -931,16 +940,122 @@ void Master::drain_agent_locked(AgentState& agent, double deadline_seconds,
       {Json(agent.id), Json(reason), Json(deadline_seconds)});
   std::cerr << "master: agent " << agent.id << " DRAINING (" << reason
             << ", deadline " << deadline_seconds << "s)" << std::endl;
+  // ONE pass, ONE broadcast: per-allocation notify_all here made every
+  // parked long-poll (signals, agent actions, searcher ops) wake once per
+  // affected allocation — the preemption fan-out cost BENCH_r05 measured
+  // at 3.4ms median on the pause path shares this shape.
   for (auto& [aid, alloc] : allocations_) {
     if (alloc.state == "TERMINATED") continue;
     for (const auto& r : alloc.resources) {
       if (r.agent_id == agent.id && r.state != "EXITED") {
-        preempt_allocation_locked(alloc, reason, agent.drain_deadline);
+        // Elastic trials get a resize OFFER instead of a plain drain
+        // preemption: shrink (or relocate at the same size) onto
+        // surviving capacity under the same allocation. Non-elastic
+        // trials, and elastic ones nothing can host, keep the PR-5
+        // requeue pipeline unchanged.
+        ExperimentState* exp = find_experiment_locked(alloc.experiment_id);
+        bool offered = false;
+        if (exp != nullptr && exp->elastic() && !alloc.preempting &&
+            alloc.slots > 0) {
+          int target = elastic_fit_target_locked(
+              alloc, exp->elastic_min_slots,
+              std::min(alloc.slots, exp->elastic_max_slots));
+          if (target > 0) {
+            offered = offer_resize_locked(alloc, target,
+                                          agent.drain_deadline, reason,
+                                          /*notify=*/false);
+          }
+        }
+        if (!offered) {
+          preempt_allocation_locked(alloc, reason, agent.drain_deadline,
+                                    /*notify=*/false);
+        }
         break;
       }
     }
   }
   cv_.notify_all();
+}
+
+int Master::elastic_fit_target_locked(const Allocation& alloc, int lo,
+                                      int hi) {
+  if (lo < 1 || hi < lo) return 0;
+  // Free view over alive, non-draining pool agents. The allocation's own
+  // slots on SURVIVING agents count as free — re-placement releases them —
+  // but its slots on a draining agent are lost capacity.
+  std::vector<HostFreeView> views;
+  for (auto& [id, a] : agents_) {
+    if (!a.alive || a.draining || a.resource_pool != alloc.resource_pool) {
+      continue;
+    }
+    if (alloc.excluded_agents.count(id)) continue;
+    HostFreeView v;
+    v.id = a.id;
+    v.total_slots = static_cast<int>(a.slots.size());
+    for (const auto& s : a.slots) {
+      if (s.enabled &&
+          (s.allocation_id.empty() || s.allocation_id == alloc.id)) {
+        v.free_slots.push_back(s.id);
+      }
+    }
+    views.push_back(std::move(v));
+  }
+  for (int k = hi; k >= lo; --k) {
+    if (!find_fit(k, views).empty()) return k;
+  }
+  return 0;
+}
+
+bool Master::offer_resize_locked(Allocation& alloc, int target,
+                                 double deadline, const std::string& reason,
+                                 bool notify) {
+  // Chaos (docs/chaos.md): dropping the offer proves the PR-5 requeue
+  // path remains the fallback for elastic trials.
+  if (FAULT_POINT("master.resize.offer.drop") != faults::Action::kNone) {
+    std::cerr << "master: resize offer for " << alloc.id
+              << " dropped by fault point" << std::endl;
+    return false;
+  }
+  alloc.resize_target = target;
+  preempt_allocation_locked(alloc, reason, deadline, notify);
+  std::cerr << "master: resize offer " << alloc.id << ": " << alloc.slots
+            << " -> " << target << " slots (" << reason << ")" << std::endl;
+  return true;
+}
+
+void Master::maybe_grow_elastic_locked() {
+  constexpr double kGrowCooldownS = 5.0;
+  double t = now();
+  for (auto& [aid, alloc] : allocations_) {
+    if (alloc.state != "RUNNING" || alloc.preempting ||
+        alloc.resize_target > 0 || alloc.killed) {
+      continue;
+    }
+    ExperimentState* exp = find_experiment_locked(alloc.experiment_id);
+    if (exp == nullptr || !exp->elastic() || exp->state != "ACTIVE") continue;
+    if (alloc.slots >= exp->elastic_max_slots) continue;
+    if (t - alloc.last_resize < kGrowCooldownS) continue;
+    // Grow only into IDLE capacity: queued work in the pool has first
+    // claim on free slots.
+    bool pool_busy = false;
+    for (const auto& pid : pending_) {
+      auto it = allocations_.find(pid);
+      if (it != allocations_.end() && it->second.state == "PENDING" &&
+          it->second.resource_pool == alloc.resource_pool) {
+        pool_busy = true;
+        break;
+      }
+    }
+    if (pool_busy) continue;
+    int target = elastic_fit_target_locked(alloc, alloc.slots + 1,
+                                           exp->elastic_max_slots);
+    if (target > alloc.slots) {
+      // Unbounded deadline: a grow is opportunistic, the node is healthy —
+      // the harness checkpoints at leisure and the budget math always
+      // clears.
+      offer_resize_locked(alloc, target, 0, "elastic scale-up");
+    }
+  }
 }
 
 void Master::kill_allocation_locked(Allocation& alloc) {

@@ -229,7 +229,18 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
             Json::parse_or_null(t["summary_metrics"].as_string());
         if (exp != nullptr) {
           for (const auto& [rid, trial] : exp->trials) {
-            if (trial.id == row["id"].as_int()) t["state"] = trial.state;
+            if (trial.id == row["id"].as_int()) {
+              t["state"] = trial.state;
+              // Elastic trials: the size the trial RUNS at right now may
+              // differ from resources.slots_per_trial (docs/elasticity.md).
+              if (!trial.allocation_id.empty()) {
+                auto ait = allocations_.find(trial.allocation_id);
+                if (ait != allocations_.end()) {
+                  t["current_slots"] =
+                      static_cast<int64_t>(ait->second.slots);
+                }
+              }
+            }
           }
         }
         trials.push_back(std::move(t));
@@ -432,6 +443,11 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
     if (verb == "pause") {
       if (exp->state == "ACTIVE") {
         set_experiment_state_locked(*exp, "PAUSED");
+        // Batched fan-out (BENCH_r05 phase_breakdown, preempt_fanout
+        // 3.4ms median): flag every allocation in one pass under the
+        // lock, then broadcast ONCE — the per-allocation notify_all made
+        // every parked long-poll in the master wake O(trials) times per
+        // pause, which is what an ASHA searcher does constantly.
         for (auto& [rid, trial] : exp->trials) {
           if (!trial.allocation_id.empty()) {
             auto ait = allocations_.find(trial.allocation_id);
@@ -441,11 +457,13 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
                 release_resources_locked(ait->second);
                 trial.allocation_id.clear();
               } else {
-                preempt_allocation_locked(ait->second, "experiment paused");
+                preempt_allocation_locked(ait->second, "experiment paused",
+                                          0, /*notify=*/false);
               }
             }
           }
         }
+        cv_.notify_all();
       }
       return json_resp(200, Json::object());
     }
@@ -514,8 +532,28 @@ HttpResponse Master::handle_trials(const HttpRequest& req,
       std::lock_guard<std::mutex> lock(mu_);
       ExperimentState* exp = nullptr;
       TrialState* trial = find_trial_locked(tid, &exp);
-      if (trial != nullptr) t["state"] = trial->state;
+      if (trial != nullptr) {
+        t["state"] = trial->state;
+        if (!trial->allocation_id.empty()) {
+          auto ait = allocations_.find(trial->allocation_id);
+          if (ait != allocations_.end()) {
+            t["current_slots"] = static_cast<int64_t>(ait->second.slots);
+          }
+        }
+      }
     }
+    // Elastic size transitions across every allocation this trial ran
+    // under (docs/elasticity.md) — `det trial describe` and the WebUI
+    // surface how the trial's footprint moved through spot churn.
+    Json hist = Json::array();
+    for (auto& row : db_.query(
+             "SELECT allocation_id, from_slots, to_slots, reason, "
+             "created_at FROM allocation_size_history WHERE trial_id=? "
+             "ORDER BY id",
+             {Json(tid)})) {
+      hist.push_back(row_to_json(row));
+    }
+    t["size_history"] = std::move(hist);
     Json out = Json::object();
     out["trial"] = std::move(t);
     return json_resp(200, out);
@@ -819,6 +857,15 @@ HttpResponse Master::handle_allocations(const HttpRequest& req,
       if (!it->second.preempt_reason.empty()) {
         out["reason"] = it->second.preempt_reason;
       }
+      // Elastic resize offer (docs/elasticity.md): the signal asks for a
+      // checkpoint + clean exit like any deadline preemption, but the
+      // exit becomes an allocation-size transition to target_slots — no
+      // requeue, restarts untouched.
+      if (it->second.resize_target > 0) {
+        out["resize"] = true;
+        out["target_slots"] =
+            static_cast<int64_t>(it->second.resize_target);
+      }
     }
     return json_resp(200, out);
   }
@@ -987,13 +1034,35 @@ HttpResponse Master::handle_allocations(const HttpRequest& req,
           {"exit_code", Json(static_cast<int64_t>(r.exit_code))}}));
     }
     Json out = Json::object();
-    out["allocation"] = Json(JsonObject{
+    Json alloc_json = Json(JsonObject{
         {"id", Json(a.id)},
         {"task_id", Json(a.task_id)},
         {"state", Json(a.state)},
         {"slots", Json(static_cast<int64_t>(a.slots))},
         {"preempting", Json(a.preempting)},
         {"resources", resources}});
+    if (a.resize_target > 0) {
+      alloc_json["resize_target"] =
+          static_cast<int64_t>(a.resize_target);
+    }
+    out["allocation"] = std::move(alloc_json);
+    return json_resp(200, out);
+  }
+
+  // GET /api/v1/allocations/{id}/size_history — elastic size transitions,
+  // oldest first (docs/elasticity.md; CLI `det trial describe`, WebUI).
+  if (parts.size() == 3 && parts[2] == "size_history" &&
+      req.method == "GET") {
+    Json events = Json::array();
+    for (auto& row : db_.query(
+             "SELECT trial_id, from_slots, to_slots, reason, created_at "
+             "FROM allocation_size_history WHERE allocation_id=? "
+             "ORDER BY id",
+             {Json(aid)})) {
+      events.push_back(row_to_json(row));
+    }
+    Json out = Json::object();
+    out["size_history"] = events;
     return json_resp(200, out);
   }
 

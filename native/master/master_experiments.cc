@@ -47,6 +47,18 @@ std::string trial_task_id(int64_t trial_id) {
   return "trial-" + std::to_string(trial_id);
 }
 
+// resources.elastic bounds (docs/elasticity.md); validated Python-side,
+// clamped defensively here. No block -> 0/0 (not elastic).
+void parse_elastic(const Json& resources, ExperimentState& exp) {
+  const Json& el = resources["elastic"];
+  if (!el.is_object()) return;
+  int mn = static_cast<int>(el["min_slots"].as_int(1));
+  int mx = static_cast<int>(el["max_slots"].as_int(exp.slots_per_trial));
+  if (mn < 1 || mx < mn) return;  // malformed: treat as not elastic
+  exp.elastic_min_slots = mn;
+  exp.elastic_max_slots = mx;
+}
+
 }  // namespace
 
 ExperimentState* Master::find_experiment_locked(int64_t id) {
@@ -151,6 +163,7 @@ int64_t Master::create_experiment_locked(const Json& config,
   exp.priority = static_cast<int>(res["priority"].as_int(42));
   exp.max_restarts = config["max_restarts"].as_int(5);
   exp.log_policies = compile_log_policies(config);
+  parse_elastic(res, exp);
   uint64_t seed = static_cast<uint64_t>(
       config["reproducibility"]["experiment_seed"].as_int(eid * 2654435761));
   exp.searcher = std::make_unique<Searcher>(config["searcher"],
@@ -452,6 +465,59 @@ void Master::request_allocation_locked(ExperimentState& exp,
   cv_.notify_all();
 }
 
+void Master::resize_allocation_locked(Allocation& alloc,
+                                      ExperimentState& exp,
+                                      TrialState& trial) {
+  int from = alloc.slots;
+  int to = alloc.resize_target;
+  std::string reason = alloc.preempt_reason;
+  alloc.resize_target = 0;
+  alloc.slots = to;
+  alloc.resources.clear();
+  alloc.state = "PENDING";
+  alloc.preempting = false;
+  alloc.preempt_deadline = 0;
+  alloc.preempt_reason.clear();
+  alloc.exit_reason.clear();
+  alloc.exit_code = -1;
+  // submitted_at is deliberately NOT reset: the scheduler orders the
+  // queue by (priority, submitted_at), and keeping the original stamp
+  // makes the resized allocation the oldest in its class — placed first,
+  // so downtime is checkpoint + reshard, not queue wait.
+  alloc.last_resize = now();
+  // The re-placed container is a NEW process run resuming from the
+  // emergency checkpoint; run_id distinguishes its metric reports. The
+  // move was elastic, not a failure: restarts stays where it was.
+  trial.run_id += 1;
+  db_.tx([&] {
+    db_.exec("UPDATE trials SET run_id=? WHERE id=?",
+             {Json(trial.run_id), Json(trial.id)});
+    db_.exec(
+        "UPDATE allocations SET state='PENDING', slots=?, resources='[]', "
+        "agent_id=NULL WHERE id=?",
+        {Json(static_cast<int64_t>(to)), Json(alloc.id)});
+    db_.exec(
+        "INSERT INTO allocation_size_history (allocation_id, trial_id, "
+        "from_slots, to_slots, reason) VALUES (?, ?, ?, ?, ?)",
+        {Json(alloc.id), Json(trial.id), Json(static_cast<int64_t>(from)),
+         Json(static_cast<int64_t>(to)), Json(reason)});
+  });
+  // Front of the queue: the whole point is downtime = checkpoint +
+  // reshard, not queue wait.
+  pending_.push_front(alloc.id);
+  publish_locked("allocations", Json(JsonObject{
+      {"id", Json(alloc.id)},
+      {"trial_id", Json(trial.id)},
+      {"event", Json(std::string("resize"))},
+      {"from_slots", Json(static_cast<int64_t>(from))},
+      {"to_slots", Json(static_cast<int64_t>(to))}}));
+  std::cerr << "master: allocation " << alloc.id << " elastic resize "
+            << from << " -> " << to << " slots (" << reason
+            << "); re-queued without a trial requeue" << std::endl;
+  snapshot_experiment_locked(exp);
+  cv_.notify_all();
+}
+
 std::string Master::store_context_blob_locked(const std::string& b64) {
   if (b64.empty()) return "";
   std::string hash;
@@ -584,6 +650,23 @@ void Master::on_allocation_exit_locked(Allocation& alloc) {
       break;
     }
   }
+  // Elastic size transition (docs/elasticity.md): a clean preempt-exit
+  // with an outstanding resize offer re-queues the SAME allocation at the
+  // new size — no trial requeue, restarts untouched. Anything less clean
+  // (nonzero exit, killed, trial finished/closing) falls through to the
+  // ordinary PR-5 exit paths below, so requeue remains the fallback.
+  if (exit_code == 0 && alloc.resize_target > 0 && !alloc.killed) {
+    ExperimentState* exp = find_experiment_locked(alloc.experiment_id);
+    if (exp != nullptr && exp->state == "ACTIVE") {
+      auto tit = exp->trials.find(alloc.request_id);
+      if (tit != exp->trials.end() && !is_terminal(tit->second.state) &&
+          !tit->second.close_requested && !tit->second.pending_ops.empty()) {
+        resize_allocation_locked(alloc, *exp, tit->second);
+        return;
+      }
+    }
+  }
+  alloc.resize_target = 0;
   db_.exec(
       "UPDATE allocations SET state='TERMINATED', end_time=datetime('now'), "
       "exit_reason=? WHERE id=?",
@@ -749,6 +832,7 @@ void Master::restore_experiments() {
     exp.priority = static_cast<int>(res["priority"].as_int(42));
     exp.max_restarts = config["max_restarts"].as_int(5);
     exp.log_policies = compile_log_policies(config);
+    parse_elastic(res, exp);
     uint64_t seed = static_cast<uint64_t>(
         config["reproducibility"]["experiment_seed"].as_int(
             eid * 2654435761));

@@ -26,12 +26,13 @@ Json diag(const char* code, const char* level, const std::string& msg) {
   return d;
 }
 
-// data*fsdp resolved against slots_per_trial, mirroring
+// data*fsdp resolved against `slots` (default: slots_per_trial), mirroring
 // MeshConfig.resolve (omitted `data` = -1 absorbs remaining chips).
-// 0 = unresolvable (schema validation reports that separately).
-int64_t batch_axes_product(const Json& config) {
+// 0 = unresolvable (schema validation reports that separately). DTL204
+// re-resolves at every elastic candidate size via the override.
+int64_t batch_axes_product(const Json& config, int64_t slots = -1) {
   const Json& mesh = config["hyperparameters"]["mesh"];
-  int64_t slots = config["resources"]["slots_per_trial"].as_int(1);
+  if (slots < 0) slots = config["resources"]["slots_per_trial"].as_int(1);
   if (slots <= 0) return 0;
   if (!mesh.is_object()) {
     // No mesh block: MeshConfig() defaults to pure data parallel over all
@@ -128,6 +129,40 @@ Json preflight_config(const Json& config) {
                 ": the bottom rung would train for zero batches and the "
                 "top rungs are unreachable; lower num_rungs or raise "
                 "max_length"));
+      }
+    }
+  }
+
+  // DTL204 — elastic configs must be runnable at EVERY slot count in
+  // [min_slots, max_slots]: mesh resolvability + batch divisibility per
+  // size (the Python analyzer also runs the abstract-trace HBM leg, which
+  // needs the trial code the master never imports).
+  const Json& elastic = config["resources"]["elastic"];
+  if (elastic.is_object()) {
+    int64_t spt = config["resources"]["slots_per_trial"].as_int(1);
+    int64_t mn = elastic["min_slots"].as_int(1);
+    int64_t mx = elastic["max_slots"].as_int(spt);
+    if (mn >= 1 && mn <= mx) {
+      for (int64_t k = mn; k <= mx; ++k) {
+        int64_t bprod = batch_axes_product(config, k);
+        if (bprod == 0) {
+          out.push_back(diag(
+              "DTL204", "error",
+              "elastic size " + std::to_string(k) + " (of [" +
+                  std::to_string(mn) + ", " + std::to_string(mx) +
+                  "]): hyperparameters.mesh does not resolve at this slot "
+                  "count — the fixed axes product must divide every size "
+                  "the scheduler may shrink/grow the trial to"));
+        } else if (gbs > 0 && gbs % bprod != 0) {
+          out.push_back(diag(
+              "DTL204", "error",
+              "elastic size " + std::to_string(k) + " (of [" +
+                  std::to_string(mn) + ", " + std::to_string(mx) +
+                  "]): hyperparameters.global_batch_size=" +
+                  std::to_string(gbs) +
+                  " is not divisible by the mesh batch axes data x fsdp = " +
+                  std::to_string(bprod) + " at this slot count"));
+        }
       }
     }
   }

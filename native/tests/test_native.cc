@@ -442,6 +442,70 @@ static void test_preflight_restarts_without_checkpoints() {
   CHECK(det::preflight_config(clean).as_array().empty());
 }
 
+static void test_preflight_elastic_sizes() {
+  // 8 slots, elastic [2, 8], pure DP mesh: batch 32 divides 2,4,8 but
+  // not 3,5,6,7 -> one DTL204 per bad size.
+  Json cfg = preflight_base_config();
+  cfg["hyperparameters"]["global_batch_size"] = static_cast<int64_t>(32);
+  Json el = Json::object();
+  el["min_slots"] = static_cast<int64_t>(2);
+  el["max_slots"] = static_cast<int64_t>(8);
+  cfg["resources"]["elastic"] = el;
+  Json d = det::preflight_config(cfg);
+  CHECK_EQ(d.as_array().size(), static_cast<size_t>(4));
+  for (const auto& diag : d.as_array()) {
+    CHECK_EQ(diag["code"].as_string(), "DTL204");
+    CHECK_EQ(diag["level"].as_string(), "error");
+  }
+
+  // tensor=2 must divide every size: 5 is unresolvable, and 6 resolves
+  // to data=3 which 32 doesn't divide — one DTL204 each; 4 is clean.
+  Json mesh = Json::object();
+  mesh["tensor"] = static_cast<int64_t>(2);
+  mesh["data"] = static_cast<int64_t>(-1);
+  cfg["hyperparameters"]["mesh"] = mesh;
+  el["min_slots"] = static_cast<int64_t>(4);
+  el["max_slots"] = static_cast<int64_t>(6);
+  cfg["resources"]["elastic"] = el;
+  Json d2 = det::preflight_config(cfg);
+  CHECK_EQ(d2.as_array().size(), static_cast<size_t>(2));
+  CHECK_EQ(d2.as_array()[0]["code"].as_string(), "DTL204");
+  CHECK_EQ(d2.as_array()[1]["code"].as_string(), "DTL204");
+
+  // Divisor range: clean. Non-elastic: DTL204 never fires.
+  el["min_slots"] = static_cast<int64_t>(4);
+  el["max_slots"] = static_cast<int64_t>(8);
+  cfg["resources"]["elastic"] = el;
+  // sizes 4..8 with tensor=2: 5 and 7 unresolvable -> restrict to the
+  // resolvable/divisible shape instead.
+  el["min_slots"] = static_cast<int64_t>(8);
+  el["max_slots"] = static_cast<int64_t>(8);
+  cfg["resources"]["elastic"] = el;
+  CHECK(det::preflight_config(cfg).as_array().empty());
+  Json plain = preflight_base_config();
+  plain["hyperparameters"]["global_batch_size"] = static_cast<int64_t>(32);
+  CHECK(det::preflight_config(plain).as_array().empty());
+
+  // Suppressible like every rule.
+  el["min_slots"] = static_cast<int64_t>(2);
+  el["max_slots"] = static_cast<int64_t>(8);
+  cfg["resources"]["elastic"] = el;
+  Json hp = Json::object();
+  hp["global_batch_size"] = static_cast<int64_t>(32);
+  cfg["hyperparameters"] = hp;  // drop the mesh block
+  Json pf = Json::object();
+  Json sup = Json::array();
+  sup.push_back(Json("DTL204"));
+  pf["suppress"] = sup;
+  pf["gate"] = "error";
+  cfg["preflight"] = pf;
+  Json d3 = det::preflight_config(cfg);
+  for (const auto& diag : d3.as_array()) {
+    CHECK(diag["suppressed"].as_bool(false));
+  }
+  CHECK(!det::preflight_should_fail(cfg, d3));
+}
+
 static void test_preflight_suppress_and_gate() {
   Json cfg = preflight_base_config();
   cfg["hyperparameters"]["global_batch_size"] = static_cast<int64_t>(30);
@@ -493,6 +557,7 @@ int main() {
       {"fit_zero_slot_aux", test_fit_zero_slot_aux},
       {"round_robin_order", test_round_robin_order},
       {"preflight_batch_mesh", test_preflight_batch_mesh},
+      {"preflight_elastic_sizes", test_preflight_elastic_sizes},
       {"preflight_searcher_rungs", test_preflight_searcher_rungs},
       {"preflight_restarts_without_checkpoints",
        test_preflight_restarts_without_checkpoints},

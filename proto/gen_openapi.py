@@ -101,8 +101,12 @@ ROUTES = [
      "Checkpoint lineage, newest first; ?state= filters (COMPLETED = the "
      "restore-fallback chain)"),
     ("get", "/api/v1/allocations/{id}", "allocations", "Introspect"),
+    ("get", "/api/v1/allocations/{id}/size_history", "allocations",
+     "Elastic allocation-size transitions (shrink on drain, grow-back), "
+     "oldest first"),
     ("get", "/api/v1/allocations/{id}/signals/preemption", "allocations",
-     "Preemption long-poll"),
+     "Preemption long-poll; elastic resize offers ride the same signal as "
+     "{resize, target_slots, deadline_seconds}"),
     ("post", "/api/v1/allocations/{id}/signals/ack_preemption",
      "allocations", "Ack preemption before checkpointing"),
     ("get", "/api/v1/allocations/{id}/rendezvous", "allocations",

@@ -1,0 +1,81 @@
+"""Elastic re-meshing e2e fixture (docs/elasticity.md): a real JaxTrial
+under the Trainer, slow enough (per-batch sleep) that a drain notice lands
+mid-run.
+
+Run 1 holds the preferred size; a spot notice on its agent makes the master
+issue a RESIZE OFFER instead of a plain preemption. The Trainer takes a
+deadline-budgeted emergency checkpoint and exits clean; the master
+re-places the SAME allocation at target_slots on surviving capacity (no
+trial requeue, restarts untouched). Run 2 restores the emergency
+checkpoint under the smaller mesh — orbax reshards on read — and trains
+on; a later grow offer moves it back the same way. Logging is configured
+so the Trainer's resize / restore lines land in the task log for the
+test's assertions.
+"""
+
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+import optax
+
+
+def main() -> int:
+    logging.basicConfig(
+        level=logging.INFO, stream=sys.stderr,
+        format="%(name)s: %(message)s")
+
+    from determined_tpu import core
+    from determined_tpu.parallel.mesh import MeshConfig
+    from determined_tpu.train import JaxTrial, Trainer
+    from determined_tpu.train.trial import TrialContext
+
+    step_sleep = float(os.environ.get("ELASTIC_STEP_SLEEP", "0.1"))
+
+    class ElasticTrial(JaxTrial):
+        prefetch = False  # keep batch consumption deterministic
+
+        def init_params(self, rng):
+            import jax
+
+            return {"w": jax.random.normal(rng, (4,)) * 0.1}
+
+        def param_logical_axes(self):
+            return {"w": (None,)}
+
+        def loss(self, params, batch, rng):
+            import jax.numpy as jnp
+
+            return jnp.mean((params["w"] - batch["x"]) ** 2)
+
+        def optimizer(self):
+            return optax.sgd(0.1)
+
+        def mesh_config(self):
+            # data=-1 absorbs whatever slot count the scheduler granted —
+            # the shape every elastic trial wants (preflight DTL204 checks
+            # the fixed axes divide every size in [min_slots, max_slots]).
+            return MeshConfig()
+
+        def build_training_data(self):
+            rng = np.random.default_rng(7)
+            while True:
+                time.sleep(step_sleep)
+                # batch of 8 divides every elastic size the test uses
+                yield {"x": rng.normal(size=(8, 4)).astype(np.float32)}
+
+    with core.init(async_checkpointing=False) as ctx:
+        import jax
+
+        print(f"elastic fixture: {jax.device_count()} device(s) visible",
+              flush=True)
+        trainer = Trainer(ElasticTrial(TrialContext()), core_context=ctx)
+        trainer.fit(report_period=1)
+    print("elastic fixture: trial complete", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

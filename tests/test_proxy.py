@@ -17,7 +17,12 @@ def cluster(tmp_path, native_binaries):  # noqa: F811
     c.start_master()
     c.start_agent()
     yield c
+    # Teardown kills the task process groups the SIGKILLed agent can no
+    # longer reap (VERDICT item 6: the spawned proxy/ws/shell servers used
+    # to outlive the suite) — and proves it left nothing behind.
     c.stop()
+    assert c.find_orphans() == [], (
+        "devcluster teardown leaked task processes")
 
 
 SERVER = textwrap.dedent("""

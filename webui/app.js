@@ -516,9 +516,10 @@ async function pageExperiment(id) {
   const hpNames = [...new Set(trials.flatMap(
     (t) => Object.keys(t.hparams || {})))].sort();
   view.append(el("h2", {}, "Trials"));
+  const elastic = experiment.config?.resources?.elastic;
   view.append(el("table", {},
     el("tr", {}, ["ID", "State", "Batches", ...hpNames, metricName,
-                  "Restarts", "Logs"].map((h) => el("th", {}, h))),
+                  "Slots", "Restarts", "Logs"].map((h) => el("th", {}, h))),
     trials.map((t) => el("tr", {},
       el("td", {}, t.id), el("td", {}, stateBadge(t.state)),
       el("td", {}, t.total_batches ?? 0),
@@ -526,6 +527,12 @@ async function pageExperiment(id) {
         t.hparams && h in t.hparams ? fmt(t.hparams[h]) : "")),
       el("td", {}, t.searcher_metric_value == null
         ? "" : fmt(t.searcher_metric_value)),
+      // Elastic trials may run below/above their preferred size; show the
+      // size the trial holds RIGHT NOW (docs/elasticity.md).
+      el("td", elastic ? { title:
+        `elastic ${elastic.min_slots ?? 1}–${elastic.max_slots ?? "?"}` } : {},
+        t.current_slots ??
+          (experiment.config?.resources?.slots_per_trial ?? 1)),
       el("td", {}, t.restarts ?? 0),
       el("td", {}, el("a", { href: `#/trials/${t.id}` }, "logs"))))));
 
@@ -657,7 +664,22 @@ async function pageTrial(id) {
     ` / Trial ${id} `, stateBadge(trial.state)));
   view.append(el("p", { class: "muted" },
     `batches ${trial.total_batches ?? 0} · restarts ${trial.restarts ?? 0}` +
+    (trial.current_slots != null ? ` · slots ${trial.current_slots}` : "") +
     (trial.latest_checkpoint ? ` · checkpoint ${trial.latest_checkpoint}` : "")));
+  // Elastic size history (docs/elasticity.md): each shrink/grow the
+  // scheduler put this trial through, with the drain/scale-up reason.
+  if ((trial.size_history ?? []).length) {
+    view.append(el("h2", {}, "Size history"));
+    view.append(el("table", {},
+      el("tr", {}, ["When", "Allocation", "From", "To", "Reason"]
+        .map((h) => el("th", {}, h))),
+      trial.size_history.map((ev) => el("tr", {},
+        el("td", { class: "muted" }, ev.created_at ?? ""),
+        el("td", { class: "muted" }, ev.allocation_id ?? ""),
+        el("td", {}, ev.from_slots),
+        el("td", {}, ev.to_slots),
+        el("td", { class: "muted" }, ev.reason ?? "")))));
+  }
 
   // Log viewer with follow (reference TrialLogs page; long-polls the
   // master's follow endpoint so new lines stream in live).
