@@ -3,7 +3,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint test chaos bench-input bench-serve native native-test clean
+.PHONY: lint test chaos bench-input bench-serve bench-trace native native-test clean
 
 # The dogfood gate (docs/preflight.md): the platform's own models and
 # examples must pass the platform's own static analyzer. Fails on any
@@ -25,6 +25,7 @@ chaos:
 	timeout -k 30 $(CHAOS_TIMEOUT) $(PY) -m pytest \
 		tests/test_chaos.py tests/test_selfheal.py tests/test_preemption.py \
 		tests/test_serving.py tests/test_elastic.py \
+		tests/test_observability.py \
 		-q -m slow
 
 # Async input pipeline A/B: prefetch on/off step time + input_wait_ms
@@ -44,6 +45,12 @@ bench-serve:
 # (docs/elasticity.md). Emits elastic_resize_downtime_s.
 bench-elastic:
 	$(PY) bench.py --only elastic
+
+# Observability overhead + throughput (docs/observability.md): step_ms
+# with lifecycle tracing on vs off (the <1% always-on gate) and span-
+# ingest throughput on the real master under concurrent batched POSTs.
+bench-trace:
+	$(PY) bench.py --only trace
 
 native:
 	$(MAKE) -C native

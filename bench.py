@@ -359,6 +359,180 @@ def elastic_bench() -> None:
     }))
 
 
+def trace_bench() -> None:
+    """`make bench-trace` (docs/observability.md): (a) step_ms with
+    lifecycle tracing on vs off — the <1% overhead gate that keeps
+    tracing always-on; (b) span-ingest throughput on the real master
+    under concurrent batched POSTs, the `bench_asha.py`-shaped control-
+    plane load."""
+    import os
+    import tempfile
+    import threading
+
+    import jax
+    import optax
+
+    from determined_tpu import core
+    from determined_tpu.parallel.mesh import MeshConfig
+    from determined_tpu.train import Trainer
+    from determined_tpu.train.trial import JaxTrial, TrialContext
+
+    class TinyTrial(JaxTrial):
+        prefetch = False
+
+        def init_params(self, rng):
+            return {"w": jax.random.normal(rng, (256, 256)) * 0.02}
+
+        def param_logical_axes(self):
+            return {"w": (None, None)}
+
+        def loss(self, params, batch, rng):
+            import jax.numpy as jnp
+
+            return jnp.mean((batch["x"] @ params["w"] - batch["y"]) ** 2)
+
+        def optimizer(self):
+            return optax.sgd(1e-3)
+
+        def mesh_config(self):
+            return MeshConfig()
+
+        def build_training_data(self):
+            rng = np.random.default_rng(0)
+            for _ in range(4096):
+                yield {"x": rng.normal(size=(32, 256)).astype(np.float32),
+                       "y": rng.normal(size=(32, 256)).astype(np.float32)}
+
+    def steady_sps(trace_off: bool):
+        """Median steps/second across post-compile metric flushes for one
+        local fit (tracing toggled via DET_TRACE_OFF)."""
+        old = os.environ.get("DET_TRACE_OFF")
+        os.environ["DET_TRACE_OFF"] = "1" if trace_off else "0"
+        try:
+            with tempfile.TemporaryDirectory() as tmp:
+                ctx = core.init(max_length=400, checkpoint_dir=tmp,
+                                async_checkpointing=False)
+                trainer = Trainer(TinyTrial(TrialContext()),
+                                  core_context=ctx)
+                trainer.fit(report_period=20, checkpoint_period=100)
+                flushes = [m["metrics"]["steps_per_second"]
+                           for m in ctx.train.local_training_metrics
+                           if "steps_per_second" in m["metrics"]]
+                n_spans = len(ctx.tracer.local_spans)
+                ctx.close()
+            assert len(flushes) >= 5, flushes
+            # Drop the compile-bearing first flush; median over the rest.
+            return float(np.median(flushes[1:])), n_spans
+        finally:
+            if old is None:
+                os.environ.pop("DET_TRACE_OFF", None)
+            else:
+                os.environ["DET_TRACE_OFF"] = old
+
+    # Interleave on/off runs in one process AND alternate which goes
+    # first each round: process warmup (allocator, caches) favors
+    # whichever mode runs later, so a fixed order would bias the delta.
+    on_runs, off_runs, spans_per_run = [], [], 0
+    for i in range(4):
+        for off_first in ([True, False] if i % 2 else [False, True]):
+            sps, n_spans = steady_sps(trace_off=off_first)
+            (off_runs if off_first else on_runs).append(sps)
+            if not off_first:
+                spans_per_run = n_spans
+    sps_on = float(np.median(on_runs))
+    sps_off = float(np.median(off_runs))
+    overhead_pct = (sps_off / sps_on - 1.0) * 100.0
+
+    print(json.dumps({
+        "metric": "trace_overhead_pct",
+        "value": round(overhead_pct, 3),
+        "unit": "% step_ms added by always-on tracing (gate: < 1%)",
+        "vs_baseline": round(sps_on / sps_off, 4),
+        "detail": {
+            "steps_per_s_tracing_on": round(sps_on, 2),
+            "steps_per_s_tracing_off": round(sps_off, 2),
+            "spans_emitted_per_run": spans_per_run,
+            "gate_passed": overhead_pct < 1.0,
+        },
+    }))
+
+    # (b) span-ingest throughput on the real master.
+    import shutil
+    import subprocess
+    import uuid
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    bindir = os.path.join(repo, "native", "bin")
+    if not os.path.exists(os.path.join(bindir, "determined-master")):
+        subprocess.run(["make", "-C", os.path.join(repo, "native")],
+                       check=True, capture_output=True)
+    sys.path.insert(0, repo)
+    from tests.test_platform_e2e import Devcluster
+
+    tmp = tempfile.mkdtemp(prefix="bench_trace_")
+    cluster = Devcluster(tmp, bindir)
+    try:
+        cluster.start_master()
+        token = cluster.login()
+        eid = cluster.api("POST", "/api/v1/experiments",
+                          {"unmanaged": True,
+                           "config": {"name": "bench-trace"}},
+                          token=token)["id"]
+        tid = cluster.api("POST", f"/api/v1/experiments/{eid}/trials",
+                          {"hparams": {}}, token=token)["id"]
+
+        batch_size, n_threads, batches_per_thread = 100, 4, 25
+
+        def make_batch():
+            t0 = int(time.time() * 1e6)
+            return {"spans": [
+                {"trace_id": "bench", "span_id": uuid.uuid4().hex[:16],
+                 "parent": "bench", "name": "harness.validate",
+                 "start_us": t0 + i, "end_us": t0 + i + 1000,
+                 "attrs": {"bench": True}}
+                for i in range(batch_size)]}
+
+        errors = []
+
+        def pump():
+            for _ in range(batches_per_thread):
+                try:
+                    cluster.api("POST", f"/api/v1/trials/{tid}/spans",
+                                make_batch(), token=token)
+                except Exception as e:  # noqa: BLE001 — report, don't hang
+                    errors.append(e)
+
+        threads = [threading.Thread(target=pump) for _ in range(n_threads)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.monotonic() - t0
+        assert not errors, errors[0]
+        total = batch_size * n_threads * batches_per_thread
+        rows = None
+        trace = cluster.api("GET", f"/api/v1/trials/{tid}/trace",
+                            token=token)
+        rows = len(trace["spans"])
+        print(json.dumps({
+            "metric": "span_ingest_spans_per_s",
+            "value": round(total / dt, 1),
+            "unit": f"spans/s ({n_threads} writers, {batch_size}/batch, "
+                    "persisted + readable)",
+            "vs_baseline": 1.0,
+            "detail": {
+                "total_spans": total,
+                "rows_readable": rows,
+                "wall_s": round(dt, 3),
+                "all_persisted": rows == total,
+            },
+        }))
+    finally:
+        cluster.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def serve_bench() -> None:
     """`make bench-serve`: continuous batching vs the sequential
     one-request-at-a-time baseline on the same GPT-2 checkpoint.
@@ -574,6 +748,7 @@ def main() -> int:
         "input": input_pipeline_bench,
         "serve": serve_bench,
         "elastic": elastic_bench,
+        "trace": trace_bench,
     }
     rc = 0
     for name, fn in sections.items():

@@ -22,10 +22,22 @@ def main(argv=None) -> int:
                    help="files or directories to lint (default: "
                         "determined_tpu examples)")
     p.add_argument("--json", action="store_true", dest="as_json")
+    p.add_argument("--no-metric-lint", action="store_true",
+                   help="skip the metric/span name registry check")
     args = p.parse_args(argv)
 
     diags = astlint.lint_paths(args.paths or ["determined_tpu", "examples"])
     active = [d for d in diags if not d.suppressed]
+
+    # Metric/span-name registry check (docs/observability.md): master,
+    # agent, serve and harness must agree with common/metric_names.py.
+    metric_problems = []
+    if not args.as_json and not args.no_metric_lint:
+        from determined_tpu.analysis import metric_lint
+
+        metric_problems = metric_lint.lint_registry()
+        for prob in metric_problems:
+            print(f"metric-lint: {prob}")
     if args.as_json:
         print(json.dumps([d.to_dict() for d in diags], indent=2))
     else:
@@ -35,8 +47,9 @@ def main(argv=None) -> int:
                 tag += " (suppressed)"
             print(f"{d.location()}: {tag}: {d.message}")
         n_sup = len(diags) - len(active)
-        print(f"lint: {len(active)} finding(s), {n_sup} suppressed")
-    return 1 if active else 0
+        print(f"lint: {len(active)} finding(s), {n_sup} suppressed; "
+              f"metric-lint: {len(metric_problems)} finding(s)")
+    return 1 if active or metric_problems else 0
 
 
 if __name__ == "__main__":

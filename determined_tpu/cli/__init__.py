@@ -209,6 +209,23 @@ def cmd_trial_describe(session: Session, args) -> int:
     return 0
 
 
+def cmd_trial_trace(session: Session, args) -> int:
+    """Text waterfall of the trial's lifecycle trace: queue wait,
+    container start, compile, restore, checkpoints, validation
+    (docs/observability.md)."""
+    from determined_tpu.common.trace import render_waterfall
+
+    resp = session.get(f"/api/v1/trials/{args.id}/trace")
+    spans = resp.get("spans", [])
+    if args.json:
+        print(json.dumps(resp, indent=2))
+        return 0
+    print(f"trial {args.id} trace {resp.get('trace_id') or '(none)'} — "
+          f"{len(spans)} span(s)")
+    print(render_waterfall(spans))
+    return 0
+
+
 def cmd_trial_logs(session: Session, args) -> int:
     offset = 0
     task_id = f"trial-{args.id}"
@@ -830,6 +847,10 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("id", type=int)
     t.add_argument("-f", "--follow", action="store_true")
     t.set_defaults(func=cmd_trial_logs)
+    t = tr.add_parser("trace")
+    t.add_argument("id", type=int)
+    t.add_argument("--json", action="store_true")
+    t.set_defaults(func=cmd_trial_trace)
 
     cp = sub.add_parser("checkpoint").add_subparsers(dest="subcommand", required=True)
     c = cp.add_parser("list")

@@ -1,0 +1,138 @@
+"""Single source of truth for every exported metric and span name.
+
+The master (C++), agent (C++), serving replicas (Python) and the harness
+all publish observability data; this registry is what keeps them from
+drifting apart on the same gauge (docs/observability.md). `make lint`
+runs determined_tpu/analysis/metric_lint.py, which checks BOTH directions:
+
+  - every `det_*` metric name and every span name emitted anywhere in the
+    scanned sources must be registered here, and
+  - every registered name must still be emitted somewhere (a stale
+    registry row is drift too).
+
+Naming rules (enforced by the lint):
+  - metric names: snake_case, `det_` prefix; counters end `_total`;
+    time/size-bearing names carry a unit suffix (`_seconds`, `_ms`,
+    `_us`, `_bytes`, `_lines`);
+  - span names: lowercase dot-separated segments
+    (`component.phase[.subphase]`).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Tuple
+
+# name -> (prometheus type, help)
+MASTER_METRICS: Dict[str, Tuple[str, str]] = {
+    "det_agents_alive": ("gauge", "Agents with a live heartbeat"),
+    "det_slots_total": ("gauge", "Slots on alive agents"),
+    "det_slots_free": ("gauge", "Enabled, unallocated slots on alive agents"),
+    "det_slots_allocated": ("gauge", "Slots bound to an allocation"),
+    "det_slots_draining": ("gauge", "Slots on DRAINING agents"),
+    "det_scheduler_queue_depth": ("gauge", "Allocations waiting for resources"),
+    "det_scheduler_queue_wait_seconds": (
+        "histogram", "Submit-to-placement wait per allocation"),
+    "det_allocations": ("gauge", "Allocations by state"),
+    "det_experiments": ("gauge", "Experiments by state"),
+    "det_preemptions_total": ("counter", "Allocation preemptions issued"),
+    "det_resizes_total": ("counter", "Elastic allocation-size transitions"),
+    "det_trial_requeues_total": (
+        "counter", "Trial container restarts re-queued by the master"),
+    "det_idempotency_replays_total": (
+        "counter", "POSTs answered from the idempotency replay cache"),
+    "det_stream_backlog_events": (
+        "gauge", "Entity-change events buffered for /api/v1/stream"),
+    "det_trial_spans_ingested_total": (
+        "counter", "Trace spans accepted by POST /trials/{id}/spans"),
+    "det_api_requests_total": ("counter", "API requests by status code"),
+    "det_api_request_seconds": (
+        "histogram", "API request latency by route family"),
+}
+
+AGENT_METRICS: Dict[str, Tuple[str, str]] = {
+    "det_agent_slots": ("gauge", "Slots this agent registered"),
+    "det_agent_tasks": ("gauge", "Supervised tasks by state"),
+    "det_agent_log_backlog_lines": (
+        "gauge", "Task-log lines queued or in flight to the master"),
+    "det_agent_draining": (
+        "gauge", "1 after a termination notice was posted, else 0"),
+    "det_agent_uptime_seconds": ("gauge", "Seconds since the agent started"),
+}
+
+SERVE_METRICS: Dict[str, Tuple[str, str]] = {
+    "det_serve_queue_depth": ("gauge", "Admission-queue depth"),
+    "det_serve_active_requests": ("gauge", "Requests joined into the batch"),
+    "det_serve_kv_blocks_free": ("gauge", "Free KV cache blocks"),
+    "det_serve_kv_blocks_total": ("gauge", "Total KV cache blocks"),
+    "det_serve_requests_total": ("counter", "Requests completed"),
+    "det_serve_tokens_total": ("counter", "Tokens generated"),
+    "det_serve_draining": ("gauge", "1 while draining, else 0"),
+}
+
+# span name -> (emitting component, help)
+SPAN_NAMES: Dict[str, Tuple[str, str]] = {
+    "trial.lifecycle": (
+        "master", "Root span: trial submit to terminal state"),
+    "trial.queue_wait": (
+        "master", "Allocation submit to placement (per container run)"),
+    "agent.image_setup": (
+        "agent", "Workdir + log-file preparation before fork"),
+    "agent.container_start": (
+        "agent", "Fork to the RUNNING report"),
+    "agent.log_drain": (
+        "agent", "Final log drain before the exit report"),
+    "harness.compile": (
+        "harness", "First jitted invocation per executable (trace+compile)"),
+    "harness.restore": (
+        "harness", "Checkpoint restore (lineage walk included)"),
+    "harness.reshard": (
+        "harness", "Elastic in-process re-mesh: rebuild + resharding restore"),
+    "harness.validate": (
+        "harness", "One validation pass"),
+    "harness.checkpoint.save": (
+        "harness", "Checkpoint phase 1: synchronous orbax save portion"),
+    "harness.checkpoint.commit": (
+        "harness", "Checkpoint phase 2: manifest + COMMIT + COMPLETED report"),
+    "harness.checkpoint.emergency": (
+        "harness", "Deadline-budgeted emergency checkpoint on preemption"),
+    "harness.resize.downtime": (
+        "harness", "Resize signal to first post-resize readiness"),
+}
+
+_METRIC_RE = re.compile(r"^det(_[a-z0-9]+)+$")
+_SPAN_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
+_UNIT_SUFFIXES = ("_total", "_seconds", "_ms", "_us", "_bytes", "_lines",
+                  "_events", "_depth", "_requests")
+# Words that imply a measured quantity and therefore REQUIRE a unit suffix.
+_UNIT_WORDS = ("seconds", "latency", "duration", "wait", "size", "backlog",
+               "uptime")
+
+
+def all_metrics() -> Dict[str, Tuple[str, str]]:
+    out: Dict[str, Tuple[str, str]] = {}
+    out.update(MASTER_METRICS)
+    out.update(AGENT_METRICS)
+    out.update(SERVE_METRICS)
+    return out
+
+
+def check_registry() -> list:
+    """Self-consistency: names conform to the naming rules. Returns a list
+    of violation strings (empty = clean)."""
+    problems = []
+    for name, (mtype, _) in all_metrics().items():
+        if not _METRIC_RE.match(name):
+            problems.append(f"metric {name!r}: not snake_case det_*")
+        if mtype == "counter" and not name.endswith("_total"):
+            problems.append(f"counter {name!r}: must end in _total")
+        if any(w in name for w in _UNIT_WORDS) and not name.endswith(
+                _UNIT_SUFFIXES):
+            problems.append(
+                f"metric {name!r}: measured quantity without a unit suffix "
+                f"({'/'.join(_UNIT_SUFFIXES)})")
+    for name in SPAN_NAMES:
+        if not _SPAN_RE.match(name):
+            problems.append(
+                f"span {name!r}: must be lowercase dot-separated segments")
+    return problems

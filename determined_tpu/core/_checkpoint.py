@@ -26,6 +26,7 @@ import time
 from typing import Any, Dict, Iterator, List, Optional
 
 from determined_tpu.common.api import Session
+from determined_tpu.common import trace as trace_mod
 from determined_tpu.core import _integrity
 from determined_tpu.core._integrity import CorruptCheckpoint  # noqa: F401  (re-export)
 from determined_tpu.storage.base import StorageManager
@@ -90,6 +91,13 @@ class CheckpointContext:
         self.last_save_ms: Optional[float] = None
         self._pending_sync_ms = 0.0
         self.local_reported: List[Dict[str, Any]] = []
+        # Lifecycle tracing (docs/observability.md): set by core.init —
+        # phase-1 saves and phase-2 commits land on the trial's trace.
+        self.tracer = None
+
+    def _span(self, name: str, start_us: int, **attrs) -> None:
+        if self.tracer is not None:
+            self.tracer.emit(name, start_us, trace_mod.now_us(), attrs)
 
     # -- orbax plumbing ------------------------------------------------
 
@@ -148,8 +156,13 @@ class CheckpointContext:
         if not _is_remote(path):
             os.makedirs(path, exist_ok=True)
         t0 = time.monotonic()
+        t0_us = trace_mod.now_us()
         self._ckptr().save(state_dir, state, force=True)
         self._pending_sync_ms = (time.monotonic() - t0) * 1000.0
+        # Phase 1 on the lifecycle trace: the synchronous save portion the
+        # train loop actually paid for (async overlap hides the rest).
+        self._span("harness.checkpoint.save", t0_us, storage_id=storage_id,
+                   steps_completed=steps_completed)
         md = dict(metadata or {})
         md.update(
             {
@@ -180,6 +193,7 @@ class CheckpointContext:
             import shutil
 
             t0 = time.monotonic()
+            t0_us = trace_mod.now_us()
             self.wait()
             try:
                 if self._is_chief():
@@ -190,6 +204,8 @@ class CheckpointContext:
             self._report(storage_id, md, state="COMPLETED")
             self.last_save_ms = (
                 self._pending_sync_ms + (time.monotonic() - t0) * 1000.0)
+            self._span("harness.checkpoint.commit", t0_us,
+                       storage_id=storage_id, staged=True)
             return storage_id
         self._pending_commit = (storage_id, path, md)
         if not self._async:
@@ -404,7 +420,9 @@ class CheckpointContext:
         """Block until pending async saves are durable AND committed
         (manifest + COMMIT marker written, COMPLETED reported)."""
         had_pending = self._pending_commit is not None
+        pending_id = self._pending_commit[0] if had_pending else None
         t0 = time.monotonic()
+        t0_us = trace_mod.now_us()
         c = self._checkpointer
         if c is not None and hasattr(c, "wait_until_finished"):
             c.wait_until_finished()
@@ -412,6 +430,10 @@ class CheckpointContext:
         if had_pending:
             self.last_save_ms = (
                 self._pending_sync_ms + (time.monotonic() - t0) * 1000.0)
+            # Phase 2 on the lifecycle trace: durability wait + manifest +
+            # COMMIT + the COMPLETED report.
+            self._span("harness.checkpoint.commit", t0_us,
+                       storage_id=pending_id)
 
     def close(self) -> None:
         self.wait()

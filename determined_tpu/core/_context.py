@@ -17,6 +17,7 @@ from typing import Any, Dict, Optional
 
 from determined_tpu._info import ClusterInfo, get_cluster_info
 from determined_tpu.common.api import Session
+from determined_tpu.common.trace import Tracer
 from determined_tpu.core._checkpoint import CheckpointContext
 from determined_tpu.core._distributed import DistributedContext
 from determined_tpu.core._preempt import PreemptContext
@@ -38,6 +39,7 @@ class Context:
         distributed: DistributedContext,
         profiler: ProfilerContext,
         info: Optional[ClusterInfo] = None,
+        tracer: Optional[Tracer] = None,
     ):
         self.train = train
         self.searcher = searcher
@@ -46,6 +48,10 @@ class Context:
         self.distributed = distributed
         self.profiler = profiler
         self.info = info
+        # Lifecycle tracing (docs/observability.md): chief-only emitter,
+        # buffered, flushed with metrics. Never None — local mode buffers
+        # into tracer.local_spans so instrumented code needs no guards.
+        self.tracer = tracer if tracer is not None else Tracer()
 
     @property
     def hparams(self) -> Dict[str, Any]:
@@ -62,8 +68,10 @@ class Context:
     def close(self) -> None:
         # Order matters (reference _context.py:79-118): drain checkpoint
         # writes first, final tensorboard sync, then stop watchers, then
-        # tear down distributed.
+        # tear down distributed. The tracer flushes after the checkpoint
+        # drain so phase-2 commit spans make the final batch.
         self.checkpoint.close()
+        self.tracer.close()
         if getattr(self.train, "_tb", None) is not None:
             self.train._tb.close()
         self.profiler.close()
@@ -175,7 +183,19 @@ def init(
     )
     preempt = PreemptContext(session, allocation_id=allocation_id, distributed=distributed)
     profiler = ProfilerContext(train)
-    ctx = Context(train, searcher, checkpoint, preempt, distributed, profiler, info)
+    # Span emitter: chief-only (non-chief ranks would duplicate every
+    # phase span), trace id from DET_TRACE_ID (minted by the master at
+    # trial submit; local mode mints its own so the same instrumentation
+    # is inspectable without a cluster).
+    is_chief = distributed is None or distributed.is_chief
+    tracer = Tracer(
+        session if is_chief else None,
+        trial_id=trial_id,
+        enabled=None if is_chief else False,
+    )
+    checkpoint.tracer = tracer  # phase-1/phase-2 commit spans
+    ctx = Context(train, searcher, checkpoint, preempt, distributed,
+                  profiler, info, tracer=tracer)
     if session is not None:
         try:
             session.post(f"/api/v1/trials/{trial_id}/run_prepare", body={})

@@ -96,11 +96,14 @@ class _Collector(threading.Thread):
         self._interval = interval
         self._get_step = get_step
         self._profiler = profiler
-        self._stop = threading.Event()
+        # NOT named `_stop`: that would shadow threading.Thread._stop and
+        # make join() blow up (the same bug class as the PR-5
+        # _PreemptionWatcher fix).
+        self._stop_event = threading.Event()
 
     def run(self) -> None:
         prev = None
-        while not self._stop.wait(self._interval):
+        while not self._stop_event.wait(self._interval):
             m = collect_system_metrics()
             try:
                 total, idle = _read_proc_stat()
@@ -118,7 +121,7 @@ class _Collector(threading.Thread):
                 logger.debug("profiler report failed", exc_info=True)
 
     def close(self) -> None:
-        self._stop.set()
+        self._stop_event.set()
 
 
 class ProfilerContext:
@@ -144,6 +147,8 @@ class ProfilerContext:
         self._input_h2d_ms = 0.0
         self._input_depth = 0.0
         self._input_batches = 0
+        self._collector_interval = 5.0
+        self._trace_active = False
 
     def set_step(self, step: int) -> None:
         self._step = step
@@ -198,6 +203,7 @@ class ProfilerContext:
 
     def on(self, sampling_interval: float = 5.0) -> None:
         if self._collector is None:
+            self._collector_interval = sampling_interval
             self._collector = _Collector(
                 self._train, sampling_interval, lambda: self._step, self
             )
@@ -205,20 +211,54 @@ class ProfilerContext:
 
     def off(self) -> None:
         if self._collector is not None:
-            self._collector.close()
+            collector = self._collector
             self._collector = None
+            collector.close()
+            # Bounded join: the collector sleeps up to one interval, and a
+            # wedged report must not hold close()/Context.close() hostage.
+            collector.join(timeout=self._collector_interval + 2.0)
+            if collector.is_alive():
+                logger.warning("profiler collector did not stop in time")
 
     @contextlib.contextmanager
     def trace(self, name: str = "train_step"):
-        """jax.profiler trace for a region → TensorBoard trace viewer."""
+        """jax.profiler trace for a region → TensorBoard trace viewer.
+
+        Hardened (docs/observability.md): re-entry is refused without
+        touching the profiler (a nested start_trace would wedge it), a
+        failed start logs and runs the body untraced, and stop_trace is
+        always attempted so a failure mid-body can't leave the profiler
+        stuck for every later trace() call.
+        """
+        if self._trace_active:
+            logger.warning(
+                "profiler.trace(%s): a trace is already active; running "
+                "untraced (jax.profiler does not nest)", name)
+            yield
+            return
         import jax
 
-        os.makedirs(self.tensorboard_dir, exist_ok=True)
-        jax.profiler.start_trace(self.tensorboard_dir)
+        started = False
+        try:
+            os.makedirs(self.tensorboard_dir, exist_ok=True)
+            jax.profiler.start_trace(self.tensorboard_dir)
+            started = True
+        except Exception:
+            # Profiler unavailability must not fail training: log, run
+            # the body untraced.
+            logger.warning("profiler.trace(%s): start_trace failed; "
+                           "running untraced", name, exc_info=True)
+        self._trace_active = started
         try:
             yield
         finally:
-            jax.profiler.stop_trace()
+            self._trace_active = False
+            if started:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    logger.warning("profiler.trace(%s): stop_trace failed",
+                                   name, exc_info=True)
 
     def close(self) -> None:
         self.off()

@@ -15,6 +15,11 @@ stats/health for load balancers and the master proxy.
 
   GET /v1/stats       batcher + engine counters (occupancy, KV blocks,
                       queue depth, compile times)
+  GET /metrics        the same counters in Prometheus text exposition
+                      (docs/observability.md) — a fleet scrape of every
+                      node sees serving replicas next to master/agent,
+                      and queue depth + occupancy are the autoscaling
+                      signal
   GET /healthz        {"status": "ok"|"draining"}
 
 The thread-per-request server is intentional: generate handlers spend
@@ -40,6 +45,29 @@ from determined_tpu.serve.scheduler import (
 logger = logging.getLogger("determined_tpu.serve")
 
 DEFAULT_REQUEST_TIMEOUT_S = 120.0
+
+
+def prometheus_exposition(stats: Dict[str, Any]) -> str:
+    """Fold ContinuousBatcher.stats() into Prometheus text format (names
+    registered in common/metric_names.py SERVE_METRICS)."""
+    kv = stats.get("kv_blocks", {}) or {}
+    lines = [
+        "# TYPE det_serve_queue_depth gauge",
+        f"det_serve_queue_depth {stats.get('queue_depth', 0)}",
+        "# TYPE det_serve_active_requests gauge",
+        f"det_serve_active_requests {stats.get('active', 0)}",
+        "# TYPE det_serve_draining gauge",
+        f"det_serve_draining {1 if stats.get('draining') else 0}",
+        "# TYPE det_serve_kv_blocks_free gauge",
+        f"det_serve_kv_blocks_free {kv.get('free_blocks', 0)}",
+        "# TYPE det_serve_kv_blocks_total gauge",
+        f"det_serve_kv_blocks_total {kv.get('num_blocks', 0)}",
+        "# TYPE det_serve_requests_total counter",
+        f"det_serve_requests_total {stats.get('completed', 0)}",
+        "# TYPE det_serve_tokens_total counter",
+        f"det_serve_tokens_total {stats.get('generated_tokens', 0)}",
+    ]
+    return "\n".join(lines) + "\n"
 
 
 def _make_handler(batcher: ContinuousBatcher):
@@ -70,6 +98,15 @@ def _make_handler(batcher: ContinuousBatcher):
                 stats = batcher.stats()
                 stats["engine"] = batcher.engine.stats()
                 self._send(200, stats)
+                return
+            if self.path == "/metrics":
+                data = prometheus_exposition(batcher.stats()).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
                 return
             self._send(404, {"error": "not found"})
 

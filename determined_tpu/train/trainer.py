@@ -28,6 +28,7 @@ import numpy as np
 from determined_tpu import _jax_compat
 from determined_tpu import core as core_mod
 from determined_tpu.common import faultpoint
+from determined_tpu.common import trace as trace_mod
 from determined_tpu.data import DevicePrefetcher, PrefetchConfig
 from determined_tpu.parallel.mesh import create_mesh
 from determined_tpu.train.health import (
@@ -43,6 +44,28 @@ from determined_tpu.train.watchdog import StepWatchdog
 _jax_compat.install()  # jax.sharding.set_mesh on jax < 0.5
 
 logger = logging.getLogger("determined_tpu.train")
+
+
+def _timed_first_call(fn, tracer, executable: str, install):
+    """Wrap a jitted step so its FIRST invocation lands a harness.compile
+    span on the lifecycle trace (dispatch blocks on trace+compile for a
+    cold executable; the persistent XLA cache makes warm ones near-zero,
+    which is exactly what the span is there to show). The wrapper then
+    UNINSTALLS itself via `install(fn)` — steady-state steps dispatch the
+    bare jitted callable, so tracing adds zero per-step cost (the
+    `make bench-trace` <1% gate)."""
+    if tracer is None or not tracer.enabled:
+        return fn
+
+    def wrapped(*args, **kwargs):
+        t0 = trace_mod.now_us()
+        out = fn(*args, **kwargs)
+        tracer.emit("harness.compile", t0, trace_mod.now_us(),
+                    {"executable": executable})
+        install(fn)
+        return out
+
+    return wrapped
 
 
 def _repeat(iterable_factory) -> Iterator[Any]:
@@ -161,19 +184,31 @@ class Trainer:
             def loss(params, batch, rng):  # noqa: F811 — pipelined selection
                 return trial.loss_pipelined(params, batch, rng, mesh)
 
-        self._train_step = make_train_step(
-            loss, tx, mesh=self.mesh, rules=self.rules,
-            donate_state=trial.donate_state, stateful=trial.stateful,
-        )
+        tracer = self.core.tracer if self.core is not None else None
+
+        def install_train(fn):
+            self._train_step = fn
+
+        def install_eval(fn):
+            self._eval_step = fn
+
+        self._train_step = _timed_first_call(
+            make_train_step(
+                loss, tx, mesh=self.mesh, rules=self.rules,
+                donate_state=trial.donate_state, stateful=trial.stateful,
+            ),
+            tracer, "train_step", install_train)
         has_eval = type(trial).evaluate is not JaxTrial.evaluate
         if pipelined and trial.supports_pipelined_eval():
             mesh = self.mesh
-            self._eval_step = make_eval_step(
-                lambda params, batch: trial.evaluate_pipelined(
-                    params, batch, mesh
+            self._eval_step = _timed_first_call(
+                make_eval_step(
+                    lambda params, batch: trial.evaluate_pipelined(
+                        params, batch, mesh
+                    ),
+                    mesh=self.mesh, rules=self.rules, stateful=trial.stateful,
                 ),
-                mesh=self.mesh, rules=self.rules, stateful=trial.stateful,
-            )
+                tracer, "eval_step", install_eval)
         elif has_eval:
             if pipelined:
                 logger.warning(
@@ -182,10 +217,12 @@ class Trainer:
                     "correct) — implement evaluate_pipelined() to fix",
                     type(trial).__name__,
                 )
-            self._eval_step = make_eval_step(
-                trial.evaluate, mesh=self.mesh, rules=self.rules,
-                stateful=trial.stateful,
-            )
+            self._eval_step = _timed_first_call(
+                make_eval_step(
+                    trial.evaluate, mesh=self.mesh, rules=self.rules,
+                    stateful=trial.stateful,
+                ),
+                tracer, "eval_step", install_eval)
         else:
             self._eval_step = None
 
@@ -461,11 +498,18 @@ class Trainer:
         if float(host.get("all_finite", 1.0)) < 1.0:
             host["divergence"] = 1.0
         core.train.report_training_metrics(last_step, host)
+        # Span batches ride the metric-flush cadence (buffer appends are
+        # the only tracing cost on the step path; the POST happens here).
+        core.tracer.flush()
         return host
 
     def _validate(self, core, step: int) -> Dict[str, Any]:
         if self._eval_step is None:
             return {}
+        with core.tracer.span("harness.validate", step=step):
+            return self._validate_inner(core, step)
+
+    def _validate_inner(self, core, step: int) -> Dict[str, Any]:
         # Accumulate per-batch metrics ON DEVICE and fetch once at the end:
         # a device_get per eval batch would serialize the eval loop on the
         # host round-trip (the same DTL101 host-sync hazard the preflight
@@ -548,21 +592,27 @@ class Trainer:
         estimate_ms = core.checkpoint.last_save_ms
         attempt = last_checkpointed != step and cfg.should_attempt_save(
             deadline, estimate_ms)
-        if attempt:
-            self._checkpoint(core, step)
-            core.checkpoint.wait()  # COMMIT must land inside the window
-        else:
-            if last_checkpointed != step:
-                logger.warning(
-                    "preemption deadline %.1fs cannot cover a durable save "
-                    "(last save %.0fms x%.1f safety + %.1fs margin); "
-                    "skipping the emergency checkpoint — restore will use "
-                    "the previous COMPLETED checkpoint",
-                    deadline, estimate_ms or 0.0, cfg.budget_safety_factor,
-                    cfg.budget_margin_sec)
-            # Commit whatever periodic save is still pending — that is the
-            # checkpoint the restart will land on.
-            core.checkpoint.wait()
+        # The emergency window on the lifecycle trace; the phase-1/phase-2
+        # checkpoint spans nest under it.
+        with core.tracer.span("harness.checkpoint.emergency",
+                              deadline_s=deadline, attempted=attempt,
+                              step=step):
+            if attempt:
+                self._checkpoint(core, step)
+                core.checkpoint.wait()  # COMMIT must land inside the window
+            else:
+                if last_checkpointed != step:
+                    logger.warning(
+                        "preemption deadline %.1fs cannot cover a durable "
+                        "save (last save %.0fms x%.1f safety + %.1fs "
+                        "margin); skipping the emergency checkpoint — "
+                        "restore will use the previous COMPLETED checkpoint",
+                        deadline, estimate_ms or 0.0,
+                        cfg.budget_safety_factor, cfg.budget_margin_sec)
+                # Commit whatever periodic save is still pending — that is
+                # the checkpoint the restart will land on.
+                core.checkpoint.wait()
+        core.tracer.flush()  # the process exits right after; don't lose it
         grace_used_ms = (time.monotonic() - t0) * 1000.0
         if resize_target is not None:
             # Managed elastic shrink on a drain: same budget math, but the
@@ -613,6 +663,14 @@ class Trainer:
 
         Downtime is checkpoint + reshard + one retrace — never a queue
         wait, and `restarts` is untouched."""
+        with core.tracer.span("harness.resize.downtime",
+                              from_slots=self.mesh.size, target=target):
+            return self._resize_in_process_inner(
+                core, target, step, last_checkpointed, data_iter, prefetcher)
+
+    def _resize_in_process_inner(self, core, target: int, step: int,
+                                 last_checkpointed: int, data_iter,
+                                 prefetcher: Optional[DevicePrefetcher]):
         from determined_tpu.train.state import abstract_train_state
 
         t0 = time.monotonic()
@@ -643,29 +701,33 @@ class Trainer:
                 "last save %s ms); resharding from %s instead",
                 deadline or -1.0, core.checkpoint.last_save_ms, restore_id)
 
-        # 2) Re-resolve the mesh for the target size over a prefix of the
-        # device list (preflight DTL204 guarantees every size in
-        # [min_slots, max_slots] resolves for elastic configs).
-        new_mesh = create_mesh(
-            self.trial.mesh_config().resolve(target), self._devices[:target])
-        self._mesh_stack.close()
-        self.mesh = new_mesh
-        self._mesh_stack.enter_context(jax.sharding.set_mesh(new_mesh))
-        self._build_steps()
+        # 2+3 are the reshard proper on the lifecycle trace (the restore
+        # span nests under it).
+        with core.tracer.span("harness.reshard", target=target):
+            # 2) Re-resolve the mesh for the target size over a prefix of
+            # the device list (preflight DTL204 guarantees every size in
+            # [min_slots, max_slots] resolves for elastic configs).
+            new_mesh = create_mesh(
+                self.trial.mesh_config().resolve(target),
+                self._devices[:target])
+            self._mesh_stack.close()
+            self.mesh = new_mesh
+            self._mesh_stack.enter_context(jax.sharding.set_mesh(new_mesh))
+            self._build_steps()
 
-        # 3) Restore by resharding: the template declares the NEW layout
-        # (aligned_param_specs under the new mesh); tensorstore reads each
-        # device's shard directly. No jitted random init is paid — the
-        # template is abstract.
-        self.state = abstract_train_state(
-            self.trial.init_params, self._tx, new_mesh, self._axes,
-            self.rules, extra=self.trial.init_extra())
-        restored = self._restore_chain([restore_id])
-        if restored is None:
-            raise RuntimeError(
-                f"resize to {target} slots failed: no restorable checkpoint "
-                f"in the lineage of {restore_id}")
-        step = int(jax.device_get(self.state.step))
+            # 3) Restore by resharding: the template declares the NEW
+            # layout (aligned_param_specs under the new mesh); tensorstore
+            # reads each device's shard directly. No jitted random init is
+            # paid — the template is abstract.
+            self.state = abstract_train_state(
+                self.trial.init_params, self._tx, new_mesh, self._axes,
+                self.rules, extra=self.trial.init_extra())
+            restored = self._restore_chain([restore_id])
+            if restored is None:
+                raise RuntimeError(
+                    f"resize to {target} slots failed: no restorable "
+                    f"checkpoint in the lineage of {restore_id}")
+            step = int(jax.device_get(self.state.step))
 
         # 4) Rebuild the input pipeline around the new batch sharding.
         # detach() preserves position: staged batches (sharded for the old
@@ -719,6 +781,15 @@ class Trainer:
         bug) and re-raises — silently discarding training progress on those
         was the seed behavior this replaces."""
         assert self.state is not None
+        with self.core.tracer.span(
+                "harness.restore",
+                requested=candidates[0] if candidates else "") as sp:
+            restored = self._restore_chain_inner(candidates)
+            if sp is not None:
+                sp.attrs["restored"] = restored or ""
+            return restored
+
+    def _restore_chain_inner(self, candidates) -> Optional[str]:
         queue = list(candidates)
         tried = set()
         extended = not queue  # empty input: nothing to extend from

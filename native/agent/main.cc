@@ -39,6 +39,7 @@
 #include "../common/faultpoint.h"
 #include "../common/http.h"
 #include "../common/json.h"
+#include "../common/trace.h"
 
 namespace {
 
@@ -71,6 +72,11 @@ struct AgentOptions {
   std::string notice_source;  // "" = off | "gce"
   std::string notice_file;
   std::string gce_metadata_url = "http://metadata.google.internal";
+  // Node-local Prometheus endpoint (docs/observability.md): every agent
+  // exposes its own /metrics so a fleet scrape sees task states, log-ship
+  // backlog and drain state per node. 0 = disabled; -1 = ephemeral port
+  // (printed at startup; tests use this).
+  int metrics_port = 0;
 };
 
 struct Task {
@@ -78,6 +84,11 @@ struct Task {
   std::string container_id;
   std::string task_id;
   std::string workdir;
+  // Lifecycle tracing (docs/observability.md): trial db id + trace id
+  // from the start action's env (DET_TRIAL_ID / DET_TRACE_ID); trial_id
+  // <= 0 (NTSC tasks) emits no spans.
+  long long trial_id = -1;
+  std::string trace_id;
   pid_t pid = -1;        // the sh wrapper's pid (the task's process group)
   long long pid_start = 0;  // /proc/<pid>/stat starttime: adoption identity
                             // check against pid recycling
@@ -105,6 +116,11 @@ struct Task {
 
 std::mutex g_mu;
 std::map<std::string, std::shared_ptr<Task>> g_tasks;  // by container_id
+
+// Observability state for /metrics (docs/observability.md).
+std::atomic<bool> g_draining{false};  // termination notice posted
+std::atomic<int> g_slots{0};          // slots registered with the master
+const auto g_started = std::chrono::steady_clock::now();
 
 // SIGTERM is a termination notice, not an exit: the handler only raises a
 // flag; the notice watcher turns it into a master notification and keeps
@@ -499,6 +515,28 @@ void report_state(const AgentOptions& opts, const std::string& alloc_id,
   }
 }
 
+// Fire-and-forget span delivery to the trial's lifecycle trace. Tracing
+// is best-effort by contract: a dead master must never wedge task
+// start/exit, so one attempt, failures logged and dropped.
+void post_trial_spans(const AgentOptions& opts, long long trial_id,
+                      const Json& spans) {
+  if (trial_id <= 0 || spans.as_array().empty()) return;
+  Json body = Json::object();
+  body["spans"] = spans;
+  try {
+    auto r = master_call(opts.master_url, "POST",
+                         "/api/v1/trials/" + std::to_string(trial_id) +
+                             "/spans",
+                         body.dump(), 5.0);
+    if (!r.ok()) {
+      std::cerr << "agent: span post rejected (" << r.status << ")"
+                << std::endl;
+    }
+  } catch (const std::exception& e) {
+    std::cerr << "agent: span post failed: " << e.what() << std::endl;
+  }
+}
+
 void finish_task(const AgentOptions& opts, std::shared_ptr<Task> task,
                  int code) {
   task->exited = true;
@@ -508,7 +546,17 @@ void finish_task(const AgentOptions& opts, std::shared_ptr<Task> task,
   // the task terminal on EXITED, and a user reading `det task logs` right
   // after must see the full output (bounded wait; a wedged master can't
   // hold the exit hostage forever).
+  int64_t drain_t0 = det::trace::now_us();
   drain_task_logs(task);
+  if (!task->trace_id.empty()) {
+    Json spans = Json::array();
+    spans.push_back(det::trace::make_span(
+        task->trace_id, "agent.log_drain", drain_t0, det::trace::now_us(),
+        "",
+        Json(JsonObject{{"container_id", Json(task->container_id)},
+                        {"exit_code", Json(static_cast<int64_t>(code))}})));
+    post_trial_spans(opts, task->trial_id, spans);
+  }
   Json done = Json::object();
   done["container_id"] = task->container_id;
   done["state"] = "EXITED";
@@ -576,6 +624,9 @@ void start_task(const AgentOptions& opts, const Json& action) {
   const Json& env = action["env"];
   task->task_id = env["DET_TASK_ID"].as_string();
   task->rank = static_cast<int>(env["DET_NODE_RANK"].as_int(0));
+  task->trial_id = env["DET_TRIAL_ID"].as_int(-1);
+  task->trace_id = env["DET_TRACE_ID"].as_string();
+  int64_t setup_t0 = det::trace::now_us();
 
   std::string workdir = opts.work_root + "/" + task->allocation_id + "-r" +
                         std::to_string(task->rank);
@@ -649,6 +700,7 @@ void start_task(const AgentOptions& opts, const Json& action) {
     std::cerr << "fork() failed" << std::endl;
     return;
   }
+  int64_t fork_us = det::trace::now_us();
   task->pid = pid;
   task->pid_start = pid_starttime(pid);
   std::cerr << "agent: started " << task->container_id << " pid=" << pid
@@ -666,6 +718,23 @@ void start_task(const AgentOptions& opts, const Json& action) {
   body["state"] = "RUNNING";
   body["daemon_addr"] = opts.addr;
   report_state(opts, task->allocation_id, body);
+
+  // Container-start phases on the trial's lifecycle trace: image_setup =
+  // workdir + log-file prep (a real image pull on container runtimes),
+  // container_start = fork to the RUNNING report landing.
+  if (!task->trace_id.empty()) {
+    Json attrs = Json(JsonObject{
+        {"container_id", Json(task->container_id)},
+        {"agent_id", Json(opts.id)},
+        {"rank", Json(static_cast<int64_t>(task->rank))}});
+    Json spans = Json::array();
+    spans.push_back(det::trace::make_span(
+        task->trace_id, "agent.image_setup", setup_t0, fork_us, "", attrs));
+    spans.push_back(det::trace::make_span(
+        task->trace_id, "agent.container_start", fork_us,
+        det::trace::now_us(), "", attrs));
+    post_trial_spans(opts, task->trial_id, spans);
+  }
 }
 
 // Reattach tasks recorded by a previous agent incarnation (reference
@@ -778,7 +847,9 @@ bool register_with_master(const AgentOptions& opts, bool reconnect) {
   body["addr"] = opts.addr;
   body["reconnect"] = reconnect;
   AgentOptions mut = opts;
-  body["slots"] = detect_slots(mut);
+  Json slots = detect_slots(mut);
+  g_slots = static_cast<int>(slots.as_array().size());
+  body["slots"] = slots;
   try {
     auto r = master_call(opts.master_url, "POST",
                          "/api/v1/agents/register", body.dump(), 10.0);
@@ -885,6 +956,56 @@ void heartbeat_loop(const AgentOptions& opts) {
       // reconnect-with-reattach, agent.go:330-362)
     }
   }
+}
+
+// ---- node-local /metrics ------------------------------------------------
+//
+// Prometheus text exposition for THIS node (docs/observability.md): the
+// master's /metrics sees the fleet through its own state machine; the
+// agent endpoint is the ground truth a per-node scrape needs — what is
+// actually running here, how far behind the log shipper is, and whether
+// a termination notice has this node draining. Unauthenticated by
+// design: it binds for node-local/VPC scrapers and carries no secrets,
+// the same posture as a node_exporter.
+
+det::HttpResponse agent_metrics_response() {
+  int running = 0, exited_pending = 0;
+  {
+    std::lock_guard<std::mutex> lock(g_mu);
+    for (const auto& [cid, t] : g_tasks) {
+      if (t->exited) {
+        ++exited_pending;
+      } else {
+        ++running;
+      }
+    }
+  }
+  long backlog = 0;
+  {
+    std::lock_guard<std::mutex> lock(g_log_mu);
+    for (const auto& [tid, n] : g_log_pending) backlog += n;
+  }
+  double uptime = std::chrono::duration<double>(
+                      std::chrono::steady_clock::now() - g_started)
+                      .count();
+  std::ostringstream out;
+  out << "# TYPE det_agent_slots gauge\n"
+      << "det_agent_slots " << g_slots.load() << "\n"
+      << "# TYPE det_agent_tasks gauge\n"
+      << "det_agent_tasks{state=\"running\"} " << running << "\n"
+      << "det_agent_tasks{state=\"exited_pending_report\"} "
+      << exited_pending << "\n"
+      << "# TYPE det_agent_log_backlog_lines gauge\n"
+      << "det_agent_log_backlog_lines " << backlog << "\n"
+      << "# TYPE det_agent_draining gauge\n"
+      << "det_agent_draining " << (g_draining.load() ? 1 : 0) << "\n"
+      << "# TYPE det_agent_uptime_seconds gauge\n"
+      << "det_agent_uptime_seconds " << uptime << "\n";
+  det::HttpResponse r;
+  r.status = 200;
+  r.content_type = "text/plain; version=0.0.4";
+  r.body = out.str();
+  return r;
 }
 
 // ---- termination-notice watcher -----------------------------------------
@@ -1010,6 +1131,7 @@ void notice_watch_loop(const AgentOptions& opts) {
     }
     if (deadline >= 0 && !reason.empty()) {
       notified = true;
+      g_draining = true;  // surfaced on /metrics (det_agent_draining)
       std::cerr << "agent: termination notice (" << reason << "), deadline "
                 << deadline << "s" << std::endl;
       post_preempt_notice(opts, deadline, reason);
@@ -1079,6 +1201,9 @@ int main(int argc, char** argv) {
     if (j["notice_file"].is_string()) {
       opts.notice_file = j["notice_file"].as_string();
     }
+    if (j["metrics_port"].is_number()) {
+      opts.metrics_port = static_cast<int>(j["metrics_port"].as_int());
+    }
   }
 
   if (const char* p = getenv("DET_MASTER")) opts.master_url = p;
@@ -1096,6 +1221,9 @@ int main(int argc, char** argv) {
     opts.notice_source = p;
   }
   if (const char* p = getenv("DET_AGENT_NOTICE_FILE")) opts.notice_file = p;
+  if (const char* p = getenv("DET_AGENT_METRICS_PORT")) {
+    opts.metrics_port = atoi(p);
+  }
   if (const char* p = getenv("DET_AGENT_GCE_METADATA_URL")) {
     opts.gce_metadata_url = p;
   }
@@ -1117,13 +1245,15 @@ int main(int argc, char** argv) {
     else if (a == "--term-grace") opts.term_grace_s = atof(next().c_str());
     else if (a == "--notice-source") opts.notice_source = next();
     else if (a == "--notice-file") opts.notice_file = next();
+    else if (a == "--metrics-port") opts.metrics_port = atoi(next().c_str());
     else if (a == "--config") next();
     else if (a == "--help" || a == "-h") {
       std::cout << "determined-agent [--config agent.json] --master-url URL "
                    "[--id ID] [--resource-pool P] [--addr A] [--slots N] "
                    "[--slot-type tpu|cpu] [--work-root DIR] "
                    "[--token-file PATH] [--term-grace SECONDS] "
-                   "[--notice-source gce] [--notice-file PATH]\n";
+                   "[--notice-source gce] [--notice-file PATH] "
+                   "[--metrics-port N  (0 off, -1 ephemeral)]\n";
       return 0;
     }
   }
@@ -1153,6 +1283,33 @@ int main(int argc, char** argv) {
   }
   std::cout << "agent " << opts.id << " registered with " << opts.master_url
             << std::endl;
+
+  // Node-local Prometheus endpoint (docs/observability.md). Started after
+  // registration so det_agent_slots reflects what the master was told.
+  det::HttpServer metrics_server;
+  if (opts.metrics_port != 0) {
+    try {
+      int port = metrics_server.listen(
+          "0.0.0.0", opts.metrics_port < 0 ? 0 : opts.metrics_port,
+          [](const det::HttpRequest& req) {
+            if (req.path == "/metrics" && req.method == "GET") {
+              return agent_metrics_response();
+            }
+            if (req.path == "/healthz") {
+              return det::HttpResponse::json(200, "{\"status\":\"ok\"}");
+            }
+            return det::HttpResponse::json(404,
+                                           "{\"error\":\"not found\"}");
+          });
+      metrics_server.start();
+      // Parseable by the devcluster harness when an ephemeral port was
+      // requested.
+      std::cout << "agent metrics on port " << port << std::endl;
+    } catch (const std::exception& e) {
+      std::cerr << "agent: metrics endpoint failed to bind ("
+                << e.what() << "); continuing without it" << std::endl;
+    }
+  }
 
   std::thread(shipper_loop, std::cref(opts)).detach();
   std::thread(heartbeat_loop, std::cref(opts)).detach();

@@ -1,0 +1,45 @@
+#include "trace.h"
+
+#include <chrono>
+#include <random>
+
+namespace det {
+namespace trace {
+
+int64_t now_us() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+std::string new_id() {
+  // Span ids only need uniqueness within a trace; thread_local mt19937_64
+  // seeded from random_device is plenty (session tokens use the CSPRNG
+  // path in master.cc, not this).
+  static thread_local std::mt19937_64 rng{std::random_device{}()};
+  static const char* hex = "0123456789abcdef";
+  std::string out(16, '0');
+  uint64_t v = rng();
+  for (int i = 0; i < 16; ++i) {
+    out[i] = hex[v & 0xf];
+    v >>= 4;
+  }
+  return out;
+}
+
+Json make_span(const std::string& trace_id, const std::string& name,
+               int64_t start_us, int64_t end_us, const std::string& parent,
+               const Json& attrs) {
+  Json s = Json::object();
+  s["trace_id"] = trace_id;
+  s["span_id"] = new_id();
+  s["parent"] = parent.empty() ? trace_id : parent;
+  s["name"] = name;
+  s["start_us"] = start_us;
+  s["end_us"] = end_us;
+  s["attrs"] = attrs.is_object() ? attrs : Json::object();
+  return s;
+}
+
+}  // namespace trace
+}  // namespace det

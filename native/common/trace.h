@@ -1,0 +1,39 @@
+// trace.h — trial-lifecycle span helpers (docs/observability.md).
+//
+// A span is {trace_id, span_id, parent, name, start_us, end_us, attrs}
+// with wall-clock epoch microseconds, the one clock domain shared by
+// master, agent and harness hosts. The master opens the root span
+// (span_id == trace_id) at trial submit and persists everything in the
+// trial_spans table (db migration 22); the agent builds its spans here
+// and POSTs them to /api/v1/trials/{id}/spans like the harness does.
+//
+// Span NAMES are registered in determined_tpu/common/metric_names.py
+// (SPAN_NAMES) — the metric/span lint greps make_span call sites, so
+// always pass the name as a string literal.
+
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+#include "json.h"
+
+namespace det {
+namespace trace {
+
+// Wall-clock epoch microseconds (NOT the master's steady clock — spans
+// from different hosts must land on one timeline).
+int64_t now_us();
+
+// Random 16-hex-char span/trace id.
+std::string new_id();
+
+// Build one span record. parent "" parents to the root (the reader treats
+// an unknown/empty parent as a root child); end_us 0 = still open.
+Json make_span(const std::string& trace_id, const std::string& name,
+               int64_t start_us, int64_t end_us,
+               const std::string& parent = "",
+               const Json& attrs = Json());
+
+}  // namespace trace
+}  // namespace det

@@ -491,6 +491,30 @@ DROP TABLE idempotency_keys;
 ALTER TABLE idempotency_keys_v2 RENAME TO idempotency_keys;
 CREATE INDEX idx_idempotency_created ON idempotency_keys(created_at);
 )sql"},
+      // Trial-lifecycle tracing (docs/observability.md): one trace per
+      // trial (trials.trace_id, minted at creation, DET_TRACE_ID in
+      // containers); spans from master/agent/harness land here via
+      // POST /api/v1/trials/{id}/spans and are served back by
+      // GET /api/v1/trials/{id}/trace. The unique (trial_id, span_id)
+      // index makes ingest idempotent at the row level — a replayed batch
+      // cannot double-insert.
+      {22, R"sql(
+ALTER TABLE trials ADD COLUMN trace_id TEXT;
+CREATE TABLE trial_spans (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  trial_id INTEGER NOT NULL,
+  trace_id TEXT NOT NULL,
+  span_id TEXT NOT NULL,
+  parent_span_id TEXT NOT NULL DEFAULT '',
+  name TEXT NOT NULL,
+  start_us INTEGER NOT NULL,
+  end_us INTEGER NOT NULL DEFAULT 0,
+  attrs TEXT NOT NULL DEFAULT '{}',
+  created_at TEXT NOT NULL DEFAULT (datetime('now'))
+);
+CREATE INDEX idx_trial_spans_trial ON trial_spans(trial_id, start_us);
+CREATE UNIQUE INDEX idx_trial_spans_span ON trial_spans(trial_id, span_id);
+)sql"},
   };
   return kMigrations;
 }

@@ -71,6 +71,17 @@ std::string random_hex(size_t nbytes) {
 
 namespace {
 
+// Fixed histogram bucket boundaries (Prometheus `le` upper bounds; +Inf
+// is implicit at exposition). Names live in
+// determined_tpu/common/metric_names.py.
+constexpr double kApiLatencyBuckets[] = {0.001, 0.005, 0.025, 0.1, 0.5, 2.5};
+constexpr size_t kApiLatencyBucketCount =
+    sizeof(kApiLatencyBuckets) / sizeof(kApiLatencyBuckets[0]);
+constexpr double kQueueWaitBuckets[] = {0.1, 0.5, 1.0, 5.0, 15.0, 60.0,
+                                        300.0};
+constexpr size_t kQueueWaitBucketCount =
+    sizeof(kQueueWaitBuckets) / sizeof(kQueueWaitBuckets[0]);
+
 std::vector<std::string> split_path(const std::string& path) {
   std::vector<std::string> parts;
   size_t start = 0;
@@ -388,11 +399,21 @@ HttpResponse Master::handle(const HttpRequest& req) {
     resp.hijack = [](Stream, std::string&&) {};
   }
   {
+    double secs = std::chrono::duration<double>(Clock::now() - t0).count();
     std::lock_guard<std::mutex> lock(api_stats_.mu);
     api_stats_.requests_by_status[resp.status]++;
-    api_stats_.seconds_sum +=
-        std::chrono::duration<double>(Clock::now() - t0).count();
+    api_stats_.seconds_sum += secs;
     api_stats_.seconds_count++;
+    // Per-route latency buckets (det_api_request_seconds): route families
+    // keep the label cardinality bounded — /trials/123/metrics and
+    // /trials/456/spans are both "trials".
+    Hist& h = api_stats_.route_hist[route_family(req.path)];
+    if (h.counts.empty()) h.counts.assign(kApiLatencyBucketCount, 0);
+    for (size_t i = 0; i < kApiLatencyBucketCount; ++i) {
+      if (secs <= kApiLatencyBuckets[i]) h.counts[i]++;
+    }
+    h.sum += secs;
+    h.count++;
   }
   return resp;
 }
@@ -417,6 +438,7 @@ HttpResponse Master::route_idempotent(const HttpRequest& req) {
   auto rows = db_.query(
       "SELECT status, body FROM idempotency_keys WHERE key=?", {Json(key)});
   if (!rows.empty()) {
+    fleet_.replay_hits.fetch_add(1);
     HttpResponse r = HttpResponse::json(
         static_cast<int>(rows[0]["status"].as_int(200)),
         rows[0]["body"].as_string());
@@ -889,19 +911,90 @@ HttpResponse Master::handle_stream(const HttpRequest& req) {
   return json_resp(200, out);
 }
 
+std::string Master::route_family(const std::string& path) {
+  // Bounded label cardinality: collapse ids, keep the resource family.
+  if (path.rfind("/api/v1/", 0) != 0) {
+    if (path == "/metrics") return "metrics";
+    if (path.rfind("/proxy", 0) == 0) return "proxy";
+    if (path.rfind("/ui", 0) == 0 || path == "/") return "ui";
+    return "other";
+  }
+  std::string rest = path.substr(8);  // after /api/v1/
+  size_t slash = rest.find('/');
+  std::string root = slash == std::string::npos ? rest : rest.substr(0, slash);
+  return root.empty() ? "other" : root;
+}
+
+void Master::observe_queue_wait_locked(double seconds) {
+  Hist& h = queue_wait_hist_;
+  if (h.counts.empty()) h.counts.assign(kQueueWaitBucketCount, 0);
+  for (size_t i = 0; i < kQueueWaitBucketCount; ++i) {
+    if (seconds <= kQueueWaitBuckets[i]) h.counts[i]++;
+  }
+  h.sum += seconds;
+  h.count++;
+}
+
+void Master::record_trial_span(int64_t trial_id, const Json& span) {
+  // INSERT OR IGNORE: the unique (trial_id, span_id) index makes span
+  // ingest idempotent at the row level (a replayed batch is a no-op).
+  db_.exec(
+      "INSERT OR IGNORE INTO trial_spans (trial_id, trace_id, span_id, "
+      "parent_span_id, name, start_us, end_us, attrs) "
+      "VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
+      {Json(trial_id), Json(span["trace_id"].as_string()),
+       Json(span["span_id"].as_string()), Json(span["parent"].as_string()),
+       Json(span["name"].as_string()), Json(span["start_us"].as_int()),
+       Json(span["end_us"].as_int()),
+       Json(span["attrs"].is_object() ? span["attrs"].dump() : "{}")});
+}
+
+namespace {
+
+// One histogram in Prometheus text format (cumulative buckets + +Inf).
+void emit_hist(std::ostringstream& out, const std::string& name,
+               const std::string& labels, const Hist& h,
+               const double* buckets, size_t n_buckets) {
+  std::string sep = labels.empty() ? "" : ",";
+  for (size_t i = 0; i < n_buckets; ++i) {
+    int64_t c = i < h.counts.size() ? h.counts[i] : 0;
+    out << name << "_bucket{" << labels << sep << "le=\"" << buckets[i]
+        << "\"} " << c << "\n";
+  }
+  out << name << "_bucket{" << labels << sep << "le=\"+Inf\"} " << h.count
+      << "\n";
+  if (labels.empty()) {
+    out << name << "_sum " << h.sum << "\n"
+        << name << "_count " << h.count << "\n";
+  } else {
+    out << name << "_sum{" << labels << "} " << h.sum << "\n"
+        << name << "_count{" << labels << "} " << h.count << "\n";
+  }
+}
+
+}  // namespace
+
 HttpResponse Master::handle_prometheus_metrics() {
-  // Prometheus text exposition format. Gauges over the in-memory cluster
-  // state + API counters (reference det_state_metrics.go gauges).
+  // Prometheus text exposition format: cluster-state gauges, fleet event
+  // counters, queue-wait + per-route latency histograms (reference
+  // det_state_metrics.go; names registered in
+  // determined_tpu/common/metric_names.py, docs/observability.md).
   std::ostringstream out;
   {
     std::lock_guard<std::mutex> lock(mu_);
     int agents_alive = 0, slots_total = 0, slots_free = 0;
+    int slots_allocated = 0, slots_draining = 0;
     for (const auto& [id, a] : agents_) {
       if (!a.alive) continue;
       ++agents_alive;
       for (const auto& s : a.slots) {
         ++slots_total;
-        if (s.enabled && s.allocation_id.empty()) ++slots_free;
+        if (a.draining) ++slots_draining;
+        if (!s.allocation_id.empty()) {
+          ++slots_allocated;
+        } else if (s.enabled) {
+          ++slots_free;
+        }
       }
     }
     std::map<std::string, int> allocs_by_state;
@@ -915,8 +1008,17 @@ HttpResponse Master::handle_prometheus_metrics() {
         << "det_slots_total " << slots_total << "\n"
         << "# TYPE det_slots_free gauge\n"
         << "det_slots_free " << slots_free << "\n"
+        << "# TYPE det_slots_allocated gauge\n"
+        << "det_slots_allocated " << slots_allocated << "\n"
+        << "# TYPE det_slots_draining gauge\n"
+        << "det_slots_draining " << slots_draining << "\n"
         << "# TYPE det_scheduler_queue_depth gauge\n"
-        << "det_scheduler_queue_depth " << pending_.size() << "\n";
+        << "det_scheduler_queue_depth " << pending_.size() << "\n"
+        << "# TYPE det_stream_backlog_events gauge\n"
+        << "det_stream_backlog_events " << stream_events_.size() << "\n";
+    out << "# TYPE det_scheduler_queue_wait_seconds histogram\n";
+    emit_hist(out, "det_scheduler_queue_wait_seconds", "", queue_wait_hist_,
+              kQueueWaitBuckets, kQueueWaitBucketCount);
     out << "# TYPE det_allocations gauge\n";
     for (const auto& [state, n] : allocs_by_state) {
       out << "det_allocations{state=\"" << state << "\"} " << n << "\n";
@@ -926,16 +1028,29 @@ HttpResponse Master::handle_prometheus_metrics() {
       out << "det_experiments{state=\"" << state << "\"} " << n << "\n";
     }
   }
+  out << "# TYPE det_preemptions_total counter\n"
+      << "det_preemptions_total " << fleet_.preemptions.load() << "\n"
+      << "# TYPE det_resizes_total counter\n"
+      << "det_resizes_total " << fleet_.resizes.load() << "\n"
+      << "# TYPE det_trial_requeues_total counter\n"
+      << "det_trial_requeues_total " << fleet_.requeues.load() << "\n"
+      << "# TYPE det_idempotency_replays_total counter\n"
+      << "det_idempotency_replays_total " << fleet_.replay_hits.load() << "\n"
+      << "# TYPE det_trial_spans_ingested_total counter\n"
+      << "det_trial_spans_ingested_total " << fleet_.spans_ingested.load()
+      << "\n";
   {
     std::lock_guard<std::mutex> lock(api_stats_.mu);
     out << "# TYPE det_api_requests_total counter\n";
     for (const auto& [code, n] : api_stats_.requests_by_status) {
       out << "det_api_requests_total{code=\"" << code << "\"} " << n << "\n";
     }
-    out << "# TYPE det_api_request_seconds summary\n"
-        << "det_api_request_seconds_sum " << api_stats_.seconds_sum << "\n"
-        << "det_api_request_seconds_count " << api_stats_.seconds_count
-        << "\n";
+    out << "# TYPE det_api_request_seconds histogram\n";
+    for (const auto& [route, h] : api_stats_.route_hist) {
+      emit_hist(out, "det_api_request_seconds",
+                "route=\"" + route + "\"", h, kApiLatencyBuckets,
+                kApiLatencyBucketCount);
+    }
   }
   HttpResponse r;
   r.status = 200;

@@ -13,6 +13,7 @@
 #include <thread>
 
 #include "../common/faultpoint.h"
+#include "../common/trace.h"
 #include "master.h"
 #include "scheduler_fit.h"
 
@@ -605,9 +606,28 @@ void Master::schedule_locked() {
       // Placement is the RM's; binding the trial + persisting is ours.
       Allocation& alloc = it->second;
       ExperimentState* exp = find_experiment_locked(alloc.experiment_id);
+      TrialState* trial = nullptr;
       if (exp != nullptr) {
         auto tit = exp->trials.find(alloc.request_id);
-        if (tit != exp->trials.end()) tit->second.allocation_id = alloc.id;
+        if (tit != exp->trials.end()) {
+          trial = &tit->second;
+          trial->allocation_id = alloc.id;
+        }
+      }
+      // Queue-wait observability: the fleet histogram sees every
+      // placement; trials additionally get a trial.queue_wait span on
+      // their lifecycle trace (docs/observability.md).
+      observe_queue_wait_locked(now() - alloc.submitted_at);
+      if (trial != nullptr && !trial->trace_id.empty() &&
+          alloc.submitted_wall_us > 0) {
+        record_trial_span(
+            trial->id,
+            trace::make_span(
+                trial->trace_id, "trial.queue_wait",
+                alloc.submitted_wall_us, trace::now_us(), "",
+                Json(JsonObject{
+                    {"allocation_id", Json(alloc.id)},
+                    {"slots", Json(static_cast<int64_t>(alloc.slots))}})));
       }
       // Persist the full placement so restore-on-boot can re-adopt the
       // allocation (which agents, which chips, which containers).
@@ -875,6 +895,15 @@ Json Master::build_task_env_locked(Allocation& alloc,
     env["DET_EXPERIMENT_ID"] = exp->id;
     env["DET_EXPERIMENT_CONFIG"] = exp->config.dump();
     env["DET_TRIAL_ID"] = trial->id;
+    // Lifecycle-trace propagation: agent + harness spans parent to the
+    // root span whose span_id == this trace id. Pre-migration trials have
+    // none — mint and persist on first container run.
+    if (trial->trace_id.empty()) {
+      trial->trace_id = trace::new_id();
+      db_.exec("UPDATE trials SET trace_id=? WHERE id=?",
+               {Json(trial->trace_id), Json(trial->id)});
+    }
+    env["DET_TRACE_ID"] = trial->trace_id;
     env["DET_TRIAL_REQUEST_ID"] = trial->request_id;
     env["DET_TRIAL_RUN_ID"] = trial->run_id;
     env["DET_TRIAL_SEED"] = trial->seed;
@@ -921,6 +950,7 @@ void Master::preempt_allocation_locked(Allocation& alloc,
   alloc.preempt_deadline = deadline;
   alloc.preempt_reason = why;
   alloc.exit_reason = why;
+  fleet_.preemptions.fetch_add(1);
   if (notify) cv_.notify_all();  // wakes the preemption long-poll watchers
 }
 

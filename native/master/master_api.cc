@@ -14,6 +14,7 @@
 #include <deque>
 #include <iostream>
 
+#include "../common/trace.h"
 #include "master.h"
 #include "preflight.h"
 
@@ -508,10 +509,16 @@ HttpResponse Master::handle_trials(const HttpRequest& req,
   // experiment owner (try_fit_locked). Reads stay open to all
   // authenticated users.
   if (req.method != "GET") {
+    AuthCtx actx = auth_ctx(req);
+    // The agent service account may post lifecycle spans (it reports the
+    // infrastructure phases — image setup, container start, log drain —
+    // of trials it hosts, docs/observability.md) but nothing else here.
+    bool agent_spans = actx.role == "agent" && parts.size() == 3 &&
+                       parts[2] == "spans";
     auto trows = db_.query("SELECT experiment_id FROM trials WHERE id=?",
                            {Json(tid)});
-    if (!trows.empty() &&
-        !can_edit_experiment(auth_ctx(req),
+    if (!trows.empty() && !agent_spans &&
+        !can_edit_experiment(actx,
                              trows[0]["experiment_id"].as_int())) {
       return json_resp(403, err_body("not authorized for this trial"));
     }
@@ -584,6 +591,68 @@ HttpResponse Master::handle_trials(const HttpRequest& req,
     }
     Json out = Json::object();
     out["checkpoints"] = cps;
+    return json_resp(200, out);
+  }
+
+  // POST /api/v1/trials/{id}/spans {spans: [...]} — lifecycle-trace span
+  // ingest from agent + harness (docs/observability.md). Idempotent twice
+  // over: the X-Idempotency-Key replay cache answers retried batches, and
+  // the unique (trial_id, span_id) index makes a replayed row a no-op.
+  if (parts.size() == 3 && parts[2] == "spans" && req.method == "POST") {
+    auto trows = db_.query("SELECT trace_id FROM trials WHERE id=?",
+                           {Json(tid)});
+    if (trows.empty()) return json_resp(404, err_body("no such trial"));
+    Json body = Json::parse_or_null(req.body);
+    if (!body["spans"].is_array()) {
+      return json_resp(400, err_body("spans array required"));
+    }
+    const std::string trial_trace = trows[0]["trace_id"].as_string();
+    int64_t ingested = 0;
+    db_.tx([&] {
+      for (const Json& sp : body["spans"].as_array()) {
+        if (!sp.is_object() || sp["name"].as_string().empty() ||
+            sp["span_id"].as_string().empty()) {
+          continue;  // malformed entry: skip, keep the batch
+        }
+        Json rec = sp;
+        // Spans ride the trial's own trace even if a confused emitter
+        // sends another trace id — the trial page must see them.
+        if (!trial_trace.empty()) rec["trace_id"] = trial_trace;
+        record_trial_span(tid, rec);
+        ++ingested;
+      }
+    });
+    fleet_.spans_ingested.fetch_add(ingested);
+    Json out = Json::object();
+    out["ingested"] = ingested;
+    return json_resp(200, out);
+  }
+
+  // GET /api/v1/trials/{id}/trace — the full lifecycle trace, ordered by
+  // start time; `det trial trace` and the WebUI waterfall read this.
+  if (parts.size() == 3 && parts[2] == "trace" && req.method == "GET") {
+    auto trows = db_.query("SELECT trace_id FROM trials WHERE id=?",
+                           {Json(tid)});
+    if (trows.empty()) return json_resp(404, err_body("no such trial"));
+    Json spans = Json::array();
+    for (auto& row : db_.query(
+             "SELECT trace_id, span_id, parent_span_id, name, start_us, "
+             "end_us, attrs FROM trial_spans WHERE trial_id=? "
+             "ORDER BY start_us, id",
+             {Json(tid)})) {
+      Json s = Json::object();
+      s["trace_id"] = row["trace_id"];
+      s["span_id"] = row["span_id"];
+      s["parent"] = row["parent_span_id"];
+      s["name"] = row["name"];
+      s["start_us"] = row["start_us"];
+      s["end_us"] = row["end_us"];
+      s["attrs"] = Json::parse_or_null(row["attrs"].as_string());
+      spans.push_back(std::move(s));
+    }
+    Json out = Json::object();
+    out["trace_id"] = trows[0]["trace_id"];
+    out["spans"] = std::move(spans);
     return json_resp(200, out);
   }
 

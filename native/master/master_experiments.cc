@@ -14,6 +14,7 @@
 
 #include "../common/faultpoint.h"
 #include "../common/tls.h"
+#include "../common/trace.h"
 #include "master.h"
 
 namespace det {
@@ -389,15 +390,26 @@ void Master::process_ops_locked(ExperimentState& exp,
     switch (op.kind) {
       case SearcherOp::Kind::Create: {
         TrialState trial;
+        trial.trace_id = trace::new_id();
         trial.id = db_.insert(
-            "INSERT INTO trials (experiment_id, request_id, hparams, seed) "
-            "VALUES (?, ?, ?, ?)",
+            "INSERT INTO trials (experiment_id, request_id, hparams, seed, "
+            "trace_id) VALUES (?, ?, ?, ?, ?)",
             {Json(exp.id), Json(op.request_id), Json(op.hparams.dump()),
-             Json(op.seed)});
+             Json(op.seed), Json(trial.trace_id)});
         trial.request_id = op.request_id;
         trial.experiment_id = exp.id;
         trial.hparams = op.hparams;
         trial.seed = op.seed;
+        // Root span of the lifecycle trace: span_id == trace_id (that is
+        // the parent every agent/harness span resolves to), closed by
+        // finish_trial_locked.
+        Json root = trace::make_span(
+            trial.trace_id, "trial.lifecycle", trace::now_us(), 0, "",
+            Json(JsonObject{{"experiment_id", Json(exp.id)},
+                            {"request_id", Json(op.request_id)}}));
+        root["span_id"] = trial.trace_id;
+        root["parent"] = std::string();
+        record_trial_span(trial.id, root);
         exp.trials[op.request_id] = std::move(trial);
         db_.exec(
             "INSERT OR IGNORE INTO tasks (id, type, state, job_id, "
@@ -451,8 +463,12 @@ void Master::request_allocation_locked(ExperimentState& exp,
   alloc.slots = exp.slots_per_trial;
   alloc.priority = exp.priority;
   alloc.submitted_at = now();
+  alloc.submitted_wall_us = trace::now_us();
   alloc.owner_id = exp.owner_id;
   alloc.excluded_agents = trial.excluded_agents;  // exclude_node policies
+  // A re-allocation after a container exit is a requeue the fleet
+  // dashboards should see (spot churn / restart pressure).
+  if (trial.run_id > 0) fleet_.requeues.fetch_add(1);
   trial.allocation_id = alloc.id;
   db_.exec(
       "INSERT INTO allocations (id, task_id, trial_id, resource_pool, slots) "
@@ -483,8 +499,12 @@ void Master::resize_allocation_locked(Allocation& alloc,
   // submitted_at is deliberately NOT reset: the scheduler orders the
   // queue by (priority, submitted_at), and keeping the original stamp
   // makes the resized allocation the oldest in its class — placed first,
-  // so downtime is checkpoint + reshard, not queue wait.
+  // so downtime is checkpoint + reshard, not queue wait. The WALL stamp
+  // is reset — the next trial.queue_wait span measures this re-placement,
+  // not the original submit.
+  alloc.submitted_wall_us = trace::now_us();
   alloc.last_resize = now();
+  fleet_.resizes.fetch_add(1);
   // The re-placed container is a NEW process run resuming from the
   // emergency checkpoint; run_id distinguishes its metric reports. The
   // move was elastic, not a failure: restarts stays where it was.
@@ -579,6 +599,12 @@ void Master::finish_trial_locked(ExperimentState& exp, TrialState& trial,
   db_.exec(
       "UPDATE trials SET state=?, end_time=datetime('now') WHERE id=?",
       {Json(state), Json(trial.id)});
+  // Close the lifecycle root span (span_id == trace_id).
+  if (!trial.trace_id.empty()) {
+    db_.exec(
+        "UPDATE trial_spans SET end_us=? WHERE trial_id=? AND span_id=?",
+        {Json(trace::now_us()), Json(trial.id), Json(trial.trace_id)});
+  }
   publish_locked("trials", Json(JsonObject{
       {"id", Json(trial.id)},
       {"experiment_id", Json(exp.id)},
@@ -711,6 +737,13 @@ void Master::on_allocation_exit_locked(Allocation& alloc) {
     db_.exec("UPDATE trials SET state='CANCELED', end_time=datetime('now') "
              "WHERE id=?",
              {Json(trial.id)});
+    if (!trial.trace_id.empty()) {
+      // This path bypasses finish_trial_locked: close the root span here
+      // too, or a canceled trial's trace renders as forever-running.
+      db_.exec(
+          "UPDATE trial_spans SET end_us=? WHERE trial_id=? AND span_id=?",
+          {Json(trace::now_us()), Json(trial.id), Json(trial.trace_id)});
+    }
     maybe_complete_experiment_locked(*exp);
     cv_.notify_all();
     return;
@@ -781,6 +814,7 @@ void Master::snapshot_experiment_locked(ExperimentState& exp) {
   for (const auto& [rid, t] : exp.trials) {
     Json tj = Json::object();
     tj["id"] = t.id;
+    tj["trace_id"] = t.trace_id;
     tj["hparams"] = t.hparams;
     tj["seed"] = t.seed;
     tj["state"] = t.state;
@@ -846,6 +880,7 @@ void Master::restore_experiments() {
       for (const auto& [rid, tj] : snap["trials"].as_object()) {
         TrialState t;
         t.id = tj["id"].as_int();
+        t.trace_id = tj["trace_id"].as_string();
         t.request_id = rid;
         t.experiment_id = eid;
         t.hparams = tj["hparams"];

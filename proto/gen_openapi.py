@@ -92,6 +92,11 @@ ROUTES = [
     ("get", "/api/v1/trials/{id}/metrics", "trials", "Read metrics"),
     ("post", "/api/v1/trials/{id}/metrics", "trials",
      "Report metrics (also maintains the summary rollups)"),
+    ("post", "/api/v1/trials/{id}/spans", "trials",
+     "Ingest lifecycle-trace spans (idempotency-keyed batch; span_id "
+     "deduped)"),
+    ("get", "/api/v1/trials/{id}/trace", "trials",
+     "Full lifecycle trace, ordered by start time"),
     ("post", "/api/v1/trials/{id}/run_prepare", "trials",
      "RunPrepareForReporting analogue"),
     ("post", "/api/v1/trials/{id}/runner/metadata", "trials",

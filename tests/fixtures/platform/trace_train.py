@@ -1,0 +1,66 @@
+"""Observability e2e fixture (docs/observability.md): a real JaxTrial
+under the Trainer so the full harness span set lands on the trial's
+lifecycle trace — harness.compile (the jitted step), periodic
+harness.checkpoint.save / harness.checkpoint.commit, harness.restore on a
+resumed run, and harness.checkpoint.emergency when a drain notice arrives
+mid-run. Slow enough (per-batch sleep) that a notice can land mid-run.
+"""
+
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+import optax
+
+
+def main() -> int:
+    logging.basicConfig(
+        level=logging.INFO, stream=sys.stderr,
+        format="%(name)s: %(message)s")
+
+    from determined_tpu import core
+    from determined_tpu.parallel.mesh import MeshConfig
+    from determined_tpu.train import JaxTrial, Trainer
+    from determined_tpu.train.trial import TrialContext
+
+    step_sleep = float(os.environ.get("TRACE_STEP_SLEEP", "0.02"))
+
+    class TraceTrial(JaxTrial):
+        prefetch = False
+
+        def init_params(self, rng):
+            import jax
+
+            return {"w": jax.random.normal(rng, (4,)) * 0.1}
+
+        def param_logical_axes(self):
+            return {"w": (None,)}
+
+        def loss(self, params, batch, rng):
+            import jax.numpy as jnp
+
+            return jnp.mean((params["w"] - batch["x"]) ** 2)
+
+        def optimizer(self):
+            return optax.sgd(0.1)
+
+        def mesh_config(self):
+            return MeshConfig()
+
+        def build_training_data(self):
+            rng = np.random.default_rng(7)
+            while True:
+                time.sleep(step_sleep)
+                yield {"x": rng.normal(size=(8, 4)).astype(np.float32)}
+
+    with core.init(async_checkpointing=False) as ctx:
+        trainer = Trainer(TraceTrial(TrialContext()), core_context=ctx)
+        trainer.fit(report_period=2, checkpoint_period=4)
+    print("trace fixture: trial complete", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -681,6 +681,49 @@ async function pageTrial(id) {
         el("td", { class: "muted" }, ev.reason ?? "")))));
   }
 
+  // Lifecycle-trace waterfall (docs/observability.md): where this trial's
+  // wall-clock went — queue wait, container start, compile, restore,
+  // checkpoints, validation — straight from GET /trials/{id}/trace.
+  try {
+    const { spans } = await API.getTrialsIdTrace(id);
+    if ((spans ?? []).length) {
+      view.append(el("h2", {}, "Trace"));
+      const t0 = Math.min(...spans.map((s) => s.start_us));
+      const t1 = Math.max(t0 + 1, ...spans.map((s) => s.end_us || 0));
+      const byId = Object.fromEntries(spans.map((s) => [s.span_id, s]));
+      const depth = (s) => {
+        let d = 0;
+        for (let cur = s; d < 16; d++) {
+          const p = byId[cur.parent];
+          if (!p || p === cur) break;
+          cur = p;
+        }
+        return d;
+      };
+      view.append(el("div", { class: "waterfall" }, spans.map((s) => {
+        const left = ((s.start_us - t0) / (t1 - t0)) * 100;
+        const end = s.end_us || t1;
+        const width = Math.max(((end - s.start_us) / (t1 - t0)) * 100, 0.5);
+        const durMs = s.end_us ? ((s.end_us - s.start_us) / 1000) : null;
+        return el("div", { class: "waterfall-row" },
+          el("span", { class: "waterfall-name",
+                       style: `padding-left:${depth(s) * 12}px` }, s.name),
+          el("span", { class: "waterfall-track" },
+            el("span", {
+              class: `waterfall-bar ${s.end_us ? "" : "open"}`,
+              style: `left:${left}%;width:${width}%`,
+              title: `${s.name}: ` + (durMs != null
+                ? `${durMs.toFixed(1)} ms` : "still open"),
+            })),
+          el("span", { class: "waterfall-dur muted" },
+            durMs != null ? `${durMs.toFixed(1)} ms` : "…"));
+      })));
+    }
+  } catch (e) {
+    // Pre-migration masters have no trace route; the trial page must
+    // still render.
+  }
+
   // Log viewer with follow (reference TrialLogs page; long-polls the
   // master's follow endpoint so new lines stream in live).
   const followBox = el("input", { type: "checkbox", checked: "checked" });
