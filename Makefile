@@ -3,7 +3,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint test chaos bench-input bench-serve bench-trace native native-test clean
+.PHONY: lint test chaos bench-input bench-serve bench-trace bench-compile native native-test clean
 
 # The dogfood gate (docs/preflight.md): the platform's own models and
 # examples must pass the platform's own static analyzer. Fails on any
@@ -25,7 +25,7 @@ chaos:
 	timeout -k 30 $(CHAOS_TIMEOUT) $(PY) -m pytest \
 		tests/test_chaos.py tests/test_selfheal.py tests/test_preemption.py \
 		tests/test_serving.py tests/test_elastic.py \
-		tests/test_observability.py \
+		tests/test_observability.py tests/test_compile_farm.py \
 		-q -m slow
 
 # Async input pipeline A/B: prefetch on/off step time + input_wait_ms
@@ -45,6 +45,14 @@ bench-serve:
 # (docs/elasticity.md). Emits elastic_resize_downtime_s.
 bench-elastic:
 	$(PY) bench.py --only elastic
+
+# Compile farm A/B (docs/compile-farm.md): nocache vs persistent-cache vs
+# farm arms of compile-bound trials on a devcluster. Gates the headline
+# metric cached_median_compile_s <= 0.5s (ROADMAP item 5: recompilation
+# eliminated as a per-trial cost) and reports the farm on/off trials/hour
+# delta.
+bench-compile:
+	$(PY) bench.py --only compile
 
 # Observability overhead + throughput (docs/observability.md): step_ms
 # with lifecycle tracing on vs off (the <1% always-on gate) and span-

@@ -127,6 +127,109 @@ def run_compile_reuse(cluster, token, tmp) -> dict:
     }
 
 
+def run_compile_farm(cluster, token, tmp) -> dict:
+    """Compile-farm on/off A/B (docs/compile-farm.md, ROADMAP item 5):
+    compile-bound Trainer trials (real jitted GPT-2 step, train_farm
+    fixture) in three arms —
+
+      nocache  persistent XLA cache AND farm disabled (every trial pays
+               the full trace+compile)
+      cache    persistent XLA cache only (the pre-farm baseline whose
+               warm trials still burned ~5.2s of trace+deserialize,
+               BENCH_r05)
+      farm     artifact exchange on (default): the first trial uploads
+               its serialized executable, successors deserialize it via
+               the agent pre-warm and skip trace+lowering+compile
+
+    The headline is cached_median_compile_s: median first-step cost of
+    the farm arm's WARM trials (target ~0; acceptance <= 0.5s)."""
+    import determined_tpu.cli as cli
+
+    model_def = cli._tar_context(
+        os.path.join(REPO, "tests", "fixtures", "compile_farm"))
+
+    def launch(arm: str) -> dict:
+        config = {
+            "name": f"bench-compile-farm-{arm}",
+            "entrypoint": "python3 train_farm.py",
+            "searcher": {
+                "name": "random",
+                "metric": "val_loss",
+                "smaller_is_better": True,
+                "max_length": {"batches": 4},
+                "max_trials": 5,
+                # Sequential: concurrent compile-heavy CPU trials
+                # oversubscribe the host and drown the reuse signal.
+                "max_concurrent_trials": 1,
+            },
+            # Const hparams: one signature across the arm, the shape an
+            # ASHA rung re-runs by the dozen.
+            "hyperparameters": {"lr": 0.001, "global_batch_size": 8},
+            "checkpoint_storage": {"type": "shared_fs",
+                                   "host_path": os.path.join(tmp, "ckpts")},
+            "resources": {"slots_per_trial": 1},
+            "max_restarts": 0,
+        }
+        env_vars = []
+        if arm == "nocache":
+            env_vars.append("DET_XLA_CACHE_DIR=")
+        if arm in ("nocache", "cache"):
+            config["compile"] = {"enabled": False}
+        if env_vars:
+            config["environment"] = {"environment_variables": env_vars}
+        t0 = time.time()
+        eid = cluster.api(
+            "POST", "/api/v1/experiments",
+            {"config": config, "model_definition": model_def,
+             "activate": True}, token=token)["id"]
+        _wait_experiment(cluster, token, eid)
+        wall = time.time() - t0
+        trials = cluster.api("GET", f"/api/v1/experiments/{eid}/trials",
+                             token=token)["trials"]
+        compiles, hits = [], []
+        for t in trials:
+            for m in cluster.api(
+                    "GET", f"/api/v1/trials/{t['id']}/metrics",
+                    token=token)["metrics"]:
+                mm = m["metrics"]
+                if m["group_name"] == "training" and "compile_ms" in mm:
+                    compiles.append(float(mm["compile_ms"]) / 1000.0)
+                    hits.append(float(mm.get("compile_cache_hit", 0)))
+                    break
+        return {"wall_s": wall, "n_trials": len(trials),
+                "trials_per_hour": len(trials) / wall * 3600,
+                "compile_s": compiles, "cache_hits": hits}
+
+    nocache = launch("nocache")
+    cache = launch("cache")
+    farm = launch("farm")
+
+    def warm_median(arm):
+        # Warm trials = all but the cold first compile of the wave.
+        warm = sorted(arm["compile_s"])[:-1] if len(arm["compile_s"]) > 1 \
+            else arm["compile_s"]
+        return round(statistics.median(warm), 3) if warm else None
+
+    farm_hits = [c for c, h in zip(farm["compile_s"], farm["cache_hits"])
+                 if h >= 1.0]
+    return {
+        "nocache_trials_per_hour": round(nocache["trials_per_hour"], 1),
+        "cache_trials_per_hour": round(cache["trials_per_hour"], 1),
+        "farm_trials_per_hour": round(farm["trials_per_hour"], 1),
+        "farm_vs_cache_speedup": round(
+            farm["trials_per_hour"] / cache["trials_per_hour"], 2),
+        "farm_vs_nocache_speedup": round(
+            farm["trials_per_hour"] / nocache["trials_per_hour"], 2),
+        "nocache_median_compile_s": warm_median(nocache),
+        "cache_median_compile_s": warm_median(cache),
+        # THE headline (ROADMAP item 5: cached_median_compile_s -> ~0).
+        "cached_median_compile_s": round(
+            statistics.median(farm_hits), 3) if farm_hits else None,
+        "farm_cache_hits": int(sum(farm["cache_hits"])),
+        "farm_trials": farm["n_trials"],
+    }
+
+
 def _api_raw(cluster, method, path, body=None, token=None, headers=None,
              timeout=60.0):
     """cluster.api with custom headers (X-Idempotency-Key) + wall timing."""
@@ -316,6 +419,7 @@ def run() -> dict:
                              token=token)["trials"]
         trials_per_hour = len(trials) / elapsed * 3600
         compile_reuse = run_compile_reuse(cluster, token, tmp)
+        compile_farm = run_compile_farm(cluster, token, tmp)
         phase_breakdown = run_phase_breakdown(
             cluster, token, tmp, trials[0]["id"] if trials else 1)
         return {
@@ -331,6 +435,10 @@ def run() -> dict:
                 # DET_XLA_CACHE_DIR): compile-bound trials with cache
                 # off vs on.
                 "compile_reuse": compile_reuse,
+                # Compile farm on/off A/B (docs/compile-farm.md): serialized
+                # executables + agent pre-warm vs the persistent cache
+                # alone vs nothing.
+                "compile_farm": compile_farm,
                 # Per-phase master-side timings (ROADMAP item 1: attribute
                 # the r5 asha_trials_per_hour regression — suspects are
                 # the submit/preflight gate, the checkpoint two-phase

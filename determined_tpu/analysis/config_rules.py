@@ -10,7 +10,7 @@ docs/preflight.md).
 from __future__ import annotations
 
 import math
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional, Tuple
 
 from determined_tpu.analysis.diagnostics import Diagnostic
 from determined_tpu.analysis.rules import RULES
@@ -18,6 +18,25 @@ from determined_tpu.parallel.mesh import AXIS_ORDER
 
 # Axes the batch shards over (LogicalRules DEFAULT_RULES "batch" entry).
 BATCH_AXES = ("data", "fsdp")
+
+# DTL205's shape-affecting heuristic: an hparam whose snake_case tokens
+# intersect this set changes tensor shapes when swept, so each distinct
+# value compiles its own executable. Mirrored in native/master/preflight.cc
+# — keep the two in lockstep.
+SHAPE_HPARAM_TOKENS = frozenset({
+    "batch", "size", "dim", "dims", "width", "depth", "layer", "layers",
+    "head", "heads", "seq", "len", "length", "vocab", "position",
+    "positions", "expert", "experts", "hidden", "model", "feature",
+    "features", "channel", "channels", "embed", "embedding",
+})
+
+# "More distinct values than anyone could mean": double/log sweeps of a
+# shape-affecting hparam without `count` are effectively unbounded.
+_UNBOUNDED = 10**9
+
+
+def is_shape_hparam(name: str) -> bool:
+    return bool(SHAPE_HPARAM_TOKENS & set(name.lower().split("_")))
 
 
 def _length_batches(v: Any) -> int:
@@ -138,6 +157,12 @@ def check_config(config: Dict[str, Any]) -> List[Diagnostic]:
                         f"divisible by the mesh batch axes data x fsdp = "
                         f"{bprod} at this slot count"))
 
+    # DTL205 — shape-affecting hparam sweep without bucketing: more
+    # distinct executables than compile.max_executables means the sweep
+    # spends its trials compiling instead of training and the compile farm
+    # can't share anything across them (docs/compile-farm.md).
+    diags.extend(_check_shape_sweep(config))
+
     # DTL203 — restarts configured but nothing to restart from. Only an
     # EXPLICIT min_checkpoint_period: 0 fires (key present): the default is
     # also 0 batches and flagging every config would be pure noise.
@@ -151,3 +176,95 @@ def check_config(config: Dict[str, Any]) -> List[Diagnostic]:
                 "checkpoint (or from scratch); set a periodic "
                 "min_checkpoint_period or max_restarts: 0"))
     return diags
+
+
+def _distinct_bucketed_batches(mn: int, mx: int, buckets) -> int:
+    """Distinct bucket boundaries an int range [mn, mx] maps onto."""
+    from determined_tpu.compile.bucketing import bucket_size
+
+    n, b = 0, mn
+    while b <= mx and n <= 64:
+        n += 1
+        b = max(bucket_size(b, buckets), b) + 1
+    return max(1, n)
+
+
+def _spec_distinct(name: str, spec: Any, cfg) -> Tuple[int, bool]:
+    """(distinct executable shapes this spec sweeps to, bucketing_helped).
+    Non-spec values and consts count 1."""
+    from determined_tpu.compile.bucketing import bucket_size
+
+    if not isinstance(spec, dict) or not isinstance(spec.get("type"), str):
+        return 1, False
+    t = spec["type"]
+    is_gbs = name == "global_batch_size"
+    if t == "const":
+        return 1, False
+    if t == "categorical":
+        vals = spec.get("vals") or []
+        if is_gbs and cfg.bucket_batch_sizes:
+            ints = [v for v in vals
+                    if isinstance(v, int) and not isinstance(v, bool)]
+            if ints:
+                return len({bucket_size(v, cfg.buckets) for v in ints}), True
+        return max(1, len(vals)), False
+    if t == "int":
+        mn, mx = spec.get("minval"), spec.get("maxval")
+        if not isinstance(mn, int) or not isinstance(mx, int) or mx < mn:
+            return 1, False
+        if is_gbs and cfg.bucket_batch_sizes:
+            return _distinct_bucketed_batches(mn, mx, cfg.buckets), True
+        cnt = spec.get("count")
+        if isinstance(cnt, int) and cnt > 0:
+            return min(cnt, mx - mn + 1), False
+        return mx - mn + 1, False
+    # double/log sweeping a shape-affecting hparam: every sample is a new
+    # shape unless `count` bounds it.
+    cnt = spec.get("count")
+    if isinstance(cnt, int) and cnt > 0:
+        return cnt, False
+    return _UNBOUNDED, False
+
+
+def _check_shape_sweep(config: Dict[str, Any]) -> List[Diagnostic]:
+    """DTL205 (docs/compile-farm.md): estimate the distinct executables a
+    sweep implies from its shape-affecting hparams and warn past
+    compile.max_executables when bucketing is off for the offenders."""
+    from determined_tpu.compile.bucketing import CompileConfig
+
+    searcher = config.get("searcher")
+    if not isinstance(searcher, dict) or searcher.get("name") in (
+            "single", "custom", None):
+        return []
+    hp = config.get("hyperparameters")
+    if not isinstance(hp, dict):
+        return []
+    cfg = CompileConfig.from_block(config.get("compile"))
+    total = 1
+    offenders: List[str] = []
+    bucketable = False
+    for name, spec in hp.items():
+        if name == "mesh" or not is_shape_hparam(name):
+            continue
+        n, bucketed = _spec_distinct(name, spec, cfg)
+        if n > 1:
+            offenders.append(f"{name} ({'unbounded' if n >= _UNBOUNDED else n}"
+                             " distinct shapes)")
+            total = min(total * n, _UNBOUNDED)
+            if name == "global_batch_size" and not bucketed:
+                bucketable = True
+    max_trials = searcher.get("max_trials")
+    if isinstance(max_trials, int) and max_trials > 0:
+        total = min(total, max_trials)
+    if not offenders or total <= cfg.max_executables:
+        return []
+    hint = ("enable compile.bucket_batch_sizes so batch sizes share "
+            "bucketed executables, " if bucketable else "")
+    return [RULES["DTL205"].diag(
+        f"searcher sweep implies ~{'unbounded' if total >= _UNBOUNDED else total} "
+        f"distinct executables from shape-affecting hyperparameters "
+        f"[{', '.join(offenders)}] > compile.max_executables="
+        f"{cfg.max_executables}: each distinct shape pays a full XLA "
+        f"compile and the compile farm cannot share artifacts across them; "
+        f"{hint}use const/categorical values, or raise "
+        "compile.max_executables if intended")]

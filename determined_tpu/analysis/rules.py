@@ -98,6 +98,14 @@ _ALL = [
          "per-device HBM footprint must fit the budget at each size — a "
          "size that fails only surfaces mid-drain, exactly when the "
          "scheduler tries to shrink onto surviving capacity"),
+    Rule("DTL205", "unbucketed-shape-sweep", "warning", "config",
+         "the searcher sweeps shape-affecting hyperparameters (e.g. raw "
+         "global_batch_size sampling) into more distinct executables than "
+         "compile.max_executables: every distinct shape pays a full XLA "
+         "compile and defeats executable sharing across the sweep — bucket "
+         "batch sizes (compile.bucket_batch_sizes), sample fewer distinct "
+         "shape values, or raise compile.max_executables if the compile "
+         "cost is intended"),
 ]
 
 RULES: Dict[str, Rule] = {r.code: r for r in _ALL}

@@ -632,6 +632,24 @@ def cmd_job_list(session: Session, args) -> int:
     return 0
 
 
+def cmd_compile_jobs(session: Session, args) -> int:
+    """Compile-farm queue visibility (docs/compile-farm.md): what is
+    queued/compiling/done, which agent took it, and the measured cost."""
+    params = {}
+    if getattr(args, "state", None):
+        params["state"] = args.state
+    if getattr(args, "experiment_id", None):
+        params["experiment_id"] = str(args.experiment_id)
+    jobs = session.get("/api/v1/compile_jobs", params=params or None)["jobs"]
+    rows = [dict(j, signature=(j.get("signature") or "")[:16],
+                 compile_ms=round(j["compile_ms"], 1)
+                 if isinstance(j.get("compile_ms"), (int, float)) else "")
+            for j in jobs]
+    _print_table(rows, ["signature", "state", "experiment_id", "slots",
+                        "attempts", "agent_id", "compile_ms"])
+    return 0
+
+
 def cmd_user_list(session: Session, args) -> int:
     users = session.get("/api/v1/users")["users"]
     _print_table(users, ["id", "username", "role", "active"])
@@ -915,6 +933,16 @@ def build_parser() -> argparse.ArgumentParser:
 
     j = sub.add_parser("job").add_subparsers(dest="subcommand", required=True)
     j.add_parser("list").set_defaults(func=cmd_job_list)
+
+    cj = sub.add_parser(
+        "compile",
+        help="compile-farm AOT queue and artifacts (docs/compile-farm.md)"
+    ).add_subparsers(dest="subcommand", required=True)
+    cjl = cj.add_parser("jobs")
+    cjl.add_argument("--state", default=None,
+                     help="QUEUED|RUNNING|DONE|FAILED")
+    cjl.add_argument("--experiment-id", type=int, default=None)
+    cjl.set_defaults(func=cmd_compile_jobs)
 
     u = sub.add_parser("user").add_subparsers(dest="subcommand", required=True)
     u.add_parser("list").set_defaults(func=cmd_user_list)

@@ -45,6 +45,15 @@ MASTER_METRICS: Dict[str, Tuple[str, str]] = {
         "gauge", "Entity-change events buffered for /api/v1/stream"),
     "det_trial_spans_ingested_total": (
         "counter", "Trace spans accepted by POST /trials/{id}/spans"),
+    "det_compile_jobs": (
+        "gauge", "Compile-farm AOT jobs by state (docs/compile-farm.md)"),
+    "det_compile_artifact_uploads_total": (
+        "counter", "Compile-artifact batches stored by POST /compile_cache"),
+    "det_compile_artifact_fetches_total": (
+        "counter", "Compile-artifact fetches served by GET /compile_cache"),
+    "det_compile_links_total": (
+        "counter", "Fingerprint-verified executable shares between "
+                   "signatures"),
     "det_api_requests_total": ("counter", "API requests by status code"),
     "det_api_request_seconds": (
         "histogram", "API request latency by route family"),
@@ -82,8 +91,12 @@ SPAN_NAMES: Dict[str, Tuple[str, str]] = {
         "agent", "Fork to the RUNNING report"),
     "agent.log_drain": (
         "agent", "Final log drain before the exit report"),
+    "agent.cache_warm": (
+        "agent", "Compile-farm artifact prefetch, overlapped with image "
+                 "setup"),
     "harness.compile": (
-        "harness", "First jitted invocation per executable (trace+compile)"),
+        "harness", "First executable acquisition (AOT load or "
+                   "trace+compile); cache_hit/signature in attrs"),
     "harness.restore": (
         "harness", "Checkpoint restore (lineage walk included)"),
     "harness.reshard": (

@@ -1,0 +1,32 @@
+"""Compile farm: ahead-of-time executable signatures, artifacts and cache
+warming (docs/compile-farm.md).
+
+The pieces:
+  - `signature` — config signatures (the farm's queue/store key, mirrored
+    by the native master) and trace-based step fingerprints (the precise
+    program identity that gates executable sharing);
+  - `bucketing` — batch-size shape canonicalization, applied consistently
+    at trace time and run time;
+  - `runtime` — serialize/deserialize compiled executables, the artifact
+    FarmClient the Trainer uses to skip trace+compile on warm trials;
+  - `worker` — the agent-dispatched background compile job.
+"""
+
+from determined_tpu.compile.bucketing import (  # noqa: F401
+    CompileConfig,
+    bucket_size,
+    bucketed_batch,
+    bucketed_iter,
+    pad_batch,
+)
+from determined_tpu.compile.runtime import (  # noqa: F401
+    FarmClient,
+    aot_artifact_name,
+    load_compiled,
+    serialize_compiled,
+)
+from determined_tpu.compile.signature import (  # noqa: F401
+    config_signature,
+    runtime_tag,
+    step_fingerprint,
+)

@@ -1,0 +1,30 @@
+"""`python -m determined_tpu.compile` — the farm worker entrypoint.
+
+XLA_FLAGS must be set BEFORE jax is imported anywhere: a CPU compile host
+needs as many virtual devices as the job's slot count for the mesh to
+resolve (TPU hosts use their real chips — the worker only runs on idle
+agents, so the chips are free by construction).
+"""
+
+import os
+import sys
+
+
+def _force_cpu_devices() -> None:
+    slots = int(os.environ.get("DET_COMPILE_SLOTS", "1"))
+    if slots <= 1:
+        return
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if platforms and "cpu" not in platforms:
+        return  # real accelerators: use the host's chips
+    flag = f"--xla_force_host_platform_device_count={slots}"
+    existing = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in existing:
+        os.environ["XLA_FLAGS"] = (existing + " " + flag).strip()
+
+
+_force_cpu_devices()
+
+from determined_tpu.compile.worker import main  # noqa: E402
+
+sys.exit(main())

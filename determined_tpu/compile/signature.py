@@ -1,0 +1,214 @@
+"""Executable signatures (docs/compile-farm.md).
+
+Two keys, two precision levels, one safety story:
+
+- **config signature** — computed WITHOUT tracing, from (entrypoint,
+  model-def hash, slots, the full hparam set with `global_batch_size`
+  bucketed). The native master computes the same key at trial creation
+  (native/master/master_compile.cc) and propagates it to containers as
+  `DET_COMPILE_SIGNATURE`; it is the compile-job queue key and the
+  artifact-store address. Because it hashes EVERY hparam value, two trials
+  share a config signature only when their configs are interchangeable —
+  there is no lossy "shape-affecting" guessing on this path.
+
+- **step fingerprint** — the precise program identity: a hash over the
+  canonicalized jaxpr of the *actual* train step (constants included, so a
+  baked-in learning rate changes it), mesh shape, batch shapes/dtypes
+  (bucketed), donation and jax/jaxlib/backend versions. Costs one abstract
+  trace (~100ms-1s, no compile). The compile WORKER uses it to share
+  executables across config signatures: before compiling job B it traces
+  B's fingerprint and, when it equals an already-compiled job A's
+  (`optax.inject_hyperparams` makes an lr sweep hparam-invariant — the
+  platform idiom, see tests/fixtures/platform/train_jit.py), links A's
+  artifacts to B instead of recompiling. Sharing is therefore always
+  fingerprint-verified; a config-signature collision can never hand a trial
+  an executable compiled from a different program.
+
+Serialized executables are platform/version-specific on top of all that:
+artifact filenames embed `runtime_tag()` so a CPU-compiled artifact can
+never be offered to a TPU trial of the same config.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from determined_tpu.compile.bucketing import CompileConfig, bucket_size
+
+SIGNATURE_VERSION = "det-compile-v1"
+
+
+def _sha(text: str) -> str:
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+def runtime_tag() -> str:
+    """Short tag identifying the compile platform: a serialized executable
+    only loads on the exact jax/jaxlib/backend/device-kind that built it."""
+    import jax
+
+    try:
+        import jaxlib
+
+        jaxlib_v = getattr(jaxlib, "__version__", "?")
+    except Exception:
+        jaxlib_v = "?"
+    try:
+        dev = jax.devices()[0]
+        platform = dev.platform
+        kind = getattr(dev, "device_kind", platform)
+    except Exception:
+        platform, kind = "unknown", "unknown"
+    return _sha("|".join(
+        [jax.__version__, jaxlib_v, platform, str(kind)]))[:12]
+
+
+def canonical_hparams(hparams: Dict[str, Any],
+                      cfg: Optional[CompileConfig] = None) -> str:
+    """Sorted `k=<json>` rendering of the hparam dict, with
+    global_batch_size replaced by its bucket when bucketing is on. The
+    native master builds the identical string (master_compile.cc) — keep
+    the two in lockstep."""
+    cfg = cfg or CompileConfig()
+    parts = []
+    for k in sorted(hparams or {}):
+        v = hparams[k]
+        if k == "global_batch_size" and cfg.bucket_batch_sizes and \
+                isinstance(v, int) and not isinstance(v, bool):
+            v = bucket_size(v, cfg.buckets)
+        parts.append(f"{k}={json.dumps(v, sort_keys=True)}")
+    return ";".join(parts)
+
+
+def config_signature(
+    hparams: Dict[str, Any],
+    entrypoint: Any = "",
+    model_def_hash: str = "",
+    slots: int = 1,
+    cfg: Optional[CompileConfig] = None,
+) -> str:
+    """The compile-farm grouping key for one trial (mirrors the native
+    master's compile_signature_locked)."""
+    ep = entrypoint if isinstance(entrypoint, str) else json.dumps(entrypoint)
+    return _sha("|".join([
+        SIGNATURE_VERSION, ep, model_def_hash or "", str(int(slots)),
+        canonical_hparams(hparams, cfg),
+    ]))
+
+
+def _abstract_state(trial: Any):
+    """ShapeDtypeStruct TrainState for the trial (no buffers, no compile)."""
+    import jax
+
+    from determined_tpu.train.state import TrainState
+
+    tx = trial.optimizer()
+
+    def init_state(r):
+        params = trial.init_params(r)
+        return TrainState(
+            step=jax.numpy.zeros((), jax.numpy.int32),
+            params=params,
+            opt_state=tx.init(params),
+            extra=trial.init_extra(),
+        )
+
+    return tx, jax.eval_shape(
+        init_state, jax.ShapeDtypeStruct((2,), np.uint32))
+
+
+def _abstract_batch(trial: Any, batch: Any,
+                    cfg: Optional[CompileConfig] = None) -> Any:
+    """One abstract global batch, bucketed exactly like run time."""
+    import jax
+
+    from determined_tpu.compile.bucketing import bucketed_batch
+
+    if batch is None:
+        batch = next(iter(trial.build_training_data()))
+    if cfg is not None:
+        batch = bucketed_batch(batch, cfg)
+
+    def one(v):
+        arr = np.asarray(v) if not hasattr(v, "shape") else v
+        return jax.ShapeDtypeStruct(
+            np.shape(arr), getattr(arr, "dtype", np.dtype(np.float32)))
+
+    return jax.tree_util.tree_map(one, batch)
+
+
+def _const_digest(consts) -> str:
+    """Hash the VALUES closed over by the jaxpr: a learning rate baked into
+    the optimizer update is invisible in the jaxpr text but changes the
+    compiled program — it must change the fingerprint too."""
+    h = hashlib.sha256()
+    for c in consts:
+        try:
+            arr = np.asarray(c)
+            h.update(str(arr.dtype).encode())
+            h.update(str(arr.shape).encode())
+            h.update(arr.tobytes())
+        except Exception:
+            h.update(repr(c).encode())
+    return h.hexdigest()
+
+
+def step_fingerprint(
+    trial: Any,
+    n_devices: int,
+    batch: Any = None,
+    cfg: Optional[CompileConfig] = None,
+) -> Tuple[str, Dict[str, Any]]:
+    """(fingerprint hex, detail) for the trial's jitted train step.
+
+    One abstract trace (jax.make_jaxpr), no devices touched, no compile —
+    the same cost class as the preflight abstract engine. Deterministic
+    across processes (tests/test_compile_farm.py asserts it): jaxpr
+    variable naming is generated in traversal order and the const digest
+    covers closed-over values.
+    """
+    import jax
+
+    from determined_tpu.parallel.mesh import AXIS_ORDER
+    from determined_tpu.train.step import make_train_step
+
+    cfg = cfg or CompileConfig.resolve(trial)
+    mesh_cfg = trial.mesh_config().resolve(n_devices)
+    sizes = dict(zip(AXIS_ORDER, mesh_cfg.sizes()))
+    tx, state_sds = _abstract_state(trial)
+    batch_sds = _abstract_batch(trial, batch, cfg)
+    rng_sds = jax.ShapeDtypeStruct((2,), np.uint32)
+
+    # mesh=None: sharding constraints only restate the mesh shape, which is
+    # hashed separately below — and tracing without a mesh works in any
+    # process regardless of how many local devices it has.
+    step = make_train_step(
+        trial.loss, tx, mesh=None, rules=trial.sharding_rules(),
+        donate_state=trial.donate_state, stateful=trial.stateful)
+    fn = getattr(step, "__wrapped__", step)
+    closed = jax.make_jaxpr(fn)(state_sds, batch_sds, rng_sds)
+
+    batch_leaves = [
+        (tuple(int(d) for d in leaf.shape), str(leaf.dtype))
+        for leaf in jax.tree_util.tree_leaves(batch_sds)
+    ]
+    param_dtypes = sorted({
+        str(leaf.dtype)
+        for leaf in jax.tree_util.tree_leaves(state_sds.params)
+    })
+    detail = {
+        "jaxpr": _sha(str(closed.jaxpr)),
+        "consts": _const_digest(closed.consts),
+        "mesh": {a: int(s) for a, s in sizes.items() if s > 1},
+        "n_devices": int(n_devices),
+        "batch": batch_leaves,
+        "param_dtypes": param_dtypes,
+        "donate_state": bool(trial.donate_state),
+        "stateful": bool(trial.stateful),
+        "runtime_tag": runtime_tag(),
+    }
+    return _sha(json.dumps(detail, sort_keys=True)), detail

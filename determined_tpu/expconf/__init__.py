@@ -227,8 +227,44 @@ def validate(config: Dict[str, Any]) -> List[str]:
     _validate_prefetch(config.get("prefetch"), errors)
     _validate_health(config.get("health"), errors)
     _validate_preemption(config.get("preemption"), errors)
+    _validate_compile(config.get("compile"), errors)
 
     return errors
+
+
+def _validate_compile(block: Any, errors: List[str]) -> None:
+    """`compile:` — the compile farm (docs/compile-farm.md): artifact
+    exchange (on by default), background AOT precompilation while trials
+    queue (opt-in), and batch-size bucketing so sweeps share executables."""
+    if block is None:
+        return
+    if isinstance(block, bool):
+        return  # bare bool == enabled switch
+    if not isinstance(block, dict):
+        errors.append("compile must be a bool or a mapping")
+        return
+    valid = {"enabled", "background", "bucket_batch_sizes", "buckets",
+             "max_executables", "upload"}
+    unknown = sorted(set(block) - valid)
+    if unknown:
+        errors.append(
+            f"compile: unknown keys {unknown}; valid: {sorted(valid)}")
+    for flag in ("enabled", "background", "bucket_batch_sizes", "upload"):
+        if flag in block and not isinstance(block[flag], bool):
+            errors.append(f"compile.{flag} must be a bool")
+    me = block.get("max_executables")
+    if me is not None and (
+        isinstance(me, bool) or not isinstance(me, int) or me < 1
+    ):
+        errors.append("compile.max_executables must be a positive int")
+    buckets = block.get("buckets")
+    if buckets is not None:
+        if not isinstance(buckets, list) or not buckets or any(
+            isinstance(b, bool) or not isinstance(b, int) or b < 1
+            for b in buckets
+        ):
+            errors.append(
+                "compile.buckets must be a non-empty list of positive ints")
 
 
 def _validate_preemption(block: Any, errors: List[str]) -> None:
@@ -636,6 +672,13 @@ def apply_defaults(config: Dict[str, Any]) -> Dict[str, Any]:
     if isinstance(pf, dict):
         pf.setdefault("enabled", True)
         pf.setdefault("depth", 2)
+    cc = c.setdefault("compile", {})
+    if isinstance(cc, dict):
+        cc.setdefault("enabled", True)
+        cc.setdefault("background", False)
+        cc.setdefault("bucket_batch_sizes", False)
+        cc.setdefault("max_executables", 8)
+        cc.setdefault("upload", True)
     health = c.setdefault("health", {})
     if isinstance(health, dict):
         health.setdefault("on_nan", "warn")

@@ -29,6 +29,8 @@ from determined_tpu import _jax_compat
 from determined_tpu import core as core_mod
 from determined_tpu.common import faultpoint
 from determined_tpu.common import trace as trace_mod
+from determined_tpu.compile.bucketing import CompileConfig, bucketed_iter
+from determined_tpu.compile.runtime import FarmClient
 from determined_tpu.data import DevicePrefetcher, PrefetchConfig
 from determined_tpu.parallel.mesh import create_mesh
 from determined_tpu.train.health import (
@@ -46,23 +48,63 @@ _jax_compat.install()  # jax.sharding.set_mesh on jax < 0.5
 logger = logging.getLogger("determined_tpu.train")
 
 
-def _timed_first_call(fn, tracer, executable: str, install):
-    """Wrap a jitted step so its FIRST invocation lands a harness.compile
-    span on the lifecycle trace (dispatch blocks on trace+compile for a
-    cold executable; the persistent XLA cache makes warm ones near-zero,
-    which is exactly what the span is there to show). The wrapper then
-    UNINSTALLS itself via `install(fn)` — steady-state steps dispatch the
-    bare jitted callable, so tracing adds zero per-step cost (the
-    `make bench-trace` <1% gate)."""
-    if tracer is None or not tracer.enabled:
+def _timed_first_call(fn, tracer, executable: str, install,
+                      farm=None, compile_cfg=None, report=None):
+    """Wrap a jitted step so its FIRST invocation is the compile-farm
+    integration point (docs/compile-farm.md):
+
+      1. try the signature's AOT artifact (agent-prewarmed or fetched from
+         the master) — a hit deserializes a compiled executable and skips
+         trace+lowering+compile entirely; a load/aval mismatch falls back
+         to the jit path, so a wrong artifact can cost time but never
+         correctness (XLA rejects mismatched avals before executing);
+      2. land a harness.compile span with cache_hit/signature attrs and
+         feed (compile_ms, cache_hit) into the next metrics flush via
+         `report`;
+      3. on a fresh compile, export+upload the serialized executable and
+         the new persistent-cache entries in a background thread.
+
+    The wrapper then UNINSTALLS itself via `install(...)` — steady-state
+    steps dispatch the bare compiled callable, so all of this adds zero
+    per-step cost (the `make bench-trace` <1% gate)."""
+    farm_on = (farm is not None and farm.enabled
+               and (compile_cfg is None or compile_cfg.enabled))
+    if (tracer is None or not tracer.enabled) and not farm_on \
+            and report is None:
         return fn
 
     def wrapped(*args, **kwargs):
-        t0 = trace_mod.now_us()
-        out = fn(*args, **kwargs)
-        tracer.emit("harness.compile", t0, trace_mod.now_us(),
-                    {"executable": executable})
-        install(fn)
+        t0 = time.monotonic()
+        t0_us = trace_mod.now_us()
+        out = None
+        cache_hit = False
+        if farm_on:
+            loaded = farm.load_executable(executable)
+            if loaded is not None:
+                try:
+                    out = loaded(*args, **kwargs)
+                    cache_hit = True
+                    install(loaded)
+                except Exception:
+                    logger.warning(
+                        "AOT executable for %s did not match this trial "
+                        "(shapes/shardings drifted?); compiling fresh",
+                        executable, exc_info=True)
+        if out is None:
+            out = fn(*args, **kwargs)
+            install(fn)
+        compile_ms = (time.monotonic() - t0) * 1000.0
+        if tracer is not None and tracer.enabled:
+            attrs = {"executable": executable, "cache_hit": cache_hit}
+            if farm is not None and farm.signature:
+                attrs["signature"] = farm.signature
+            tracer.emit("harness.compile", t0_us, trace_mod.now_us(), attrs)
+        if report is not None:
+            report(executable, compile_ms, cache_hit)
+        if farm_on and not cache_hit and \
+                (compile_cfg is None or compile_cfg.upload):
+            farm.export_and_upload_async(fn, args, executable,
+                                         compile_ms=compile_ms)
         return out
 
     return wrapped
@@ -107,6 +149,13 @@ class Trainer:
         self._preempt_period = 0
         self._watchdog: Optional[StepWatchdog] = None
         self._rollbacks = 0
+        # Compile farm (docs/compile-farm.md): artifact client for this
+        # trial's signature (DET_COMPILE_SIGNATURE, master-minted) and the
+        # (executable, compile_ms, cache_hit) events the first-call
+        # wrappers feed into the next metrics flush.
+        self._farm: Optional[FarmClient] = None
+        self._compile_cfg: Optional[CompileConfig] = None
+        self._compile_events: list = []
 
     # -- setup ---------------------------------------------------------
 
@@ -185,6 +234,12 @@ class Trainer:
                 return trial.loss_pipelined(params, batch, rng, mesh)
 
         tracer = self.core.tracer if self.core is not None else None
+        if self._compile_cfg is None:
+            self._compile_cfg = self._compile_config(self.core)
+        if self._farm is None:
+            session = (self.core.checkpoint._session
+                       if self.core is not None else None)
+            self._farm = FarmClient(session)
 
         def install_train(fn):
             self._train_step = fn
@@ -192,12 +247,18 @@ class Trainer:
         def install_eval(fn):
             self._eval_step = fn
 
+        def report(executable, compile_ms, cache_hit):
+            self._compile_events.append(
+                {"executable": executable, "compile_ms": compile_ms,
+                 "cache_hit": cache_hit})
+
         self._train_step = _timed_first_call(
             make_train_step(
                 loss, tx, mesh=self.mesh, rules=self.rules,
                 donate_state=trial.donate_state, stateful=trial.stateful,
             ),
-            tracer, "train_step", install_train)
+            tracer, "train_step", install_train,
+            farm=self._farm, compile_cfg=self._compile_cfg, report=report)
         has_eval = type(trial).evaluate is not JaxTrial.evaluate
         if pipelined and trial.supports_pipelined_eval():
             mesh = self.mesh
@@ -208,7 +269,9 @@ class Trainer:
                     ),
                     mesh=self.mesh, rules=self.rules, stateful=trial.stateful,
                 ),
-                tracer, "eval_step", install_eval)
+                tracer, "eval_step", install_eval,
+                farm=self._farm, compile_cfg=self._compile_cfg,
+                report=report)
         elif has_eval:
             if pipelined:
                 logger.warning(
@@ -222,7 +285,9 @@ class Trainer:
                     trial.evaluate, mesh=self.mesh, rules=self.rules,
                     stateful=trial.stateful,
                 ),
-                tracer, "eval_step", install_eval)
+                tracer, "eval_step", install_eval,
+                farm=self._farm, compile_cfg=self._compile_cfg,
+                report=report)
         else:
             self._eval_step = None
 
@@ -245,6 +310,12 @@ class Trainer:
         if core is not None and core.info is not None and core.info.trial:
             expconf = core.info.trial.config
         return PreemptionConfig.resolve(self.trial, expconf)
+
+    def _compile_config(self, core) -> CompileConfig:
+        expconf = None
+        if core is not None and core.info is not None and core.info.trial:
+            expconf = core.info.trial.config
+        return CompileConfig.resolve(self.trial, expconf)
 
     def fit(
         self,
@@ -285,6 +356,12 @@ class Trainer:
         self._preempt_cfg = self._preemption_config(core)
         self._rollbacks = 0
         data_iter: Any = _repeat(self.trial.build_training_data)
+        if self._compile_cfg is not None and \
+                self._compile_cfg.bucket_batch_sizes:
+            # Shape canonicalization (docs/compile-farm.md): pad host
+            # batches to the signed bucket BEFORE sharding/transfer so the
+            # jitted step only ever sees the bucketed shapes.
+            data_iter = bucketed_iter(data_iter, self._compile_cfg)
         prefetcher: Optional[DevicePrefetcher] = None
         if self._pf_cfg.enabled:
             sharding = (batch_sharding(self.mesh, self.rules)
@@ -467,6 +544,10 @@ class Trainer:
                 prefetcher.close()
 
         core.checkpoint.wait()
+        if self._farm is not None:
+            # Fresh compiles export in the background; short ASHA trials
+            # exit fast — give successors their artifacts before dying.
+            self._farm.wait(30.0)
         if profile:
             core.profiler.off()
         return self.state
@@ -492,6 +573,14 @@ class Trainer:
                 host["h2d_ms"] = h2d / n
                 host["prefetch_queue_depth"] = depth / n
                 core.profiler.observe_input(wait, h2d, depth, n)
+        if self._compile_events:
+            # First-call compile events land in the flush AFTER the compile
+            # (i.e. the first one): `det trial trace` shows hit/miss via
+            # the span attrs, dashboards via these two keys.
+            events, self._compile_events = self._compile_events, []
+            host["compile_ms"] = sum(e["compile_ms"] for e in events)
+            host["compile_cache_hit"] = (
+                1.0 if all(e["cache_hit"] for e in events) else 0.0)
         # The divergence sentinel's event channel: a non-finite step marks
         # this flush's report so dashboards/webhooks see `divergence: 1`
         # exactly where the loss went bad (train/health.py).
@@ -518,6 +607,9 @@ class Trainer:
         count = 0
         pf_cfg = self._pf_cfg or self._prefetch_config(core)
         data: Any = self.trial.build_validation_data()
+        if self._compile_cfg is not None and \
+                self._compile_cfg.bucket_batch_sizes:
+            data = bucketed_iter(data, self._compile_cfg)
         prefetcher: Optional[DevicePrefetcher] = None
         if pf_cfg.enabled:
             sharding = (batch_sharding(self.mesh, self.rules)

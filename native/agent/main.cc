@@ -617,6 +617,154 @@ void supervise(const AgentOptions& opts, std::shared_ptr<Task> task) {
   }
 }
 
+// ---- compile farm (docs/compile-farm.md) --------------------------------
+
+// Minimal base64 decode (artifact blobs arrive b64 over the JSON API; the
+// cache dirs need raw bytes).
+std::string b64_decode(const std::string& in) {
+  static bool init = false;
+  static int8_t t[256];
+  if (!init) {
+    for (int i = 0; i < 256; ++i) t[i] = -1;
+    const char* alpha =
+        "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+    for (int i = 0; i < 64; ++i) t[static_cast<uint8_t>(alpha[i])] = i;
+    init = true;
+  }
+  std::string out;
+  out.reserve(in.size() * 3 / 4);
+  int val = 0, bits = -8;
+  for (unsigned char c : in) {
+    if (t[c] < 0) {
+      if (c == '=') break;
+      continue;  // whitespace
+    }
+    val = (val << 6) | t[c];
+    bits += 6;
+    if (bits >= 0) {
+      out.push_back(static_cast<char>((val >> bits) & 0xFF));
+      bits -= 8;
+    }
+  }
+  return out;
+}
+
+struct PrewarmResult {
+  int files = 0;
+  long long bytes = 0;
+};
+
+// Fetch the trial's precompiled artifacts BEFORE its container starts:
+// aot-* executables land in work_root/aot_cache/<signature>/ (the harness
+// deserializes them and skips trace+compile), everything else in the
+// node's shared persistent XLA cache dir. Existing files are skipped —
+// both stores are content-keyed, so a re-fetch is pure overlap time.
+PrewarmResult prewarm_compile_cache(const AgentOptions& opts,
+                                    const std::string& signature) {
+  PrewarmResult res;
+  HttpClientResponse r;
+  try {
+    r = master_call(opts.master_url, "GET",
+                    "/api/v1/compile_cache/" + signature, "", 30.0);
+  } catch (const std::exception& e) {
+    std::cerr << "agent: compile-cache prewarm failed: " << e.what()
+              << std::endl;
+    return res;
+  }
+  if (!r.ok()) return res;
+  Json doc = Json::parse_or_null(r.body);
+  std::string aot_dir = opts.work_root + "/aot_cache";
+  std::string sig_dir = aot_dir + "/" + signature;
+  std::string xla_dir = opts.work_root + "/xla_cache";
+  mkdir(opts.work_root.c_str(), 0755);
+  for (const auto& f : doc["files"].as_array()) {
+    std::string name = f["name"].as_string("");
+    // Artifact names are store keys, never paths.
+    if (name.empty() || name.find('/') != std::string::npos ||
+        name.find("..") != std::string::npos) {
+      continue;
+    }
+    std::string dir = xla_dir;
+    if (name.rfind("aot-", 0) == 0) {
+      mkdir(aot_dir.c_str(), 0755);
+      mkdir(sig_dir.c_str(), 0755);
+      dir = sig_dir;
+    } else {
+      mkdir(xla_dir.c_str(), 0755);
+    }
+    std::string path = dir + "/" + name;
+    struct stat st;
+    if (stat(path.c_str(), &st) == 0) continue;  // already warm
+    std::string raw = b64_decode(f["b64"].as_string(""));
+    if (raw.empty()) continue;
+    std::string tmp = path + ".tmp";
+    std::ofstream out(tmp, std::ios::binary);
+    out.write(raw.data(), static_cast<std::streamsize>(raw.size()));
+    out.close();
+    if (rename(tmp.c_str(), path.c_str()) == 0) {
+      ++res.files;
+      res.bytes += static_cast<long long>(raw.size());
+    }
+  }
+  return res;
+}
+
+// Background AOT compile job dispatched by the master to this (idle)
+// agent: run the harness compile worker; the worker reports DONE +
+// artifacts itself, the agent only reports a crashed worker.
+void run_compile_job(const AgentOptions& opts, const Json& action) {
+  std::string sig = action["signature"].as_string("");
+  const Json env = action["env"];
+  std::string workdir =
+      opts.work_root + "/compile-" + sig.substr(0, 12);
+  mkdir(opts.work_root.c_str(), 0755);
+  mkdir(workdir.c_str(), 0755);
+  pid_t pid = fork();
+  if (pid == 0) {
+    setpgid(0, 0);
+    int out_fd = open((workdir + "/worker.log").c_str(),
+                      O_WRONLY | O_CREAT | O_APPEND, 0644);
+    if (out_fd >= 0) {
+      dup2(out_fd, STDOUT_FILENO);
+      dup2(out_fd, STDERR_FILENO);
+      close(out_fd);
+    }
+    if (chdir(workdir.c_str()) != 0) _exit(125);
+    for (const auto& [k, v] : env.as_object()) {
+      std::string val = v.is_string() ? v.as_string() : v.dump();
+      setenv(k.c_str(), val.c_str(), 1);
+    }
+    // The worker compiles INTO the node's shared persistent cache, so
+    // this host is warm before any artifact round-trips.
+    std::string xla_cache = opts.work_root + "/xla_cache";
+    setenv("DET_XLA_CACHE_DIR", xla_cache.c_str(), 0);
+    execlp("python3", "python3", "-m", "determined_tpu.compile",
+           static_cast<char*>(nullptr));
+    _exit(127);
+  }
+  if (pid < 0) return;
+  std::cerr << "agent: compile job " << sig.substr(0, 12) << " pid=" << pid
+            << std::endl;
+  std::thread([opts, sig, pid] {
+    int status = 0;
+    waitpid(pid, &status, 0);
+    int code =
+        WIFEXITED(status) ? WEXITSTATUS(status) : 128 + WTERMSIG(status);
+    if (code != 0) {
+      Json body = Json::object();
+      body["state"] = "FAILED";
+      body["error"] = "worker exited " + std::to_string(code);
+      try {
+        master_call(opts.master_url, "POST", "/api/v1/compile_jobs/" + sig,
+                    body.dump(), 10.0);
+      } catch (const std::exception&) {
+      }
+    }
+    std::cerr << "agent: compile job " << sig.substr(0, 12) << " exited "
+              << code << std::endl;
+  }).detach();
+}
+
 void start_task(const AgentOptions& opts, const Json& action) {
   auto task = std::make_shared<Task>();
   task->allocation_id = action["allocation_id"].as_string();
@@ -627,6 +775,22 @@ void start_task(const AgentOptions& opts, const Json& action) {
   task->trial_id = env["DET_TRIAL_ID"].as_int(-1);
   task->trace_id = env["DET_TRACE_ID"].as_string();
   int64_t setup_t0 = det::trace::now_us();
+
+  // Compile-farm cache warming (docs/compile-farm.md): fetch the trial's
+  // precompiled artifacts CONCURRENTLY with workdir/log-file prep and join
+  // before fork — the container starts with the node's XLA cache and the
+  // signature's AOT executables already on disk, so the pre-warm cost is
+  // overlap, not serial launch latency.
+  std::string compile_sig = env["DET_COMPILE_SIGNATURE"].as_string("");
+  PrewarmResult warm;
+  int64_t warm_t0 = setup_t0, warm_t1 = setup_t0;
+  std::thread warm_thread;
+  if (!compile_sig.empty()) {
+    warm_thread = std::thread([&opts, compile_sig, &warm, &warm_t1] {
+      warm = prewarm_compile_cache(opts, compile_sig);
+      warm_t1 = det::trace::now_us();
+    });
+  }
 
   std::string workdir = opts.work_root + "/" + task->allocation_id + "-r" +
                         std::to_string(task->rank);
@@ -650,8 +814,12 @@ void start_task(const AgentOptions& opts, const Json& action) {
     fail["state"] = "EXITED";
     fail["exit_code"] = static_cast<int64_t>(125);
     report_state(opts, task->allocation_id, fail);
+    if (warm_thread.joinable()) warm_thread.join();
     return;
   }
+
+  // The cache must be fully warm before the trial process can race it.
+  if (warm_thread.joinable()) warm_thread.join();
 
   pid_t pid = fork();
   if (pid == 0) {
@@ -684,6 +852,10 @@ void start_task(const AgentOptions& opts, const Json& action) {
     // overwrite=0: an expconf environment_variables override wins.
     std::string xla_cache = opts.work_root + "/xla_cache";
     setenv("DET_XLA_CACHE_DIR", xla_cache.c_str(), 0);
+    // Prewarmed AOT executables (compile farm); the harness looks in
+    // $DET_COMPILE_AOT_DIR/$DET_COMPILE_SIGNATURE/.
+    std::string aot_cache = opts.work_root + "/aot_cache";
+    setenv("DET_COMPILE_AOT_DIR", aot_cache.c_str(), 0);
     // sh wrapper records the exit status to .det_status — that is what
     // lets a RESTARTED agent (which cannot waitpid an orphan) recover the
     // code. The in-container bootstrap (reference entrypoint.sh →
@@ -730,6 +902,15 @@ void start_task(const AgentOptions& opts, const Json& action) {
     Json spans = Json::array();
     spans.push_back(det::trace::make_span(
         task->trace_id, "agent.image_setup", setup_t0, fork_us, "", attrs));
+    if (!compile_sig.empty()) {
+      Json wa = attrs;
+      wa["signature"] = compile_sig;
+      wa["files"] = static_cast<int64_t>(warm.files);
+      wa["bytes"] = static_cast<int64_t>(warm.bytes);
+      spans.push_back(det::trace::make_span(
+          task->trace_id, "agent.cache_warm", warm_t0,
+          warm_t1 > warm_t0 ? warm_t1 : det::trace::now_us(), "", wa));
+    }
     spans.push_back(det::trace::make_span(
         task->trace_id, "agent.container_start", fork_us,
         det::trace::now_us(), "", attrs));
@@ -1341,6 +1522,8 @@ int main(int argc, char** argv) {
                   << action["allocation_id"].as_string() << std::endl;
         if (type == "start") {
           start_task(opts, action);
+        } else if (type == "compile") {
+          run_compile_job(opts, action);
         } else if (type == "kill") {
           kill_allocation(action["allocation_id"].as_string());
         }

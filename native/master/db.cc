@@ -515,6 +515,39 @@ CREATE TABLE trial_spans (
 CREATE INDEX idx_trial_spans_trial ON trial_spans(trial_id, start_us);
 CREATE UNIQUE INDEX idx_trial_spans_span ON trial_spans(trial_id, span_id);
 )sql"},
+      // Compile farm (docs/compile-farm.md): compile_jobs is the AOT
+      // queue — one row per distinct executable signature, enumerated at
+      // trial creation and claimed by idle agents; compile_artifacts maps
+      // a signature to its files, stored content-addressed in model_defs
+      // (the blob sweep's DELETE joins against blob_hash so a live
+      // artifact can never be GC'd out from under its signature).
+      {23, R"sql(
+CREATE TABLE compile_jobs (
+  signature TEXT PRIMARY KEY,
+  experiment_id INTEGER,
+  state TEXT NOT NULL DEFAULT 'QUEUED',
+  hparams TEXT NOT NULL DEFAULT '{}',
+  slots INTEGER NOT NULL DEFAULT 1,
+  attempts INTEGER NOT NULL DEFAULT 0,
+  agent_id TEXT,
+  fingerprint TEXT NOT NULL DEFAULT '',
+  compile_ms REAL,
+  error TEXT NOT NULL DEFAULT '',
+  created_at TEXT NOT NULL DEFAULT (datetime('now')),
+  updated_at TEXT NOT NULL DEFAULT (datetime('now'))
+) WITHOUT ROWID;
+CREATE INDEX idx_compile_jobs_state ON compile_jobs(state, created_at);
+CREATE INDEX idx_compile_jobs_fingerprint ON compile_jobs(fingerprint);
+CREATE TABLE compile_artifacts (
+  signature TEXT NOT NULL,
+  filename TEXT NOT NULL,
+  blob_hash TEXT NOT NULL,
+  size_bytes INTEGER NOT NULL DEFAULT 0,
+  created_at TEXT NOT NULL DEFAULT (datetime('now')),
+  PRIMARY KEY (signature, filename)
+) WITHOUT ROWID;
+CREATE INDEX idx_compile_artifacts_hash ON compile_artifacts(blob_hash);
+)sql"},
   };
   return kMigrations;
 }

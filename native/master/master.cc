@@ -617,6 +617,8 @@ HttpResponse Master::route(const HttpRequest& req) {
     if (root == "templates") return handle_templates(req, rest);
     if (root == "webhooks") return handle_webhooks(req, rest);
     if (root == "job-queues") return handle_job_queue(req);
+    if (root == "compile_cache") return handle_compile_cache(req, rest);
+    if (root == "compile_jobs") return handle_compile_jobs(req, rest);
   } catch (const std::exception& e) {
     return json_resp(500, err_body(e.what()));
   }
@@ -1027,6 +1029,16 @@ HttpResponse Master::handle_prometheus_metrics() {
     for (const auto& [state, n] : exps_by_state) {
       out << "det_experiments{state=\"" << state << "\"} " << n << "\n";
     }
+    // Compile farm (docs/compile-farm.md): queue depth by state — the
+    // fleet-level view of how much recompilation is still ahead of the
+    // trials vs already absorbed off-allocation.
+    out << "# TYPE det_compile_jobs gauge\n";
+    for (auto& r : db_.query(
+             "SELECT state, COUNT(*) AS n FROM compile_jobs "
+             "GROUP BY state")) {
+      out << "det_compile_jobs{state=\"" << r["state"].as_string("")
+          << "\"} " << r["n"].as_int(0) << "\n";
+    }
   }
   out << "# TYPE det_preemptions_total counter\n"
       << "det_preemptions_total " << fleet_.preemptions.load() << "\n"
@@ -1038,7 +1050,15 @@ HttpResponse Master::handle_prometheus_metrics() {
       << "det_idempotency_replays_total " << fleet_.replay_hits.load() << "\n"
       << "# TYPE det_trial_spans_ingested_total counter\n"
       << "det_trial_spans_ingested_total " << fleet_.spans_ingested.load()
-      << "\n";
+      << "\n"
+      << "# TYPE det_compile_artifact_uploads_total counter\n"
+      << "det_compile_artifact_uploads_total "
+      << fleet_.compile_uploads.load() << "\n"
+      << "# TYPE det_compile_artifact_fetches_total counter\n"
+      << "det_compile_artifact_fetches_total "
+      << fleet_.compile_fetches.load() << "\n"
+      << "# TYPE det_compile_links_total counter\n"
+      << "det_compile_links_total " << fleet_.compile_links.load() << "\n";
   {
     std::lock_guard<std::mutex> lock(api_stats_.mu);
     out << "# TYPE det_api_requests_total counter\n";

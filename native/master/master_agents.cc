@@ -346,6 +346,9 @@ void Master::scheduler_loop() {
     // on an empty queue, and an empty queue is exactly when idle
     // capacity can be handed to under-sized elastic trials).
     maybe_grow_elastic_locked();
+    // Compile farm (docs/compile-farm.md): AFTER placements and grow-back
+    // — only capacity nothing else wanted this tick compiles.
+    dispatch_compile_jobs_locked();
     // Hourly task-log retention sweep (reference internal/logretention/).
     // Runs with mu_ RELEASED — a big DELETE must not stall the scheduler
     // or API handlers (the db has its own lock).
@@ -904,6 +907,11 @@ Json Master::build_task_env_locked(Allocation& alloc,
                {Json(trial->trace_id), Json(trial->id)});
     }
     env["DET_TRACE_ID"] = trial->trace_id;
+    // Compile farm: the trial's executable signature addresses its
+    // precompiled artifacts; the agent pre-warms from it before fork and
+    // the harness loads/uploads AOT executables under it.
+    std::string csig = compile_signature_locked(*exp, trial->hparams);
+    if (!csig.empty()) env["DET_COMPILE_SIGNATURE"] = csig;
     env["DET_TRIAL_REQUEST_ID"] = trial->request_id;
     env["DET_TRIAL_RUN_ID"] = trial->run_id;
     env["DET_TRIAL_SEED"] = trial->seed;

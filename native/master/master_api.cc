@@ -201,7 +201,9 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
         "UPDATE model_defs SET refcount = refcount - 1 WHERE hash = "
         "(SELECT model_def_hash FROM experiments WHERE id=?)",
         {Json(eid)});
-    db_.exec("DELETE FROM model_defs WHERE refcount <= 0");
+    db_.exec(
+        "DELETE FROM model_defs WHERE refcount <= 0 AND hash NOT IN "
+        "(SELECT blob_hash FROM compile_artifacts)");
     db_.exec(
         "UPDATE experiments SET state='DELETED', archived=1, "
         "model_def_hash=NULL WHERE id=?",

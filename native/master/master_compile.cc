@@ -1,0 +1,446 @@
+// master_compile.cc — the compile farm's control plane
+// (docs/compile-farm.md).
+//
+// The farm turns recompilation into a background, off-allocation cost:
+// trial creation enumerates each trial's executable SIGNATURE into a
+// persistent queue (compile_jobs, migration 23); the scheduler hands
+// QUEUED jobs to IDLE agents as {type:"compile"} actions (idle/queued time
+// becomes compile time); workers upload serialized executables + XLA-cache
+// entries to the content-addressed blob store via
+// POST /api/v1/compile_cache/{signature}; and agents pre-warm a node's
+// caches from GET /api/v1/compile_cache/{signature} before the container
+// starts.
+//
+// The signature here is the CONFIG-LEVEL key: entrypoint + model-def hash
+// + slots + the full hparam set (global_batch_size bucketed when
+// compile.bucket_batch_sizes is on). It hashes every hparam value, so two
+// trials share a key only when their configs are interchangeable; the
+// finer-grained sharing (an lr sweep collapsing to one executable) happens
+// worker-side, gated on the trace-based step fingerprint
+// (determined_tpu/compile/signature.py) — never by config guessing.
+
+#include <algorithm>
+#include <iostream>
+
+#include "../common/tls.h"
+#include "master.h"
+
+namespace det {
+
+namespace {
+
+Json err_body(const std::string& msg) {
+  Json j = Json::object();
+  j["error"] = msg;
+  return j;
+}
+
+HttpResponse json_resp(int status, const Json& j) {
+  return HttpResponse::json(status, j.dump());
+}
+
+HttpResponse not_found() { return json_resp(404, err_body("not found")); }
+
+// Smallest bucket boundary >= n (mirrors compile/bucketing.py
+// bucket_size): powers of two by default; with an explicit bucket list,
+// sizes above the largest bucket stay exact.
+int64_t bucket_size(int64_t n, const Json& buckets) {
+  if (n <= 0) return n;
+  if (buckets.is_array() && !buckets.as_array().empty()) {
+    std::vector<int64_t> bs;
+    for (const auto& b : buckets.as_array()) {
+      if (b.is_int()) bs.push_back(b.as_int());
+    }
+    std::sort(bs.begin(), bs.end());
+    for (int64_t b : bs) {
+      if (b >= n) return b;
+    }
+    return n;
+  }
+  int64_t p = 1;
+  while (p < n) p <<= 1;
+  return p;
+}
+
+// How long a dispatched compile job may run before the queue reclaims it.
+constexpr double kCompileJobDeadlineS = 600.0;
+constexpr int kCompileJobMaxAttempts = 3;
+
+}  // namespace
+
+std::string Master::compile_signature_locked(const ExperimentState& exp,
+                                             const Json& hparams) {
+  const Json& cc = exp.config["compile"];
+  if (cc.is_bool() && !cc.as_bool(true)) return "";
+  if (cc.is_object() && !cc["enabled"].as_bool(true)) return "";
+  bool bucket = cc.is_object() && cc["bucket_batch_sizes"].as_bool(false);
+
+  // Canonical hparams: JsonObject is a std::map, so iteration is sorted.
+  std::string hp;
+  bool first = true;
+  for (const auto& [k, v] : hparams.as_object()) {
+    if (!first) hp += ";";
+    first = false;
+    if (k == "global_batch_size" && bucket && v.is_int()) {
+      hp += k + "=" + Json(bucket_size(v.as_int(), cc["buckets"])).dump();
+    } else {
+      hp += k + "=" + v.dump();
+    }
+  }
+
+  std::string md_hash;
+  auto rows = db_.query("SELECT model_def_hash FROM experiments WHERE id=?",
+                        {Json(exp.id)});
+  if (!rows.empty()) md_hash = rows[0]["model_def_hash"].as_string("");
+
+  std::string ep = exp.config["entrypoint"].is_string()
+                       ? exp.config["entrypoint"].as_string()
+                       : exp.config["entrypoint"].dump();
+  std::string canonical = "det-compile-v1|" + ep + "|" + md_hash + "|" +
+                          std::to_string(exp.slots_per_trial) + "|" + hp;
+  try {
+    return sha256_hex(canonical);
+  } catch (const std::exception&) {
+    // No libcrypto: a random key would break the whole point (successor
+    // trials could never find the artifacts) — disable the farm instead.
+    return "";
+  }
+}
+
+void Master::enqueue_compile_job_locked(const ExperimentState& exp,
+                                        const TrialState& trial) {
+  // Background precompilation is opt-in (compile.background): dispatching
+  // workers for entrypoints that aren't Trainer-based would burn idle CPU
+  // for nothing. Artifact exchange (trial-side upload, agent pre-warm) is
+  // always on — after the first trial of a signature compiles, successors
+  // are warm either way; `background: true` additionally makes the FIRST
+  // trial warm by compiling while it queues.
+  const Json& cc = exp.config["compile"];
+  if (!(cc.is_object() && cc["background"].as_bool(false))) return;
+  std::string sig = compile_signature_locked(exp, trial.hparams);
+  if (sig.empty()) return;
+  // Idempotent: N trials of a sweep sharing a signature enqueue one job;
+  // a DONE row from an earlier experiment stays DONE (artifacts already
+  // exist — that is the cross-experiment reuse).
+  db_.exec(
+      "INSERT INTO compile_jobs (signature, experiment_id, hparams, slots) "
+      "VALUES (?, ?, ?, ?) ON CONFLICT(signature) DO NOTHING",
+      {Json(sig), Json(exp.id), Json(trial.hparams.dump()),
+       Json(static_cast<int64_t>(exp.slots_per_trial))});
+  compile_queue_maybe_ = true;
+}
+
+void Master::dispatch_compile_jobs_locked() {
+  // 0) Master-restart reconciliation (once): RUNNING rows with no
+  // in-memory tracking entry were dispatched by a previous incarnation —
+  // requeue them (the attempts bound still caps retries).
+  if (!compile_reconciled_) {
+    compile_reconciled_ = true;
+    for (auto& r : db_.query(
+             "SELECT signature, attempts FROM compile_jobs "
+             "WHERE state='RUNNING'")) {
+      std::string sig = r["signature"].as_string("");
+      if (compile_running_.count(sig)) continue;
+      bool exhausted = r["attempts"].as_int(0) >= kCompileJobMaxAttempts;
+      db_.exec(
+          "UPDATE compile_jobs SET state=?, updated_at=datetime('now') "
+          "WHERE signature=?",
+          {Json(std::string(exhausted ? "FAILED" : "QUEUED")), Json(sig)});
+      if (!exhausted) compile_queue_maybe_ = true;
+    }
+  }
+
+  // 1) Reclaim jobs whose agent died or deadline lapsed.
+  for (auto it = compile_running_.begin(); it != compile_running_.end();) {
+    const std::string& sig = it->first;
+    const std::string& agent_id = it->second.first;
+    auto ait = agents_.find(agent_id);
+    bool agent_gone = ait == agents_.end() || !ait->second.alive;
+    if (agent_gone || now() > it->second.second) {
+      auto rows = db_.query(
+          "SELECT attempts, state FROM compile_jobs WHERE signature=?",
+          {Json(sig)});
+      if (!rows.empty() && rows[0]["state"].as_string("") == "RUNNING") {
+        bool exhausted =
+            rows[0]["attempts"].as_int(0) >= kCompileJobMaxAttempts;
+        db_.exec(
+            "UPDATE compile_jobs SET state=?, updated_at=datetime('now') "
+            "WHERE signature=?",
+            {Json(std::string(exhausted ? "FAILED" : "QUEUED")), Json(sig)});
+        if (!exhausted) compile_queue_maybe_ = true;
+        std::cerr << "master: compile job " << sig.substr(0, 12)
+                  << (exhausted ? " failed (attempts exhausted)"
+                                : " requeued")
+                  << " (agent " << agent_id
+                  << (agent_gone ? " gone)" : " deadline lapsed)")
+                  << std::endl;
+      }
+      it = compile_running_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+
+  // 2) Idle agents: alive, not draining, zero allocated slots, not
+  // already compiling. Compile work must never delay real placements —
+  // schedule_locked ran first this tick, so whatever is idle now really
+  // had no trial to run.
+  std::vector<AgentState*> idle;
+  for (auto& [id, a] : agents_) {
+    if (!a.alive || a.draining) continue;
+    bool busy = false;
+    for (const auto& s : a.slots) {
+      if (!s.allocation_id.empty()) busy = true;
+    }
+    for (const auto& [sig, info] : compile_running_) {
+      if (info.first == id) busy = true;
+    }
+    if (!busy) idle.push_back(&a);
+  }
+  if (idle.empty() || !compile_queue_maybe_) return;
+
+  auto jobs = db_.query(
+      "SELECT signature, experiment_id, hparams, slots FROM compile_jobs "
+      "WHERE state='QUEUED' ORDER BY created_at LIMIT ?",
+      {Json(static_cast<int64_t>(idle.size()))});
+  if (jobs.empty()) {
+    compile_queue_maybe_ = false;
+    return;
+  }
+
+  size_t ai = 0;
+  bool dispatched = false;
+  for (auto& job : jobs) {
+    if (ai >= idle.size()) break;
+    std::string sig = job["signature"].as_string("");
+    int64_t eid = job["experiment_id"].as_int(-1);
+    ExperimentState* exp = find_experiment_locked(eid);
+    Json config = exp != nullptr ? exp->config : Json();
+    int64_t owner_id = exp != nullptr ? exp->owner_id : 1;
+    if (!config.is_object()) {
+      auto rows = db_.query(
+          "SELECT config, owner_id FROM experiments WHERE id=?",
+          {Json(eid)});
+      if (rows.empty()) {
+        // Experiment vanished (deleted): the job is moot.
+        db_.exec("UPDATE compile_jobs SET state='FAILED', error='experiment "
+                 "deleted', updated_at=datetime('now') WHERE signature=?",
+                 {Json(sig)});
+        continue;
+      }
+      config = Json::parse_or_null(rows[0]["config"].as_string("{}"));
+      owner_id = rows[0]["owner_id"].as_int(1);
+    }
+    AgentState* agent = idle[ai++];
+
+    Json env = Json::object();
+    env["DET_MASTER"] =
+        !cfg_.advertised_url.empty()
+            ? cfg_.advertised_url
+            : std::string(server_.tls_enabled() ? "https://" : "http://") +
+                  (cfg_.host == "0.0.0.0" ? "127.0.0.1" : cfg_.host) + ":" +
+                  std::to_string(server_.port());
+    env["DET_COMPILE_SIGNATURE"] = sig;
+    env["DET_COMPILE_HPARAMS"] = job["hparams"].as_string("{}");
+    env["DET_COMPILE_SLOTS"] = job["slots"].as_int(1);
+    env["DET_EXPERIMENT_ID"] = eid;
+    env["DET_EXPERIMENT_CONFIG"] = config.dump();
+    std::string token = random_hex(24);
+    db_.exec(
+        "INSERT INTO user_sessions (user_id, token, expires_at) "
+        "VALUES (?, ?, datetime('now', '+1 day'))",
+        {Json(owner_id), Json(token)});
+    env["DET_SESSION_TOKEN"] = token;
+
+    Json action = Json::object();
+    action["type"] = "compile";
+    action["signature"] = sig;
+    action["env"] = env;
+    agent->actions.push_back(action);
+    compile_running_[sig] = {agent->id, now() + kCompileJobDeadlineS};
+    db_.exec(
+        "UPDATE compile_jobs SET state='RUNNING', agent_id=?, "
+        "attempts=attempts+1, updated_at=datetime('now') WHERE signature=?",
+        {Json(agent->id), Json(sig)});
+    std::cerr << "master: compile job " << sig.substr(0, 12)
+              << " dispatched to idle agent " << agent->id << std::endl;
+    dispatched = true;
+  }
+  if (dispatched) cv_.notify_all();
+}
+
+HttpResponse Master::handle_compile_cache(
+    const HttpRequest& req, const std::vector<std::string>& parts) {
+  if (parts.size() != 2) return not_found();
+  const std::string& sig = parts[1];
+
+  if (req.method == "GET") {
+    std::string only = req.query_param("name");
+    std::string sql =
+        "SELECT ca.filename AS filename, ca.size_bytes AS size_bytes, "
+        "md.blob AS blob FROM compile_artifacts ca "
+        "JOIN model_defs md ON md.hash = ca.blob_hash "
+        "WHERE ca.signature = ?";
+    std::vector<Json> params = {Json(sig)};
+    if (!only.empty()) {
+      sql += " AND ca.filename = ?";
+      params.push_back(Json(only));
+    }
+    auto rows = db_.query(sql, params);
+    Json files = Json::array();
+    for (auto& r : rows) {
+      Json f = Json::object();
+      f["name"] = r["filename"];
+      f["b64"] = r["blob"];
+      f["size"] = r["size_bytes"];
+      files.push_back(std::move(f));
+    }
+    fleet_.compile_fetches.fetch_add(1);
+    Json out = Json::object();
+    out["signature"] = sig;
+    out["files"] = std::move(files);
+    return json_resp(200, out);
+  }
+
+  if (req.method == "POST") {
+    Json body = Json::parse_or_null(req.body);
+    const Json& files = body["files"];
+    if (!files.is_object()) {
+      return json_resp(400, err_body("files object required"));
+    }
+    std::lock_guard<std::mutex> lock(mu_);
+    int64_t stored = 0;
+    for (const auto& [name, b64] : files.as_object()) {
+      if (!b64.is_string() || b64.as_string().empty()) continue;
+      auto exists = db_.query(
+          "SELECT 1 AS x FROM compile_artifacts WHERE signature=? AND "
+          "filename=?",
+          {Json(sig), Json(name)});
+      if (!exists.empty()) continue;  // idempotent re-upload: no new claim
+      std::string hash = store_context_blob_locked(b64.as_string());
+      if (hash.empty()) continue;
+      db_.exec(
+          "INSERT INTO compile_artifacts (signature, filename, blob_hash, "
+          "size_bytes) VALUES (?, ?, ?, ?) "
+          "ON CONFLICT(signature, filename) DO NOTHING",
+          {Json(sig), Json(name), Json(hash),
+           Json(static_cast<int64_t>(b64.as_string().size()))});
+      ++stored;
+    }
+    // Artifacts arriving marks the signature compiled — whether they came
+    // from a farm worker or a trial that compiled fresh and uploaded.
+    db_.exec(
+        "INSERT INTO compile_jobs (signature, state, fingerprint, "
+        "compile_ms) VALUES (?, 'DONE', ?, ?) "
+        "ON CONFLICT(signature) DO UPDATE SET state='DONE', "
+        "fingerprint=CASE WHEN excluded.fingerprint != '' THEN "
+        "excluded.fingerprint ELSE fingerprint END, "
+        "compile_ms=COALESCE(excluded.compile_ms, compile_ms), "
+        "updated_at=datetime('now')",
+        {Json(sig), Json(body["fingerprint"].as_string("")),
+         body["compile_ms"].is_number() ? body["compile_ms"] : Json()});
+    compile_running_.erase(sig);
+    fleet_.compile_uploads.fetch_add(1);
+    Json out = Json::object();
+    out["stored"] = stored;
+    return json_resp(200, out);
+  }
+  return not_found();
+}
+
+HttpResponse Master::handle_compile_jobs(
+    const HttpRequest& req, const std::vector<std::string>& parts) {
+  // GET /api/v1/compile_jobs[?state=&fingerprint=&experiment_id=]
+  if (parts.size() == 1 && req.method == "GET") {
+    std::string sql =
+        "SELECT signature, experiment_id, state, slots, attempts, agent_id, "
+        "fingerprint, compile_ms, error, created_at, updated_at "
+        "FROM compile_jobs WHERE 1=1";
+    std::vector<Json> params;
+    std::string state = req.query_param("state");
+    if (!state.empty()) {
+      sql += " AND state=?";
+      params.push_back(Json(state));
+    }
+    std::string fp = req.query_param("fingerprint");
+    if (!fp.empty()) {
+      sql += " AND fingerprint=?";
+      params.push_back(Json(fp));
+    }
+    std::string eid = req.query_param("experiment_id");
+    if (!eid.empty()) {
+      sql += " AND experiment_id=?";
+      params.push_back(Json(eid));
+    }
+    sql += " ORDER BY created_at";
+    auto rows = db_.query(sql, params);
+    Json jobs = Json::array();
+    for (auto& r : rows) {
+      Json j = Json::object();
+      for (const char* k :
+           {"signature", "experiment_id", "state", "slots", "attempts",
+            "agent_id", "fingerprint", "compile_ms", "error", "created_at",
+            "updated_at"}) {
+        j[k] = r[k];
+      }
+      jobs.push_back(std::move(j));
+    }
+    Json out = Json::object();
+    out["jobs"] = std::move(jobs);
+    return json_resp(200, out);
+  }
+
+  // POST /api/v1/compile_jobs/{sig} — worker/agent result report.
+  if (parts.size() == 2 && req.method == "POST") {
+    Json body = Json::parse_or_null(req.body);
+    std::string state = body["state"].as_string("");
+    if (state != "DONE" && state != "FAILED") {
+      return json_resp(400, err_body("state must be DONE or FAILED"));
+    }
+    std::lock_guard<std::mutex> lock(mu_);
+    db_.exec(
+        "UPDATE compile_jobs SET state=?, "
+        "fingerprint=CASE WHEN ? != '' THEN ? ELSE fingerprint END, "
+        "compile_ms=COALESCE(?, compile_ms), error=?, "
+        "updated_at=datetime('now') WHERE signature=?",
+        {Json(state), Json(body["fingerprint"].as_string("")),
+         Json(body["fingerprint"].as_string("")),
+         body["compile_ms"].is_number() ? body["compile_ms"] : Json(),
+         Json(body["error"].as_string("")), Json(parts[1])});
+    compile_running_.erase(parts[1]);
+    return json_resp(200, Json::object());
+  }
+
+  // POST /api/v1/compile_jobs/{sig}/link {from} — fingerprint-verified
+  // executable sharing: copy another signature's artifact rows. The new
+  // rows reference the same blobs without fresh claims; the blob sweep's
+  // compile_artifacts join keeps those blobs alive.
+  if (parts.size() == 3 && parts[2] == "link" && req.method == "POST") {
+    Json body = Json::parse_or_null(req.body);
+    std::string from = body["from"].as_string("");
+    if (from.empty()) return json_resp(400, err_body("from required"));
+    std::lock_guard<std::mutex> lock(mu_);
+    auto n = db_.exec(
+        "INSERT INTO compile_artifacts (signature, filename, blob_hash, "
+        "size_bytes) SELECT ?, filename, blob_hash, size_bytes "
+        "FROM compile_artifacts WHERE signature=? "
+        "ON CONFLICT(signature, filename) DO NOTHING",
+        {Json(parts[1]), Json(from)});
+    db_.exec(
+        "INSERT INTO compile_jobs (signature, state, fingerprint) "
+        "VALUES (?, 'DONE', ?) "
+        "ON CONFLICT(signature) DO UPDATE SET state='DONE', "
+        "fingerprint=CASE WHEN excluded.fingerprint != '' THEN "
+        "excluded.fingerprint ELSE fingerprint END, "
+        "updated_at=datetime('now')",
+        {Json(parts[1]), Json(body["fingerprint"].as_string(""))});
+    compile_running_.erase(parts[1]);
+    fleet_.compile_links.fetch_add(1);
+    Json out = Json::object();
+    out["linked"] = n;
+    return json_resp(200, out);
+  }
+  return not_found();
+}
+
+}  // namespace det

@@ -416,6 +416,9 @@ void Master::process_ops_locked(ExperimentState& exp,
             "owner_id, workspace_id) VALUES (?, 'TRIAL', 'ACTIVE', ?, ?, ?)",
             {Json(trial_task_id(exp.trials[op.request_id].id)),
              Json(exp.job_id), Json(exp.owner_id), Json(exp.workspace_id)});
+        // Compile farm: every distinct signature the searcher creates
+        // becomes a background AOT job while the trial queues.
+        enqueue_compile_job_locked(exp, exp.trials[op.request_id]);
         break;
       }
       case SearcherOp::Kind::ValidateAfter: {
@@ -563,7 +566,12 @@ void Master::release_task_context_locked(const std::string& task_id) {
       "(SELECT context_hash FROM tasks WHERE id=?)",
       {Json(task_id)});
   db_.exec("UPDATE tasks SET context_hash=NULL WHERE id=?", {Json(task_id)});
-  db_.exec("DELETE FROM model_defs WHERE refcount <= 0");
+  // A blob referenced by a live compile-artifact row must survive a
+  // refcount that drained to zero: compile-farm links reference blobs
+  // without fresh claims (docs/compile-farm.md).
+  db_.exec(
+      "DELETE FROM model_defs WHERE refcount <= 0 AND hash NOT IN "
+      "(SELECT blob_hash FROM compile_artifacts)");
 }
 
 int64_t Master::sweep_context_blobs_locked() {
@@ -587,7 +595,12 @@ int64_t Master::sweep_context_blobs_locked() {
     released = db_.exec(
         "UPDATE tasks SET context_hash=NULL WHERE end_time IS NOT NULL "
         "AND context_hash IS NOT NULL");
-    db_.exec("DELETE FROM model_defs WHERE refcount <= 0");
+    // Compile artifacts hold blobs independently of task/experiment
+    // claims: the sweep must never purge a blob a live signature row
+    // still references (regression-tested in tests/test_compile_farm.py).
+    db_.exec(
+        "DELETE FROM model_defs WHERE refcount <= 0 AND hash NOT IN "
+        "(SELECT blob_hash FROM compile_artifacts)");
   });
   return released;
 }

@@ -5,9 +5,11 @@
 
 #include "preflight.h"
 
+#include <algorithm>
 #include <cmath>
 #include <set>
 #include <string>
+#include <utility>
 #include <vector>
 
 namespace det {
@@ -67,6 +69,100 @@ int64_t batch_axes_product(const Json& config, int64_t slots = -1) {
     return 0;
   }
   return sizes["data"] * sizes["fsdp"];
+}
+
+// DTL205 helpers — mirror determined_tpu/analysis/config_rules.py
+// (SHAPE_HPARAM_TOKENS / _spec_distinct) token for token.
+const std::set<std::string>& shape_tokens() {
+  static const std::set<std::string> kTokens = {
+      "batch",    "size",      "dim",     "dims",    "width",   "depth",
+      "layer",    "layers",    "head",    "heads",   "seq",     "len",
+      "length",   "vocab",     "position", "positions", "expert",
+      "experts",  "hidden",    "model",   "feature", "features",
+      "channel",  "channels",  "embed",   "embedding"};
+  return kTokens;
+}
+
+bool is_shape_hparam(const std::string& name) {
+  std::string tok;
+  for (size_t i = 0; i <= name.size(); ++i) {
+    if (i == name.size() || name[i] == '_') {
+      std::string lower = tok;
+      for (auto& c : lower) c = static_cast<char>(tolower(c));
+      if (shape_tokens().count(lower)) return true;
+      tok.clear();
+    } else {
+      tok.push_back(name[i]);
+    }
+  }
+  return false;
+}
+
+int64_t bucket_boundary(int64_t n, const Json& buckets) {
+  if (n <= 0) return n;
+  if (buckets.is_array() && !buckets.as_array().empty()) {
+    std::vector<int64_t> bs;
+    for (const auto& b : buckets.as_array()) {
+      if (b.is_int()) bs.push_back(b.as_int());
+    }
+    std::sort(bs.begin(), bs.end());
+    for (int64_t b : bs) {
+      if (b >= n) return b;
+    }
+    return n;
+  }
+  int64_t p = 1;
+  while (p < n) p <<= 1;
+  return p;
+}
+
+constexpr int64_t kUnbounded = 1000000000;
+
+int64_t distinct_bucketed_batches(int64_t mn, int64_t mx,
+                                  const Json& buckets) {
+  int64_t n = 0, b = mn;
+  while (b <= mx && n <= 64) {
+    ++n;
+    int64_t bb = bucket_boundary(b, buckets);
+    b = (bb > b ? bb : b) + 1;
+  }
+  return n > 0 ? n : 1;
+}
+
+// (distinct shapes, bucketing applied) for one hparam spec.
+std::pair<int64_t, bool> spec_distinct(const std::string& name,
+                                       const Json& spec, bool bucket_on,
+                                       const Json& buckets) {
+  if (!spec.is_object() || !spec["type"].is_string()) return {1, false};
+  const std::string t = spec["type"].as_string("");
+  const bool is_gbs = name == "global_batch_size";
+  if (t == "const") return {1, false};
+  if (t == "categorical") {
+    const auto& vals = spec["vals"].as_array();
+    if (is_gbs && bucket_on) {
+      std::set<int64_t> bs;
+      for (const auto& v : vals) {
+        if (v.is_int()) bs.insert(bucket_boundary(v.as_int(), buckets));
+      }
+      if (!bs.empty()) return {static_cast<int64_t>(bs.size()), true};
+    }
+    return {std::max<int64_t>(1, static_cast<int64_t>(vals.size())), false};
+  }
+  if (t == "int") {
+    if (!spec["minval"].is_int() || !spec["maxval"].is_int()) return {1, false};
+    int64_t mn = spec["minval"].as_int(), mx = spec["maxval"].as_int();
+    if (mx < mn) return {1, false};
+    if (is_gbs && bucket_on) {
+      return {distinct_bucketed_batches(mn, mx, buckets), true};
+    }
+    int64_t cnt = spec["count"].as_int(0);
+    if (cnt > 0) return {std::min(cnt, mx - mn + 1), false};
+    return {mx - mn + 1, false};
+  }
+  // double/log
+  int64_t cnt = spec["count"].as_int(0);
+  if (cnt > 0) return {cnt, false};
+  return {kUnbounded, false};
 }
 
 int64_t length_batches(const Json& v) {
@@ -163,6 +259,59 @@ Json preflight_config(const Json& config) {
                   " is not divisible by the mesh batch axes data x fsdp = " +
                   std::to_string(bprod) + " at this slot count"));
         }
+      }
+    }
+  }
+
+  // DTL205 — shape-affecting hparam sweep without bucketing
+  // (docs/compile-farm.md): each distinct shape compiles its own
+  // executable and the compile farm can't share across them.
+  {
+    const std::string sname = searcher["name"].as_string("");
+    const Json& hp = config["hyperparameters"];
+    if (!sname.empty() && sname != "single" && sname != "custom" &&
+        hp.is_object()) {
+      const Json& cc = config["compile"];
+      bool bucket_on = cc.is_object() && cc["bucket_batch_sizes"].as_bool(false);
+      const Json& buckets = cc["buckets"];
+      int64_t max_exec =
+          cc.is_object() ? cc["max_executables"].as_int(8) : 8;
+      if (max_exec < 1) max_exec = 8;
+      int64_t total = 1;
+      bool bucketable = false;
+      std::string offenders;
+      for (const auto& [hname, spec] : hp.as_object()) {
+        if (hname == "mesh" || !is_shape_hparam(hname)) continue;
+        auto [n, bucketed] = spec_distinct(hname, spec, bucket_on, buckets);
+        if (n > 1) {
+          if (!offenders.empty()) offenders += ", ";
+          offenders += hname + " (" +
+                       (n >= kUnbounded ? std::string("unbounded")
+                                        : std::to_string(n)) +
+                       " distinct shapes)";
+          total = std::min<int64_t>(total * n, kUnbounded);
+          if (hname == "global_batch_size" && !bucketed) bucketable = true;
+        }
+      }
+      int64_t max_trials = searcher["max_trials"].as_int(0);
+      if (max_trials > 0) total = std::min(total, max_trials);
+      if (!offenders.empty() && total > max_exec) {
+        std::string hint =
+            bucketable ? "enable compile.bucket_batch_sizes so batch sizes "
+                         "share bucketed executables, "
+                       : "";
+        out.push_back(diag(
+            "DTL205", "warning",
+            "searcher sweep implies ~" +
+                (total >= kUnbounded ? std::string("unbounded")
+                                     : std::to_string(total)) +
+                " distinct executables from shape-affecting "
+                "hyperparameters [" + offenders +
+                "] > compile.max_executables=" + std::to_string(max_exec) +
+                ": each distinct shape pays a full XLA compile and the "
+                "compile farm cannot share artifacts across them; " + hint +
+                "use const/categorical values, or raise "
+                "compile.max_executables if intended"));
       }
     }
   }

@@ -506,6 +506,66 @@ static void test_preflight_elastic_sizes() {
   CHECK(!det::preflight_should_fail(cfg, d3));
 }
 
+static void test_preflight_shape_sweep() {
+  // random searcher sampling global_batch_size raw over [16, 256] with
+  // 32 trials -> far more distinct executables than the default 8.
+  Json cfg = preflight_base_config();
+  cfg["searcher"]["name"] = "random";
+  cfg["searcher"]["max_trials"] = static_cast<int64_t>(32);
+  Json gbs = Json::object();
+  gbs["type"] = "int";
+  gbs["minval"] = static_cast<int64_t>(16);
+  gbs["maxval"] = static_cast<int64_t>(256);
+  cfg["hyperparameters"]["global_batch_size"] = gbs;
+  Json d = det::preflight_config(cfg);
+  CHECK_EQ(d.as_array().size(), static_cast<size_t>(1));
+  CHECK_EQ(d.as_array()[0]["code"].as_string(), "DTL205");
+  CHECK_EQ(d.as_array()[0]["level"].as_string(), "warning");
+
+  // Bucketing on: [16,256] maps to 5 buckets {16,32,64,128,256} <= 8.
+  Json cc = Json::object();
+  cc["bucket_batch_sizes"] = true;
+  cfg["compile"] = cc;
+  CHECK(det::preflight_config(cfg).as_array().empty());
+
+  // Raised ceiling silences it too.
+  cfg["compile"] = Json::object();
+  cfg["compile"]["max_executables"] = static_cast<int64_t>(512);
+  CHECK(det::preflight_config(cfg).as_array().empty());
+
+  // single searcher: one trial, one executable — silent regardless.
+  cfg["compile"] = Json();
+  cfg["searcher"]["name"] = "single";
+  CHECK(det::preflight_config(cfg).as_array().empty());
+
+  // Non-shape sweep (lr) alone never fires.
+  Json cfg2 = preflight_base_config();
+  cfg2["searcher"]["name"] = "random";
+  cfg2["searcher"]["max_trials"] = static_cast<int64_t>(32);
+  Json lr = Json::object();
+  lr["type"] = "log";
+  lr["minval"] = static_cast<int64_t>(-4);
+  lr["maxval"] = static_cast<int64_t>(-1);
+  cfg2["hyperparameters"]["lr"] = lr;
+  CHECK(det::preflight_config(cfg2).as_array().empty());
+
+  // max_trials bounds the estimate: 4 trials can't exceed 8 executables.
+  cfg["searcher"]["name"] = "random";
+  cfg["searcher"]["max_trials"] = static_cast<int64_t>(4);
+  CHECK(det::preflight_config(cfg).as_array().empty());
+
+  // Config-level suppression works like every DTL2xx rule.
+  cfg["searcher"]["max_trials"] = static_cast<int64_t>(32);
+  Json sup = Json::object();
+  Json codes = Json::array();
+  codes.push_back(Json(std::string("DTL205")));
+  sup["suppress"] = codes;
+  cfg["preflight"] = sup;
+  Json d3 = det::preflight_config(cfg);
+  CHECK_EQ(d3.as_array().size(), static_cast<size_t>(1));
+  CHECK(d3.as_array()[0]["suppressed"].as_bool(false));
+}
+
 static void test_preflight_suppress_and_gate() {
   Json cfg = preflight_base_config();
   cfg["hyperparameters"]["global_batch_size"] = static_cast<int64_t>(30);
@@ -561,6 +621,7 @@ int main() {
       {"preflight_searcher_rungs", test_preflight_searcher_rungs},
       {"preflight_restarts_without_checkpoints",
        test_preflight_restarts_without_checkpoints},
+      {"preflight_shape_sweep", test_preflight_shape_sweep},
       {"preflight_suppress_and_gate", test_preflight_suppress_and_gate},
   };
   for (auto& t : tests) {

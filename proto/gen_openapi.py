@@ -192,6 +192,22 @@ ROUTES += [
     ("get", "/api/v1/serving/{id}", "serving", "Get serving task"),
     ("post", "/api/v1/serving/{id}/kill", "serving",
      "Kill the serving task (no respawn)"),
+    # Compile farm (docs/compile-farm.md): the AOT artifact store over the
+    # content-addressed blobs + the background compile-job queue.
+    ("get", "/api/v1/compile_cache/{signature}", "compile",
+     "Fetch a signature's precompiled artifacts (?name= filters; agents "
+     "pre-warm from this before a container starts)"),
+    ("post", "/api/v1/compile_cache/{signature}", "compile",
+     "Store artifacts {files: {name: b64}} for a signature (marks its "
+     "compile job DONE; idempotent per filename)"),
+    ("get", "/api/v1/compile_jobs", "compile",
+     "List AOT compile jobs (?state=&fingerprint=&experiment_id=)"),
+    ("post", "/api/v1/compile_jobs/{signature}", "compile",
+     "Worker/agent result report {state: DONE|FAILED, fingerprint, "
+     "compile_ms, error}"),
+    ("post", "/api/v1/compile_jobs/{signature}/link", "compile",
+     "Share another signature's artifacts ({from}) after a fingerprint "
+     "match — executable sharing without recompiling"),
 ]
 
 

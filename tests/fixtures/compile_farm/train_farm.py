@@ -1,0 +1,70 @@
+"""Compile-farm e2e/bench fixture (docs/compile-farm.md): a compile-heavy
+GPT-2 JaxTrial under the Trainer.
+
+The trial class is module-level on purpose — the farm worker discovers and
+instantiates it exactly like `det preflight` does, so the background AOT
+compile runs the same program the trial will. `inject_hyperparams` keeps
+the learning rate out of the compiled program (optimizer STATE, not a
+baked constant), which is what lets an lr sweep share one executable
+across signatures via the worker's fingerprint link.
+"""
+
+import os
+import sys
+
+import numpy as np
+import optax
+
+from determined_tpu.models import gpt2
+from determined_tpu.train.trial import JaxTrial, TrialContext
+
+VOCAB = 512
+SEQ = 128
+
+
+class FarmTrial(JaxTrial):
+    prefetch = False  # keep the compile measurement free of pipeline noise
+
+    def _cfg(self):
+        return gpt2.Config(
+            vocab_size=VOCAB,
+            n_positions=SEQ,
+            d_model=int(os.environ.get("FARM_D_MODEL", "512")),
+            n_layer=int(os.environ.get("FARM_N_LAYER", "6")),
+            n_head=8,
+            remat=False,
+        )
+
+    def init_params(self, rng):
+        return gpt2.init(rng, self._cfg())
+
+    def loss(self, params, batch, rng):
+        return gpt2.loss_fn(params, batch, self._cfg())
+
+    def optimizer(self):
+        return optax.inject_hyperparams(optax.adamw)(
+            learning_rate=float(self.context.hparams.get("lr", 1e-3)))
+
+    def build_training_data(self):
+        rng = np.random.default_rng(0)
+        bs = int(self.context.hparams.get("global_batch_size", 8))
+        while True:
+            yield {"tokens": rng.integers(
+                0, VOCAB, size=(bs, SEQ + 1)).astype(np.int32)}
+
+
+def main() -> int:
+    from determined_tpu import core
+    from determined_tpu.train import Trainer
+
+    with core.init(async_checkpointing=False) as ctx:
+        trial = FarmTrial(TrialContext(hparams=ctx.hparams,
+                                       core_context=ctx))
+        trainer = Trainer(trial, core_context=ctx)
+        trainer.fit(report_period=2)
+    print("farm fixture: trial complete", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
