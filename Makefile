@@ -3,7 +3,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint test chaos bench-input bench-serve bench-trace bench-compile native native-test clean
+.PHONY: lint test chaos bench-input bench-serve bench-serve-fleet bench-trace bench-compile native native-test clean
 
 # The dogfood gate (docs/preflight.md): the platform's own models and
 # examples must pass the platform's own static analyzer. Fails on any
@@ -24,7 +24,7 @@ CHAOS_TIMEOUT ?= 1800
 chaos:
 	timeout -k 30 $(CHAOS_TIMEOUT) $(PY) -m pytest \
 		tests/test_chaos.py tests/test_selfheal.py tests/test_preemption.py \
-		tests/test_serving.py tests/test_elastic.py \
+		tests/test_serving.py tests/test_deployments.py tests/test_elastic.py \
 		tests/test_observability.py tests/test_compile_farm.py \
 		-q -m slow
 
@@ -39,6 +39,14 @@ bench-input:
 # serve_p50_ms, serve_p99_ms.
 bench-serve:
 	$(PY) bench.py --only serve
+
+# Fleet serving (docs/serving.md "Deployments & autoscaling"): a
+# 2-replica deployment behind the master router vs a single replica on
+# the same checkpoint — gates routed throughput >= 1.8x single-replica —
+# plus a rolling drain under load proving zero dropped accepted requests.
+# Emits serve_fleet_tokens_per_s, serve_fleet_drain_dropped.
+bench-serve-fleet:
+	$(PY) bench.py --only serve_fleet
 
 # Elastic re-meshing: resize downtime (signal -> first post-resize step)
 # vs the restart-from-checkpoint requeue baseline for the same drain

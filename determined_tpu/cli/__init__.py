@@ -507,17 +507,43 @@ def cmd_preflight(session, args) -> int:
 
 
 def cmd_serve(session, args) -> int:
-    """`det serve <config> [context_dir]` — launch a serve replica;
-    `det serve status [id]` — list/inspect; `det serve kill <id>`.
+    """`det serve <config> [context_dir]` — launch a serve replica, or a
+    deployment when the config carries `serving.replicas`;
+    `det serve status [id]` — list/inspect (deployments + tasks);
+    `det serve scale <deployment> <target>` — manual scale within
+    [min, max]; `det serve kill <id>` — kill a task or a deployment.
 
     `--local` runs the replica in-process against local checkpoint
     storage (no master) — the dev loop for serving configs."""
     target = args.target
     if target == "status":
         if args.extra:
-            resp = session.get(f"/api/v1/serving/{args.extra[0]}")
+            tid = args.extra[0]
+            if tid.startswith("deploy-"):
+                resp = session.get(f"/api/v1/deployments/{tid}")
+                print(json.dumps(resp.get("deployment", resp), indent=2))
+                return 0
+            resp = session.get(f"/api/v1/serving/{tid}")
             print(json.dumps(resp.get("task", resp), indent=2))
             return 0
+        deployments = session.get(
+            "/api/v1/deployments").get("deployments", [])
+        if deployments:
+            _print_table(
+                [
+                    {
+                        "id": d.get("id"),
+                        "name": d.get("name"),
+                        "state": d.get("state"),
+                        "replicas": (f"{d.get('replica_count', 0)}"
+                                     f"/{d.get('target_replicas', 0)}"),
+                        "range": (f"[{d.get('min_replicas')}, "
+                                  f"{d.get('max_replicas')}]"),
+                        "load": round(d.get("smoothed_load") or 0.0, 3),
+                    }
+                    for d in deployments
+                ],
+                ["id", "name", "state", "replicas", "range", "load"])
         resp = session.get("/api/v1/serving")
         rows = [
             {
@@ -532,11 +558,25 @@ def cmd_serve(session, args) -> int:
         _print_table(rows, ["id", "state", "allocation", "address",
                             "restarts"])
         return 0
+    if target == "scale":
+        if len(args.extra) != 2:
+            raise SystemExit(
+                "usage: det serve scale <deployment-id> <target>")
+        dep, n = args.extra[0], int(args.extra[1])
+        resp = session.post(f"/api/v1/deployments/{dep}/scale",
+                            body={"target": n})
+        print(f"deployment {resp.get('id', dep)} target -> "
+              f"{resp.get('target', n)}")
+        return 0
     if target == "kill":
         if not args.extra:
-            raise SystemExit("usage: det serve kill <task-id>")
-        session.post(f"/api/v1/serving/{args.extra[0]}/kill")
-        print(f"killed {args.extra[0]}")
+            raise SystemExit("usage: det serve kill <task-or-deployment-id>")
+        tid = args.extra[0]
+        if tid.startswith("deploy-"):
+            session.post(f"/api/v1/deployments/{tid}/kill")
+        else:
+            session.post(f"/api/v1/serving/{tid}/kill")
+        print(f"killed {tid}")
         return 0
 
     # Launch path: <config> [context_dir].
@@ -553,6 +593,18 @@ def cmd_serve(session, args) -> int:
     body = {"config": config}
     if context_dir:
         body["context"] = _tar_context(context_dir)
+    if isinstance(config["serving"].get("replicas"), dict):
+        # serving.replicas makes this a deployment: a reconciled replica
+        # set behind the /serve/{deployment} router, autoscaled within
+        # [min, max] (docs/serving.md "Deployments & autoscaling").
+        resp = session.post("/api/v1/deployments", body=body)
+        print(f"Created deployment {resp['id']} "
+              f"({resp.get('target')} replicas: "
+              f"{', '.join(resp.get('replicas', []))})")
+        print("  status:  det serve status " + resp["id"])
+        print(f"  scale:   det serve scale {resp['id']} <target>")
+        print(f"  route:   POST /serve/{resp['id']}/v1/generate")
+        return 0
     resp = session.post("/api/v1/serving", body=body)
     print(f"Created serving task {resp['id']} "
           f"(allocation {resp.get('allocation_id')})")
@@ -1058,10 +1110,12 @@ def build_parser() -> argparse.ArgumentParser:
              "(docs/serving.md)")
     sv.add_argument(
         "target",
-        help="serving config file to launch, or 'status' / 'kill'")
+        help="serving config file to launch, or 'status' / 'scale' / "
+             "'kill'")
     sv.add_argument(
         "extra", nargs="*",
-        help="context dir (launch), or the serving task id (status/kill)")
+        help="context dir (launch), task/deployment id (status/kill), or "
+             "<deployment-id> <target> (scale)")
     sv.add_argument(
         "--local", action="store_true",
         help="run the replica in-process against local storage (no master)")

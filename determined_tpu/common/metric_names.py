@@ -54,6 +54,19 @@ MASTER_METRICS: Dict[str, Tuple[str, str]] = {
     "det_compile_links_total": (
         "counter", "Fingerprint-verified executable shares between "
                    "signatures"),
+    "det_deployment_replicas": (
+        "gauge", "Serving-deployment replicas by state "
+                 "(ready/starting/draining; docs/serving.md)"),
+    "det_deployment_target_replicas": (
+        "gauge", "Replica count the deployment controller is steering to"),
+    "det_deployment_scale_events_total": (
+        "counter", "Autoscaler/manual deployment scale decisions by "
+                   "direction"),
+    "det_serve_router_retries_total": (
+        "counter", "Requests retried onto another replica after a "
+                   "connection refusal"),
+    "det_serve_router_ejections_total": (
+        "counter", "Replica circuit-breaker ejections by the serve router"),
     "det_api_requests_total": ("counter", "API requests by status code"),
     "det_api_request_seconds": (
         "histogram", "API request latency by route family"),

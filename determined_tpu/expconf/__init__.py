@@ -386,7 +386,7 @@ def _validate_serving(block: Any, errors: List[str]) -> None:
     valid = {"checkpoint", "trial_id", "model", "model_config",
              "max_batch_size", "max_seq_len", "kv_block_size",
              "prefill_buckets", "queue_depth", "port", "seed",
-             "stats_log_period_s"}
+             "stats_log_period_s", "replicas", "heartbeat_period_s"}
     unknown = sorted(set(block) - valid)
     if unknown:
         errors.append(
@@ -425,6 +425,65 @@ def _validate_serving(block: Any, errors: List[str]) -> None:
                 "positive ints")
         elif sorted(buckets) != buckets:
             errors.append("serving.prefill_buckets must be ascending")
+    hb = block.get("heartbeat_period_s")
+    if hb is not None and (
+        isinstance(hb, bool) or not isinstance(hb, (int, float)) or hb <= 0
+    ):
+        errors.append("serving.heartbeat_period_s must be a positive number")
+    _validate_serving_replicas(block.get("replicas"), errors)
+
+
+def _validate_serving_replicas(block: Any, errors: List[str]) -> None:
+    """`serving.replicas:` — a deployment (docs/serving.md "Deployments &
+    autoscaling"): the master keeps `target` replicas within [min, max],
+    and the autoscaler moves target from sustained backpressure / idle
+    cooldown when min < max."""
+    if block is None:
+        return
+    if not isinstance(block, dict):
+        errors.append("serving.replicas must be a mapping")
+        return
+    valid = {"min", "max", "target", "scale_up_after_s",
+             "scale_down_after_s", "scale_up_threshold",
+             "scale_down_threshold"}
+    unknown = sorted(set(block) - valid)
+    if unknown:
+        errors.append(
+            f"serving.replicas: unknown keys {unknown}; "
+            f"valid: {sorted(valid)}")
+    counts = {}
+    for key in ("min", "max", "target"):
+        v = block.get(key)
+        if v is None:
+            continue
+        if isinstance(v, bool) or not isinstance(v, int) or v < 1:
+            errors.append(f"serving.replicas.{key} must be a positive int")
+        else:
+            counts[key] = v
+    lo = counts.get("min", 1)
+    hi = counts.get("max", max(lo, counts.get("target", lo)))
+    target = counts.get("target", lo)
+    if "min" in counts and "max" in counts and lo > hi:
+        errors.append("serving.replicas.min must be <= max")
+    elif not (lo <= target <= hi):
+        errors.append(
+            "serving.replicas.target must be within [min, max]")
+    for key in ("scale_up_after_s", "scale_down_after_s"):
+        v = block.get(key)
+        if v is not None and (
+            isinstance(v, bool) or not isinstance(v, (int, float)) or v < 0
+        ):
+            errors.append(
+                f"serving.replicas.{key} must be a non-negative number")
+    for key in ("scale_up_threshold", "scale_down_threshold"):
+        v = block.get(key)
+        if v is not None and (
+            isinstance(v, bool) or not isinstance(v, (int, float))
+            or not 0 < v <= 2
+        ):
+            errors.append(
+                f"serving.replicas.{key} must be in (0, 2] (queue "
+                "fraction + batch occupancy)")
 
 
 def _validate_prefetch(block: Any, errors: List[str]) -> None:
@@ -647,6 +706,11 @@ def apply_defaults(config: Dict[str, Any]) -> Dict[str, Any]:
         s.setdefault("max_seq_len", 256)
         s.setdefault("kv_block_size", 16)
         s.setdefault("queue_depth", 64)
+        if isinstance(s.get("replicas"), dict):
+            rep = s["replicas"]
+            rep.setdefault("min", 1)
+            rep.setdefault("target", rep["min"])
+            rep.setdefault("max", max(rep["min"], rep["target"]))
         # No searcher/validation machinery for a deployment config.
         return c
     searcher = c.setdefault("searcher", {})

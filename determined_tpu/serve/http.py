@@ -97,6 +97,7 @@ def _make_handler(batcher: ContinuousBatcher):
             if self.path == "/v1/stats":
                 stats = batcher.stats()
                 stats["engine"] = batcher.engine.stats()
+                stats["retry_after_hint_s"] = batcher.retry_after_hint()
                 self._send(200, stats)
                 return
             if self.path == "/metrics":
@@ -134,7 +135,12 @@ def _make_handler(batcher: ContinuousBatcher):
                 self._send(503, {"error": str(e)}, {"Retry-After": "5"})
                 return
             except QueueFull as e:
-                self._send(429, {"error": str(e)}, {"Retry-After": "1"})
+                # Computed backoff: queue depth × smoothed service time
+                # over the batch slots — a hint the harness Session (and
+                # the master router, which propagates the header) can act
+                # on instead of a bare 429.
+                hint = str(batcher.retry_after_hint())
+                self._send(429, {"error": str(e)}, {"Retry-After": hint})
                 return
             except ValueError as e:
                 self._send(400, {"error": str(e)})

@@ -230,6 +230,10 @@ class ContinuousBatcher:
         self.completed = 0
         self.generated_tokens = 0
         self.failed = 0
+        # EWMA of admit→finish seconds, updated at retire: the basis of
+        # the computed Retry-After hint (429s carry an actionable backoff
+        # instead of a bare "1"; the master router propagates it).
+        self._service_s_ewma = 0.0
 
     # -- lifecycle -----------------------------------------------------
 
@@ -400,8 +404,28 @@ class ContinuousBatcher:
         with self._lock:
             self.events.append(("retire", req.id, self.steps))
             self.completed += 1
+            if req.admitted_at is not None:
+                service_s = max(0.0, req.finished_at - req.admitted_at)
+                alpha = 0.2
+                self._service_s_ewma = (
+                    service_s if self._service_s_ewma == 0.0
+                    else alpha * service_s
+                    + (1 - alpha) * self._service_s_ewma)
 
     # -- stats ---------------------------------------------------------
+
+    def retry_after_hint(self) -> int:
+        """Seconds a 429'd client should wait before retrying: the time
+        until a queue slot plausibly frees, from the queue depth and the
+        smoothed per-request service time spread over the batch slots.
+        Clamped to [1, 60] so a cold or idle replica still answers 1."""
+        with self._lock:
+            service = self._service_s_ewma
+        depth = self.queue.depth()
+        if service <= 0.0 or depth <= 0:
+            return 1
+        est = depth * service / max(1, self.engine.slots)
+        return max(1, min(60, int(est + 0.999)))
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -424,3 +448,19 @@ class ContinuousBatcher:
                 "dropped": self.queue.dropped,
                 "kv_blocks": self.blocks.stats(),
             }
+
+    def heartbeat_stats(self) -> Dict[str, Any]:
+        """The load-report subset pushed to the master on the replica
+        heartbeat (POST /allocations/{id}/serve_stats): the router's
+        least-loaded signal and the deployment autoscaler's input."""
+        kv = self.blocks.stats()
+        return {
+            "queue_depth": self.queue.depth(),
+            "queue_capacity": self.queue.maxsize,
+            "active": self.active_count(),
+            "slots": self.engine.slots,
+            "kv_blocks_free": kv.get("free_blocks", 0),
+            "kv_blocks_total": kv.get("num_blocks", 0),
+            "draining": self.queue.draining,
+            "retry_after_hint_s": self.retry_after_hint(),
+        }

@@ -34,6 +34,57 @@ from typing import Any, Dict, Optional
 logger = logging.getLogger("determined_tpu.serve")
 
 DRAIN_SAFETY_MARGIN_S = 2.0
+HEARTBEAT_PERIOD_S = 2.0
+
+
+class ReplicaHeartbeat:
+    """Pushes the replica's load report (queue depth, occupancy, KV
+    blocks, drain state) to the master on a fixed period — the router's
+    least-loaded signal and the deployment autoscaler's input
+    (docs/serving.md "Deployments & autoscaling"). Loss-tolerant: a
+    failed POST is logged and the next beat retries; the master treats
+    stale reports as "no signal", never as "dead"."""
+
+    def __init__(self, session, allocation_id: str, batcher,
+                 period_s: float = HEARTBEAT_PERIOD_S):
+        self._session = session
+        self._allocation_id = allocation_id
+        self._batcher = batcher
+        self._period = max(0.2, float(period_s))
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def beat(self) -> None:
+        """One synchronous report. Called by the loop, and directly at
+        drain start (the drain handshake: the master must see
+        draining=true before the grace window burns down, so the router
+        ejects the replica immediately rather than at the next period)."""
+        if self._session is None or not self._allocation_id:
+            return
+        try:
+            self._session.post(
+                f"/api/v1/allocations/{self._allocation_id}/serve_stats",
+                body=self._batcher.heartbeat_stats())
+        except Exception:
+            logger.debug("serve_stats heartbeat failed", exc_info=True)
+
+    def _run(self) -> None:
+        while not self._stop_evt.wait(self._period):
+            self.beat()
+
+    def start(self) -> "ReplicaHeartbeat":
+        if self._session is None or not self._allocation_id:
+            return self  # local/masterless mode: nothing to report to
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="serve-heartbeat")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
 
 
 def build_model(serving: Dict[str, Any]):
@@ -166,6 +217,12 @@ def main(argv=None) -> int:
         except Exception:
             logger.warning("ready report failed", exc_info=True)
 
+    heartbeat = ReplicaHeartbeat(
+        session, allocation_id or "", batcher,
+        period_s=float(serving.get("heartbeat_period_s",
+                                   HEARTBEAT_PERIOD_S)))
+    heartbeat.start()
+
     # -- drain plumbing -------------------------------------------------
     from determined_tpu.core._preempt import PreemptContext
 
@@ -199,6 +256,12 @@ def main(argv=None) -> int:
         budget = (max(1.0, deadline - DRAIN_SAFETY_MARGIN_S)
                   if deadline is not None else 60.0)
         t0 = time.monotonic()
+        batcher.queue.drain()
+        # Drain handshake: report draining=true NOW so the deployment
+        # router stops dispatching here immediately instead of waiting
+        # out the heartbeat period (requests it already forwarded still
+        # finish — that's the zero-dropped contract below).
+        heartbeat.beat()
         finished = batcher.drain(timeout=budget)
         logger.info(
             "drain %s in %.2fs (budget %.1fs): %s",
@@ -208,6 +271,7 @@ def main(argv=None) -> int:
         # to die; rescheduling beats burning the rest of the grace.
         return 0
     finally:
+        heartbeat.stop()
         server.stop()
         batcher.stop()
         preempt.close()

@@ -548,6 +548,36 @@ CREATE TABLE compile_artifacts (
 ) WITHOUT ROWID;
 CREATE INDEX idx_compile_artifacts_hash ON compile_artifacts(blob_hash);
 )sql"},
+      // Serving deployments (docs/serving.md "Deployments & autoscaling"):
+      // a deployment owns N SERVING replica tasks that the reconciler
+      // keeps at target_replicas; deployment_replicas maps deployment →
+      // replica task id and records the per-replica lifecycle (STARTING →
+      // ACTIVE → RETIRING → RETIRED/DEAD) so scale-down drains and
+      // crash-respawns survive a master restart.
+      {24, R"sql(
+CREATE TABLE deployments (
+  id TEXT PRIMARY KEY,
+  name TEXT NOT NULL DEFAULT '',
+  config TEXT NOT NULL,
+  state TEXT NOT NULL DEFAULT 'ACTIVE',
+  min_replicas INTEGER NOT NULL DEFAULT 1,
+  max_replicas INTEGER NOT NULL DEFAULT 1,
+  target_replicas INTEGER NOT NULL DEFAULT 1,
+  owner_id INTEGER,
+  workspace_id INTEGER NOT NULL DEFAULT 1,
+  created_at TEXT NOT NULL DEFAULT (datetime('now')),
+  end_time TEXT
+);
+CREATE TABLE deployment_replicas (
+  deployment_id TEXT NOT NULL,
+  task_id TEXT NOT NULL,
+  state TEXT NOT NULL DEFAULT 'STARTING',
+  created_at TEXT NOT NULL DEFAULT (datetime('now')),
+  retired_at TEXT,
+  PRIMARY KEY (deployment_id, task_id)
+) WITHOUT ROWID;
+CREATE INDEX idx_deployment_replicas_task ON deployment_replicas(task_id);
+)sql"},
   };
   return kMigrations;
 }

@@ -64,6 +64,8 @@ int main(int argc, char** argv) {
     else if (a == "--webui-dir") cfg.webui_dir = next();
     else if (a == "--log-retention-days")
       cfg.log_retention_days = atoi(next().c_str());
+    else if (a == "--compile-ttl-days")
+      cfg.compile_cache_ttl_days = atoi(next().c_str());
     else if (a == "--tls-cert") cfg.tls_cert_file = next();
     else if (a == "--tls-key") cfg.tls_key_file = next();
     else if (a == "--config") next();

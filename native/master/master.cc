@@ -141,6 +141,12 @@ MasterConfig MasterConfig::from_json(const Json& j) {
   if (j["log_retention_days"].is_number()) {
     c.log_retention_days = static_cast<int>(j["log_retention_days"].as_int());
   }
+  // Compile-farm artifact retention (docs/compile-farm.md): age-based
+  // eviction of compile_artifacts rows, wired into the blob sweep.
+  if (j["compile_cache"]["ttl_days"].is_number()) {
+    c.compile_cache_ttl_days =
+        static_cast<int>(j["compile_cache"]["ttl_days"].as_int());
+  }
   for (const auto& [pool, policy] : j["resource_pools"].as_object()) {
     c.pool_policies[pool] = policy["scheduler"].as_string("priority");
   }
@@ -327,6 +333,11 @@ Master::Master(MasterConfig cfg) : cfg_(std::move(cfg)), db_(cfg_.db_path) {
     }
   }
   restore_experiments();
+  // Deployments restore after experiments/allocations: replica tasks whose
+  // allocations were re-adopted above reconnect to their ReplicaHealth
+  // rows; anything that died with the old master is pruned (and respawned
+  // to target) by the first reconcile tick.
+  restore_deployments_locked();
 }
 
 Master::~Master() { stop(); }
@@ -516,6 +527,21 @@ HttpResponse Master::route(const HttpRequest& req) {
       // Non-GET probes (HEAD from load balancers) keep the health answer.
       return HttpResponse::json(200, "{\"status\":\"ok\"}");
     }
+    // /serve/{deployment}/... — the deployment request router
+    // (master_deployments.cc, docs/serving.md "Deployments &
+    // autoscaling"): least-loaded dispatch over READY replicas with
+    // health ejection and retry-once on connection refusal.
+    if (parts.size() >= 2 && parts[0] == "serve") {
+      if (auth_user(req) < 0) {
+        return json_resp(401, err_body("unauthenticated"));
+      }
+      try {
+        return handle_serve_router(req, parts);
+      } catch (const std::exception& e) {
+        return json_resp(502,
+                         err_body(std::string("serve router: ") + e.what()));
+      }
+    }
     // /proxy/{task_id}/... — HTTP proxy to NTSC task servers (reference
     // internal/proxy/proxy.go + tcp.go; HTTP-only here — notebooks and
     // tensorboards serve HTTP).
@@ -579,6 +605,9 @@ HttpResponse Master::route(const HttpRequest& req) {
       Json out = Json::object();
       {
         std::lock_guard<std::mutex> lock(mu_);
+        // TTL-expired compile artifacts release their blob holds first so
+        // this same sweep reclaims them (docs/compile-farm.md retention).
+        out["compile_artifacts_evicted"] = sweep_compile_artifacts_locked();
         out["released"] = sweep_context_blobs_locked();
       }
       return json_resp(200, out);
@@ -610,6 +639,7 @@ HttpResponse Master::route(const HttpRequest& req) {
         root == "serving") {
       return handle_ntsc(req, root, rest);
     }
+    if (root == "deployments") return handle_deployments(req, rest);
     if (root == "runs") return handle_runs(req, rest);
     if (root == "workspaces") return handle_workspaces(req, rest);
     if (root == "projects") return handle_projects(req, rest);
@@ -918,6 +948,7 @@ std::string Master::route_family(const std::string& path) {
   if (path.rfind("/api/v1/", 0) != 0) {
     if (path == "/metrics") return "metrics";
     if (path.rfind("/proxy", 0) == 0) return "proxy";
+    if (path.rfind("/serve", 0) == 0) return "serve";
     if (path.rfind("/ui", 0) == 0 || path == "/") return "ui";
     return "other";
   }
@@ -1039,6 +1070,46 @@ HttpResponse Master::handle_prometheus_metrics() {
       out << "det_compile_jobs{state=\"" << r["state"].as_string("")
           << "\"} " << r["n"].as_int(0) << "\n";
     }
+    // Serving deployments (docs/serving.md "Deployments & autoscaling"):
+    // per-deployment replica-state gauges — ready (routable), starting
+    // (placed but not yet registered), draining (scale-down or preempt in
+    // progress) — plus the autoscaler's target, so a scrape shows both
+    // where the fleet IS and where the controller is steering it.
+    if (!deployments_.empty()) {
+      double t_now = now();
+      out << "# TYPE det_deployment_replicas gauge\n";
+      std::ostringstream targets;
+      for (const auto& [dep_id, dep] : deployments_) {
+        int ready = 0, starting = 0, draining = 0;
+        for (const auto& [tid, r] : dep.replicas) {
+          bool routable = false, preempting = false;
+          for (const auto& [aid, a] : allocations_) {
+            if (a.task_id != tid || a.state == "TERMINATED") continue;
+            preempting |= a.preempting;
+            routable |= a.state == "RUNNING" && !a.preempting &&
+                        !a.proxy_addresses.empty() &&
+                        r.breaker_open_until <= t_now;
+          }
+          if (r.retiring || r.draining || preempting) {
+            ++draining;
+          } else if (routable) {
+            ++ready;
+          } else {
+            ++starting;
+          }
+        }
+        out << "det_deployment_replicas{deployment=\"" << dep_id
+            << "\",state=\"ready\"} " << ready << "\n"
+            << "det_deployment_replicas{deployment=\"" << dep_id
+            << "\",state=\"starting\"} " << starting << "\n"
+            << "det_deployment_replicas{deployment=\"" << dep_id
+            << "\",state=\"draining\"} " << draining << "\n";
+        targets << "det_deployment_target_replicas{deployment=\"" << dep_id
+                << "\"} " << dep.target << "\n";
+      }
+      out << "# TYPE det_deployment_target_replicas gauge\n"
+          << targets.str();
+    }
   }
   out << "# TYPE det_preemptions_total counter\n"
       << "det_preemptions_total " << fleet_.preemptions.load() << "\n"
@@ -1058,7 +1129,18 @@ HttpResponse Master::handle_prometheus_metrics() {
       << "det_compile_artifact_fetches_total "
       << fleet_.compile_fetches.load() << "\n"
       << "# TYPE det_compile_links_total counter\n"
-      << "det_compile_links_total " << fleet_.compile_links.load() << "\n";
+      << "det_compile_links_total " << fleet_.compile_links.load() << "\n"
+      << "# TYPE det_deployment_scale_events_total counter\n"
+      << "det_deployment_scale_events_total{direction=\"up\"} "
+      << fleet_.deploy_scale_ups.load() << "\n"
+      << "det_deployment_scale_events_total{direction=\"down\"} "
+      << fleet_.deploy_scale_downs.load() << "\n"
+      << "# TYPE det_serve_router_retries_total counter\n"
+      << "det_serve_router_retries_total " << fleet_.router_retries.load()
+      << "\n"
+      << "# TYPE det_serve_router_ejections_total counter\n"
+      << "det_serve_router_ejections_total "
+      << fleet_.router_ejections.load() << "\n";
   {
     std::lock_guard<std::mutex> lock(api_stats_.mu);
     out << "# TYPE det_api_requests_total counter\n";

@@ -346,6 +346,12 @@ void Master::scheduler_loop() {
     // on an empty queue, and an empty queue is exactly when idle
     // capacity can be handed to under-sized elastic trials).
     maybe_grow_elastic_locked();
+    // Serving deployments (docs/serving.md "Deployments & autoscaling"):
+    // the autoscaler moves target from the smoothed replica signal, then
+    // the reconciler converges replica count onto it (spawn deficits land
+    // in pending_ for the placement pass of the NEXT tick).
+    autoscale_deployments_locked();
+    reconcile_deployments_locked();
     // Compile farm (docs/compile-farm.md): AFTER placements and grow-back
     // — only capacity nothing else wanted this tick compiles.
     dispatch_compile_jobs_locked();
@@ -354,6 +360,11 @@ void Master::scheduler_loop() {
     // or API handlers (the db has its own lock).
     if (now() - last_log_sweep > 3600) {
       last_log_sweep = now();
+      // Compile-artifact retention (compile_cache.ttl_days, docs/
+      // compile-farm.md): evict expired artifact rows FIRST so the blob
+      // sweep right after can drop their now-unreferenced blobs in the
+      // same pass.
+      sweep_compile_artifacts_locked();
       // Context blobs of ended tasks: the terminal transitions release
       // inline; this catches any path that missed (tasks orphaned by a
       // master restart). Runs BEFORE unlock — under mu_ it cannot

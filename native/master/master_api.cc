@@ -1084,6 +1084,15 @@ HttpResponse Master::handle_allocations(const HttpRequest& req,
     return json_resp(200, Json::object());
   }
 
+  // POST /api/v1/allocations/{id}/serve_stats — serving-replica heartbeat
+  // (queue depth, occupancy, drain state): the router's least-loaded
+  // signal and the deployment autoscaler's input
+  // (docs/serving.md "Deployments & autoscaling").
+  if (parts.size() == 3 && parts[2] == "serve_stats" &&
+      req.method == "POST") {
+    return handle_serve_stats(req, aid);
+  }
+
   // GET /api/v1/allocations/{id} — introspection.
   if (parts.size() == 2 && req.method == "GET") {
     std::lock_guard<std::mutex> lock(mu_);

@@ -1,0 +1,812 @@
+// master_deployments.cc — serving deployments: replica-set controller,
+// master-side request router, and signal-driven autoscaler
+// (docs/serving.md "Deployments & autoscaling").
+//
+// A serving config with `serving.replicas: {min, max, target}` becomes a
+// Deployment: N SERVING replica tasks that the scheduler-tick reconciler
+// keeps at target (respawn on death reuses the PR-6 requeue machinery;
+// scale-down always drains — zero dropped accepted requests). On top sits
+// the /serve/{deployment}/... router: least-loaded dispatch over READY
+// replicas using each replica's heartbeated queue depth + occupancy, a
+// per-replica circuit breaker (consecutive connection failures eject,
+// half-open re-probe re-admits), retry-once-on-another-replica for
+// connection refusals (never for an in-flight generation), and
+// 429/Retry-After when every replica reports a full admission queue.
+// The autoscaler tick moves target within [min, max] from the smoothed
+// signal: sustained backpressure scales up, an idle cooldown scales down.
+//
+// Reference posture: vLLM/Orca assume a fleet tier above the per-replica
+// engine; the reference platform has no serving tier at all — this is the
+// TPU-native master growing one as a first-class subsystem.
+
+#include <algorithm>
+#include <iostream>
+
+#include "master.h"
+
+namespace det {
+
+namespace {
+
+Json err_body(const std::string& msg) {
+  Json j = Json::object();
+  j["error"] = msg;
+  return j;
+}
+
+HttpResponse json_resp(int status, const Json& j) {
+  return HttpResponse::json(status, j.dump());
+}
+
+// Replica load reports older than this are treated as "no signal": the
+// replica stays routable (scored by router-local inflight only) but its
+// stale queue numbers never gate admission or drive the autoscaler.
+constexpr double kReportStaleS = 15.0;
+// Circuit breaker: this many consecutive connection failures open the
+// circuit; the hold doubles per re-open up to the cap, then one half-open
+// probe decides re-admit vs re-open.
+constexpr int kBreakerThreshold = 3;
+constexpr double kBreakerHoldS = 5.0;
+constexpr double kBreakerHoldMaxS = 30.0;
+
+bool is_connect_failure(const std::string& what) {
+  // common/http.cc throws distinct messages for failures BEFORE any
+  // request bytes reached the replica ("connect failed: ...",
+  // "resolve failed: ..."). Only these are safe to retry on another
+  // replica — anything later may have an in-flight generation attached.
+  return what.find("connect failed") != std::string::npos ||
+         what.find("resolve failed") != std::string::npos;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// Replica lifecycle.
+// ---------------------------------------------------------------------------
+
+DeploymentState* Master::deployment_for_task_locked(
+    const std::string& task_id) {
+  for (auto& [id, dep] : deployments_) {
+    if (dep.replicas.count(task_id)) return &dep;
+  }
+  return nullptr;
+}
+
+std::string Master::spawn_deployment_replica_locked(DeploymentState& dep) {
+  // Mirrors the POST /api/v1/serving create path (master_ntsc.cc): one
+  // SERVING task + one allocation; the replica rebuilds its engine purely
+  // from DET_SERVING_CONFIG and registers a proxy address when ready.
+  std::string task_id = "serving-" + random_hex(6);
+  for (auto& c : task_id) c = static_cast<char>(tolower(c));
+  const Json& config = dep.config;
+  db_.exec(
+      "INSERT INTO tasks (id, type, state, config, owner_id, workspace_id) "
+      "VALUES (?, 'SERVING', 'ACTIVE', ?, ?, ?)",
+      {Json(task_id), Json(config.dump()), Json(dep.owner_id),
+       Json(dep.workspace_id)});
+  db_.exec(
+      "INSERT OR REPLACE INTO deployment_replicas "
+      "(deployment_id, task_id, state) VALUES (?, ?, 'STARTING')",
+      {Json(dep.id), Json(task_id)});
+
+  Allocation alloc;
+  alloc.id = "alloc-" + task_id;
+  alloc.task_id = task_id;
+  alloc.resource_pool =
+      config["resources"]["resource_pool"].as_string(cfg_.default_pool);
+  alloc.slots = static_cast<int>(config["resources"]["slots"].as_int(
+      config["resources"]["slots_per_trial"].as_int(0)));
+  alloc.priority =
+      static_cast<int>(config["resources"]["priority"].as_int(42));
+  alloc.submitted_at = now();
+  alloc.last_activity = now();
+  alloc.owner_id = dep.owner_id;
+  std::string entrypoint = "python3 -m determined_tpu.serve.task";
+  if (config["entrypoint"].is_string()) {
+    entrypoint = config["entrypoint"].as_string();
+  } else if (config["entrypoint"].is_array()) {
+    entrypoint = config["entrypoint"].dump();
+  }
+  alloc.extra_env["DET_ENTRYPOINT"] = Json(entrypoint);
+  alloc.extra_env["DET_TASK_TYPE"] = Json(std::string("SERVING"));
+  alloc.extra_env["DET_SERVING_CONFIG"] = Json(config.dump());
+  alloc.extra_env["DET_DEPLOYMENT_ID"] = Json(dep.id);
+  for (const auto& [k, v] : config["environment"].as_object()) {
+    if (v.is_string()) alloc.extra_env[k] = v;
+  }
+  db_.exec(
+      "INSERT INTO allocations (id, task_id, resource_pool, slots) "
+      "VALUES (?, ?, ?, ?)",
+      {Json(alloc.id), Json(task_id), Json(alloc.resource_pool),
+       Json(static_cast<int64_t>(alloc.slots))});
+  std::string aid = alloc.id;
+  allocations_[aid] = std::move(alloc);
+  pending_.push_back(aid);
+
+  ReplicaHealth r;
+  r.task_id = task_id;
+  dep.replicas[task_id] = std::move(r);
+  dep.last_spawn = now();
+  cv_.notify_all();
+  return task_id;
+}
+
+void Master::retire_deployment_replica_locked(DeploymentState& dep,
+                                              const std::string& task_id) {
+  auto rit = dep.replicas.find(task_id);
+  if (rit == dep.replicas.end() || rit->second.retiring) return;
+  rit->second.retiring = true;
+  db_.exec(
+      "UPDATE deployment_replicas SET state='RETIRING' WHERE "
+      "deployment_id=? AND task_id=?",
+      {Json(dep.id), Json(task_id)});
+  for (auto& [aid, a] : allocations_) {
+    if (a.task_id != task_id || a.state == "TERMINATED") continue;
+    if (a.state == "PENDING") {
+      // Nothing running to drain: release the queue slot and finish the
+      // task directly.
+      kill_task_tree_locked(task_id);
+    } else if (!a.preempting) {
+      // Cooperative drain (no deadline): the replica stops admitting,
+      // finishes every accepted request, and exits 0 — the zero-dropped
+      // contract of the drain lifecycle. requeue_serving_task_locked
+      // skips retiring replicas so the exit is terminal.
+      preempt_allocation_locked(a, "deployment scale-down", 0);
+    }
+  }
+}
+
+void Master::set_deployment_target_locked(DeploymentState& dep, int target,
+                                          const std::string& reason) {
+  target = std::max(dep.min_replicas, std::min(dep.max_replicas, target));
+  if (target == dep.target) return;
+  const bool up = target > dep.target;
+  if (up) {
+    dep.scale_ups++;
+    fleet_.deploy_scale_ups.fetch_add(1);
+  } else {
+    dep.scale_downs++;
+    fleet_.deploy_scale_downs.fetch_add(1);
+  }
+  std::cerr << "master: deployment " << dep.id << " scale "
+            << (up ? "up" : "down") << " " << dep.target << " -> " << target
+            << " (" << reason << ")" << std::endl;
+  dep.target = target;
+  dep.last_scale = now();
+  dep.pressure_since = 0;
+  dep.idle_since = 0;
+  db_.exec("UPDATE deployments SET target_replicas=? WHERE id=?",
+           {Json(static_cast<int64_t>(target)), Json(dep.id)});
+  publish_locked("deployments",
+                 Json(JsonObject{{"id", Json(dep.id)},
+                                 {"target", Json(static_cast<int64_t>(target))},
+                                 {"direction", Json(std::string(
+                                     up ? "up" : "down"))},
+                                 {"reason", Json(reason)}}));
+}
+
+void Master::reconcile_deployments_locked() {
+  double t = now();
+  for (auto& [id, dep] : deployments_) {
+    // 1. Prune replicas whose task finished for good (killed, scale-down
+    // drain completed, or died past max_restarts — the PR-6 requeue
+    // machinery already respawned anything that could be respawned).
+    std::vector<std::string> gone;
+    for (auto& [tid, r] : dep.replicas) {
+      bool live = false;
+      for (const auto& [aid, a] : allocations_) {
+        if (a.task_id == tid && a.state != "TERMINATED") {
+          live = true;
+          break;
+        }
+      }
+      if (!live) gone.push_back(tid);
+    }
+    for (const auto& tid : gone) {
+      bool retiring = dep.replicas[tid].retiring;
+      db_.exec(
+          "UPDATE deployment_replicas SET state=?, "
+          "retired_at=datetime('now') WHERE deployment_id=? AND task_id=?",
+          {Json(std::string(retiring ? "RETIRED" : "DEAD")), Json(dep.id),
+           Json(tid)});
+      dep.replicas.erase(tid);
+    }
+
+    // 2. Converge on target. Spawns are throttled to one batch per
+    // second so a crash-looping config cannot flood the task table.
+    int live = 0;
+    for (const auto& [tid, r] : dep.replicas) {
+      if (!r.retiring) ++live;
+    }
+    if (live < dep.target) {
+      if (t - dep.last_spawn >= 1.0 || dep.last_spawn == 0) {
+        for (int i = live; i < dep.target; ++i) {
+          spawn_deployment_replica_locked(dep);
+        }
+      }
+    } else if (live > dep.target) {
+      // Drain the lowest-loaded replicas first (cheapest zero-dropped
+      // finish); ties break on newest task id so the oldest replicas —
+      // warmest caches — survive.
+      std::vector<std::pair<int64_t, std::string>> order;
+      for (const auto& [tid, r] : dep.replicas) {
+        if (r.retiring) continue;
+        order.emplace_back(r.queue_depth + r.active + r.inflight, tid);
+      }
+      std::sort(order.begin(), order.end(),
+                [](const auto& a, const auto& b) {
+                  if (a.first != b.first) return a.first < b.first;
+                  return a.second > b.second;
+                });
+      for (int i = 0; i < live - dep.target &&
+                      i < static_cast<int>(order.size()); ++i) {
+        retire_deployment_replica_locked(dep, order[i].second);
+      }
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Autoscaler.
+// ---------------------------------------------------------------------------
+
+void Master::autoscale_deployments_locked() {
+  double t = now();
+  for (auto& [id, dep] : deployments_) {
+    const Json& rep = dep.config["serving"]["replicas"];
+    if (!rep.is_object() || dep.min_replicas >= dep.max_replicas) continue;
+    const double up_after = rep["scale_up_after_s"].as_double(5.0);
+    const double down_after = rep["scale_down_after_s"].as_double(60.0);
+    const double up_thresh = rep["scale_up_threshold"].as_double(0.9);
+    const double down_thresh = rep["scale_down_threshold"].as_double(0.1);
+
+    // Aggregate fresh heartbeats from non-retiring replicas: queue
+    // fraction + batch occupancy per replica, mean across the set —
+    // the ROADMAP-2 signal (queue depth + occupancy from /v1/stats).
+    int fresh = 0;
+    double load = 0;
+    bool any = false;
+    for (const auto& [tid, r] : dep.replicas) {
+      if (r.retiring) continue;
+      any = true;
+      if (r.last_report == 0 || t - r.last_report > kReportStaleS) continue;
+      ++fresh;
+      double qf = r.queue_capacity > 0
+                      ? static_cast<double>(r.queue_depth) / r.queue_capacity
+                      : 0.0;
+      double occ = r.slots > 0
+                       ? static_cast<double>(r.active) / r.slots
+                       : 0.0;
+      load += qf + occ;
+    }
+    if (!any || fresh == 0) {
+      // No replicas (all mid-respawn) or no signal: hold, and never let a
+      // stale sustain clock fire the moment signal returns.
+      dep.pressure_since = 0;
+      dep.idle_since = 0;
+      continue;
+    }
+    double inst = load / fresh;
+    double dt = dep.ewma_updated > 0 ? std::min(t - dep.ewma_updated, 3.0)
+                                     : 0.2;
+    dep.ewma_updated = t;
+    double alpha = std::min(1.0, dt / 3.0);  // ~3s smoothing window
+    dep.load_ewma += alpha * (inst - dep.load_ewma);
+
+    if (dep.load_ewma >= up_thresh && dep.target < dep.max_replicas) {
+      dep.idle_since = 0;
+      if (dep.pressure_since == 0) dep.pressure_since = t;
+      if (t - dep.pressure_since >= up_after &&
+          t - dep.last_scale >= up_after) {
+        set_deployment_target_locked(
+            dep, dep.target + 1,
+            "sustained backpressure (smoothed load " +
+                std::to_string(dep.load_ewma) + ")");
+      }
+    } else if (dep.load_ewma <= down_thresh &&
+               dep.target > dep.min_replicas) {
+      dep.pressure_since = 0;
+      if (dep.idle_since == 0) dep.idle_since = t;
+      if (t - dep.idle_since >= down_after &&
+          t - dep.last_scale >= down_after) {
+        set_deployment_target_locked(
+            dep, dep.target - 1,
+            "idle cooldown (smoothed load " +
+                std::to_string(dep.load_ewma) + ")");
+      }
+    } else {
+      dep.pressure_since = 0;
+      dep.idle_since = 0;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Boot restore.
+// ---------------------------------------------------------------------------
+
+void Master::restore_deployments_locked() {
+  for (auto& row : db_.query(
+           "SELECT id, name, config, min_replicas, max_replicas, "
+           "target_replicas, owner_id, workspace_id FROM deployments "
+           "WHERE end_time IS NULL")) {
+    DeploymentState dep;
+    dep.id = row["id"].as_string();
+    dep.name = row["name"].as_string();
+    dep.config = Json::parse_or_null(row["config"].as_string());
+    dep.min_replicas = static_cast<int>(row["min_replicas"].as_int(1));
+    dep.max_replicas = static_cast<int>(row["max_replicas"].as_int(1));
+    dep.target = static_cast<int>(row["target_replicas"].as_int(1));
+    dep.owner_id = row["owner_id"].as_int(1);
+    dep.workspace_id = row["workspace_id"].as_int(1);
+    for (auto& rrow : db_.query(
+             "SELECT task_id, state FROM deployment_replicas WHERE "
+             "deployment_id=? AND state IN ('STARTING','ACTIVE','RETIRING')",
+             {Json(dep.id)})) {
+      ReplicaHealth r;
+      r.task_id = rrow["task_id"].as_string();
+      r.retiring = rrow["state"].as_string() == "RETIRING";
+      dep.replicas[r.task_id] = std::move(r);
+    }
+    // Load/breaker state is soft: heartbeats repopulate it within one
+    // period, and the first reconcile tick prunes replicas whose tasks
+    // ended while the master was down.
+    deployments_[dep.id] = std::move(dep);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// API: /api/v1/deployments.
+// ---------------------------------------------------------------------------
+
+HttpResponse Master::handle_deployments(
+    const HttpRequest& req, const std::vector<std::string>& parts) {
+  // POST /api/v1/deployments {config} — create from a serving config
+  // carrying serving.replicas (validated by expconf client-side; the
+  // bounds are re-checked here because the master is the authority).
+  if (parts.size() == 1 && req.method == "POST") {
+    Json body = Json::parse_or_null(req.body);
+    const Json& config = body["config"];
+    AuthCtx ctx = auth_ctx(req);
+    if (!ctx.ok()) return json_resp(401, err_body("unauthenticated"));
+    int64_t ws = body["workspace_id"].as_int(1);
+    if (!can_create(ctx, ws)) {
+      return json_resp(403, err_body("viewer role cannot launch tasks"));
+    }
+    if (!config["serving"].is_object()) {
+      return json_resp(400, err_body("config.serving block required"));
+    }
+    const Json& rep = config["serving"]["replicas"];
+    int minr = 1, maxr = 1, target = 1;
+    if (rep.is_object()) {
+      minr = static_cast<int>(rep["min"].as_int(1));
+      target = static_cast<int>(rep["target"].as_int(minr));
+      maxr = static_cast<int>(rep["max"].as_int(std::max(minr, target)));
+    }
+    if (minr < 1 || maxr < minr || target < minr || target > maxr) {
+      return json_resp(400, err_body(
+          "serving.replicas requires 1 <= min <= target <= max"));
+    }
+    std::lock_guard<std::mutex> lock(mu_);
+    DeploymentState dep;
+    dep.id = "deploy-" + random_hex(4);
+    for (auto& c : dep.id) c = static_cast<char>(tolower(c));
+    dep.name = config["name"].as_string(dep.id);
+    dep.config = config;
+    dep.min_replicas = minr;
+    dep.max_replicas = maxr;
+    dep.target = target;
+    dep.owner_id = ctx.uid;
+    dep.workspace_id = ws;
+    db_.exec(
+        "INSERT INTO deployments (id, name, config, min_replicas, "
+        "max_replicas, target_replicas, owner_id, workspace_id) "
+        "VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
+        {Json(dep.id), Json(dep.name), Json(config.dump()),
+         Json(static_cast<int64_t>(minr)), Json(static_cast<int64_t>(maxr)),
+         Json(static_cast<int64_t>(target)), Json(ctx.uid), Json(ws)});
+    auto [it, _] = deployments_.emplace(dep.id, std::move(dep));
+    Json replicas = Json::array();
+    for (int i = 0; i < it->second.target; ++i) {
+      replicas.push_back(Json(spawn_deployment_replica_locked(it->second)));
+    }
+    Json out = Json::object();
+    out["id"] = it->second.id;
+    out["name"] = it->second.name;
+    out["target"] = static_cast<int64_t>(it->second.target);
+    out["replicas"] = replicas;
+    return json_resp(200, out);
+  }
+
+  // GET /api/v1/deployments — list.
+  if (parts.size() == 1 && req.method == "GET") {
+    auto rows = db_.query(
+        "SELECT id, name, state, min_replicas, max_replicas, "
+        "target_replicas, created_at, end_time FROM deployments "
+        "ORDER BY created_at DESC");
+    Json deps = Json::array();
+    std::lock_guard<std::mutex> lock(mu_);
+    for (auto& row : rows) {
+      Json d = Json(JsonObject(row.begin(), row.end()));
+      auto it = deployments_.find(row["id"].as_string());
+      if (it != deployments_.end()) {
+        d["target_replicas"] = static_cast<int64_t>(it->second.target);
+        int ready = 0;
+        for (const auto& [tid, r] : it->second.replicas) (void)tid, ++ready;
+        d["replica_count"] = static_cast<int64_t>(ready);
+        d["smoothed_load"] = it->second.load_ewma;
+      }
+      deps.push_back(std::move(d));
+    }
+    Json out = Json::object();
+    out["deployments"] = deps;
+    return json_resp(200, out);
+  }
+
+  if (parts.size() < 2) return json_resp(404, err_body("no such deployment"));
+  const std::string& dep_id = parts[1];
+
+  // POST /api/v1/deployments/{id}/scale {target} — manual scale within
+  // [min, max]; resets the autoscaler sustain clocks.
+  if (parts.size() == 3 && parts[2] == "scale" && req.method == "POST") {
+    Json body = Json::parse_or_null(req.body);
+    if (!body["target"].is_number()) {
+      return json_resp(400, err_body("target required"));
+    }
+    int target = static_cast<int>(body["target"].as_int());
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = deployments_.find(dep_id);
+    if (it == deployments_.end()) {
+      return json_resp(404, err_body("no such deployment"));
+    }
+    DeploymentState& dep = it->second;
+    AuthCtx ctx = auth_ctx(req);
+    if (!can_edit(ctx, dep.owner_id, dep.workspace_id)) {
+      return json_resp(403, err_body("not authorized for this deployment"));
+    }
+    if (target < dep.min_replicas || target > dep.max_replicas) {
+      return json_resp(400, err_body(
+          "target must be within [" + std::to_string(dep.min_replicas) +
+          ", " + std::to_string(dep.max_replicas) + "]"));
+    }
+    set_deployment_target_locked(dep, target, "manual scale");
+    reconcile_deployments_locked();
+    Json out = Json::object();
+    out["id"] = dep.id;
+    out["target"] = static_cast<int64_t>(dep.target);
+    return json_resp(200, out);
+  }
+
+  // POST /api/v1/deployments/{id}/kill — delete: every replica is killed
+  // (no drain — kill is the operator's hard stop; `scale` to min first
+  // for a graceful teardown).
+  if (parts.size() == 3 && parts[2] == "kill" && req.method == "POST") {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = deployments_.find(dep_id);
+    if (it == deployments_.end()) {
+      return json_resp(404, err_body("no such deployment"));
+    }
+    AuthCtx ctx = auth_ctx(req);
+    if (!can_edit(ctx, it->second.owner_id, it->second.workspace_id)) {
+      return json_resp(403, err_body("not authorized for this deployment"));
+    }
+    for (const auto& [tid, r] : it->second.replicas) {
+      kill_task_tree_locked(tid);
+      db_.exec(
+          "UPDATE deployment_replicas SET state='RETIRED', "
+          "retired_at=datetime('now') WHERE deployment_id=? AND task_id=?",
+          {Json(dep_id), Json(tid)});
+    }
+    db_.exec(
+        "UPDATE deployments SET state='KILLED', end_time=datetime('now') "
+        "WHERE id=?",
+        {Json(dep_id)});
+    deployments_.erase(it);
+    return json_resp(200, Json::object());
+  }
+
+  // GET /api/v1/deployments/{id} — detail with per-replica health.
+  if (parts.size() == 2 && req.method == "GET") {
+    auto rows = db_.query("SELECT * FROM deployments WHERE id=?",
+                          {Json(dep_id)});
+    if (rows.empty()) return json_resp(404, err_body("no such deployment"));
+    Json d = Json(JsonObject(rows[0].begin(), rows[0].end()));
+    d["config"] = Json::parse_or_null(d["config"].as_string());
+    Json replicas = Json::array();
+    std::lock_guard<std::mutex> lock(mu_);
+    double t = now();
+    auto it = deployments_.find(dep_id);
+    if (it != deployments_.end()) {
+      DeploymentState& dep = it->second;
+      d["target_replicas"] = static_cast<int64_t>(dep.target);
+      d["smoothed_load"] = dep.load_ewma;
+      d["scale_ups"] = dep.scale_ups;
+      d["scale_downs"] = dep.scale_downs;
+      for (const auto& [tid, r] : dep.replicas) {
+        Json rj = Json::object();
+        rj["task_id"] = tid;
+        rj["retiring"] = r.retiring;
+        rj["queue_depth"] = r.queue_depth;
+        rj["queue_capacity"] = r.queue_capacity;
+        rj["active"] = r.active;
+        rj["slots"] = r.slots;
+        rj["draining"] = r.draining;
+        rj["inflight"] = r.inflight;
+        rj["consecutive_failures"] =
+            static_cast<int64_t>(r.consecutive_failures);
+        rj["breaker_open"] = r.breaker_open_until > t;
+        rj["report_age_s"] =
+            r.last_report > 0 ? t - r.last_report : -1.0;
+        for (const auto& [aid, a] : allocations_) {
+          if (a.task_id == tid && a.state != "TERMINATED") {
+            rj["allocation_state"] = a.state;
+            rj["preempting"] = a.preempting;
+            if (!a.proxy_addresses.empty()) {
+              rj["proxy_address"] = a.proxy_addresses.begin()->second;
+            }
+          }
+        }
+        replicas.push_back(std::move(rj));
+      }
+    }
+    d["replicas"] = replicas;
+    Json out = Json::object();
+    out["deployment"] = std::move(d);
+    return json_resp(200, out);
+  }
+
+  return json_resp(404, err_body("no such deployment"));
+}
+
+// ---------------------------------------------------------------------------
+// Replica heartbeat.
+// ---------------------------------------------------------------------------
+
+HttpResponse Master::handle_serve_stats(const HttpRequest& req,
+                                        const std::string& alloc_id) {
+  Json body = Json::parse_or_null(req.body);
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = allocations_.find(alloc_id);
+  if (it == allocations_.end()) {
+    return json_resp(404, err_body("unknown allocation"));
+  }
+  DeploymentState* dep = deployment_for_task_locked(it->second.task_id);
+  if (dep == nullptr) {
+    // Single-replica `det serve` task: the heartbeat is accepted (keeps
+    // the replica non-idle) but there is no router state to update.
+    it->second.last_activity = now();
+    return json_resp(200, Json::object());
+  }
+  ReplicaHealth& r = dep->replicas[it->second.task_id];
+  r.task_id = it->second.task_id;
+  r.last_report = now();
+  r.queue_depth = body["queue_depth"].as_int(0);
+  r.queue_capacity = std::max<int64_t>(1, body["queue_capacity"].as_int(1));
+  r.active = body["active"].as_int(0);
+  r.slots = std::max<int64_t>(1, body["slots"].as_int(1));
+  r.kv_blocks_free = body["kv_blocks_free"].as_int(0);
+  r.kv_blocks_total = body["kv_blocks_total"].as_int(0);
+  r.draining = body["draining"].as_bool(false);
+  r.retry_after_hint =
+      std::max<int64_t>(1, body["retry_after_hint_s"].as_int(1));
+  db_.exec(
+      "UPDATE deployment_replicas SET state='ACTIVE' WHERE deployment_id=? "
+      "AND task_id=? AND state='STARTING'",
+      {Json(dep->id), Json(r.task_id)});
+  it->second.last_activity = now();
+  return json_resp(200, Json::object());
+}
+
+// ---------------------------------------------------------------------------
+// Request router: /serve/{deployment}/...
+// ---------------------------------------------------------------------------
+
+HttpResponse Master::handle_serve_router(
+    const HttpRequest& req, const std::vector<std::string>& parts) {
+  // Resolve by id or name.
+  std::string dep_id = parts[1];
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    if (!deployments_.count(dep_id)) {
+      for (const auto& [id, dep] : deployments_) {
+        if (dep.name == dep_id) {
+          dep_id = id;
+          break;
+        }
+      }
+    }
+    if (!deployments_.count(dep_id)) {
+      return json_resp(404, err_body("no such deployment"));
+    }
+  }
+
+  std::string fwd_path;
+  for (size_t i = 2; i < parts.size(); ++i) {
+    fwd_path += "/" + url_encode(parts[i], /*keep_slash=*/false);
+  }
+  if (fwd_path.empty()) fwd_path = "/";
+  if (!req.query.empty()) {
+    std::string qs;
+    for (const auto& [k, v] : req.query) {
+      qs += (qs.empty() ? "?" : "&") + url_encode(k, false) + "=" +
+            url_encode(v, false);
+    }
+    fwd_path += qs;
+  }
+  std::map<std::string, std::string> fwd_headers;
+  auto ct_it = req.headers.find("content-type");
+  if (ct_it != req.headers.end()) fwd_headers["Content-Type"] = ct_it->second;
+
+  // At most two attempts: the retry is ONLY taken for a connection-level
+  // failure (nothing reached the replica, so nothing can be generating);
+  // a failure after bytes were sent may have an in-flight generation
+  // attached and must surface to the caller instead.
+  std::set<std::string> tried;
+  for (int attempt = 0; attempt < 2; ++attempt) {
+    std::string target_task, target_addr;
+    bool probe = false;
+    int64_t full_retry_after = 0;
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      auto dit = deployments_.find(dep_id);
+      if (dit == deployments_.end()) {
+        return json_resp(404, err_body("no such deployment"));
+      }
+      DeploymentState& dep = dit->second;
+      double t = now();
+      struct Cand {
+        std::string task_id;
+        std::string addr;
+        double score;
+        bool probe;
+        bool full;
+        int64_t retry_after;
+      };
+      std::vector<Cand> cands;
+      for (auto& [tid, r] : dep.replicas) {
+        if (tried.count(tid) || r.retiring || r.draining) continue;
+        // READY = running, not preempting, proxy address registered.
+        std::string addr;
+        for (const auto& [aid, a] : allocations_) {
+          if (a.task_id == tid && a.state == "RUNNING" && !a.preempting &&
+              !a.proxy_addresses.empty()) {
+            addr = a.proxy_addresses.begin()->second;
+            break;
+          }
+        }
+        if (addr.empty()) continue;
+        bool half_open = false;
+        if (r.breaker_open_until > t) {
+          continue;  // circuit open: ejected
+        }
+        if (r.breaker_open_until > 0) {
+          // Hold expired: admit ONE half-open probe at a time.
+          if (r.half_open_probe) continue;
+          half_open = true;
+        }
+        bool fresh = r.last_report > 0 && t - r.last_report <= kReportStaleS;
+        bool full = fresh && r.queue_depth + r.inflight >= r.queue_capacity;
+        double score =
+            static_cast<double>(r.queue_depth + r.inflight) /
+                static_cast<double>(std::max<int64_t>(1, r.queue_capacity)) +
+            (r.slots > 0 ? static_cast<double>(r.active) / r.slots : 0.0);
+        cands.push_back(
+            {tid, addr, score, half_open, full, r.retry_after_hint});
+      }
+      if (cands.empty()) {
+        if (attempt > 0) {
+          // The only ready replica refused the connection and no other
+          // exists — surface the connection failure.
+          fleet_.router_ejections.fetch_add(1);
+          return json_resp(
+              502, err_body("replica connection refused; no other ready "
+                            "replica to retry on"));
+        }
+        HttpResponse resp = json_resp(
+            503, err_body("no ready replicas (deployment starting, "
+                          "draining, or all ejected)"));
+        resp.headers["Retry-After"] = "2";
+        return resp;
+      }
+      bool all_full = true;
+      for (const auto& c : cands) all_full &= c.full;
+      if (all_full) {
+        // Every READY replica reports a full admission queue: shed at
+        // the router with the smallest replica-computed hint instead of
+        // burning a round-trip on a guaranteed 429.
+        full_retry_after = cands[0].retry_after;
+        for (const auto& c : cands) {
+          full_retry_after = std::min(full_retry_after, c.retry_after);
+        }
+        HttpResponse resp = json_resp(
+            429, err_body("every replica reports a full admission queue"));
+        resp.headers["Retry-After"] = std::to_string(full_retry_after);
+        return resp;
+      }
+      // Least-loaded; ties rotate via rr_cursor so equal replicas share.
+      std::stable_sort(cands.begin(), cands.end(),
+                       [](const Cand& a, const Cand& b) {
+                         return a.score < b.score;
+                       });
+      size_t n_best = 1;
+      while (n_best < cands.size() &&
+             cands[n_best].score == cands[0].score) {
+        ++n_best;
+      }
+      const Cand& pick = cands[dep.rr_cursor++ % n_best];
+      target_task = pick.task_id;
+      target_addr = pick.addr;
+      probe = pick.probe;
+      ReplicaHealth& r = dep.replicas[target_task];
+      r.inflight++;
+      if (probe) r.half_open_probe = true;
+      for (auto& [aid, a] : allocations_) {
+        if (a.task_id == target_task) a.last_activity = t;
+      }
+    }
+
+    // Forward OUTSIDE the lock: a generation can run for minutes and the
+    // master lock must not be held across it.
+    HttpClientResponse pr;
+    std::string fail;
+    try {
+      pr = http_request(req.method, target_addr, fwd_path, req.body, 600.0,
+                        fwd_headers);
+    } catch (const std::exception& e) {
+      fail = e.what();
+    }
+
+    std::lock_guard<std::mutex> lock(mu_);
+    auto dit = deployments_.find(dep_id);
+    DeploymentState* dep =
+        dit != deployments_.end() ? &dit->second : nullptr;
+    ReplicaHealth* r = nullptr;
+    if (dep != nullptr) {
+      auto rit = dep->replicas.find(target_task);
+      if (rit != dep->replicas.end()) r = &rit->second;
+    }
+    if (r != nullptr) {
+      r->inflight = std::max<int64_t>(0, r->inflight - 1);
+      if (probe) r->half_open_probe = false;
+    }
+    if (fail.empty()) {
+      // Any HTTP response (even a 5xx) proves the replica's front-end is
+      // alive: close the breaker.
+      if (r != nullptr) {
+        r->consecutive_failures = 0;
+        r->breaker_open_until = 0;
+      }
+      HttpResponse out;
+      out.status = pr.status;
+      out.body = pr.body;
+      auto ct = pr.headers.find("content-type");
+      out.content_type = ct != pr.headers.end() ? ct->second
+                                                : "application/json";
+      // Backpressure hints must survive the hop (serve/http.py computes
+      // Retry-After on 429/503; the harness Session honors it).
+      auto ra = pr.headers.find("retry-after");
+      if (ra != pr.headers.end()) out.headers["Retry-After"] = ra->second;
+      return out;
+    }
+    // Failure path: breaker bookkeeping, then maybe retry.
+    bool connect_fail = is_connect_failure(fail);
+    if (r != nullptr) {
+      r->consecutive_failures++;
+      if (probe || r->consecutive_failures >= kBreakerThreshold) {
+        int over = std::max(0, r->consecutive_failures - kBreakerThreshold);
+        double hold = std::min(kBreakerHoldMaxS,
+                               kBreakerHoldS * (1 << std::min(over, 3)));
+        r->breaker_open_until = now() + hold;
+        fleet_.router_ejections.fetch_add(1);
+      }
+    }
+    if (!connect_fail || attempt == 1) {
+      return json_resp(502, err_body("serve router: " + fail));
+    }
+    tried.insert(target_task);
+    fleet_.router_retries.fetch_add(1);
+  }
+  return json_resp(502, err_body("serve router: no replica reachable"));
+}
+
+}  // namespace det

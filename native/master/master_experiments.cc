@@ -574,6 +574,35 @@ void Master::release_task_context_locked(const std::string& task_id) {
       "(SELECT blob_hash FROM compile_artifacts)");
 }
 
+int64_t Master::sweep_compile_artifacts_locked() {
+  // Age-based compile-artifact eviction (compile_cache.ttl_days; default
+  // off). Dropping the artifact rows releases their hold on the blob
+  // store (the sweeps' NOT IN (SELECT blob_hash FROM compile_artifacts)
+  // guard), so the blob sweep that runs right after reclaims the bytes.
+  // The signature's job row goes too: a DONE job with no artifacts would
+  // read as "already compiled" and the farm would never re-enqueue it.
+  if (cfg_.compile_cache_ttl_days <= 0) return 0;
+  const std::string cutoff =
+      "-" + std::to_string(cfg_.compile_cache_ttl_days) + " days";
+  int64_t evicted = 0;
+  db_.tx([&] {
+    db_.exec(
+        "DELETE FROM compile_jobs WHERE signature IN "
+        "(SELECT DISTINCT signature FROM compile_artifacts "
+        "WHERE created_at < datetime('now', ?))",
+        {Json(cutoff)});
+    evicted = db_.exec(
+        "DELETE FROM compile_artifacts WHERE created_at < "
+        "datetime('now', ?)",
+        {Json(cutoff)});
+  });
+  if (evicted > 0) {
+    std::cerr << "master: compile-cache TTL evicted " << evicted
+              << " artifact rows" << std::endl;
+  }
+  return evicted;
+}
+
 int64_t Master::sweep_context_blobs_locked() {
   // Catch-all for ended tasks whose inline release never ran (tasks
   // orphaned by a master restart). Two invariants the old bulk form

@@ -510,6 +510,16 @@ bool Master::requeue_serving_task_locked(const Allocation& old_alloc) {
   if (trows.empty()) return false;
   if (trows[0]["type"].as_string() != "SERVING") return false;
   if (!trows[0]["end_time"].as_string("").empty()) return false;
+  // Deployment scale-down (docs/serving.md "Deployments & autoscaling"):
+  // a RETIRING replica's drain-exit is terminal — the reconciler asked
+  // for fewer replicas, so respawning here would fight it forever.
+  {
+    DeploymentState* dep = deployment_for_task_locked(old_alloc.task_id);
+    if (dep != nullptr) {
+      auto rit = dep->replicas.find(old_alloc.task_id);
+      if (rit != dep->replicas.end() && rit->second.retiring) return false;
+    }
+  }
   Json config = Json::parse_or_null(trows[0]["config"].as_string());
   int64_t restarts = trows[0]["restarts"].as_int(0);
   int64_t max_restarts = config["max_restarts"].as_int(5);
@@ -530,10 +540,17 @@ bool Master::requeue_serving_task_locked(const Allocation& old_alloc) {
   alloc.owner_id = old_alloc.owner_id;
   alloc.extra_env = old_alloc.extra_env;
   alloc.excluded_agents = old_alloc.excluded_agents;
-  // Avoid the node that just drained: DRAINING exclusion usually covers
-  // it, but a fast agent re-register could race the respawn.
+  // Avoid a node that is draining or dead: DRAINING exclusion usually
+  // covers it, but a fast agent re-register could race the respawn. A
+  // HEALTHY node stays eligible — a replica that merely crashed (exit!=0
+  // with its agent alive) must be respawnable in place, or a single-node
+  // deployment could never recover.
   for (const auto& r : old_alloc.resources) {
-    alloc.excluded_agents.insert(r.agent_id);
+    auto ait = agents_.find(r.agent_id);
+    if (ait == agents_.end() || !ait->second.alive ||
+        ait->second.draining) {
+      alloc.excluded_agents.insert(r.agent_id);
+    }
   }
   db_.exec(
       "INSERT INTO allocations (id, task_id, resource_pool, slots) "

@@ -125,6 +125,9 @@ ROUTES = [
     ("post", "/api/v1/allocations/{id}/exit_reason", "allocations",
      "Task names the cause of its imminent nonzero exit (step watchdog, "
      "divergence fail-stop)"),
+    ("post", "/api/v1/allocations/{id}/serve_stats", "serving",
+     "Serving-replica heartbeat: queue depth + occupancy + drain state "
+     "(the router's least-loaded signal, the autoscaler's input)"),
     ("post", "/api/v1/checkpoints", "checkpoints", "Report checkpoint"),
     ("patch", "/api/v1/checkpoints", "checkpoints",
      "Batch state updates (GC)"),
@@ -192,6 +195,20 @@ ROUTES += [
     ("get", "/api/v1/serving/{id}", "serving", "Get serving task"),
     ("post", "/api/v1/serving/{id}/kill", "serving",
      "Kill the serving task (no respawn)"),
+    # Deployments (docs/serving.md "Deployments & autoscaling"): replica
+    # sets kept at target by the reconciler, routed via /serve/{id}/...,
+    # autoscaled within [min, max] from the replica heartbeat signal.
+    ("get", "/api/v1/deployments", "serving",
+     "List deployments (replica counts, target, smoothed load)"),
+    ("post", "/api/v1/deployments", "serving",
+     "Create a deployment from a serving config with serving.replicas"),
+    ("get", "/api/v1/deployments/{id}", "serving",
+     "Get deployment detail incl. per-replica health/breaker state"),
+    ("post", "/api/v1/deployments/{id}/scale", "serving",
+     "Manually set target replicas within [min, max]"),
+    ("post", "/api/v1/deployments/{id}/kill", "serving",
+     "Kill the deployment and every replica (hard stop; scale to min "
+     "first for a graceful teardown)"),
     # Compile farm (docs/compile-farm.md): the AOT artifact store over the
     # content-addressed blobs + the background compile-job queue.
     ("get", "/api/v1/compile_cache/{signature}", "compile",

@@ -1,0 +1,190 @@
+"""Featherweight serving replica for deployment-controller tests.
+
+Speaks just enough of the real replica's protocol (serve/task.py +
+serve/http.py) to exercise the master's deployment subsystem — proxy
+registration, serve_stats heartbeats, the preemption-drain handshake —
+without building a model or compiling anything, so router/reconciler/
+autoscaler tests run in tier-1 time.
+
+Endpoints:
+  POST /v1/generate     sleeps DET_FAKE_GEN_MS (or body.delay_ms), then
+                        {"id", "tokens": [...], "replica": <task id>} —
+                        the replica field lets tests assert dispatch.
+  GET  /v1/stats        the heartbeat payload as currently reported
+  POST /force_stats     override the reported stats (least-loaded /
+                        all-full scenarios); {} clears the override
+  POST /die             os._exit(1) mid-service (connection-refused +
+                        respawn path)
+  GET  /healthz         {"status": "ok"|"draining"}
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+sys.path.insert(0, REPO)
+
+from determined_tpu.common.api import Session  # noqa: E402
+from determined_tpu.core._preempt import PreemptContext  # noqa: E402
+from determined_tpu.exec._util import report_proxy_address  # noqa: E402
+
+TASK_ID = os.environ.get("DET_TASK_ID", "fake")
+ALLOCATION_ID = os.environ.get("DET_ALLOCATION_ID", "")
+GEN_MS = float(os.environ.get("DET_FAKE_GEN_MS", "30"))
+HEARTBEAT_S = float(os.environ.get("DET_FAKE_HEARTBEAT_S", "0.5"))
+# Per-replica service capacity: at most SLOTS generates run concurrently,
+# like the real batcher's slot count. Requests beyond it queue on the
+# semaphore — the capacity bound that makes replica-scaling benchmarks
+# honest (each replica models an engine that owns its own accelerator).
+SLOTS = int(os.environ.get("DET_FAKE_SLOTS", "4"))
+
+_slots_sem = threading.Semaphore(SLOTS)
+_lock = threading.Lock()
+_state = {
+    "inflight": 0,   # holding a slot
+    "waiting": 0,    # queued on the semaphore
+    "completed": 0,
+    "draining": False,
+    "override": None,  # forced stats dict, or None
+}
+
+
+def heartbeat_stats():
+    with _lock:
+        if _state["override"] is not None:
+            stats = dict(_state["override"])
+            stats.setdefault("draining", _state["draining"])
+            return stats
+        return {
+            "queue_depth": _state["waiting"],
+            "queue_capacity": 4 * SLOTS,
+            "active": _state["inflight"],
+            "slots": SLOTS,
+            "kv_blocks_free": 64,
+            "kv_blocks_total": 64,
+            "draining": _state["draining"],
+            "retry_after_hint_s": 1,
+        }
+
+
+class Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _send(self, status, body):
+        data = json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802
+        if self.path == "/healthz":
+            self._send(200, {"status": "draining" if _state["draining"]
+                             else "ok"})
+        elif self.path == "/v1/stats":
+            self._send(200, heartbeat_stats())
+        else:
+            self._send(404, {"error": "not found"})
+
+    def do_POST(self):  # noqa: N802
+        length = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(length) or b"{}")
+        if self.path == "/v1/generate":
+            if _state["draining"]:
+                self._send(503, {"error": "draining"})
+                return
+            with _lock:
+                _state["waiting"] += 1
+            _slots_sem.acquire()
+            with _lock:
+                _state["waiting"] -= 1
+                _state["inflight"] += 1
+            try:
+                time.sleep(float(body.get("delay_ms", GEN_MS)) / 1e3)
+                n = int(body.get("max_new_tokens", 4))
+                self._send(200, {"id": f"{TASK_ID}-{_state['completed']}",
+                                 "tokens": list(range(n)),
+                                 "replica": TASK_ID})
+            finally:
+                _slots_sem.release()
+                with _lock:
+                    _state["inflight"] -= 1
+                    _state["completed"] += 1
+        elif self.path == "/force_stats":
+            with _lock:
+                _state["override"] = body or None
+            beat()
+            self._send(200, {"ok": True})
+        elif self.path == "/die":
+            self._send(200, {"bye": True})
+            self.wfile.flush()
+            os._exit(1)
+        else:
+            self._send(404, {"error": "not found"})
+
+
+def make_session():
+    master = os.environ.get("DET_MASTER")
+    if not master or not ALLOCATION_ID:
+        return None
+    return Session(master, os.environ.get("DET_SESSION_TOKEN"))
+
+
+_session = make_session()
+
+
+def beat():
+    if _session is None:
+        return
+    try:
+        _session.post(f"/api/v1/allocations/{ALLOCATION_ID}/serve_stats",
+                      body=heartbeat_stats())
+    except Exception:
+        pass
+
+
+def main():
+    httpd = ThreadingHTTPServer(("0.0.0.0", 0), Handler)
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    import socket
+
+    addr = f"http://{socket.gethostname()}:{httpd.server_address[1]}"
+    report_proxy_address(addr)
+    print(f"fake replica {TASK_ID} at {addr}", flush=True)
+
+    preempt = PreemptContext(_session, ALLOCATION_ID or None)
+    try:
+        while True:
+            if preempt.should_preempt():
+                break
+            beat()
+            time.sleep(HEARTBEAT_S)
+        # Drain handshake: report draining NOW, finish in-flight, exit 0.
+        with _lock:
+            _state["draining"] = True
+        beat()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            with _lock:
+                if _state["inflight"] == 0 and _state["waiting"] == 0:
+                    break
+            time.sleep(0.05)
+        print("fake replica drained; exiting 0", flush=True)
+        return 0
+    finally:
+        preempt.close()
+        httpd.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

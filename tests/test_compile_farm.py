@@ -682,6 +682,102 @@ def test_blob_sweep_respects_compile_artifacts(master_only):
     assert base64.b64decode(got["files"][0]["b64"]) == b"payload"
 
 
+def test_compile_artifact_ttl_eviction(tmp_path, native_binaries):
+    """Retention (compile_cache.ttl_days, ROADMAP item 5 leftover): the
+    blob sweep evicts artifact rows past the TTL — INCLUDING rows holding
+    a blob only through a fingerprint link — so their blobs get swept,
+    while fresh artifacts survive untouched. Default-off: artifacts on a
+    master without the flag persist forever (the pre-TTL behavior)."""
+    cluster = Devcluster(str(tmp_path), native_binaries)
+    cluster.start_master(extra_args=["--compile-ttl-days", "7"])
+    try:
+        token = cluster.login()
+        sig_old, sig_linked, sig_fresh = "e" * 64, "f" * 64, "0" * 64
+        _upload_artifacts(cluster, token, sig_old,
+                          {"aot-old.bin": b"old-exec"},
+                          fingerprint="ttlfp")
+        # Linked signature: holds the SAME blob through the link only.
+        cluster.api("POST", f"/api/v1/compile_jobs/{sig_linked}/link",
+                    {"from": sig_old, "fingerprint": "ttlfp"}, token=token)
+        _upload_artifacts(cluster, token, sig_fresh,
+                          {"aot-fresh.bin": b"fresh-exec"})
+
+        db = sqlite3.connect(cluster.db_path)
+        try:
+            (old_hash,) = db.execute(
+                "SELECT blob_hash FROM compile_artifacts WHERE signature=?",
+                (sig_old,)).fetchone()
+            # Age the original AND the linked rows past the 7-day TTL;
+            # drain the upload's task claim so only compile_artifacts
+            # holds the blob (the linked-row scenario).
+            db.execute(
+                "UPDATE compile_artifacts SET "
+                "created_at = datetime('now', '-10 days') "
+                "WHERE signature IN (?, ?)", (sig_old, sig_linked))
+            db.execute("UPDATE model_defs SET refcount=0 WHERE hash=?",
+                       (old_hash,))
+            db.commit()
+        finally:
+            db.close()
+
+        admin = cluster.login("admin")
+        out = cluster.api("POST", "/api/v1/master/cleanup_blobs", {},
+                          token=admin)
+        assert out["compile_artifacts_evicted"] == 2, out
+
+        db = sqlite3.connect(cluster.db_path)
+        try:
+            # Expired rows gone (both the original and the linked one),
+            # their job rows re-enqueueable, their blob swept.
+            assert db.execute(
+                "SELECT COUNT(*) FROM compile_artifacts WHERE "
+                "signature IN (?, ?)", (sig_old, sig_linked)
+            ).fetchone()[0] == 0
+            assert db.execute(
+                "SELECT COUNT(*) FROM compile_jobs WHERE "
+                "signature IN (?, ?)", (sig_old, sig_linked)
+            ).fetchone()[0] == 0
+            assert db.execute(
+                "SELECT COUNT(*) FROM model_defs WHERE hash=?",
+                (old_hash,)).fetchone()[0] == 0, "expired blob not swept"
+            # The fresh artifact and its blob survive.
+            assert db.execute(
+                "SELECT COUNT(*) FROM compile_artifacts WHERE signature=?",
+                (sig_fresh,)).fetchone()[0] == 1
+        finally:
+            db.close()
+        got = cluster.api("GET", f"/api/v1/compile_cache/{sig_fresh}",
+                          token=token)
+        assert base64.b64decode(got["files"][0]["b64"]) == b"fresh-exec"
+        got = cluster.api("GET", f"/api/v1/compile_cache/{sig_old}",
+                          token=token)
+        assert got["files"] == []
+    finally:
+        cluster.stop()
+
+
+def test_compile_artifact_ttl_off_by_default(master_only):
+    """No ttl flag → aged artifacts persist through the sweep."""
+    cluster = master_only
+    token = cluster.login()
+    sig = "9" * 64
+    _upload_artifacts(cluster, token, sig, {"aot-keep.bin": b"keep"})
+    db = sqlite3.connect(cluster.db_path)
+    try:
+        db.execute(
+            "UPDATE compile_artifacts SET "
+            "created_at = datetime('now', '-400 days') WHERE signature=?",
+            (sig,))
+        db.commit()
+    finally:
+        db.close()
+    out = cluster.api("POST", "/api/v1/master/cleanup_blobs", {},
+                      token=cluster.login("admin"))
+    assert out["compile_artifacts_evicted"] == 0
+    got = cluster.api("GET", f"/api/v1/compile_cache/{sig}", token=token)
+    assert base64.b64decode(got["files"][0]["b64"]) == b"keep"
+
+
 def test_worker_run_job_compiles_and_uploads(master_only, tmp_path,
                                              monkeypatch):
     """The farm worker end to end against a real master: download the

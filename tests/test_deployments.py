@@ -1,0 +1,646 @@
+"""Serving deployments (docs/serving.md "Deployments & autoscaling"):
+replica-set controller, master-side request router, signal-driven
+autoscaler.
+
+Fast tests run the REAL master + agent (devcluster) with a featherweight
+fake replica (tests/fixtures/serving/fake_replica.py) that speaks the
+replica protocol — proxy registration, serve_stats heartbeats, the
+preemption-drain handshake — without building a model, so router and
+controller semantics are exercised end-to-end in tier-1 time. The -m slow
+e2e at the bottom runs the full lifecycle with REAL engines in `make
+chaos`.
+
+The acceptance contracts:
+  - the reconciler keeps a deployment at target (spawn on deficit,
+    drain-retire on surplus, respawn on death);
+  - the router dispatches least-loaded, retries connection refusals once
+    on another replica (never an in-flight generation), ejects a failing
+    replica via the circuit breaker and re-admits it after respawn;
+  - 429/Retry-After when every replica reports a full admission queue;
+  - scale-down always drains: zero accepted requests dropped.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tests.test_platform_e2e import (  # noqa: F401  (fixture re-export)
+    Devcluster,
+    native_binaries,
+)
+
+from determined_tpu import expconf
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# expconf: serving.replicas validation + defaults.
+# ---------------------------------------------------------------------------
+
+
+def _serving_cfg(replicas):
+    return {"name": "d", "serving": {"model": "gpt2", "replicas": replicas},
+            "resources": {"slots_per_trial": 1}}
+
+
+def test_expconf_replicas_valid_and_defaults():
+    cfg = expconf.check(_serving_cfg({"min": 1, "max": 4, "target": 2}))
+    rep = cfg["serving"]["replicas"]
+    assert (rep["min"], rep["target"], rep["max"]) == (1, 2, 4)
+    # Defaults fill from min upward.
+    cfg = expconf.check(_serving_cfg({"min": 2}))
+    rep = cfg["serving"]["replicas"]
+    assert (rep["min"], rep["target"], rep["max"]) == (2, 2, 2)
+    # Autoscaler knobs pass through.
+    cfg = expconf.check(_serving_cfg(
+        {"min": 1, "max": 2, "scale_up_after_s": 0.5,
+         "scale_down_after_s": 1, "scale_up_threshold": 0.5,
+         "scale_down_threshold": 0.2}))
+    assert cfg["serving"]["replicas"]["scale_up_after_s"] == 0.5
+
+
+@pytest.mark.parametrize("bad,needle", [
+    ({"min": 0}, "positive int"),
+    ({"min": 3, "max": 2}, "min must be <= max"),
+    ({"min": 1, "max": 2, "target": 5}, "within [min, max]"),
+    ({"min": 1, "bogus": 2}, "unknown keys"),
+    ({"min": 1, "scale_up_after_s": -1}, "non-negative"),
+    ({"min": 1, "scale_up_threshold": 3}, "(0, 2]"),
+    ("two", "must be a mapping"),
+])
+def test_expconf_replicas_invalid(bad, needle):
+    errors = expconf.validate(_serving_cfg(bad))
+    assert any(needle in e for e in errors), (bad, errors)
+
+
+def test_expconf_heartbeat_period():
+    cfg = _serving_cfg({"min": 1})
+    cfg["serving"]["heartbeat_period_s"] = 0.5
+    assert not expconf.validate(cfg)
+    cfg["serving"]["heartbeat_period_s"] = 0
+    assert any("heartbeat_period_s" in e for e in expconf.validate(cfg))
+
+
+# ---------------------------------------------------------------------------
+# Devcluster plumbing.
+# ---------------------------------------------------------------------------
+
+
+def _http(method, url, body=None, token=None, timeout=60.0):
+    """Raw request returning (status, headers, parsed-json) — unlike
+    Devcluster.api it surfaces 4xx/5xx instead of raising."""
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json",
+                 **({"Authorization": f"Bearer {token}"} if token else {})},
+        method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            text = resp.read().decode()
+            return resp.status, dict(resp.headers), (
+                json.loads(text) if text else None)
+    except urllib.error.HTTPError as e:
+        text = e.read().decode(errors="replace")
+        try:
+            parsed = json.loads(text) if text else None
+        except ValueError:
+            parsed = {"raw": text}
+        return e.code, dict(e.headers), parsed
+
+
+def _dep_config(min_r=1, max_r=4, target=2, heartbeat_s=0.3, **rep_extra):
+    replicas = {"min": min_r, "max": max_r, "target": target}
+    replicas.update(rep_extra)
+    return {
+        "name": "fake-dep",
+        # Fake replica instead of the real engine: the subsystem under
+        # test is the master's controller/router, not the batcher.
+        "entrypoint": "python3 -m tests.fixtures.serving.fake_replica",
+        "serving": {"model": "gpt2", "replicas": replicas},
+        "resources": {"slots_per_trial": 0},
+        "environment": {"DET_FAKE_HEARTBEAT_S": str(heartbeat_s)},
+    }
+
+
+@pytest.fixture()
+def master_only(tmp_path, native_binaries):  # noqa: F811
+    c = Devcluster(str(tmp_path), native_binaries)
+    c.start_master()
+    yield c
+    c.stop()
+
+
+@pytest.fixture()
+def fleet(tmp_path, native_binaries):  # noqa: F811
+    c = Devcluster(str(tmp_path), native_binaries, slots=4)
+    c.start_master()
+    c.start_agent()
+    yield c
+    c.stop()
+
+
+def _wait_ready(c, token, dep_id, n, timeout=90.0):
+    """Until `n` replicas are RUNNING with a proxy address and a fresh
+    heartbeat; returns the deployment detail."""
+    deadline = time.time() + timeout
+    detail = None
+    while time.time() < deadline:
+        detail = c.api("GET", f"/api/v1/deployments/{dep_id}",
+                       token=token)["deployment"]
+        ready = [r for r in detail["replicas"]
+                 if r.get("allocation_state") == "RUNNING"
+                 and r.get("proxy_address")
+                 and 0 <= (r.get("report_age_s") or -1) < 10
+                 and not r["retiring"]]
+        if len(ready) == n and len(detail["replicas"]) == n:
+            return detail
+        time.sleep(0.2)
+    raise TimeoutError(f"deployment never reached {n} ready replicas: "
+                       f"{json.dumps(detail, indent=2)}")
+
+
+def _replica_addr(detail, task_id):
+    for r in detail["replicas"]:
+        if r["task_id"] == task_id:
+            return r["proxy_address"]
+    raise KeyError(task_id)
+
+
+def _generate(c, token, dep_id, body=None, timeout=60.0):
+    return _http("POST", f"{c.master_url}/serve/{dep_id}/v1/generate",
+                 body or {"max_new_tokens": 4}, token=token,
+                 timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# Controller: create / reconcile / scale / kill (no agent needed).
+# ---------------------------------------------------------------------------
+
+
+def test_deployment_create_scale_kill(master_only):
+    c = master_only
+    token = c.login()
+    resp = c.api("POST", "/api/v1/deployments",
+                 {"config": _dep_config(target=2)}, token=token)
+    dep_id = resp["id"]
+    assert dep_id.startswith("deploy-") and len(resp["replicas"]) == 2
+
+    # Replicas exist as SERVING tasks (PENDING without an agent).
+    serving = c.api("GET", "/api/v1/serving", token=token)["serving"]
+    ours = [t for t in serving if t["id"] in resp["replicas"]]
+    assert len(ours) == 2 and all(t["state"] == "ACTIVE" for t in ours)
+
+    # Scale up: reconciler spawns the deficit.
+    c.api("POST", f"/api/v1/deployments/{dep_id}/scale", {"target": 3},
+          token=token)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        detail = c.api("GET", f"/api/v1/deployments/{dep_id}",
+                       token=token)["deployment"]
+        if len(detail["replicas"]) == 3:
+            break
+        time.sleep(0.2)
+    assert len(detail["replicas"]) == 3
+
+    # Out-of-range manual scale is a 400, not a clamp-and-shrug.
+    status, _, body = _http(
+        "POST", f"{c.master_url}/api/v1/deployments/{dep_id}/scale",
+        {"target": 9}, token=token)
+    assert status == 400 and "within" in body["error"]
+
+    # Scale down: PENDING surplus replicas terminate immediately.
+    c.api("POST", f"/api/v1/deployments/{dep_id}/scale", {"target": 1},
+          token=token)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        detail = c.api("GET", f"/api/v1/deployments/{dep_id}",
+                       token=token)["deployment"]
+        if len(detail["replicas"]) == 1:
+            break
+        time.sleep(0.2)
+    assert len(detail["replicas"]) == 1
+
+    # Kill: deployment ends, remaining replica task goes terminal.
+    c.api("POST", f"/api/v1/deployments/{dep_id}/kill", token=token)
+    deps = c.api("GET", "/api/v1/deployments", token=token)["deployments"]
+    assert deps[0]["state"] == "KILLED" and deps[0]["end_time"]
+    serving = c.api("GET", "/api/v1/serving", token=token)["serving"]
+    assert all(t["state"] in ("CANCELED", "COMPLETED", "ERROR")
+               for t in serving if t["id"] in resp["replicas"] or
+               any(t["id"] == r["task_id"] for r in detail["replicas"]))
+
+
+def test_deployment_requires_serving_block_and_valid_range(master_only):
+    c = master_only
+    token = c.login()
+    status, _, body = _http(
+        "POST", f"{c.master_url}/api/v1/deployments",
+        {"config": {"name": "x"}}, token=token)
+    assert status == 400 and "serving" in body["error"]
+    status, _, body = _http(
+        "POST", f"{c.master_url}/api/v1/deployments",
+        {"config": {"serving": {"replicas": {"min": 3, "max": 1}}}},
+        token=token)
+    assert status == 400
+    status, _, body = _http(
+        "GET", f"{c.master_url}/api/v1/deployments/deploy-nope", token=token)
+    assert status == 404 and body["error"] == "no such deployment"
+
+
+# ---------------------------------------------------------------------------
+# Router: dispatch, least-loaded, 429-all-full, failover, breaker.
+# ---------------------------------------------------------------------------
+
+
+def test_router_dispatch_and_least_loaded(fleet):
+    c = fleet
+    token = c.login()
+    resp = c.api("POST", "/api/v1/deployments",
+                 {"config": _dep_config(target=2)}, token=token)
+    dep_id = resp["id"]
+    detail = _wait_ready(c, token, dep_id, 2)
+    tids = [r["task_id"] for r in detail["replicas"]]
+
+    # Equal load: the rotation spreads requests over both replicas.
+    seen = set()
+    for _ in range(6):
+        status, _, body = _generate(c, token, dep_id)
+        assert status == 200, body
+        seen.add(body["replica"])
+    assert seen == set(tids)
+
+    # Routing by name works too.
+    status, _, body = _generate(c, token, "fake-dep")
+    assert status == 200
+
+    # Load up replica A: everything flows to B until A clears.
+    a, b = tids[0], tids[1]
+    addr_a = _replica_addr(detail, a)
+    status, _, _ = _http("POST", f"{addr_a}/force_stats",
+                         {"queue_depth": 7, "queue_capacity": 8,
+                          "active": 4, "slots": 4})
+    assert status == 200
+    time.sleep(0.2)  # force_stats beats immediately; allow the hop
+    for _ in range(4):
+        status, _, body = _generate(c, token, dep_id)
+        assert status == 200 and body["replica"] == b, body
+    _http("POST", f"{addr_a}/force_stats", {})
+
+
+def test_router_429_when_every_replica_full(fleet):
+    c = fleet
+    token = c.login()
+    resp = c.api("POST", "/api/v1/deployments",
+                 {"config": _dep_config(target=2)}, token=token)
+    dep_id = resp["id"]
+    detail = _wait_ready(c, token, dep_id, 2)
+    full = {"queue_depth": 8, "queue_capacity": 8, "active": 4, "slots": 4,
+            "retry_after_hint_s": 7}
+    for r in detail["replicas"]:
+        status, _, _ = _http(
+            "POST", f"{r['proxy_address']}/force_stats", full)
+        assert status == 200
+    time.sleep(0.3)
+    status, headers, body = _generate(c, token, dep_id)
+    assert status == 429, body
+    # The Retry-After hint is the smallest replica-computed backoff.
+    assert headers.get("Retry-After") == "7", headers
+    # One replica clears → requests flow again (to that replica).
+    clear = detail["replicas"][0]
+    _http("POST", f"{clear['proxy_address']}/force_stats", {})
+    time.sleep(0.3)
+    status, _, body = _generate(c, token, dep_id)
+    assert status == 200 and body["replica"] == clear["task_id"]
+
+
+def test_router_failover_ejection_and_readmission(fleet):
+    """The satellite contract: kill one replica of a 2-replica deployment
+    mid-burst — connection-refused requests retry onto the survivor (zero
+    accepted requests dropped), the dead replica is ejected, and after the
+    master respawns it the router re-admits it."""
+    c = fleet
+    token = c.login()
+    resp = c.api("POST", "/api/v1/deployments",
+                 {"config": _dep_config(target=2, max_r=2)}, token=token)
+    dep_id = resp["id"]
+    detail = _wait_ready(c, token, dep_id, 2)
+    tids = {r["task_id"] for r in detail["replicas"]}
+    victim = detail["replicas"][0]
+    survivor_tid = (tids - {victim["task_id"]}).pop()
+
+    results, failures = [], []
+
+    def _burst(n):
+        for _ in range(n):
+            status, _, body = _generate(
+                c, token, dep_id, {"max_new_tokens": 2, "delay_ms": 40})
+            (results if status == 200 else failures).append((status, body))
+
+    threads = [threading.Thread(target=_burst, args=(6,)) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.15)
+    # Kill the victim process mid-burst (its socket dies with it).
+    try:
+        _http("POST", f"{victim['proxy_address']}/die", {}, timeout=5)
+    except Exception:
+        pass  # the process may die before finishing the response
+    for t in threads:
+        t.join(timeout=120)
+
+    # Zero dropped: every request either succeeded (possibly via the
+    # retry-once path) or was an explicit router rejection — never a
+    # torso. In-flight requests on the victim die WITH their connection
+    # (the router must not replay a possibly-generating request), so the
+    # caller sees an explicit 502 for those, and only those.
+    assert len(results) >= 18, (len(results), failures)
+    for status, body in failures:
+        assert status == 502, (status, body)
+    assert len(failures) <= 6, failures
+
+    # The retry path actually ran: router counters prove the refusals
+    # were re-dispatched rather than surfaced.
+    raw = urllib.request.urlopen(urllib.request.Request(
+        f"{c.master_url}/metrics",
+        headers={"Authorization": f"Bearer {token}"}), timeout=10
+    ).read().decode()
+    retries = [line for line in raw.splitlines()
+               if line.startswith("det_serve_router_retries_total")]
+    assert retries and int(retries[0].split()[-1]) >= 1, retries
+
+    # Survivor kept serving throughout; victim respawns (restarts >= 1)
+    # and is re-admitted by the router after the breaker hold.
+    detail = _wait_ready(c, token, dep_id, 2, timeout=120)
+    assert {r["task_id"] for r in detail["replicas"]} == tids
+    task = c.api("GET", f"/api/v1/serving/{victim['task_id']}",
+                 token=token)["task"]
+    assert int(task.get("restarts") or 0) >= 1
+    deadline = time.time() + 60
+    seen = set()
+    while time.time() < deadline and len(seen) < 2:
+        status, _, body = _generate(c, token, dep_id,
+                                    {"max_new_tokens": 2, "delay_ms": 1})
+        if status == 200:
+            seen.add(body["replica"])
+    assert seen == tids, f"victim never re-admitted: {seen}"
+    assert survivor_tid in seen
+
+
+def test_scale_down_drains_running_replica_zero_dropped(fleet):
+    c = fleet
+    token = c.login()
+    resp = c.api("POST", "/api/v1/deployments",
+                 {"config": _dep_config(target=2, max_r=2)}, token=token)
+    dep_id = resp["id"]
+    _wait_ready(c, token, dep_id, 2)
+
+    results, failures = [], []
+
+    def _burst(n):
+        for _ in range(n):
+            status, _, body = _generate(
+                c, token, dep_id, {"max_new_tokens": 2, "delay_ms": 30})
+            (results if status == 200 else failures).append((status, body))
+
+    threads = [threading.Thread(target=_burst, args=(8,)) for _ in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.1)
+    c.api("POST", f"/api/v1/deployments/{dep_id}/scale", {"target": 1},
+          token=token)
+    for t in threads:
+        t.join(timeout=120)
+    # The drain is cooperative: every accepted request completed; the
+    # router stopped dispatching to the retiring replica the moment its
+    # preemption landed, so nothing was refused either.
+    assert not failures, failures
+    assert len(results) == 24
+
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        detail = c.api("GET", f"/api/v1/deployments/{dep_id}",
+                       token=token)["deployment"]
+        if len(detail["replicas"]) == 1:
+            break
+        time.sleep(0.2)
+    assert len(detail["replicas"]) == 1
+    # The retired replica finished COMPLETED — a drain, not a kill — and
+    # was NOT respawned.
+    serving = c.api("GET", "/api/v1/serving", token=token)["serving"]
+    done = [t for t in serving if t["state"] == "COMPLETED"]
+    assert len(done) == 1, serving
+    assert int(done[0].get("restarts") or 0) == 0
+
+
+def test_autoscaler_scales_up_on_backpressure_down_when_idle(fleet):
+    c = fleet
+    token = c.login()
+    cfg = _dep_config(min_r=1, max_r=2, target=1, heartbeat_s=0.2,
+                      scale_up_after_s=0.5, scale_down_after_s=0.5,
+                      scale_up_threshold=0.5, scale_down_threshold=0.2)
+    resp = c.api("POST", "/api/v1/deployments", {"config": cfg},
+                 token=token)
+    dep_id = resp["id"]
+    detail = _wait_ready(c, token, dep_id, 1)
+    addr = detail["replicas"][0]["proxy_address"]
+
+    # Sustained backpressure: the replica reports a full queue + full
+    # batch until the smoothed signal crosses the threshold.
+    _http("POST", f"{addr}/force_stats",
+          {"queue_depth": 8, "queue_capacity": 8, "active": 4, "slots": 4})
+    deadline = time.time() + 45
+    while time.time() < deadline:
+        detail = c.api("GET", f"/api/v1/deployments/{dep_id}",
+                       token=token)["deployment"]
+        if detail["target_replicas"] == 2:
+            break
+        time.sleep(0.2)
+    assert detail["target_replicas"] == 2, detail
+    detail = _wait_ready(c, token, dep_id, 2)
+
+    # Quiet down: the signal decays, the idle cooldown passes, target
+    # returns to min — via drain (completed, not canceled/killed).
+    _http("POST", f"{addr}/force_stats", {})
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        detail = c.api("GET", f"/api/v1/deployments/{dep_id}",
+                       token=token)["deployment"]
+        if detail["target_replicas"] == 1 and len(detail["replicas"]) == 1:
+            break
+        time.sleep(0.3)
+    assert detail["target_replicas"] == 1, detail
+    assert len(detail["replicas"]) == 1
+
+    # Scale events are published on the stream and counted in /metrics.
+    raw = urllib.request.urlopen(urllib.request.Request(
+        f"{c.master_url}/metrics",
+        headers={"Authorization": f"Bearer {token}"}), timeout=10
+    ).read().decode()
+    ups = [line for line in raw.splitlines() if line.startswith(
+        'det_deployment_scale_events_total{direction="up"}')]
+    downs = [line for line in raw.splitlines() if line.startswith(
+        'det_deployment_scale_events_total{direction="down"}')]
+    assert ups and int(ups[0].split()[-1]) >= 1
+    assert downs and int(downs[0].split()[-1]) >= 1
+    stream = c.api("GET", "/api/v1/stream?entities=deployments&"
+                   "timeout_seconds=0", token=token)
+    assert any(e["payload"].get("direction") == "up"
+               for e in stream["events"]), stream
+
+
+def test_replica_death_respawns_to_target(fleet):
+    """A replica that dies (nonzero exit) respawns via the PR-6 requeue
+    machinery under the SAME task id — the deployment holds target."""
+    c = fleet
+    token = c.login()
+    resp = c.api("POST", "/api/v1/deployments",
+                 {"config": _dep_config(target=1, max_r=1)}, token=token)
+    dep_id = resp["id"]
+    detail = _wait_ready(c, token, dep_id, 1)
+    tid = detail["replicas"][0]["task_id"]
+    try:
+        _http("POST", f"{detail['replicas'][0]['proxy_address']}/die", {},
+              timeout=5)
+    except Exception:
+        pass
+    # First the death lands (restarts bumps), then the respawn comes up.
+    deadline = time.time() + 120
+    task = {}
+    while time.time() < deadline:
+        task = c.api("GET", f"/api/v1/serving/{tid}", token=token)["task"]
+        if int(task.get("restarts") or 0) >= 1:
+            break
+        time.sleep(0.2)
+    assert int(task.get("restarts") or 0) >= 1, task
+    detail = _wait_ready(c, token, dep_id, 1, timeout=120)
+    assert detail["replicas"][0]["task_id"] == tid
+
+
+# ---------------------------------------------------------------------------
+# Full lifecycle with REAL replicas (make chaos).
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_deployment_lifecycle_real_replicas_e2e(tmp_path, native_binaries):  # noqa: F811
+    """Scale-up under real load, scale-down via drain, zero dropped — with
+    real engines serving a real checkpoint through the router."""
+    import jax
+    import jax.numpy as jnp
+
+    from determined_tpu import core
+    from determined_tpu.models import gpt2
+
+    cfg = gpt2.Config(
+        vocab_size=256, n_positions=64, d_model=32, n_layer=2, n_head=2,
+        dtype=jnp.float32, remat=False, attention_impl="dot")
+    params = gpt2.init(jax.random.PRNGKey(0), cfg)
+    ctx = core.init(max_length=2,
+                    checkpoint_dir=os.path.join(str(tmp_path), "ckpts"))
+    ctx.checkpoint.save_state(
+        {"step": jnp.asarray(2, jnp.int32), "params": params,
+         "opt_state": {"count": jnp.zeros((), jnp.int32)}}, 2)
+    ctx.checkpoint.wait()
+    ctx.close()
+
+    config = {
+        "name": "real-dep",
+        "serving": {
+            "checkpoint": "trial0-step2",
+            "model": "gpt2",
+            "model_config": {"model_size": "tiny", "seq_len": 64,
+                             "dtype": "float32", "vocab_size": 256,
+                             "n_positions": 64, "d_model": 32,
+                             "n_layer": 2, "n_head": 2},
+            "max_batch_size": 4,
+            "max_seq_len": 32,
+            "prefill_buckets": [8],
+            "queue_depth": 32,
+            "heartbeat_period_s": 0.3,
+            "replicas": {"min": 1, "max": 2, "target": 1,
+                         "scale_up_after_s": 1.0,
+                         "scale_down_after_s": 2.0,
+                         "scale_up_threshold": 0.5,
+                         "scale_down_threshold": 0.05},
+        },
+        "resources": {"slots_per_trial": 1},
+        "checkpoint_storage": {
+            "type": "shared_fs",
+            "host_path": os.path.join(str(tmp_path), "ckpts"),
+        },
+    }
+
+    c = Devcluster(str(tmp_path), native_binaries, slots=1)
+    c.start_master()
+    c.start_agent("fleet-a")
+    c.start_agent("fleet-b")
+    try:
+        token = c.login()
+        dep_id = c.api("POST", "/api/v1/deployments", {"config": config},
+                       token=token)["id"]
+        _wait_ready(c, token, dep_id, 1, timeout=240)
+
+        stop_load = threading.Event()
+        results, failures = [], []
+
+        def _loader():
+            while not stop_load.is_set():
+                status, _, body = _generate(
+                    c, token, dep_id,
+                    {"tokens": [5, 9, 17, 3], "max_new_tokens": 16,
+                     "timeout_s": 120}, timeout=150)
+                if status == 200:
+                    results.append(body)
+                elif status in (429, 503):
+                    time.sleep(0.2)  # explicit backpressure, not a drop
+                else:
+                    failures.append((status, body))
+
+        threads = [threading.Thread(target=_loader) for _ in range(8)]
+        for t in threads:
+            t.start()
+
+        # Sustained backpressure on the single replica → autoscale to 2.
+        deadline = time.time() + 240
+        scaled = False
+        while time.time() < deadline:
+            detail = c.api("GET", f"/api/v1/deployments/{dep_id}",
+                           token=token)["deployment"]
+            if detail["target_replicas"] == 2:
+                scaled = True
+                break
+            time.sleep(0.5)
+        assert scaled, f"never scaled up: {json.dumps(detail, indent=2)}"
+        _wait_ready(c, token, dep_id, 2, timeout=240)
+
+        # Load off → idle cooldown → drain back to 1 with zero dropped.
+        stop_load.set()
+        for t in threads:
+            t.join(timeout=180)
+        assert not failures, failures[:5]
+        assert results, "no request completed under load"
+        assert all(len(r["tokens"]) == 16 for r in results)
+
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            detail = c.api("GET", f"/api/v1/deployments/{dep_id}",
+                           token=token)["deployment"]
+            if (detail["target_replicas"] == 1
+                    and len(detail["replicas"]) == 1):
+                break
+            time.sleep(0.5)
+        assert detail["target_replicas"] == 1, detail
+        # The drained replica completed cleanly (zero-dropped drain).
+        serving = c.api("GET", "/api/v1/serving", token=token)["serving"]
+        assert any(t["state"] == "COMPLETED" for t in serving), serving
+
+        c.api("POST", f"/api/v1/deployments/{dep_id}/kill", token=token)
+    finally:
+        c.stop()

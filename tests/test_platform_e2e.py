@@ -72,7 +72,7 @@ class Devcluster:
         # (and off the single real chip).
         self.env.pop("PALLAS_AXON_POOL_IPS", None)
 
-    def start_master(self):
+    def start_master(self, extra_args=()):
         self.master = subprocess.Popen(
             [
                 os.path.join(self.binaries, "determined-master"),
@@ -80,6 +80,7 @@ class Devcluster:
                 "--host", "127.0.0.1",
                 "--db", self.db_path,
                 "--agent-timeout", "15",
+                *extra_args,
             ],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         )

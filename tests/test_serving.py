@@ -476,6 +476,61 @@ def test_http_error_codes(http_replica):
     assert status == 200
 
 
+def test_429_carries_computed_retry_after(tiny_params):
+    """A QueueFull 429 carries a Retry-After computed from queue depth ×
+    the smoothed service time — a hint the harness Session (and the
+    deployment router, which propagates the header) can act on."""
+    import urllib.error
+    import urllib.request
+
+    from determined_tpu.serve.http import ServingServer
+
+    eng = make_engine(tiny_params, slots=2)
+    b = make_batcher(eng, queue_size=1)
+    # No start(): with the batcher thread parked, the queue fills and the
+    # second submit 429s deterministically.
+    eng.compile()
+    server = ServingServer(b, host="127.0.0.1", port=0).start()
+    try:
+        url = f"http://127.0.0.1:{server.port}"
+        body = {"tokens": [1, 2], "max_new_tokens": 2, "timeout_s": 0.1}
+        req = urllib.request.Request(
+            url + "/v1/generate", method="POST",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=10)  # fills the queue(504)
+        except urllib.error.HTTPError:
+            pass
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("expected 429")
+        except urllib.error.HTTPError as e:
+            assert e.code == 429
+            assert int(e.headers["Retry-After"]) >= 1
+    finally:
+        server.stop()
+        b.stop()
+
+
+def test_retry_after_hint_scales_with_backlog(tiny_params):
+    eng = make_engine(tiny_params, slots=2)
+    b = make_batcher(eng, queue_size=64)
+    assert b.retry_after_hint() == 1  # no history, empty queue
+    # Synthetic history: 4s per request over 2 slots; 8 queued → ~16s.
+    b._service_s_ewma = 4.0
+    for _ in range(8):
+        b.queue.submit(_req())
+    assert b.retry_after_hint() == 16
+    assert b.heartbeat_stats()["retry_after_hint_s"] == 16
+    hb = b.heartbeat_stats()
+    assert hb["queue_depth"] == 8 and hb["queue_capacity"] == 64
+    assert hb["slots"] == 2 and hb["draining"] is False
+    # The hint is clamped: a pathological backlog still answers <= 60.
+    b._service_s_ewma = 1000.0
+    assert b.retry_after_hint() == 60
+
+
 # ---------------------------------------------------------------------------
 # Devcluster e2e (slow): submit → serve → drain → replica reschedule.
 # ---------------------------------------------------------------------------

@@ -970,9 +970,51 @@ async function pageTasks() {
 
 async function pageServing() {
   const { serving } = await API.getServing();
+  const { deployments } = await API.getDeployments();
   view.textContent = "";
   view.append(el("h1", {}, "Serving"));
   const err = el("span", { class: "error" });
+  // Deployments (docs/serving.md "Deployments & autoscaling"): replica
+  // sets behind the /serve/{id} router; +/- adjust target within
+  // [min, max], the reconciler drains or spawns to match.
+  if (deployments.length) {
+    view.append(el("h2", {}, "Deployments"));
+    view.append(el("table", {},
+      el("tr", {}, ["ID", "Name", "State", "Replicas", "Range", "Load", ""]
+        .map((h) => el("th", {}, h))),
+      deployments.map((d) => el("tr", {},
+        el("td", {}, d.id),
+        el("td", {}, d.name),
+        el("td", {}, stateBadge(d.state)),
+        el("td", {}, `${d.replica_count ?? 0}/${d.target_replicas}`),
+        el("td", { class: "muted" },
+          `[${d.min_replicas}, ${d.max_replicas}]`),
+        el("td", { class: "muted" },
+          d.smoothed_load != null ? d.smoothed_load.toFixed(2) : ""),
+        el("td", {}, d.state === "ACTIVE" ? [
+          el("button", {
+            onclick: async () => {
+              try {
+                await API.postDeploymentsIdScale(
+                  d.id, { target: d.target_replicas - 1 });
+                pageServing();
+              } catch (e) { err.textContent = `scale failed: ${e.message}`; }
+            } }, "−"),
+          el("button", {
+            onclick: async () => {
+              try {
+                await API.postDeploymentsIdScale(
+                  d.id, { target: d.target_replicas + 1 });
+                pageServing();
+              } catch (e) { err.textContent = `scale failed: ${e.message}`; }
+            } }, "+"),
+          el("button", {
+            onclick: async () => {
+              try { await API.postDeploymentsIdKill(d.id); pageServing(); }
+              catch (e) { err.textContent = `kill failed: ${e.message}`; }
+            } }, "kill"),
+        ] : "")))));
+  }
   view.append(el("table", {},
     el("tr", {}, ["ID", "State", "Address", "Restarts", "Started", ""]
       .map((h) => el("th", {}, h))),
