@@ -163,6 +163,38 @@ def check_config(config: Dict[str, Any]) -> List[Diagnostic]:
     # can't share anything across them (docs/compile-farm.md).
     diags.extend(_check_shape_sweep(config))
 
+    # DTL206 — serving paged-KV geometry (docs/serving.md "Paged KV &
+    # prefix caching"): the block tables tile max_seq_len in
+    # kv_block_size steps, so the block size must divide it; and an
+    # explicit kv_num_blocks must leave room for at least one worst-case
+    # sequence or admission can never succeed. Both fail the replica at
+    # runtime — catch them before launch.
+    serving = config.get("serving")
+    if isinstance(serving, dict):
+        bs = serving.get("kv_block_size", 16)
+        max_seq = serving.get("max_seq_len", 256)
+        nb = serving.get("kv_num_blocks")
+        impl = serving.get("attention_impl", "auto")
+        paged = impl != "dense"
+        ok_ints = (isinstance(bs, int) and not isinstance(bs, bool)
+                   and bs > 0 and isinstance(max_seq, int)
+                   and not isinstance(max_seq, bool) and max_seq > 0)
+        if paged and ok_ints:
+            if max_seq % bs != 0:
+                diags.append(RULES["DTL206"].diag(
+                    f"serving.kv_block_size={bs} does not divide "
+                    f"serving.max_seq_len={max_seq}: the paged block "
+                    "tables tile max_seq_len exactly; pick a block size "
+                    "that divides it"))
+            elif (isinstance(nb, int) and not isinstance(nb, bool)
+                  and nb > 0 and nb * bs < max_seq):
+                diags.append(RULES["DTL206"].diag(
+                    f"serving.kv_num_blocks={nb} x kv_block_size={bs} = "
+                    f"{nb * bs} tokens of paged KV pool cannot hold even "
+                    f"one max_seq_len={max_seq} sequence — no request "
+                    "could ever be admitted; raise kv_num_blocks or lower "
+                    "max_seq_len"))
+
     # DTL203 — restarts configured but nothing to restart from. Only an
     # EXPLICIT min_checkpoint_period: 0 fires (key present): the default is
     # also 0 batches and flagging every config would be pure noise.

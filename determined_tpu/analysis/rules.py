@@ -106,6 +106,13 @@ _ALL = [
          "batch sizes (compile.bucket_batch_sizes), sample fewer distinct "
          "shape values, or raise compile.max_executables if the compile "
          "cost is intended"),
+    Rule("DTL206", "serving-kv-geometry", "error", "config",
+         "a serving config's paged KV geometry is unusable: kv_block_size "
+         "must divide max_seq_len (the block tables tile max_seq_len "
+         "exactly), and an explicit kv_num_blocks must give the pool room "
+         "for at least one max_seq_len sequence — otherwise the replica "
+         "fails at engine startup (or requests can never be admitted) "
+         "instead of at config time"),
 ]
 
 RULES: Dict[str, Rule] = {r.code: r for r in _ALL}

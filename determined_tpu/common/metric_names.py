@@ -86,7 +86,12 @@ SERVE_METRICS: Dict[str, Tuple[str, str]] = {
     "det_serve_queue_depth": ("gauge", "Admission-queue depth"),
     "det_serve_active_requests": ("gauge", "Requests joined into the batch"),
     "det_serve_kv_blocks_free": ("gauge", "Free KV cache blocks"),
+    "det_serve_kv_blocks_used": ("gauge", "KV cache blocks held by "
+                                 "admitted sequences (paged layout)"),
     "det_serve_kv_blocks_total": ("gauge", "Total KV cache blocks"),
+    "det_serve_prefix_cache_hit_rate": (
+        "gauge", "Prompt tokens served from cached prefix blocks / prompt "
+        "tokens seen (docs/serving.md 'Paged KV & prefix caching')"),
     "det_serve_requests_total": ("counter", "Requests completed"),
     "det_serve_tokens_total": ("counter", "Tokens generated"),
     "det_serve_draining": ("gauge", "1 while draining, else 0"),

@@ -385,6 +385,7 @@ def _validate_serving(block: Any, errors: List[str]) -> None:
         return
     valid = {"checkpoint", "trial_id", "model", "model_config",
              "max_batch_size", "max_seq_len", "kv_block_size",
+             "kv_num_blocks", "prefix_cache", "attention_impl",
              "prefill_buckets", "queue_depth", "port", "seed",
              "stats_log_period_s", "replicas", "heartbeat_period_s"}
     unknown = sorted(set(block) - valid)
@@ -403,12 +404,21 @@ def _validate_serving(block: Any, errors: List[str]) -> None:
     if mc is not None and not isinstance(mc, dict):
         errors.append("serving.model_config must be a mapping")
     for key in ("max_batch_size", "max_seq_len", "kv_block_size",
-                "queue_depth"):
+                "kv_num_blocks", "queue_depth"):
         v = block.get(key)
         if v is not None and (
             isinstance(v, bool) or not isinstance(v, int) or v < 1
         ):
             errors.append(f"serving.{key} must be a positive int")
+    pc = block.get("prefix_cache")
+    if pc is not None and not isinstance(pc, bool):
+        errors.append("serving.prefix_cache must be a boolean")
+    impl = block.get("attention_impl")
+    if impl is not None and impl not in ("auto", "pallas", "reference",
+                                         "dense"):
+        errors.append(
+            "serving.attention_impl must be one of: auto, pallas, "
+            "reference, dense")
     for key in ("trial_id", "port", "seed"):
         v = block.get(key)
         if v is not None and (
@@ -705,6 +715,8 @@ def apply_defaults(config: Dict[str, Any]) -> Dict[str, Any]:
         s.setdefault("max_batch_size", 8)
         s.setdefault("max_seq_len", 256)
         s.setdefault("kv_block_size", 16)
+        s.setdefault("prefix_cache", True)
+        s.setdefault("attention_impl", "auto")
         s.setdefault("queue_depth", 64)
         if isinstance(s.get("replicas"), dict):
             rep = s["replicas"]

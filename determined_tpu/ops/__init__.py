@@ -6,4 +6,9 @@ code runs on CPU test meshes.
 """
 
 from determined_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from determined_tpu.ops.paged_attention import (  # noqa: F401
+    paged_attention_pallas,
+    paged_attention_reference,
+    paged_decode_attention,
+)
 from determined_tpu.ops.ring_attention import ring_attention  # noqa: F401

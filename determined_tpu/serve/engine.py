@@ -102,8 +102,36 @@ def _restore_raw(checkpoint_ctx, storage_id: str) -> Any:
     return ocp.StandardCheckpointer().restore(state_dir)
 
 
+def resolve_attention_impl(impl: str) -> str:
+    """serving.attention_impl → the engine's concrete path.
+
+    "auto" picks the Pallas kernel on TPU and the jnp gather reference
+    elsewhere (both paged); "pallas"/"reference"/"dense" force a path —
+    off-TPU the kernel runs through pallas interpret mode (tier-1)."""
+    import jax
+
+    if impl == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "reference"
+    if impl in ("pallas", "reference", "dense"):
+        return impl
+    raise ValueError(
+        f"unknown serving.attention_impl {impl!r}; "
+        "valid: auto, pallas, reference, dense")
+
+
 class ServingEngine:
-    """Compiled prefill/decode over a fixed slot batch + KV cache."""
+    """Compiled prefill/decode over a fixed slot batch + KV cache.
+
+    The cache is paged by default (docs/serving.md "Paged KV & prefix
+    caching"): a block pool `[L, num_blocks + 1, block_size, H, Dh]`
+    (the extra block is the trash block for padded/inactive writes) plus
+    per-slot block tables the batcher hands in at prefill. Every
+    executable takes the table as an input canonicalized to the full
+    `max_seq_len // block_size` length, so ONE decode executable and one
+    prefill executable per token bucket cover every table — joining,
+    retiring and prefix sharing never recompile. `attention_impl:
+    dense` keeps the legacy slot-dense lane layout for A/B benching.
+    """
 
     def __init__(
         self,
@@ -115,6 +143,9 @@ class ServingEngine:
         prefill_buckets: Optional[Sequence[int]] = None,
         rules: Optional[LogicalRules] = None,
         seed: int = 0,
+        attention_impl: str = "auto",
+        kv_block_size: int = 16,
+        kv_num_blocks: Optional[int] = None,
     ):
         import jax
 
@@ -129,16 +160,74 @@ class ServingEngine:
         self.prefill_buckets = buckets
         self.rules = rules or LogicalRules()
         self.params = jax.device_put(params)
-        self._cache = smodel.init_cache(cfg, slots, self.max_seq_len)
+        self.attention_impl = resolve_attention_impl(attention_impl)
+        self.paged = self.attention_impl != "dense"
+        self.block_size = int(kv_block_size)
+        self.num_blocks = int(kv_num_blocks) if kv_num_blocks else 0
+        self._check_geometry()
+        self._cache = None  # materialized at compile() (geometry may move)
+        self._tables = None  # host [slots, max_blocks] int32, paged only
         self._rng = jax.random.PRNGKey(seed)
         self._step_counter = 0
         self._compiled_decode = None
         self._compiled_prefill: Dict[int, Any] = {}
         self._compiled_sample = None
+        self._compiled_copy_block = None
         self.compile_stats: Dict[str, float] = {}
         # device-call counters (drained into /v1/stats)
         self.decode_steps = 0
         self.prefills = 0
+        self.block_copies = 0
+
+    # -- paged geometry ------------------------------------------------
+
+    def _check_geometry(self) -> None:
+        if not self.paged:
+            return
+        if self.max_seq_len % self.block_size != 0:
+            raise ValueError(
+                f"kv_block_size {self.block_size} must divide max_seq_len "
+                f"{self.max_seq_len} (preflight rule DTL206)")
+        if not self.num_blocks:
+            self.num_blocks = self.slots * (
+                self.max_seq_len // self.block_size)
+        # A pool smaller than one max_seq sequence is legal here (tests
+        # build tiny backpressure pools); configs are gated by DTL206,
+        # and the batcher rejects any request the pool can never cover.
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return self.max_seq_len // self.block_size
+
+    @property
+    def trash_block(self) -> int:
+        """Pool index of the write sink for padded/inactive lanes."""
+        return self.num_blocks
+
+    def set_block_geometry(self, block_size: int,
+                           num_blocks: int) -> None:
+        """Sync the device pool to an external BlockManager's geometry
+        (the batcher calls this before compile so the tables it hands
+        out index the real pool)."""
+        if not self.paged:
+            return
+        if (self._compiled_decode is not None
+                and (block_size != self.block_size
+                     or num_blocks != self.num_blocks)):
+            raise RuntimeError(
+                "engine already compiled with block geometry "
+                f"{self.num_blocks}x{self.block_size}; cannot switch to "
+                f"{num_blocks}x{block_size}")
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self._check_geometry()
+
+    def cache_hbm_bytes(self) -> int:
+        """HBM the KV cache occupies (the admission budget's anchor)."""
+        if self.paged:
+            return smodel.paged_cache_bytes(
+                self.cfg, self.num_blocks + 1, self.block_size)
+        return smodel.cache_bytes(self.cfg, self.slots, self.max_seq_len)
 
     # -- compilation ---------------------------------------------------
 
@@ -153,32 +242,71 @@ class ServingEngine:
 
         t_all = time.monotonic()
         cfg, rules = self.cfg, self.rules
+        if self._cache is None:
+            if self.paged:
+                self._cache = smodel.init_paged_cache(
+                    cfg, self.num_blocks + 1, self.block_size)
+                self._tables = np.full(
+                    (self.slots, self.max_blocks_per_seq),
+                    self.trash_block, np.int32)
+            else:
+                self._cache = smodel.init_cache(
+                    cfg, self.slots, self.max_seq_len)
         sds = jax.ShapeDtypeStruct
         cache_sd = jax.tree_util.tree_map(
             lambda x: sds(x.shape, x.dtype), self._cache)
         params_sd = jax.tree_util.tree_map(
             lambda x: sds(x.shape, x.dtype), self.params)
         i32, f32 = np.int32, np.float32
+        mb = self.max_blocks_per_seq
+        impl = self.attention_impl
 
         t0 = time.monotonic()
-        decode = jax.jit(
-            lambda p, c, t, pos: smodel.decode_step(p, c, t, pos, cfg, rules),
-            donate_argnums=(1,))
-        self._compiled_decode = decode.lower(
-            params_sd, cache_sd,
-            sds((self.slots,), i32), sds((self.slots,), i32)).compile()
+        if self.paged:
+            decode = jax.jit(
+                lambda p, c, t, pos, tbl: smodel.paged_decode_step(
+                    p, c, t, pos, tbl, cfg, rules, attention_impl=impl),
+                donate_argnums=(1,))
+            self._compiled_decode = decode.lower(
+                params_sd, cache_sd, sds((self.slots,), i32),
+                sds((self.slots,), i32), sds((self.slots, mb), i32)).compile()
+        else:
+            decode = jax.jit(
+                lambda p, c, t, pos: smodel.decode_step(
+                    p, c, t, pos, cfg, rules),
+                donate_argnums=(1,))
+            self._compiled_decode = decode.lower(
+                params_sd, cache_sd,
+                sds((self.slots,), i32), sds((self.slots,), i32)).compile()
         self.compile_stats["decode_s"] = round(time.monotonic() - t0, 3)
 
         for bucket in self.prefill_buckets:
             t0 = time.monotonic()
-            pf = jax.jit(
-                lambda p, c, t, ln, sl: smodel.prefill(
-                    p, c, t, ln, sl, cfg, rules),
-                donate_argnums=(1,))
-            self._compiled_prefill[bucket] = pf.lower(
-                params_sd, cache_sd, sds((bucket,), i32),
-                sds((), i32), sds((), i32)).compile()
+            if self.paged:
+                pf = jax.jit(
+                    lambda p, c, t, ln, pfx, tbl: smodel.paged_prefill(
+                        p, c, t, ln, pfx, tbl, cfg, rules),
+                    donate_argnums=(1,))
+                self._compiled_prefill[bucket] = pf.lower(
+                    params_sd, cache_sd, sds((bucket,), i32),
+                    sds((), i32), sds((), i32), sds((mb,), i32)).compile()
+            else:
+                pf = jax.jit(
+                    lambda p, c, t, ln, sl: smodel.prefill(
+                        p, c, t, ln, sl, cfg, rules),
+                    donate_argnums=(1,))
+                self._compiled_prefill[bucket] = pf.lower(
+                    params_sd, cache_sd, sds((bucket,), i32),
+                    sds((), i32), sds((), i32)).compile()
             self.compile_stats[f"prefill_{bucket}_s"] = round(
+                time.monotonic() - t0, 3)
+
+        if self.paged:
+            t0 = time.monotonic()
+            cp = jax.jit(smodel.copy_paged_block, donate_argnums=(0,))
+            self._compiled_copy_block = cp.lower(
+                cache_sd, sds((), i32), sds((), i32)).compile()
+            self.compile_stats["copy_block_s"] = round(
                 time.monotonic() - t0, 3)
 
         t0 = time.monotonic()
@@ -208,26 +336,86 @@ class ServingEngine:
         self._step_counter += 1
         return jax.random.fold_in(self._rng, self._step_counter)
 
+    def _default_table(self, slot: int, n_blocks: int) -> list:
+        """Static per-slot partition for direct engine use (no external
+        BlockManager): slot i owns pool blocks [i*mb, (i+1)*mb)."""
+        mb = self.max_blocks_per_seq
+        if (slot + 1) * mb > self.num_blocks:
+            raise ValueError(
+                f"pool of {self.num_blocks} blocks cannot statically "
+                f"partition slot {slot}; pass an explicit block_table")
+        return list(range(slot * mb, slot * mb + n_blocks))
+
+    def copy_block(self, src: int, dst: int) -> None:
+        """Copy-on-write device copy: pool block `src` → `dst` across all
+        layers (both K and V). The BlockManager decides WHEN (a shared
+        block is about to be written); this mirrors it on-device."""
+        if not self.paged:
+            raise RuntimeError("copy_block requires the paged cache")
+        if self._compiled_decode is None:
+            self.compile()
+        self._cache = self._compiled_copy_block(
+            self._cache, np.int32(dst), np.int32(src))
+        self.block_copies += 1
+
     def prefill_request(self, slot: int, tokens: np.ndarray,
-                        temperature: float = 0.0) -> int:
-        """Prefill `tokens` into cache lane `slot`; returns the first
-        generated token. Compiled-bucket dispatch by prompt length."""
+                        temperature: float = 0.0,
+                        block_table: Optional[Sequence[int]] = None,
+                        cached_len: int = 0) -> int:
+        """Prefill `tokens` into the slot's cache; returns the first
+        generated token. Compiled-bucket dispatch by NOVEL length: with
+        `cached_len > 0` (prefix-cache hit) only the suffix
+        `tokens[cached_len:]` runs through the model — the bucket, and
+        therefore the prefill cost, shrinks to the novel part."""
         if self._compiled_decode is None:
             self.compile()
         length = int(tokens.shape[0])
-        bucket = self.bucket_for(length)
+        if not self.paged:
+            if cached_len:
+                raise ValueError(
+                    "prefix caching requires the paged cache layout")
+            bucket = self.bucket_for(length)
+            if bucket is None:
+                raise ValueError(
+                    f"prompt length {length} exceeds the largest prefill "
+                    f"bucket ({self.prefill_buckets[-1]})")
+            padded = np.zeros((bucket,), np.int32)
+            padded[:length] = tokens
+            self._cache, logits = self._compiled_prefill[bucket](
+                self.params, self._cache, padded,
+                np.int32(length), np.int32(slot))
+            self.prefills += 1
+            return self._sample_first(logits, temperature)
+        if not 0 <= cached_len < length:
+            raise ValueError(
+                f"cached_len {cached_len} must leave >= 1 novel token "
+                f"of the {length}-token prompt")
+        mb = self.max_blocks_per_seq
+        if block_table is None:
+            # Direct engine use (no BlockManager): the slot's whole
+            # static partition, so decode can grow past the prompt.
+            block_table = self._default_table(slot, mb)
+        table = np.full((mb,), self.trash_block, np.int32)
+        table[:min(len(block_table), mb)] = list(block_table)[:mb]
+        suffix = np.asarray(tokens, np.int32)[cached_len:]
+        s_len = int(suffix.shape[0])
+        bucket = self.bucket_for(s_len)
         if bucket is None:
             raise ValueError(
-                f"prompt length {length} exceeds the largest prefill "
+                f"suffix length {s_len} exceeds the largest prefill "
                 f"bucket ({self.prefill_buckets[-1]})")
         padded = np.zeros((bucket,), np.int32)
-        padded[:length] = tokens
+        padded[:s_len] = suffix
         self._cache, logits = self._compiled_prefill[bucket](
             self.params, self._cache, padded,
-            np.int32(length), np.int32(slot))
+            np.int32(s_len), np.int32(cached_len), table)
+        self._tables[slot] = table
         self.prefills += 1
-        # Sample via the slot-wide compiled sampler (slot 0 carries the
-        # logits; the rest are padding lanes).
+        return self._sample_first(logits, temperature)
+
+    def _sample_first(self, logits, temperature: float) -> int:
+        """Sample via the slot-wide compiled sampler (slot 0 carries the
+        logits; the rest are padding lanes)."""
         batch = np.zeros((self.slots, self.cfg.vocab_size), np.float32)
         batch[0] = np.asarray(logits, np.float32)
         temps = np.zeros((self.slots,), np.float32)
@@ -235,14 +423,30 @@ class ServingEngine:
         toks = self._compiled_sample(batch, temps, self._next_rng())
         return int(np.asarray(toks)[0])
 
+    def release_slot(self, slot: int) -> None:
+        """Point a retired slot's table at the trash block so later
+        decode steps can never touch its (possibly reallocated) blocks."""
+        if self.paged and self._tables is not None:
+            self._tables[slot] = self.trash_block
+
     def decode(self, tokens: np.ndarray, positions: np.ndarray,
                temperatures: np.ndarray) -> np.ndarray:
-        """One decode step for all slots → sampled next tokens [slots]."""
+        """One decode step for all slots → sampled next tokens [slots].
+
+        Paged mode feeds the per-slot block tables recorded at prefill
+        (they only change at admission/CoW, both of which happen at step
+        boundaries in the batcher thread)."""
         if self._compiled_decode is None:
             self.compile()
-        self._cache, logits = self._compiled_decode(
-            self.params, self._cache,
-            np.asarray(tokens, np.int32), np.asarray(positions, np.int32))
+        if self.paged:
+            self._cache, logits = self._compiled_decode(
+                self.params, self._cache,
+                np.asarray(tokens, np.int32),
+                np.asarray(positions, np.int32), self._tables)
+        else:
+            self._cache, logits = self._compiled_decode(
+                self.params, self._cache,
+                np.asarray(tokens, np.int32), np.asarray(positions, np.int32))
         toks = self._compiled_sample(
             logits, np.asarray(temperatures, np.float32), self._next_rng())
         self.decode_steps += 1
@@ -253,7 +457,13 @@ class ServingEngine:
             "slots": self.slots,
             "max_seq_len": self.max_seq_len,
             "prefill_buckets": list(self.prefill_buckets),
+            "attention_impl": self.attention_impl,
+            "kv_layout": "paged" if self.paged else "dense",
+            "kv_block_size": self.block_size if self.paged else None,
+            "kv_num_blocks": self.num_blocks if self.paged else None,
+            "cache_hbm_bytes": self.cache_hbm_bytes(),
             "decode_steps": self.decode_steps,
             "prefills": self.prefills,
+            "block_copies": self.block_copies,
             "compile": dict(self.compile_stats),
         }

@@ -60,8 +60,13 @@ def prometheus_exposition(stats: Dict[str, Any]) -> str:
         f"det_serve_draining {1 if stats.get('draining') else 0}",
         "# TYPE det_serve_kv_blocks_free gauge",
         f"det_serve_kv_blocks_free {kv.get('free_blocks', 0)}",
+        "# TYPE det_serve_kv_blocks_used gauge",
+        f"det_serve_kv_blocks_used {kv.get('used_blocks', 0)}",
         "# TYPE det_serve_kv_blocks_total gauge",
         f"det_serve_kv_blocks_total {kv.get('num_blocks', 0)}",
+        "# TYPE det_serve_prefix_cache_hit_rate gauge",
+        "det_serve_prefix_cache_hit_rate "
+        f"{kv.get('prefix_cache_hit_rate', 0.0)}",
         "# TYPE det_serve_requests_total counter",
         f"det_serve_requests_total {stats.get('completed', 0)}",
         "# TYPE det_serve_tokens_total counter",

@@ -13,11 +13,22 @@ standard way:
     composition; the continuous batcher joins/retires sequences purely by
     editing host-side slot state.
 
-Cache layout is slot-dense: `[n_layer, slots, max_seq, heads, head_dim]`
-per K and V, stacked over layers exactly like the training params so both
-paths `lax.scan` the same block structure. Positions beyond a slot's
-current length hold stale bytes; the decode mask (`index <= position`)
-never admits a stale index before the step that overwrites it.
+Two cache layouts share this module (both stacked over layers exactly
+like the training params, so every path `lax.scan`s the same block
+structure):
+
+  - **paged** (the default; `init_paged_cache`/`paged_prefill`/
+    `paged_decode_step`): a block pool `[L, num_blocks + 1, block_size,
+    H, Dh]` addressed through per-sequence block tables — admission
+    bounds real HBM and prompt prefixes can be shared (docs/serving.md
+    "Paged KV & prefix caching");
+  - **slot-dense** (legacy, kept for A/B): `[L, slots, max_seq, H, Dh]`,
+    one private lane per slot.
+
+Positions beyond a sequence's current length hold stale bytes; the
+decode mask (`index <= position`) never admits a stale index before the
+step that overwrites it, and paged padded/inactive writes land in a
+dedicated trash block.
 
 Works for dense and MoE blocks (the MoE FFN routes per token, so a
 1-token decode step reuses ops/moe.moe_block unchanged). All functions are
@@ -221,6 +232,187 @@ def decode_step(
         body, x, (params["blocks"], cache["k"], cache["v"]))
     logits = _finish(params, x, cfg, rules)  # [slots, 1, V]
     return {"k": new_k, "v": new_v}, logits[:, 0].astype(jnp.float32)
+
+
+# ---------------------------------------------------------------- paged
+#
+# vLLM-style paged layout (docs/serving.md "Paged KV & prefix caching"):
+# the cache is a block pool `[L, pool_blocks, block_size, H, Dh]` and each
+# sequence owns an ordered block table mapping logical block i → a pool
+# block. The LAST pool block is the trash block: padded/inactive writes
+# land there so they can never corrupt an owned block, and inactive slots
+# point their whole table at it. Prefix caching falls out of the layout —
+# a shared prompt's blocks appear in many tables at once (refcounted by
+# the host BlockManager), and prefill only computes the novel suffix.
+
+
+def init_paged_cache(
+    cfg: Config, pool_blocks: int, block_size: int, dtype: Any = None
+) -> Dict[str, jax.Array]:
+    """Zeroed paged KV pool: {"k","v"}: [L, pool_blocks, bs, H, Dh].
+
+    `pool_blocks` INCLUDES the trailing trash block (callers size it as
+    `num_blocks + 1`)."""
+    dt = dtype or cfg.dtype
+    shape = (cfg.n_layer, pool_blocks, block_size, cfg.n_head, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def paged_cache_bytes(cfg: Config, pool_blocks: int, block_size: int,
+                      dtype: Any = None) -> int:
+    """HBM footprint of the paged pool (both K and V)."""
+    dt = jnp.dtype(dtype or cfg.dtype)
+    per = cfg.n_layer * pool_blocks * block_size * cfg.n_head * cfg.head_dim
+    return 2 * per * dt.itemsize
+
+
+def paged_prefill(
+    params: Dict[str, Any],
+    cache: Dict[str, jax.Array],
+    tokens: jax.Array,       # [bucket] int32: the NOVEL SUFFIX, right-padded
+    suffix_len: jax.Array,   # scalar int32: real suffix length (<= bucket)
+    prefix_len: jax.Array,   # scalar int32: tokens already cached (KV reuse)
+    block_table: jax.Array,  # [max_blocks] int32: the sequence's table
+    cfg: Config,
+    rules: Optional[LogicalRules] = None,
+) -> Tuple[Dict[str, jax.Array], jax.Array]:
+    """Prefill the suffix `tokens[prefix_len:]` of a prompt whose first
+    `prefix_len` tokens' K/V already sit in `block_table`'s blocks.
+
+    Returns (cache', last-position logits [vocab]). The suffix K/V are
+    scattered into the pool per the table, then the suffix queries attend
+    over the gathered lane (cached prefix + just-written suffix). With
+    `prefix_len == 0` this is a full prefill — same executable.
+    """
+    s = tokens.shape[0]
+    mb = block_table.shape[0]
+    bs = cache["k"].shape[2]
+    trash = cache["k"].shape[1] - 1
+    dt = cfg.dtype
+    x = _embed_tokens(params, tokens[None], cfg, rules, dt)
+    # Absolute positions prefix_len + i (clip keeps padded lanes in-table;
+    # their queries are garbage the `last` index never selects).
+    pos_ids = jnp.minimum(prefix_len + jnp.arange(s),
+                          params["wpe"].shape[0] - 1)
+    x = x + jnp.take(params["wpe"].astype(dt), pos_ids, axis=0)[None]
+    x = shard_logical(x, ("batch", "seq", "embed"), rules)
+    # Scatter destinations: real suffix positions land in their table
+    # block; padded positions land in the trash block.
+    dest_blk = jnp.where(jnp.arange(s) < suffix_len,
+                         block_table[jnp.minimum(pos_ids // bs, mb - 1)],
+                         trash)
+    dest_off = pos_ids % bs
+    # Causal mask over the gathered lane: key j visible to suffix query i
+    # iff j <= prefix_len + i (prefix + suffix written so far + self).
+    mask = jnp.arange(mb * bs)[None, :] <= (prefix_len + jnp.arange(s))[:, None]
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+
+    def body(carry, layer_in):
+        xx = carry
+        lp, k_pool, v_pool = layer_in
+        y = _layer_norm(xx, lp["ln1"]["scale"], lp["ln1"]["bias"],
+                        cfg.layer_norm_eps)
+        q, k, v = _qkv(y, lp, cfg)  # [1, S, H, Dh]
+        k_pool = k_pool.at[dest_blk, dest_off].set(k[0].astype(k_pool.dtype))
+        v_pool = v_pool.at[dest_blk, dest_off].set(v[0].astype(v_pool.dtype))
+        k_lane = k_pool[block_table].reshape(mb * bs, cfg.n_head,
+                                             cfg.head_dim)
+        v_lane = v_pool[block_table].reshape(mb * bs, cfg.n_head,
+                                             cfg.head_dim)
+        logits = jnp.einsum("bshd,mhd->bhsm", q, k_lane).astype(jnp.float32)
+        logits = jnp.where(mask[None, None], logits * scale,
+                           jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        attn = jnp.einsum("bhsm,mhd->bshd", probs, v_lane)
+        attn = attn.reshape(xx.shape)
+        attn = (jnp.einsum("bsd,de->bse", attn,
+                           lp["attn_out"]["kernel"].astype(dt))
+                + lp["attn_out"]["bias"].astype(dt))
+        xx = xx + attn
+        y = _layer_norm(xx, lp["ln2"]["scale"], lp["ln2"]["bias"],
+                        cfg.layer_norm_eps)
+        xx = xx + _mlp(y, lp, cfg, rules)
+        xx = shard_logical(xx, ("batch", "seq", "embed"), rules)
+        return xx, (k_pool, v_pool)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"]))
+    logits = _finish(params, x, cfg, rules)  # [1, S, V]
+    last = jax.lax.dynamic_index_in_dim(
+        logits[0], jnp.maximum(suffix_len - 1, 0), axis=0, keepdims=False)
+    return {"k": new_k, "v": new_v}, last.astype(jnp.float32)
+
+
+def paged_decode_step(
+    params: Dict[str, Any],
+    cache: Dict[str, jax.Array],
+    tokens: jax.Array,        # [slots] int32: last emitted token per slot
+    positions: jax.Array,     # [slots] int32: index this step writes at
+    block_tables: jax.Array,  # [slots, max_blocks] int32
+    cfg: Config,
+    rules: Optional[LogicalRules] = None,
+    attention_impl: str = "reference",
+) -> Tuple[Dict[str, jax.Array], jax.Array]:
+    """One paged decode step for every slot → (cache', logits [slots, V]).
+
+    The dense decode's lane write/attend becomes a block write (table
+    lookup of `position // block_size`) + a block-table-gathered
+    attention (ops/paged_attention). Inactive slots write the trash block
+    and attend garbage the batcher discards — zero recompiles to join or
+    retire, exactly like the dense path.
+    """
+    from determined_tpu.ops.paged_attention import paged_decode_attention
+
+    slots = tokens.shape[0]
+    bs = cache["k"].shape[2]
+    mb = block_tables.shape[1]
+    dt = cfg.dtype
+    x = _embed_tokens(params, tokens[:, None], cfg, rules, dt)  # [slots,1,D]
+    pos_emb = jnp.take(params["wpe"].astype(dt), positions, axis=0)
+    x = x + pos_emb[:, None]
+    x = shard_logical(x, ("batch", "seq", "embed"), rules)
+    wblk = jnp.take_along_axis(
+        block_tables, jnp.minimum(positions // bs, mb - 1)[:, None],
+        axis=1)[:, 0]  # [slots]
+    woff = positions % bs
+
+    def body(carry, layer_in):
+        xx = carry  # [slots, 1, D]
+        lp, k_pool, v_pool = layer_in
+        y = _layer_norm(xx, lp["ln1"]["scale"], lp["ln1"]["bias"],
+                        cfg.layer_norm_eps)
+        q, k, v = _qkv(y, lp, cfg)  # [slots, 1, H, Dh]
+        k_pool = k_pool.at[wblk, woff].set(k[:, 0].astype(k_pool.dtype))
+        v_pool = v_pool.at[wblk, woff].set(v[:, 0].astype(v_pool.dtype))
+        attn = paged_decode_attention(
+            q[:, 0], k_pool, v_pool, block_tables, positions,
+            impl=attention_impl)  # [slots, H, Dh]
+        attn = attn.reshape(slots, 1, -1)
+        attn = (jnp.einsum("bsd,de->bse", attn,
+                           lp["attn_out"]["kernel"].astype(dt))
+                + lp["attn_out"]["bias"].astype(dt))
+        xx = xx + attn
+        y = _layer_norm(xx, lp["ln2"]["scale"], lp["ln2"]["bias"],
+                        cfg.layer_norm_eps)
+        xx = xx + _mlp(y, lp, cfg, rules)
+        return xx, (k_pool, v_pool)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"]))
+    logits = _finish(params, x, cfg, rules)  # [slots, 1, V]
+    return {"k": new_k, "v": new_v}, logits[:, 0].astype(jnp.float32)
+
+
+def copy_paged_block(
+    cache: Dict[str, jax.Array], dst: jax.Array, src: jax.Array
+) -> Dict[str, jax.Array]:
+    """Copy-on-write: duplicate pool block `src` into `dst` across every
+    layer (both K and V). Used when a sequence must write into a block
+    whose content is shared with other sequences (prefix caching)."""
+    return {
+        "k": cache["k"].at[:, dst].set(cache["k"][:, src]),
+        "v": cache["v"].at[:, dst].set(cache["v"][:, src]),
+    }
 
 
 def sample(

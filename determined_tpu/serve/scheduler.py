@@ -210,11 +210,23 @@ class ContinuousBatcher:
         self.engine = engine
         self.queue = queue or AdmissionQueue()
         bm = block_manager
+        paged = getattr(engine, "paged", False)
         if bm is None:
-            # Pool sized to the cache: slots lanes of max_seq tokens.
-            bm = BlockManager(
-                num_blocks=engine.slots * max(
-                    1, engine.max_seq_len // 16), block_size=16)
+            if paged:
+                # Mirror the engine's device pool exactly: tables the
+                # manager hands out index real pool blocks.
+                bm = BlockManager(num_blocks=engine.num_blocks,
+                                  block_size=engine.block_size)
+            else:
+                # Dense layout: accounting-only pool sized to the cache
+                # (slots lanes of max_seq tokens).
+                bm = BlockManager(
+                    num_blocks=engine.slots * max(
+                        1, engine.max_seq_len // 16), block_size=16)
+        elif paged:
+            # An external manager defines the geometry; sync the device
+            # pool to it before compile() freezes the executables.
+            engine.set_block_geometry(bm.block_size, bm.num_blocks)
         self.blocks = bm
         self._idle_wait = idle_wait_s
         self._slots: List[Optional[_Slot]] = [None] * engine.slots
@@ -257,6 +269,13 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prompt + max_new_tokens = {req.total_budget} exceeds "
                 f"max_seq_len ({self.engine.max_seq_len})")
+        if self.blocks.blocks_for_tokens(req.total_budget) > \
+                self.blocks.num_blocks:
+            raise ValueError(
+                f"prompt + max_new_tokens = {req.total_budget} exceeds the "
+                f"KV pool ({self.blocks.num_blocks} x "
+                f"{self.blocks.block_size}-token blocks) — the request "
+                "could never be admitted")
         return self.queue.submit(req)
 
     def drain(self, timeout: Optional[float] = None) -> bool:
@@ -329,7 +348,12 @@ class ContinuousBatcher:
     def _admit(self) -> None:
         """Join queued requests at this step boundary while a free slot
         AND enough KV blocks exist (block exhaustion keeps the request
-        queued — backpressure, not failure)."""
+        queued — backpressure, not failure).
+
+        Paged engines admit through BlockManager.admit: a prompt whose
+        prefix is cached reuses those blocks (refcounted) and is charged
+        only its novel suffix — prefill then runs only that suffix."""
+        paged = getattr(self.engine, "paged", False)
         while True:
             free = [i for i, s in enumerate(self._slots) if s is None]
             if not free:
@@ -337,18 +361,38 @@ class ContinuousBatcher:
             req = self.queue.peek()
             if req is None:
                 return
-            blocks = self.blocks.allocate(req.id, req.total_budget)
-            if blocks is None:
-                return  # pool exhausted: wait for a retire
+            cached_len = 0
+            cow_pairs = ()
+            if paged:
+                admitted = self.blocks.admit(
+                    req.id, req.tokens.tolist(), req.total_budget)
+                if admitted is None:
+                    return  # pool exhausted: wait for a retire
+                table, cached_len, cow_pairs = admitted
+            else:
+                table = self.blocks.allocate(req.id, req.total_budget)
+                if table is None:
+                    return  # pool exhausted: wait for a retire
             popped = self.queue.pop()
             assert popped is req, "single-consumer queue invariant"
             slot_id = free[0]
             req.admitted_at = time.monotonic()
             try:
-                first = self.engine.prefill_request(
-                    slot_id, req.tokens, req.temperature)
+                # Device-side copy-on-write BEFORE any write can land in
+                # a block other sequences still reference.
+                for src, dst in cow_pairs:
+                    self.engine.copy_block(src, dst)
+                if paged:
+                    first = self.engine.prefill_request(
+                        slot_id, req.tokens, req.temperature,
+                        block_table=table, cached_len=cached_len)
+                else:
+                    first = self.engine.prefill_request(
+                        slot_id, req.tokens, req.temperature)
             except Exception as e:
-                self.blocks.free(req.id)
+                # discard=True: the blocks' K/V were never (fully)
+                # written; they must not linger in the prefix cache.
+                self.blocks.free(req.id, discard=True)
                 req._finish(f"prefill failed: {type(e).__name__}: {e}")
                 self.failed += 1
                 continue
@@ -399,6 +443,13 @@ class ContinuousBatcher:
         of the batch keeps decoding (no drain)."""
         if not admitted_only:
             self._slots[slot_id] = None
+        # Paged: the retired slot keeps riding the decode batch as an
+        # inactive lane (position 0); its table must point at the trash
+        # block so that lane's dead write can never land in a block the
+        # pool hands to the next sequence.
+        release = getattr(self.engine, "release_slot", None)
+        if release is not None:
+            release(slot_id)
         self.blocks.free(req.id)
         req._finish()
         with self._lock:
@@ -460,7 +511,9 @@ class ContinuousBatcher:
             "active": self.active_count(),
             "slots": self.engine.slots,
             "kv_blocks_free": kv.get("free_blocks", 0),
+            "kv_blocks_used": kv.get("used_blocks", 0),
             "kv_blocks_total": kv.get("num_blocks", 0),
+            "prefix_cache_hit_rate": kv.get("prefix_cache_hit_rate", 0.0),
             "draining": self.queue.draining,
             "retry_after_hint_s": self.retry_after_hint(),
         }

@@ -155,19 +155,29 @@ def build_replica(config: Dict[str, Any], session=None):
 
     slots = int(serving.get("max_batch_size", 8))
     max_seq = int(serving.get("max_seq_len", min(cfg.n_positions, 1024)))
+    block_size = int(serving.get("kv_block_size", 16))
+    num_blocks = serving.get("kv_num_blocks")
     engine = ServingEngine(
         params, cfg,
         slots=slots,
         max_seq_len=max_seq,
         prefill_buckets=serving.get("prefill_buckets"),
         seed=int(serving.get("seed", 0)),
+        attention_impl=str(serving.get("attention_impl", "auto")),
+        kv_block_size=block_size,
+        kv_num_blocks=int(num_blocks) if num_blocks else None,
     )
-    block_size = int(serving.get("kv_block_size", 16))
-    blocks = BlockManager(
-        num_blocks=slots * max(1, (engine.max_seq_len + block_size - 1)
-                               // block_size),
-        block_size=block_size,
-    )
+    if engine.paged:
+        # The device pool IS the budget: the manager mirrors it exactly.
+        blocks = BlockManager(
+            num_blocks=engine.num_blocks, block_size=engine.block_size,
+            prefix_cache=bool(serving.get("prefix_cache", True)))
+    else:
+        blocks = BlockManager(
+            num_blocks=slots * max(1, (engine.max_seq_len + block_size - 1)
+                                   // block_size),
+            block_size=block_size,
+        )
     queue = AdmissionQueue(maxsize=int(serving.get("queue_depth", 64)))
     batcher = ContinuousBatcher(engine, queue=queue, block_manager=blocks)
     return engine, batcher

@@ -23,6 +23,7 @@
 #include <iostream>
 
 #include "master.h"
+#include "preflight.h"
 
 namespace det {
 
@@ -387,6 +388,17 @@ HttpResponse Master::handle_deployments(
       return json_resp(400, err_body(
           "serving.replicas requires 1 <= min <= target <= max"));
     }
+    {
+      // Preflight gate (docs/preflight.md): DTL206 paged-KV geometry —
+      // a deployment spawning N replicas that all fail at engine startup
+      // is the expensive way to learn the block size is wrong.
+      Json pf = preflight_config(config);
+      if (preflight_should_fail(config, pf)) {
+        Json err = err_body("deployment rejected by preflight gate");
+        err["preflight"] = pf;
+        return json_resp(400, err);
+      }
+    }
     std::lock_guard<std::mutex> lock(mu_);
     DeploymentState dep;
     dep.id = "deploy-" + random_hex(4);
@@ -530,6 +542,10 @@ HttpResponse Master::handle_deployments(
         rj["queue_capacity"] = r.queue_capacity;
         rj["active"] = r.active;
         rj["slots"] = r.slots;
+        rj["kv_blocks_used"] = r.kv_blocks_used;
+        rj["kv_blocks_free"] = r.kv_blocks_free;
+        rj["kv_blocks_total"] = r.kv_blocks_total;
+        rj["prefix_cache_hit_rate"] = r.prefix_cache_hit_rate;
         rj["draining"] = r.draining;
         rj["inflight"] = r.inflight;
         rj["consecutive_failures"] =
@@ -585,7 +601,9 @@ HttpResponse Master::handle_serve_stats(const HttpRequest& req,
   r.active = body["active"].as_int(0);
   r.slots = std::max<int64_t>(1, body["slots"].as_int(1));
   r.kv_blocks_free = body["kv_blocks_free"].as_int(0);
+  r.kv_blocks_used = body["kv_blocks_used"].as_int(0);
   r.kv_blocks_total = body["kv_blocks_total"].as_int(0);
+  r.prefix_cache_hit_rate = body["prefix_cache_hit_rate"].as_double(0);
   r.draining = body["draining"].as_bool(false);
   r.retry_after_hint =
       std::max<int64_t>(1, body["retry_after_hint_s"].as_int(1));

@@ -19,6 +19,7 @@
 
 #include "../common/tls.h"
 #include "master.h"
+#include "preflight.h"
 
 namespace det {
 
@@ -579,6 +580,18 @@ HttpResponse Master::handle_ntsc(const HttpRequest& req,
     if (!ctx.ok()) return json_resp(401, err_body("unauthenticated"));
     if (!can_create(ctx, body["workspace_id"].as_int(1))) {
       return json_resp(403, err_body("viewer role cannot launch tasks"));
+    }
+    if (kind == "serving") {
+      // Preflight gate (docs/preflight.md): serving configs carry the
+      // paged-KV geometry rule (DTL206) — same gate semantics as
+      // experiment creation (400 only under `preflight: {gate: error}`
+      // with an unsuppressed error-level diagnostic).
+      Json pf = preflight_config(config);
+      if (preflight_should_fail(config, pf)) {
+        Json err = err_body("serving task rejected by preflight gate");
+        err["preflight"] = pf;
+        return json_resp(400, err);
+      }
     }
     std::lock_guard<std::mutex> lock(mu_);
     int64_t uid = ctx.uid;

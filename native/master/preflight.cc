@@ -316,6 +316,38 @@ Json preflight_config(const Json& config) {
     }
   }
 
+  // DTL206 — serving paged-KV geometry (docs/serving.md "Paged KV &
+  // prefix caching"): kv_block_size must divide max_seq_len, and an
+  // explicit kv_num_blocks must hold at least one worst-case sequence.
+  const Json& serving = config["serving"];
+  if (serving.is_object()) {
+    int64_t bs = serving["kv_block_size"].as_int(16);
+    int64_t max_seq = serving["max_seq_len"].as_int(256);
+    int64_t nb = serving["kv_num_blocks"].as_int(0);
+    const std::string impl = serving["attention_impl"].as_string("auto");
+    if (impl != "dense" && bs > 0 && max_seq > 0) {
+      if (max_seq % bs != 0) {
+        out.push_back(diag(
+            "DTL206", "error",
+            "serving.kv_block_size=" + std::to_string(bs) +
+                " does not divide serving.max_seq_len=" +
+                std::to_string(max_seq) +
+                ": the paged block tables tile max_seq_len exactly; pick "
+                "a block size that divides it"));
+      } else if (nb > 0 && nb * bs < max_seq) {
+        out.push_back(diag(
+            "DTL206", "error",
+            "serving.kv_num_blocks=" + std::to_string(nb) +
+                " x kv_block_size=" + std::to_string(bs) + " = " +
+                std::to_string(nb * bs) +
+                " tokens of paged KV pool cannot hold even one "
+                "max_seq_len=" + std::to_string(max_seq) +
+                " sequence — no request could ever be admitted; raise "
+                "kv_num_blocks or lower max_seq_len"));
+      }
+    }
+  }
+
   // DTL203 — restarts configured but nothing to restart from. Only an
   // EXPLICIT min_checkpoint_period: 0 fires (key present): the default is
   // also 0 batches and flagging every config would be pure noise.

@@ -566,6 +566,54 @@ static void test_preflight_shape_sweep() {
   CHECK(d3.as_array()[0]["suppressed"].as_bool(false));
 }
 
+static void test_preflight_serving_kv_geometry() {
+  // Serving config, block size does not divide max_seq -> DTL206 error.
+  Json cfg = Json::object();
+  Json serving = Json::object();
+  serving["checkpoint"] = "latest";
+  serving["kv_block_size"] = static_cast<int64_t>(24);
+  serving["max_seq_len"] = static_cast<int64_t>(256);
+  cfg["serving"] = serving;
+  Json d = det::preflight_config(cfg);
+  CHECK_EQ(d.as_array().size(), static_cast<size_t>(1));
+  CHECK_EQ(d.as_array()[0]["code"].as_string(), "DTL206");
+  CHECK_EQ(d.as_array()[0]["level"].as_string(), "error");
+
+  // Divides -> clean; too-small explicit pool -> DTL206.
+  cfg["serving"]["kv_block_size"] = static_cast<int64_t>(16);
+  CHECK(det::preflight_config(cfg).as_array().empty());
+  cfg["serving"]["kv_num_blocks"] = static_cast<int64_t>(8);  // 128 < 256
+  d = det::preflight_config(cfg);
+  CHECK_EQ(d.as_array().size(), static_cast<size_t>(1));
+  CHECK_EQ(d.as_array()[0]["code"].as_string(), "DTL206");
+
+  // Enough blocks -> clean. Dense layout -> geometry rules moot.
+  cfg["serving"]["kv_num_blocks"] = static_cast<int64_t>(16);  // 256
+  CHECK(det::preflight_config(cfg).as_array().empty());
+  cfg["serving"]["kv_num_blocks"] = static_cast<int64_t>(8);
+  cfg["serving"]["kv_block_size"] = static_cast<int64_t>(24);
+  cfg["serving"]["attention_impl"] = "dense";
+  CHECK(det::preflight_config(cfg).as_array().empty());
+
+  // Defaults (no explicit keys) never fire: 16 divides 256.
+  Json clean = Json::object();
+  clean["serving"] = Json::object();
+  CHECK(det::preflight_config(clean).as_array().empty());
+
+  // Suppressible like every rule.
+  cfg["serving"]["attention_impl"] = "auto";
+  Json pf = Json::object();
+  pf["gate"] = "error";
+  Json sup = Json::array();
+  sup.push_back(Json("DTL206"));
+  pf["suppress"] = sup;
+  cfg["preflight"] = pf;
+  d = det::preflight_config(cfg);
+  CHECK_EQ(d.as_array().size(), static_cast<size_t>(1));
+  CHECK(d.as_array()[0]["suppressed"].as_bool(false));
+  CHECK(!det::preflight_should_fail(cfg, d));
+}
+
 static void test_preflight_suppress_and_gate() {
   Json cfg = preflight_base_config();
   cfg["hyperparameters"]["global_batch_size"] = static_cast<int64_t>(30);
@@ -622,6 +670,7 @@ int main() {
       {"preflight_restarts_without_checkpoints",
        test_preflight_restarts_without_checkpoints},
       {"preflight_shape_sweep", test_preflight_shape_sweep},
+      {"preflight_serving_kv_geometry", test_preflight_serving_kv_geometry},
       {"preflight_suppress_and_gate", test_preflight_suppress_and_gate},
   };
   for (auto& t : tests) {

@@ -349,6 +349,24 @@ class TestServingBlock:
         errs = expconf.validate(self._config(prefill_buckets=[]))
         assert any("prefill_buckets" in e for e in errs)
 
+    def test_paged_kv_knobs_validate_and_default(self):
+        # Defaults: paged layout with prefix caching on, impl auto.
+        c = expconf.check(self._config())
+        assert c["serving"]["prefix_cache"] is True
+        assert c["serving"]["attention_impl"] == "auto"
+        assert "kv_num_blocks" not in c["serving"]  # derived, not defaulted
+        # Valid explicit values pass.
+        assert expconf.validate(self._config(
+            attention_impl="pallas", prefix_cache=False,
+            kv_num_blocks=128)) == []
+        # Bad values are rejected.
+        errs = expconf.validate(self._config(attention_impl="flash"))
+        assert any("attention_impl" in e for e in errs)
+        errs = expconf.validate(self._config(prefix_cache="yes"))
+        assert any("prefix_cache" in e for e in errs)
+        errs = expconf.validate(self._config(kv_num_blocks=0))
+        assert any("kv_num_blocks" in e for e in errs)
+
     def test_serving_must_be_mapping(self):
         errs = expconf.validate({"name": "x", "serving": "yes"})
         assert any("serving must be a mapping" in e for e in errs)

@@ -396,6 +396,44 @@ class TestConfigRules:
         c = _config(min_checkpoint_period={"batches": 0})
         assert codes(check_config(c)) == ["DTL203"]
 
+    def test_dtl206_block_size_must_divide_max_seq(self):
+        c = {"serving": {"checkpoint": "latest", "kv_block_size": 24,
+                         "max_seq_len": 256}}
+        diags = check_config(c)
+        assert codes(diags) == ["DTL206"]
+        assert diags[0].level == "error"
+        c["serving"]["kv_block_size"] = 16
+        assert check_config(c) == []
+
+    def test_dtl206_pool_must_hold_one_sequence(self):
+        c = {"serving": {"checkpoint": "latest", "kv_block_size": 16,
+                         "max_seq_len": 256, "kv_num_blocks": 8}}  # 128 tok
+        assert codes(check_config(c)) == ["DTL206"]
+        c["serving"]["kv_num_blocks"] = 16  # exactly one sequence
+        assert check_config(c) == []
+        # Derived pool (no explicit kv_num_blocks) can never underrun.
+        del c["serving"]["kv_num_blocks"]
+        assert check_config(c) == []
+
+    def test_dtl206_negative(self):
+        # Defaults (16 | 256) are clean; dense layout is exempt — the
+        # dense cache has no block tables to tile.
+        assert check_config({"serving": {"checkpoint": "latest"}}) == []
+        c = {"serving": {"checkpoint": "latest", "kv_block_size": 24,
+                         "max_seq_len": 256, "attention_impl": "dense"}}
+        assert check_config(c) == []
+        # Non-serving configs never fire it.
+        assert "DTL206" not in codes(check_config(_config()))
+
+    def test_dtl206_suppressible(self):
+        from determined_tpu.analysis import filter_suppressed
+
+        c = {"serving": {"checkpoint": "latest", "kv_block_size": 24,
+                         "max_seq_len": 256}}
+        diags = filter_suppressed(check_config(c), ["DTL206"])
+        assert [d.code for d in diags] == ["DTL206"]
+        assert diags[0].suppressed
+
     def test_dtl203_negative(self):
         # absent key: the default is also 0 batches, but only an EXPLICIT
         # zero is flagged (otherwise every config would warn)
