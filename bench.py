@@ -633,7 +633,7 @@ def serve_bench() -> None:
         loaded = load_checkpoint_params(ctx.checkpoint, "trial0-step1")
         ctx.close()
 
-    def run(n_slots):
+    def run(n_slots, tracing=False):
         engine = ServingEngine(
             loaded, cfg, slots=n_slots, max_seq_len=max_seq,
             prefill_buckets=buckets)
@@ -642,6 +642,16 @@ def serve_bench() -> None:
             block_manager=BlockManager(
                 num_blocks=n_slots * (max_seq // 16), block_size=16),
             idle_wait_s=0.002)
+        tracer = None
+        if tracing:
+            # The production request tracer with its shipper thread
+            # running (local sink: no master in this bench, the span
+            # build + buffer cost is what's being measured).
+            from determined_tpu.serve.tracing import RequestTracer
+
+            tracer = RequestTracer(None, "", sample=1.0,
+                                   flush_period_s=0.5).start()
+            batcher.tracer = tracer
         batcher.start()  # compiles AOT; excluded from the timed window
         try:
             t0 = time.time()
@@ -659,9 +669,13 @@ def serve_bench() -> None:
                                    int(len(lats) * 0.99))],
                 "mean_occupancy": stats["mean_occupancy"],
                 "compile": engine.compile_stats,
+                "latency": stats["latency"],
+                "spans_recorded": tracer.recorded if tracer else 0,
             }
         finally:
             batcher.stop()
+            if tracer is not None:
+                tracer.stop()
 
     seq = run(1)        # sequential baseline: one slot = no batching
     cont = run(slots)   # continuous batching
@@ -827,6 +841,44 @@ def serve_bench() -> None:
             "off_blocks_allocated": pfx_off["kv"]["total_allocated"],
             "on_p99_ms": round(pfx_on["p99_ms"], 1),
             "off_p99_ms": round(pfx_off["p99_ms"], 1),
+        },
+    }))
+
+    # ---- request tracing on/off A/B (ISSUE-12; docs/serving.md "Request
+    # latency & SLOs"). Same burst through the 8-slot batcher with the
+    # RequestTracer attached (sample=1.0, shipper thread live) vs without;
+    # interleaved best-of-2 per arm debiases cache warmth. Gate: tracing
+    # costs < 1% tokens/s — span trees are retire-time buffer appends, so
+    # steady-state decode executes zero tracing code. The traced arm also
+    # yields the TTFT/TPOT/e2e histograms recorded in BENCH.md.
+    t_off = [run(slots, tracing=False)]
+    t_on = [run(slots, tracing=True)]
+    t_off.append(run(slots, tracing=False))
+    t_on.append(run(slots, tracing=True))
+    best_off = max(t_off, key=lambda r: r["tokens_per_s"])
+    best_on = max(t_on, key=lambda r: r["tokens_per_s"])
+    overhead_pct = (1.0 - best_on["tokens_per_s"]
+                    / best_off["tokens_per_s"]) * 100.0
+    lat = best_on["latency"]
+    print(json.dumps({
+        "metric": "serve_trace_overhead_pct",
+        "value": round(overhead_pct, 2),
+        "unit": "% tokens/s lost with request tracing on "
+                "(gate < 1%; negative = within noise)",
+        "vs_baseline": round(
+            best_on["tokens_per_s"] / best_off["tokens_per_s"], 4),
+        "detail": {
+            "gate_passed": overhead_pct < 1.0,
+            "on_tokens_per_s": round(best_on["tokens_per_s"], 1),
+            "off_tokens_per_s": round(best_off["tokens_per_s"], 1),
+            "spans_recorded": best_on["spans_recorded"],
+            "ttft_p50_ms": lat["ttft"]["p50_ms"],
+            "ttft_p99_ms": lat["ttft"]["p99_ms"],
+            "tpot_p50_ms": lat["tpot"]["p50_ms"],
+            "tpot_p99_ms": lat["tpot"]["p99_ms"],
+            "e2e_p50_ms": lat["e2e"]["p50_ms"],
+            "e2e_p99_ms": lat["e2e"]["p99_ms"],
+            "queue_wait_p99_ms": lat["queue_wait"]["p99_ms"],
         },
     }))
 

@@ -37,13 +37,15 @@ METRIC_SOURCES = [
     "determined_tpu/serve/http.py",
 ]
 
-# Everything that emits lifecycle spans.
+# Everything that emits lifecycle or request spans.
 SPAN_SOURCES = [
     "native/master/master_experiments.cc",
     "native/master/master_agents.cc",
+    "native/master/master_deployments.cc",
     "native/agent/main.cc",
     "determined_tpu/train/trainer.py",
     "determined_tpu/core/_checkpoint.py",
+    "determined_tpu/serve/tracing.py",
 ]
 
 _STRING_RE = re.compile(r'"((?:[^"\\\n]|\\.)*)"')

@@ -529,6 +529,15 @@ def cmd_serve(session, args) -> int:
         deployments = session.get(
             "/api/v1/deployments").get("deployments", [])
         if deployments:
+
+            def _pp(d, key):
+                """'p50/p99 ms' from the aggregated latency summary —
+                fresh-heartbeat merged across the replica set."""
+                h = (d.get("latency") or {}).get(key) or {}
+                if not h.get("count"):
+                    return "-"
+                return f"{h['p50_ms']:.0f}/{h['p99_ms']:.0f}"
+
             _print_table(
                 [
                     {
@@ -540,10 +549,16 @@ def cmd_serve(session, args) -> int:
                         "range": (f"[{d.get('min_replicas')}, "
                                   f"{d.get('max_replicas')}]"),
                         "load": round(d.get("smoothed_load") or 0.0, 3),
+                        "ttft_ms": _pp(d, "ttft"),
+                        "tpot_ms": _pp(d, "tpot"),
+                        "e2e_ms": _pp(d, "e2e"),
                     }
                     for d in deployments
                 ],
-                ["id", "name", "state", "replicas", "range", "load"])
+                ["id", "name", "state", "replicas", "range", "load",
+                 "ttft_ms", "tpot_ms", "e2e_ms"])
+            print("  (latency columns are p50/p99 ms over fresh replica "
+                  "heartbeats)")
         resp = session.get("/api/v1/serving")
         rows = [
             {
@@ -567,6 +582,26 @@ def cmd_serve(session, args) -> int:
                             body={"target": n})
         print(f"deployment {resp.get('id', dep)} target -> "
               f"{resp.get('target', n)}")
+        return 0
+    if target == "trace":
+        # `det serve trace <deployment> <request-id>` — the request's
+        # router→replica span tree as the same text waterfall `det trial
+        # trace` renders (docs/observability.md "Request spans").
+        if len(args.extra) != 2:
+            raise SystemExit(
+                "usage: det serve trace <deployment> <request-id>")
+        from determined_tpu.common.trace import render_waterfall
+
+        dep, rid = args.extra
+        resp = session.get(
+            f"/api/v1/deployments/{dep}/requests/{rid}/trace")
+        spans = resp.get("spans", [])
+        if getattr(args, "json", False):
+            print(json.dumps(spans, indent=2))
+            return 0
+        print(f"request {rid} on {resp.get('deployment_id', dep)} — "
+              f"{len(spans)} span(s)")
+        print(render_waterfall(spans))
         return 0
     if target == "kill":
         if not args.extra:
@@ -1111,14 +1146,18 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument(
         "target",
         help="serving config file to launch, or 'status' / 'scale' / "
-             "'kill'")
+             "'kill' / 'trace'")
     sv.add_argument(
         "extra", nargs="*",
-        help="context dir (launch), task/deployment id (status/kill), or "
-             "<deployment-id> <target> (scale)")
+        help="context dir (launch), task/deployment id (status/kill), "
+             "<deployment-id> <target> (scale), or "
+             "<deployment> <request-id> (trace)")
     sv.add_argument(
         "--local", action="store_true",
         help="run the replica in-process against local storage (no master)")
+    sv.add_argument(
+        "--json", action="store_true",
+        help="raw span JSON instead of the waterfall (trace)")
     sv.set_defaults(func=cmd_serve)
 
     pf = sub.add_parser(

@@ -67,6 +67,16 @@ MASTER_METRICS: Dict[str, Tuple[str, str]] = {
                    "connection refusal"),
     "det_serve_router_ejections_total": (
         "counter", "Replica circuit-breaker ejections by the serve router"),
+    "det_serve_request_seconds": (
+        "histogram", "End-to-end serving request latency per deployment, "
+        "merged from fresh replica heartbeats (docs/serving.md 'Request "
+        "latency & SLOs')"),
+    "det_request_spans_ingested_total": (
+        "counter", "Serving request spans accepted by "
+        "POST /allocations/{id}/request_spans"),
+    "det_serve_slo_breaches_total": (
+        "counter", "Routed generations whose wall time exceeded the "
+        "deployment's serving.slo_ms"),
     "det_api_requests_total": ("counter", "API requests by status code"),
     "det_api_request_seconds": (
         "histogram", "API request latency by route family"),
@@ -95,6 +105,17 @@ SERVE_METRICS: Dict[str, Tuple[str, str]] = {
     "det_serve_requests_total": ("counter", "Requests completed"),
     "det_serve_tokens_total": ("counter", "Tokens generated"),
     "det_serve_draining": ("gauge", "1 while draining, else 0"),
+    # Token-latency SLO histograms (docs/serving.md "Request latency &
+    # SLOs") — also on the replica heartbeat, aggregated per deployment.
+    "det_serve_ttft_seconds": (
+        "histogram", "Submit to first generated token, per request"),
+    "det_serve_tpot_seconds": (
+        "histogram", "Mean inter-token interval per request "
+        "(time-per-output-token)"),
+    "det_serve_e2e_seconds": (
+        "histogram", "Submit to final token, per request"),
+    "det_serve_queue_wait_seconds": (
+        "histogram", "Submit to batch admission, per request"),
 }
 
 # span name -> (emitting component, help)
@@ -129,6 +150,22 @@ SPAN_NAMES: Dict[str, Tuple[str, str]] = {
         "harness", "Deadline-budgeted emergency checkpoint on preemption"),
     "harness.resize.downtime": (
         "harness", "Resize signal to first post-resize readiness"),
+    # Serving request-path spans (docs/observability.md "Request spans"):
+    # one trace per served request, trace id == X-Request-Id.
+    "serve.request": (
+        "serve", "Root span: request submit to finish on the replica "
+        "(span_id == request id)"),
+    "serve.queue_wait": (
+        "serve", "Admission-queue wait: submit to batch join"),
+    "serve.prefill": (
+        "serve", "Prompt prefill; bucket/suffix_len/prefix_cache_hit/"
+        "blocks in attrs"),
+    "serve.decode": (
+        "serve", "Token generation: first token to finish; tokens/steps/"
+        "occupancy_at_admit in attrs"),
+    "serve.router.dispatch": (
+        "master", "One router forward attempt: replica chosen, retries, "
+        "breaker state in attrs (a retried request shows two)"),
 }
 
 _METRIC_RE = re.compile(r"^det(_[a-z0-9]+)+$")

@@ -387,7 +387,8 @@ def _validate_serving(block: Any, errors: List[str]) -> None:
              "max_batch_size", "max_seq_len", "kv_block_size",
              "kv_num_blocks", "prefix_cache", "attention_impl",
              "prefill_buckets", "queue_depth", "port", "seed",
-             "stats_log_period_s", "replicas", "heartbeat_period_s"}
+             "stats_log_period_s", "replicas", "heartbeat_period_s",
+             "trace_sample", "slo_ms"}
     unknown = sorted(set(block) - valid)
     if unknown:
         errors.append(
@@ -440,6 +441,21 @@ def _validate_serving(block: Any, errors: List[str]) -> None:
         isinstance(hb, bool) or not isinstance(hb, (int, float)) or hb <= 0
     ):
         errors.append("serving.heartbeat_period_s must be a positive number")
+    # Request-path observability (docs/serving.md "Request latency &
+    # SLOs"): span sampling fraction + the latency SLO that arms the
+    # always-trace-slow path and the master's slow-request ring.
+    ts = block.get("trace_sample")
+    if ts is not None and (
+        isinstance(ts, bool) or not isinstance(ts, (int, float))
+        or not 0 <= ts <= 1
+    ):
+        errors.append("serving.trace_sample must be a number in [0, 1]")
+    slo = block.get("slo_ms")
+    if slo is not None and (
+        isinstance(slo, bool) or not isinstance(slo, (int, float))
+        or slo <= 0
+    ):
+        errors.append("serving.slo_ms must be a positive number")
     _validate_serving_replicas(block.get("replicas"), errors)
 
 

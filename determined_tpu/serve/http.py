@@ -47,9 +47,28 @@ logger = logging.getLogger("determined_tpu.serve")
 DEFAULT_REQUEST_TIMEOUT_S = 120.0
 
 
-def prometheus_exposition(stats: Dict[str, Any]) -> str:
+def _hist_exposition(name: str, wire: Dict[str, Any]) -> list:
+    """One histogram in Prometheus text format from the LatencyHist wire
+    form (cumulative counts + le boundaries)."""
+    lines = [f"# TYPE {name} histogram"]
+    les = wire.get("le") or []
+    counts = wire.get("counts") or []
+    for le, c in zip(les, counts):
+        lines.append(f'{name}_bucket{{le="{le}"}} {c}')
+    lines.append(f'{name}_bucket{{le="+Inf"}} {wire.get("count", 0)}')
+    lines.append(f"{name}_sum {wire.get('sum', 0.0)}")
+    lines.append(f"{name}_count {wire.get('count', 0)}")
+    return lines
+
+
+def prometheus_exposition(stats: Dict[str, Any],
+                          latency_wire: Optional[Dict[str, Any]] = None
+                          ) -> str:
     """Fold ContinuousBatcher.stats() into Prometheus text format (names
-    registered in common/metric_names.py SERVE_METRICS)."""
+    registered in common/metric_names.py SERVE_METRICS). `latency_wire`
+    is the heartbeat-form histogram dict ({ttft,tpot,e2e,queue_wait} →
+    le/counts/sum/count) — the TTFT/TPOT/e2e/queue-wait SLO histograms of
+    docs/serving.md "Request latency & SLOs"."""
     kv = stats.get("kv_blocks", {}) or {}
     lines = [
         "# TYPE det_serve_queue_depth gauge",
@@ -72,6 +91,14 @@ def prometheus_exposition(stats: Dict[str, Any]) -> str:
         "# TYPE det_serve_tokens_total counter",
         f"det_serve_tokens_total {stats.get('generated_tokens', 0)}",
     ]
+    if latency_wire:
+        for name, key in (
+            ("det_serve_ttft_seconds", "ttft"),
+            ("det_serve_tpot_seconds", "tpot"),
+            ("det_serve_e2e_seconds", "e2e"),
+            ("det_serve_queue_wait_seconds", "queue_wait"),
+        ):
+            lines.extend(_hist_exposition(name, latency_wire.get(key) or {}))
     return "\n".join(lines) + "\n"
 
 
@@ -106,7 +133,9 @@ def _make_handler(batcher: ContinuousBatcher):
                 self._send(200, stats)
                 return
             if self.path == "/metrics":
-                data = prometheus_exposition(batcher.stats()).encode()
+                latency = batcher.heartbeat_stats().get("latency")
+                data = prometheus_exposition(
+                    batcher.stats(), latency_wire=latency).encode()
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "text/plain; version=0.0.4")
@@ -120,6 +149,12 @@ def _make_handler(batcher: ContinuousBatcher):
             if self.path != "/v1/generate":
                 self._send(404, {"error": "not found"})
                 return
+            # X-Request-Id names the request's trace: the master router
+            # mints one per routed request (accepting a caller-supplied
+            # id) and the replica's span tree rides it, so
+            # `det serve trace <deployment> <request-id>` finds the whole
+            # router→replica tree under one id.
+            rid = (self.headers.get("X-Request-Id") or "").strip() or None
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
@@ -128,6 +163,7 @@ def _make_handler(batcher: ContinuousBatcher):
                     max_new_tokens=int(body.get("max_new_tokens", 16)),
                     temperature=float(body.get("temperature", 0.0)),
                     eos_id=body.get("eos_id"),
+                    request_id=rid,
                 )
                 timeout = float(
                     body.get("timeout_s", DEFAULT_REQUEST_TIMEOUT_S))
@@ -150,12 +186,14 @@ def _make_handler(batcher: ContinuousBatcher):
             except ValueError as e:
                 self._send(400, {"error": str(e)})
                 return
+            rid_hdr = {"X-Request-Id": req.id}
             try:
-                self._send(200, req.result(timeout))
+                self._send(200, req.result(timeout), rid_hdr)
             except TimeoutError:
-                self._send(504, {"error": "request timed out", "id": req.id})
+                self._send(504, {"error": "request timed out",
+                                 "id": req.id}, rid_hdr)
             except RuntimeError as e:
-                self._send(500, {"error": str(e), "id": req.id})
+                self._send(500, {"error": str(e), "id": req.id}, rid_hdr)
 
     return Handler
 

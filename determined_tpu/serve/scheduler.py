@@ -23,6 +23,14 @@ The batching contract (Orca/vLLM-style continuous batching):
 
 Chaos: `serving.request.drop` fires in submit() (docs/chaos.md) — drop
 sheds the request as if the queue were full; error fails the submit.
+
+Observability (docs/serving.md "Request latency & SLOs"): every request
+records wall-clock phase timestamps (submitted → admitted → prefill →
+first token → finished) so retire can fold it into the token-latency
+histograms — TTFT, TPOT (inter-token), e2e, queue wait — and hand it to
+an attached RequestTracer (serve/tracing.py) for the per-request span
+tree. Both are retire-time work: the decode loop itself never touches a
+clock beyond the per-step timestamps it already takes.
 """
 
 from __future__ import annotations
@@ -44,6 +52,78 @@ logger = logging.getLogger("determined_tpu.serve")
 FAULT_POINT_DROP = "serving.request.drop"
 
 _req_counter = itertools.count()
+
+
+def now_us() -> int:
+    """Wall-clock epoch microseconds — the span time domain shared with
+    the master router's dispatch spans (common/trace.py now_us)."""
+    return int(time.time() * 1e6)
+
+
+# Shared bucket boundaries (seconds) for every serving latency histogram.
+# The replica heartbeat ships them with the counts, so the master's
+# aggregation and `det_serve_request_seconds` exposition can never drift
+# from the replica's binning.
+LATENCY_BUCKETS_S = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+class LatencyHist:
+    """Fixed-bucket latency histogram (cumulative counts, Prometheus `le`
+    semantics — the Python twin of the master's Hist struct)."""
+
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets=LATENCY_BUCKETS_S):
+        self.buckets = tuple(buckets)
+        self.counts = [0] * len(self.buckets)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, seconds: float) -> None:
+        seconds = max(0.0, float(seconds))
+        for i, le in enumerate(self.buckets):
+            if seconds <= le:
+                self.counts[i] += 1
+        self.sum += seconds
+        self.count += 1
+
+    def percentile(self, q: float) -> float:
+        """Quantile estimate in seconds, linearly interpolated inside the
+        winning bucket (histogram_quantile style). 0 when empty; the last
+        boundary when the quantile lands in the +Inf bucket."""
+        if self.count <= 0:
+            return 0.0
+        target = q * self.count
+        prev_le, prev_c = 0.0, 0
+        for le, c in zip(self.buckets, self.counts):
+            if c >= target:
+                span = c - prev_c
+                frac = (target - prev_c) / span if span > 0 else 1.0
+                return prev_le + (le - prev_le) * frac
+            prev_le, prev_c = le, c
+        return self.buckets[-1]
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "count": self.count,
+            "mean_ms": round(self.sum / self.count * 1e3, 3)
+            if self.count else 0.0,
+            "p50_ms": round(self.percentile(0.5) * 1e3, 3),
+            "p99_ms": round(self.percentile(0.99) * 1e3, 3),
+        }
+
+    def to_wire(self) -> Dict[str, Any]:
+        """Heartbeat form: boundaries + cumulative counts, mergeable
+        master-side by summing counts across replicas."""
+        return {
+            "le": list(self.buckets),
+            "counts": list(self.counts),
+            "sum": round(self.sum, 6),
+            "count": self.count,
+        }
 
 
 class QueueFull(RuntimeError):
@@ -80,16 +160,40 @@ class Request:
         self.finished_at: Optional[float] = None
         self.error: Optional[str] = None
         self._done = threading.Event()
+        # Wall-clock phase stamps (epoch µs, the span time domain): set by
+        # the batcher as the request moves submit → admit → prefill →
+        # first token → finish. Consumed at retire by the latency
+        # histograms and the RequestTracer's span tree.
+        self.submitted_us = now_us()
+        self.admitted_us = 0
+        self.prefill_start_us = 0
+        self.prefill_end_us = 0
+        self.first_token_us = 0
+        self.finished_us = 0
+        # Trace attributes recorded at admission (serve.prefill /
+        # serve.decode span attrs).
+        self.bucket = 0               # prefill bucket chosen (suffix len)
+        self.cached_len = 0           # prefix-cache hit depth in tokens
+        self.blocks_allocated = 0     # KV blocks charged at admission
+        self.occupancy_at_admit = 0   # active slots when this one joined
+        self.decode_steps = 0         # decode steps this request rode
 
     @property
     def total_budget(self) -> int:
         """Worst-case KV footprint in tokens (prompt + every new token)."""
         return int(self.tokens.size) + self.max_new_tokens
 
-    def _finish(self, error: Optional[str] = None) -> None:
+    def _finish(self, error: Optional[str] = None,
+                notify: bool = True) -> None:
         self.error = error
         self.finished_at = time.monotonic()
-        self._done.set()
+        self.finished_us = now_us()
+        # notify=False lets the batcher observe latency + spans BEFORE
+        # waiters wake: by the time the HTTP response leaves, the
+        # request's trace and histogram entries exist (tests and the
+        # drain's final flush rely on that ordering).
+        if notify:
+            self._done.set()
 
     def done(self) -> bool:
         return self._done.is_set()
@@ -103,13 +207,21 @@ class Request:
         latency_ms = (self.finished_at - self.submitted_at) * 1e3
         queue_ms = ((self.admitted_at or self.finished_at)
                     - self.submitted_at) * 1e3
-        return {
+        out = {
             "id": self.id,
             "tokens": list(self.out_tokens),
             "prompt_tokens": int(self.tokens.size),
             "latency_ms": round(latency_ms, 3),
             "queue_ms": round(queue_ms, 3),
         }
+        if self.first_token_us:
+            out["ttft_ms"] = round(
+                (self.first_token_us - self.submitted_us) / 1e3, 3)
+            if len(self.out_tokens) > 1 and self.finished_us:
+                out["tpot_ms"] = round(
+                    (self.finished_us - self.first_token_us) / 1e3
+                    / (len(self.out_tokens) - 1), 3)
+        return out
 
 
 class AdmissionQueue:
@@ -246,6 +358,16 @@ class ContinuousBatcher:
         # the computed Retry-After hint (429s carry an actionable backoff
         # instead of a bare "1"; the master router propagates it).
         self._service_s_ewma = 0.0
+        # Token-latency SLO histograms (docs/serving.md "Request latency
+        # & SLOs"), observed once per request at retire — exposed on
+        # /v1/stats, /metrics, and the master heartbeat.
+        self.ttft_hist = LatencyHist()        # submit → first token
+        self.tpot_hist = LatencyHist()        # mean inter-token interval
+        self.e2e_hist = LatencyHist()         # submit → finished
+        self.queue_wait_hist = LatencyHist()  # submit → admitted
+        # Optional per-request span tracer (serve/tracing.py), attached by
+        # the task entrypoint / tests; None = no request tracing.
+        self.tracer = None
 
     # -- lifecycle -----------------------------------------------------
 
@@ -377,6 +499,15 @@ class ContinuousBatcher:
             assert popped is req, "single-consumer queue invariant"
             slot_id = free[0]
             req.admitted_at = time.monotonic()
+            req.admitted_us = now_us()
+            req.cached_len = cached_len
+            req.occupancy_at_admit = self.engine.slots - len(free) + 1
+            req.blocks_allocated = (
+                len(table) if paged
+                else self.blocks.blocks_for_tokens(req.total_budget))
+            req.bucket = self.engine.bucket_for(
+                int(req.tokens.size) - cached_len) or 0
+            req.prefill_start_us = req.admitted_us
             try:
                 # Device-side copy-on-write BEFORE any write can land in
                 # a block other sequences still reference.
@@ -393,9 +524,13 @@ class ContinuousBatcher:
                 # discard=True: the blocks' K/V were never (fully)
                 # written; they must not linger in the prefix cache.
                 self.blocks.free(req.id, discard=True)
-                req._finish(f"prefill failed: {type(e).__name__}: {e}")
+                req._finish(f"prefill failed: {type(e).__name__}: {e}",
+                            notify=False)
                 self.failed += 1
+                self._observe_finished(req)
+                req._done.set()
                 continue
+            req.prefill_end_us = req.first_token_us = now_us()
             req.out_tokens.append(first)
             with self._lock:
                 self.events.append(("admit", req.id, self.steps))
@@ -426,6 +561,7 @@ class ContinuousBatcher:
             s = self._slots[i]
             tok = int(next_tokens[i])
             s.req.out_tokens.append(tok)
+            s.req.decode_steps += 1
             self.generated_tokens += 1
             s.position += 1
             s.last_token = tok
@@ -451,7 +587,7 @@ class ContinuousBatcher:
         if release is not None:
             release(slot_id)
         self.blocks.free(req.id)
-        req._finish()
+        req._finish(notify=False)
         with self._lock:
             self.events.append(("retire", req.id, self.steps))
             self.completed += 1
@@ -462,6 +598,32 @@ class ContinuousBatcher:
                     service_s if self._service_s_ewma == 0.0
                     else alpha * service_s
                     + (1 - alpha) * self._service_s_ewma)
+        self._observe_finished(req)
+        req._done.set()
+
+    def _observe_finished(self, req: Request) -> None:
+        """Retire-time observability: fold the request into the latency
+        histograms and hand it to the tracer (which samples + buffers;
+        span-sink loss can never reach the decode loop)."""
+        with self._lock:
+            self.e2e_hist.observe(
+                (req.finished_us - req.submitted_us) / 1e6)
+            if req.admitted_us:
+                self.queue_wait_hist.observe(
+                    (req.admitted_us - req.submitted_us) / 1e6)
+            if req.first_token_us:
+                self.ttft_hist.observe(
+                    (req.first_token_us - req.submitted_us) / 1e6)
+                if len(req.out_tokens) > 1 and req.finished_us:
+                    self.tpot_hist.observe(
+                        (req.finished_us - req.first_token_us) / 1e6
+                        / (len(req.out_tokens) - 1))
+        tracer = self.tracer
+        if tracer is not None:
+            try:
+                tracer.record(req)
+            except Exception:
+                logger.warning("request tracer failed", exc_info=True)
 
     # -- stats ---------------------------------------------------------
 
@@ -498,6 +660,12 @@ class ContinuousBatcher:
                 "rejected_draining": self.queue.rejected_draining,
                 "dropped": self.queue.dropped,
                 "kv_blocks": self.blocks.stats(),
+                "latency": {
+                    "ttft": self.ttft_hist.summary(),
+                    "tpot": self.tpot_hist.summary(),
+                    "e2e": self.e2e_hist.summary(),
+                    "queue_wait": self.queue_wait_hist.summary(),
+                },
             }
 
     def heartbeat_stats(self) -> Dict[str, Any]:
@@ -505,6 +673,13 @@ class ContinuousBatcher:
         heartbeat (POST /allocations/{id}/serve_stats): the router's
         least-loaded signal and the deployment autoscaler's input."""
         kv = self.blocks.stats()
+        with self._lock:
+            latency = {
+                "ttft": self.ttft_hist.to_wire(),
+                "tpot": self.tpot_hist.to_wire(),
+                "e2e": self.e2e_hist.to_wire(),
+                "queue_wait": self.queue_wait_hist.to_wire(),
+            }
         return {
             "queue_depth": self.queue.depth(),
             "queue_capacity": self.queue.maxsize,
@@ -516,4 +691,9 @@ class ContinuousBatcher:
             "prefix_cache_hit_rate": kv.get("prefix_cache_hit_rate", 0.0),
             "draining": self.queue.draining,
             "retry_after_hint_s": self.retry_after_hint(),
+            # Mergeable latency histograms (boundaries + cumulative
+            # counts): the master sums counts across fresh replicas into
+            # the per-deployment p50/p99 on the deployment APIs and the
+            # det_serve_request_seconds{deployment=...} exposition.
+            "latency": latency,
         }

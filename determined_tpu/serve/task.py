@@ -207,6 +207,23 @@ def main(argv=None) -> int:
         session = Session(master, os.environ.get("DET_SESSION_TOKEN"))
 
     engine, batcher = build_replica(config, session=session)
+
+    # Per-request span tracing (docs/observability.md "Request spans"):
+    # retire-time span trees batch-POST to the master's request_spans
+    # store; errors/SLO breaches always traced, the rest at
+    # serving.trace_sample. serving.trace_sample: 0 disables entirely.
+    from determined_tpu.serve.tracing import RequestTracer
+
+    serving_cfg = config.get("serving") or {}
+    sample = float(serving_cfg.get("trace_sample", 1.0))
+    tracer = None
+    if sample > 0:
+        tracer = RequestTracer(
+            session, allocation_id or "", sample=sample,
+            slo_ms=serving_cfg.get("slo_ms"))
+        batcher.tracer = tracer
+        tracer.start()
+
     batcher.start()  # compiles everything AOT before serving
 
     from determined_tpu.serve.http import ServingServer
@@ -284,6 +301,8 @@ def main(argv=None) -> int:
         heartbeat.stop()
         server.stop()
         batcher.stop()
+        if tracer is not None:
+            tracer.stop()  # final flush: drained requests keep traces
         preempt.close()
 
 

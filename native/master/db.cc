@@ -578,6 +578,35 @@ CREATE TABLE deployment_replicas (
 ) WITHOUT ROWID;
 CREATE INDEX idx_deployment_replicas_task ON deployment_replicas(task_id);
 )sql"},
+      // Serving request-path tracing (docs/observability.md "Request
+      // spans"): one trace per served request, its id minted/propagated
+      // as X-Request-Id by the /serve router. The router records its
+      // serve.router.dispatch span(s) here directly; replicas batch-POST
+      // serve.request/queue_wait/prefill/decode via
+      // POST /api/v1/allocations/{id}/request_spans. The unique
+      // (request_id, span_id) index makes ingest idempotent at the row
+      // level; rows expire via the hourly sweep (request traces are an
+      // operational ring, not an archive).
+      {25, R"sql(
+CREATE TABLE request_spans (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  deployment_id TEXT NOT NULL,
+  request_id TEXT NOT NULL,
+  trace_id TEXT NOT NULL,
+  span_id TEXT NOT NULL,
+  parent_span_id TEXT NOT NULL DEFAULT '',
+  name TEXT NOT NULL,
+  start_us INTEGER NOT NULL,
+  end_us INTEGER NOT NULL DEFAULT 0,
+  attrs TEXT NOT NULL DEFAULT '{}',
+  created_at TEXT NOT NULL DEFAULT (datetime('now'))
+);
+CREATE INDEX idx_request_spans_req
+  ON request_spans(deployment_id, request_id, start_us);
+CREATE UNIQUE INDEX idx_request_spans_span
+  ON request_spans(request_id, span_id);
+CREATE INDEX idx_request_spans_created ON request_spans(created_at);
+)sql"},
   };
   return kMigrations;
 }

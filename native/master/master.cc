@@ -1109,6 +1109,27 @@ HttpResponse Master::handle_prometheus_metrics() {
       }
       out << "# TYPE det_deployment_target_replicas gauge\n"
           << targets.str();
+      // Per-deployment end-to-end request latency (docs/serving.md
+      // "Request latency & SLOs"): the replicas' heartbeat histograms
+      // merged across fresh reports, so one master scrape carries the
+      // fleet's serving latency next to its replica counts.
+      out << "# TYPE det_serve_request_seconds histogram\n";
+      for (const auto& [dep_id, dep] : deployments_) {
+        Json h = deployment_e2e_hist_locked(dep);
+        const auto& les = h["le"].as_array();
+        const auto& counts = h["counts"].as_array();
+        for (size_t i = 0; i < les.size() && i < counts.size(); ++i) {
+          out << "det_serve_request_seconds_bucket{deployment=\"" << dep_id
+              << "\",le=\"" << les[i].as_double(0) << "\"} "
+              << counts[i].as_int(0) << "\n";
+        }
+        out << "det_serve_request_seconds_bucket{deployment=\"" << dep_id
+            << "\",le=\"+Inf\"} " << h["count"].as_int(0) << "\n"
+            << "det_serve_request_seconds_sum{deployment=\"" << dep_id
+            << "\"} " << h["sum"].as_double(0) << "\n"
+            << "det_serve_request_seconds_count{deployment=\"" << dep_id
+            << "\"} " << h["count"].as_int(0) << "\n";
+      }
     }
   }
   out << "# TYPE det_preemptions_total counter\n"
@@ -1140,7 +1161,13 @@ HttpResponse Master::handle_prometheus_metrics() {
       << "\n"
       << "# TYPE det_serve_router_ejections_total counter\n"
       << "det_serve_router_ejections_total "
-      << fleet_.router_ejections.load() << "\n";
+      << fleet_.router_ejections.load() << "\n"
+      << "# TYPE det_request_spans_ingested_total counter\n"
+      << "det_request_spans_ingested_total "
+      << fleet_.request_spans_ingested.load() << "\n"
+      << "# TYPE det_serve_slo_breaches_total counter\n"
+      << "det_serve_slo_breaches_total " << fleet_.slo_breaches.load()
+      << "\n";
   {
     std::lock_guard<std::mutex> lock(api_stats_.mu);
     out << "# TYPE det_api_requests_total counter\n";

@@ -384,6 +384,12 @@ void Master::scheduler_loop() {
       db_.exec(
           "DELETE FROM idempotency_keys WHERE created_at < "
           "datetime('now', '-1 day')");
+      // Request traces are an operational ring, not an archive: a day of
+      // "why was THIS request slow" is plenty, and the table would
+      // otherwise grow with every routed generation.
+      db_.exec(
+          "DELETE FROM request_spans WHERE created_at < "
+          "datetime('now', '-1 day')");
       if (cfg_.log_retention_days > 0) {
         int64_t n = sweep_task_logs(cfg_.log_retention_days);
         if (n > 0) {

@@ -1093,6 +1093,15 @@ HttpResponse Master::handle_allocations(const HttpRequest& req,
     return handle_serve_stats(req, aid);
   }
 
+  // POST /api/v1/allocations/{id}/request_spans — serving request-span
+  // batches from a replica (docs/observability.md "Request spans"):
+  // serve.request/queue_wait/prefill/decode trees land in the
+  // request_spans store next to the router's dispatch spans.
+  if (parts.size() == 3 && parts[2] == "request_spans" &&
+      req.method == "POST") {
+    return handle_request_spans(req, aid);
+  }
+
   // GET /api/v1/allocations/{id} — introspection.
   if (parts.size() == 2 && req.method == "GET") {
     std::lock_guard<std::mutex> lock(mu_);

@@ -22,6 +22,7 @@
 #include <algorithm>
 #include <iostream>
 
+#include "../common/trace.h"
 #include "master.h"
 #include "preflight.h"
 
@@ -38,6 +39,69 @@ Json err_body(const std::string& msg) {
 HttpResponse json_resp(int status, const Json& j) {
   return HttpResponse::json(status, j.dump());
 }
+
+// Slow-request ring capacity per deployment (newest first).
+constexpr size_t kSlowRingCap = 32;
+
+// Quantile estimate (seconds) from a merged wire-form histogram:
+// boundaries `les` + cumulative counts, linearly interpolated inside the
+// winning bucket — the C++ twin of serve/scheduler.py
+// LatencyHist.percentile, so the deployment API and a replica's own
+// /v1/stats agree on the same data.
+double hist_percentile(const std::vector<double>& les,
+                       const std::vector<int64_t>& counts, int64_t total,
+                       double q) {
+  if (total <= 0 || les.empty()) return 0.0;
+  double target = q * total;
+  double prev_le = 0.0;
+  int64_t prev_c = 0;
+  for (size_t i = 0; i < les.size() && i < counts.size(); ++i) {
+    if (counts[i] >= target) {
+      int64_t span = counts[i] - prev_c;
+      double frac = span > 0 ? (target - prev_c) / span : 1.0;
+      return prev_le + (les[i] - prev_le) * frac;
+    }
+    prev_le = les[i];
+    prev_c = counts[i];
+  }
+  return les.back();
+}
+
+// Merge one replica's wire-form histogram into the accumulator (counts
+// summed bucket-wise; boundaries adopted from the first replica seen —
+// all replicas run the same LatencyHist buckets).
+struct MergedHist {
+  std::vector<double> les;
+  std::vector<int64_t> counts;
+  double sum = 0;
+  int64_t count = 0;
+
+  void add(const Json& wire) {
+    if (!wire.is_object()) return;
+    const Json& jles = wire["le"];
+    const Json& jcounts = wire["counts"];
+    if (!jles.is_array() || !jcounts.is_array()) return;
+    if (les.empty()) {
+      for (const Json& v : jles.as_array()) les.push_back(v.as_double(0));
+      counts.assign(les.size(), 0);
+    }
+    const auto& arr = jcounts.as_array();
+    for (size_t i = 0; i < counts.size() && i < arr.size(); ++i) {
+      counts[i] += arr[i].as_int(0);
+    }
+    sum += wire["sum"].as_double(0);
+    count += wire["count"].as_int(0);
+  }
+
+  Json summary() const {
+    Json j = Json::object();
+    j["count"] = count;
+    j["p50_ms"] = hist_percentile(les, counts, count, 0.5) * 1e3;
+    j["p99_ms"] = hist_percentile(les, counts, count, 0.99) * 1e3;
+    if (count > 0) j["mean_ms"] = sum / count * 1e3;
+    return j;
+  }
+};
 
 // Replica load reports older than this are treated as "no signal": the
 // replica stays routable (scored by router-local inflight only) but its
@@ -447,6 +511,8 @@ HttpResponse Master::handle_deployments(
         for (const auto& [tid, r] : it->second.replicas) (void)tid, ++ready;
         d["replica_count"] = static_cast<int64_t>(ready);
         d["smoothed_load"] = it->second.load_ewma;
+        // Aggregated token-latency p50/p99 (`det serve status` columns).
+        d["latency"] = deployment_latency_locked(it->second);
       }
       deps.push_back(std::move(d));
     }
@@ -456,7 +522,55 @@ HttpResponse Master::handle_deployments(
   }
 
   if (parts.size() < 2) return json_resp(404, err_body("no such deployment"));
-  const std::string& dep_id = parts[1];
+  std::string dep_id = parts[1];
+
+  // GET /api/v1/deployments/{id}/requests/{rid}/trace — the full
+  // router→replica span tree for one served request, ordered by start
+  // time; `det serve trace <deployment> <request-id>` renders it as the
+  // same text waterfall `det trial trace` uses. Accepts a deployment id,
+  // a deployment name, or a standalone serving task id (the span scope
+  // replicas without a deployment record under).
+  if (parts.size() == 5 && parts[2] == "requests" && parts[4] == "trace" &&
+      req.method == "GET") {
+    const std::string& rid = parts[3];
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      if (!deployments_.count(dep_id)) {
+        for (const auto& [id, dep] : deployments_) {
+          if (dep.name == dep_id) {
+            dep_id = id;
+            break;
+          }
+        }
+      }
+    }
+    Json spans = Json::array();
+    for (auto& row : db_.query(
+             "SELECT trace_id, span_id, parent_span_id, name, start_us, "
+             "end_us, attrs FROM request_spans WHERE deployment_id=? AND "
+             "request_id=? ORDER BY start_us, id",
+             {Json(dep_id), Json(rid)})) {
+      Json s = Json::object();
+      s["trace_id"] = row["trace_id"];
+      s["span_id"] = row["span_id"];
+      s["parent"] = row["parent_span_id"];
+      s["name"] = row["name"];
+      s["start_us"] = row["start_us"];
+      s["end_us"] = row["end_us"];
+      s["attrs"] = Json::parse_or_null(row["attrs"].as_string());
+      spans.push_back(std::move(s));
+    }
+    if (spans.as_array().empty()) {
+      return json_resp(404, err_body(
+          "no spans recorded for this request id (sampled out, expired, "
+          "or never routed here)"));
+    }
+    Json out = Json::object();
+    out["deployment_id"] = dep_id;
+    out["request_id"] = rid;
+    out["spans"] = std::move(spans);
+    return json_resp(200, out);
+  }
 
   // POST /api/v1/deployments/{id}/scale {target} — manual scale within
   // [min, max]; resets the autoscaler sustain clocks.
@@ -534,6 +648,14 @@ HttpResponse Master::handle_deployments(
       d["smoothed_load"] = dep.load_ewma;
       d["scale_ups"] = dep.scale_ups;
       d["scale_downs"] = dep.scale_downs;
+      // Request-latency SLO view (docs/serving.md "Request latency &
+      // SLOs"): merged TTFT/TPOT/e2e/queue-wait p50/p99 plus the
+      // slow-request ring (newest first; armed by serving.slo_ms).
+      d["latency"] = deployment_latency_locked(dep);
+      Json slow = Json::array();
+      for (const Json& s : dep.slow_requests) slow.push_back(s);
+      d["slow_requests"] = std::move(slow);
+      d["slo_ms"] = dep.config["serving"]["slo_ms"].as_double(0);
       for (const auto& [tid, r] : dep.replicas) {
         Json rj = Json::object();
         rj["task_id"] = tid;
@@ -546,6 +668,15 @@ HttpResponse Master::handle_deployments(
         rj["kv_blocks_free"] = r.kv_blocks_free;
         rj["kv_blocks_total"] = r.kv_blocks_total;
         rj["prefix_cache_hit_rate"] = r.prefix_cache_hit_rate;
+        if (r.latency.is_object()) {
+          Json lat = Json::object();
+          for (const char* key : {"ttft", "tpot", "e2e", "queue_wait"}) {
+            MergedHist h;
+            h.add(r.latency[key]);
+            lat[key] = h.summary();
+          }
+          rj["latency"] = std::move(lat);
+        }
         rj["draining"] = r.draining;
         rj["inflight"] = r.inflight;
         rj["consecutive_failures"] =
@@ -607,12 +738,120 @@ HttpResponse Master::handle_serve_stats(const HttpRequest& req,
   r.draining = body["draining"].as_bool(false);
   r.retry_after_hint =
       std::max<int64_t>(1, body["retry_after_hint_s"].as_int(1));
+  // Token-latency histograms ride the same heartbeat (wire form:
+  // boundaries + cumulative counts) — the deployment APIs aggregate them
+  // into per-deployment p50/p99 so an operator never scrapes replicas.
+  if (body["latency"].is_object()) r.latency = body["latency"];
   db_.exec(
       "UPDATE deployment_replicas SET state='ACTIVE' WHERE deployment_id=? "
       "AND task_id=? AND state='STARTING'",
       {Json(dep->id), Json(r.task_id)});
   it->second.last_activity = now();
   return json_resp(200, Json::object());
+}
+
+Json Master::deployment_latency_locked(const DeploymentState& dep) const {
+  // Merge fresh, non-retiring replicas' heartbeat histograms. Stale
+  // reports are excluded the same way the autoscaler excludes them: a
+  // dead replica's last numbers must not pin the percentile forever.
+  double t = now();
+  MergedHist ttft, tpot, e2e, queue_wait;
+  for (const auto& [tid, r] : dep.replicas) {
+    if (r.retiring || !r.latency.is_object()) continue;
+    if (r.last_report == 0 || t - r.last_report > kReportStaleS) continue;
+    ttft.add(r.latency["ttft"]);
+    tpot.add(r.latency["tpot"]);
+    e2e.add(r.latency["e2e"]);
+    queue_wait.add(r.latency["queue_wait"]);
+  }
+  Json out = Json::object();
+  out["ttft"] = ttft.summary();
+  out["tpot"] = tpot.summary();
+  out["e2e"] = e2e.summary();
+  out["queue_wait"] = queue_wait.summary();
+  return out;
+}
+
+Json Master::deployment_e2e_hist_locked(const DeploymentState& dep) const {
+  double t = now();
+  MergedHist e2e;
+  for (const auto& [tid, r] : dep.replicas) {
+    if (r.retiring || !r.latency.is_object()) continue;
+    if (r.last_report == 0 || t - r.last_report > kReportStaleS) continue;
+    e2e.add(r.latency["e2e"]);
+  }
+  Json les = Json::array(), counts = Json::array();
+  for (double le : e2e.les) les.push_back(Json(le));
+  for (int64_t c : e2e.counts) counts.push_back(Json(c));
+  Json out = Json::object();
+  out["le"] = std::move(les);
+  out["counts"] = std::move(counts);
+  out["sum"] = e2e.sum;
+  out["count"] = e2e.count;
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Request-span ingest + trace read (docs/observability.md "Request spans").
+// ---------------------------------------------------------------------------
+
+void Master::record_request_span(const std::string& deployment_id,
+                                 const std::string& request_id,
+                                 const Json& span) {
+  // INSERT OR IGNORE: the unique (request_id, span_id) index makes a
+  // replayed batch a row-level no-op, mirroring trial-span ingest.
+  db_.exec(
+      "INSERT OR IGNORE INTO request_spans (deployment_id, request_id, "
+      "trace_id, span_id, parent_span_id, name, start_us, end_us, attrs) "
+      "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+      {Json(deployment_id), Json(request_id),
+       Json(span["trace_id"].as_string()),
+       Json(span["span_id"].as_string()), Json(span["parent"].as_string()),
+       Json(span["name"].as_string()), Json(span["start_us"].as_int()),
+       Json(span["end_us"].as_int()),
+       Json(span["attrs"].is_object() ? span["attrs"].dump() : "{}")});
+}
+
+HttpResponse Master::handle_request_spans(const HttpRequest& req,
+                                          const std::string& alloc_id) {
+  Json body = Json::parse_or_null(req.body);
+  if (!body["spans"].is_array()) {
+    return json_resp(400, err_body("spans array required"));
+  }
+  std::string scope, task_id;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = allocations_.find(alloc_id);
+    if (it == allocations_.end()) {
+      return json_resp(404, err_body("unknown allocation"));
+    }
+    task_id = it->second.task_id;
+    DeploymentState* dep = deployment_for_task_locked(task_id);
+    // Standalone `det serve` tasks trace under their own task id so
+    // `det serve trace <task-id> <request-id>` works without a
+    // deployment wrapping them.
+    scope = dep != nullptr ? dep->id : task_id;
+    it->second.last_activity = now();
+  }
+  int64_t ingested = 0;
+  db_.tx([&] {
+    for (const Json& sp : body["spans"].as_array()) {
+      if (!sp.is_object() || sp["name"].as_string().empty() ||
+          sp["span_id"].as_string().empty()) {
+        continue;  // malformed entry: skip, keep the batch
+      }
+      // The trace id IS the request id (X-Request-Id) — a confused
+      // emitter cannot detach a span from its request.
+      std::string rid = sp["trace_id"].as_string();
+      if (rid.empty()) continue;
+      record_request_span(scope, rid, sp);
+      ++ingested;
+    }
+  });
+  fleet_.request_spans_ingested.fetch_add(ingested);
+  Json out = Json::object();
+  out["ingested"] = ingested;
+  return json_resp(200, out);
 }
 
 // ---------------------------------------------------------------------------
@@ -623,6 +862,7 @@ HttpResponse Master::handle_serve_router(
     const HttpRequest& req, const std::vector<std::string>& parts) {
   // Resolve by id or name.
   std::string dep_id = parts[1];
+  double slo_ms = 0;
   {
     std::lock_guard<std::mutex> lock(mu_);
     if (!deployments_.count(dep_id)) {
@@ -633,8 +873,27 @@ HttpResponse Master::handle_serve_router(
         }
       }
     }
-    if (!deployments_.count(dep_id)) {
+    auto dit = deployments_.find(dep_id);
+    if (dit == deployments_.end()) {
       return json_resp(404, err_body("no such deployment"));
+    }
+    slo_ms = dit->second.config["serving"]["slo_ms"].as_double(0);
+  }
+
+  // Request identity (docs/observability.md "Request spans"): mint an
+  // X-Request-Id here — or adopt the caller's — and propagate it to the
+  // replica, whose span tree rides the same id. The id comes back on
+  // every response so a caller can always ask `det serve trace` about
+  // the request it just made.
+  std::string rid;
+  {
+    auto h = req.headers.find("x-request-id");
+    if (h != req.headers.end() && !h->second.empty() &&
+        h->second.size() <= 128) {
+      rid = h->second;
+    } else {
+      rid = "rq-" + random_hex(8);
+      for (auto& c : rid) c = static_cast<char>(tolower(c));
     }
   }
 
@@ -654,6 +913,11 @@ HttpResponse Master::handle_serve_router(
   std::map<std::string, std::string> fwd_headers;
   auto ct_it = req.headers.find("content-type");
   if (ct_it != req.headers.end()) fwd_headers["Content-Type"] = ct_it->second;
+  fwd_headers["X-Request-Id"] = rid;
+  // Only generation requests get dispatch spans + SLO tracking — stats/
+  // health probes through the router would be pure table noise.
+  const bool traced =
+      req.method == "POST" && fwd_path.rfind("/v1/generate", 0) == 0;
 
   // At most two attempts: the retry is ONLY taken for a connection-level
   // failure (nothing reached the replica, so nothing can be generating);
@@ -663,6 +927,7 @@ HttpResponse Master::handle_serve_router(
   for (int attempt = 0; attempt < 2; ++attempt) {
     std::string target_task, target_addr;
     bool probe = false;
+    int pick_failures = 0;
     int64_t full_retry_after = 0;
     {
       std::lock_guard<std::mutex> lock(mu_);
@@ -716,14 +981,17 @@ HttpResponse Master::handle_serve_router(
           // The only ready replica refused the connection and no other
           // exists — surface the connection failure.
           fleet_.router_ejections.fetch_add(1);
-          return json_resp(
+          HttpResponse resp = json_resp(
               502, err_body("replica connection refused; no other ready "
                             "replica to retry on"));
+          resp.headers["X-Request-Id"] = rid;
+          return resp;
         }
         HttpResponse resp = json_resp(
             503, err_body("no ready replicas (deployment starting, "
                           "draining, or all ejected)"));
         resp.headers["Retry-After"] = "2";
+        resp.headers["X-Request-Id"] = rid;
         return resp;
       }
       bool all_full = true;
@@ -739,6 +1007,7 @@ HttpResponse Master::handle_serve_router(
         HttpResponse resp = json_resp(
             429, err_body("every replica reports a full admission queue"));
         resp.headers["Retry-After"] = std::to_string(full_retry_after);
+        resp.headers["X-Request-Id"] = rid;
         return resp;
       }
       // Least-loaded; ties rotate via rr_cursor so equal replicas share.
@@ -756,6 +1025,7 @@ HttpResponse Master::handle_serve_router(
       target_addr = pick.addr;
       probe = pick.probe;
       ReplicaHealth& r = dep.replicas[target_task];
+      pick_failures = r.consecutive_failures;
       r.inflight++;
       if (probe) r.half_open_probe = true;
       for (auto& [aid, a] : allocations_) {
@@ -767,11 +1037,34 @@ HttpResponse Master::handle_serve_router(
     // master lock must not be held across it.
     HttpClientResponse pr;
     std::string fail;
+    int64_t t_dispatch_us = trace::now_us();
     try {
       pr = http_request(req.method, target_addr, fwd_path, req.body, 600.0,
                         fwd_headers);
     } catch (const std::exception& e) {
       fail = e.what();
+    }
+    int64_t t_done_us = trace::now_us();
+
+    if (traced) {
+      // One serve.router.dispatch span per ATTEMPT, so a retried request
+      // shows both the refused hop and the one that served it. Parent is
+      // the request id itself — the replica's serve.request root.
+      Json attrs = Json::object();
+      attrs["replica"] = target_task;
+      attrs["attempt"] = static_cast<int64_t>(attempt);
+      attrs["retried"] = attempt > 0;
+      attrs["half_open_probe"] = probe;
+      attrs["breaker_failures"] = static_cast<int64_t>(pick_failures);
+      if (fail.empty()) {
+        attrs["status"] = static_cast<int64_t>(pr.status);
+      } else {
+        attrs["error"] = fail;
+      }
+      record_request_span(
+          dep_id, rid,
+          trace::make_span(rid, "serve.router.dispatch", t_dispatch_us,
+                           t_done_us, rid, attrs));
     }
 
     std::lock_guard<std::mutex> lock(mu_);
@@ -794,6 +1087,24 @@ HttpResponse Master::handle_serve_router(
         r->consecutive_failures = 0;
         r->breaker_open_until = 0;
       }
+      // SLO burn visibility (docs/serving.md "Request latency & SLOs"):
+      // generations over serving.slo_ms land in the deployment's
+      // slow-request ring, newest first, so the detail API answers
+      // "which requests burned the SLO" without scraping replicas.
+      double wall_ms = (t_done_us - t_dispatch_us) / 1e3;
+      if (traced && slo_ms > 0 && wall_ms > slo_ms && dep != nullptr) {
+        fleet_.slo_breaches.fetch_add(1);
+        Json slow = Json::object();
+        slow["request_id"] = rid;
+        slow["ms"] = wall_ms;
+        slow["replica"] = target_task;
+        slow["status"] = static_cast<int64_t>(pr.status);
+        slow["at_us"] = t_done_us;
+        dep->slow_requests.push_front(std::move(slow));
+        while (dep->slow_requests.size() > kSlowRingCap) {
+          dep->slow_requests.pop_back();
+        }
+      }
       HttpResponse out;
       out.status = pr.status;
       out.body = pr.body;
@@ -804,6 +1115,7 @@ HttpResponse Master::handle_serve_router(
       // Retry-After on 429/503; the harness Session honors it).
       auto ra = pr.headers.find("retry-after");
       if (ra != pr.headers.end()) out.headers["Retry-After"] = ra->second;
+      out.headers["X-Request-Id"] = rid;
       return out;
     }
     // Failure path: breaker bookkeeping, then maybe retry.
@@ -819,12 +1131,17 @@ HttpResponse Master::handle_serve_router(
       }
     }
     if (!connect_fail || attempt == 1) {
-      return json_resp(502, err_body("serve router: " + fail));
+      HttpResponse resp = json_resp(502, err_body("serve router: " + fail));
+      resp.headers["X-Request-Id"] = rid;
+      return resp;
     }
     tried.insert(target_task);
     fleet_.router_retries.fetch_add(1);
   }
-  return json_resp(502, err_body("serve router: no replica reachable"));
+  HttpResponse resp =
+      json_resp(502, err_body("serve router: no replica reachable"));
+  resp.headers["X-Request-Id"] = rid;
+  return resp;
 }
 
 }  // namespace det

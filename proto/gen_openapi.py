@@ -126,8 +126,12 @@ ROUTES = [
      "Task names the cause of its imminent nonzero exit (step watchdog, "
      "divergence fail-stop)"),
     ("post", "/api/v1/allocations/{id}/serve_stats", "serving",
-     "Serving-replica heartbeat: queue depth + occupancy + drain state "
-     "(the router's least-loaded signal, the autoscaler's input)"),
+     "Serving-replica heartbeat: queue depth + occupancy + drain state + "
+     "token-latency histograms (the router's least-loaded signal, the "
+     "autoscaler's input, the deployment p50/p99 source)"),
+    ("post", "/api/v1/allocations/{id}/request_spans", "serving",
+     "Serving request-span batch from a replica "
+     "(serve.request/queue_wait/prefill/decode; trace id = X-Request-Id)"),
     ("post", "/api/v1/checkpoints", "checkpoints", "Report checkpoint"),
     ("patch", "/api/v1/checkpoints", "checkpoints",
      "Batch state updates (GC)"),
@@ -203,7 +207,13 @@ ROUTES += [
     ("post", "/api/v1/deployments", "serving",
      "Create a deployment from a serving config with serving.replicas"),
     ("get", "/api/v1/deployments/{id}", "serving",
-     "Get deployment detail incl. per-replica health/breaker state"),
+     "Get deployment detail incl. per-replica health/breaker state, "
+     "aggregated TTFT/TPOT/e2e/queue-wait p50/p99, and the slow-request "
+     "ring (serving.slo_ms)"),
+    ("get", "/api/v1/deployments/{id}/requests/{rid}/trace", "serving",
+     "One served request's span tree (router dispatch + replica "
+     "queue-wait/prefill/decode), ordered by start time — rendered by "
+     "`det serve trace <deployment> <request-id>`"),
     ("post", "/api/v1/deployments/{id}/scale", "serving",
      "Manually set target replicas within [min, max]"),
     ("post", "/api/v1/deployments/{id}/kill", "serving",

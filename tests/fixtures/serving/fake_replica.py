@@ -10,6 +10,12 @@ Endpoints:
   POST /v1/generate     sleeps DET_FAKE_GEN_MS (or body.delay_ms), then
                         {"id", "tokens": [...], "replica": <task id>} —
                         the replica field lets tests assert dispatch.
+                        Honors X-Request-Id and emits the REAL request
+                        span tree (serve/tracing.py RequestTracer) +
+                        latency histograms (serve/scheduler.py
+                        LatencyHist), so router/observability tests
+                        exercise the production span + heartbeat protocol
+                        without building a model.
   GET  /v1/stats        the heartbeat payload as currently reported
   POST /force_stats     override the reported stats (least-loaded /
                         all-full scenarios); {} clears the override
@@ -29,9 +35,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))))
 sys.path.insert(0, REPO)
 
+import numpy as np  # noqa: E402
+
 from determined_tpu.common.api import Session  # noqa: E402
 from determined_tpu.core._preempt import PreemptContext  # noqa: E402
 from determined_tpu.exec._util import report_proxy_address  # noqa: E402
+from determined_tpu.serve.scheduler import (  # noqa: E402
+    LatencyHist,
+    Request,
+    now_us,
+)
+from determined_tpu.serve.tracing import RequestTracer  # noqa: E402
 
 TASK_ID = os.environ.get("DET_TASK_ID", "fake")
 ALLOCATION_ID = os.environ.get("DET_ALLOCATION_ID", "")
@@ -53,12 +67,24 @@ _state = {
     "override": None,  # forced stats dict, or None
 }
 
+# The REAL latency histograms + span tracer (serve/scheduler.py /
+# serve/tracing.py): the fake only fakes the model, never the
+# observability protocol.
+_hists = {
+    "ttft": LatencyHist(),
+    "tpot": LatencyHist(),
+    "e2e": LatencyHist(),
+    "queue_wait": LatencyHist(),
+}
+
 
 def heartbeat_stats():
     with _lock:
+        latency = {k: h.to_wire() for k, h in _hists.items()}
         if _state["override"] is not None:
             stats = dict(_state["override"])
             stats.setdefault("draining", _state["draining"])
+            stats.setdefault("latency", latency)
             return stats
         return {
             "queue_depth": _state["waiting"],
@@ -69,6 +95,7 @@ def heartbeat_stats():
             "kv_blocks_total": 64,
             "draining": _state["draining"],
             "retry_after_hint_s": 1,
+            "latency": latency,
         }
 
 
@@ -102,17 +129,51 @@ class Handler(BaseHTTPRequestHandler):
             if _state["draining"]:
                 self._send(503, {"error": "draining"})
                 return
+            rid = (self.headers.get("X-Request-Id") or "").strip() or None
+            req = Request(
+                np.asarray(body.get("tokens") or [1, 2, 3], np.int32),
+                max_new_tokens=max(1, int(body.get("max_new_tokens", 4))),
+                request_id=rid)
             with _lock:
                 _state["waiting"] += 1
             _slots_sem.acquire()
             with _lock:
                 _state["waiting"] -= 1
                 _state["inflight"] += 1
+            # Phase stamps mirror the real batcher's: admit = slot grant,
+            # "prefill" = a fixed slice of the service sleep, decode = the
+            # rest — so the spans and histograms carry honest shapes.
+            req.admitted_us = req.prefill_start_us = now_us()
+            req.occupancy_at_admit = _state["inflight"]
+            req.bucket = 8
+            req.blocks_allocated = 2
             try:
-                time.sleep(float(body.get("delay_ms", GEN_MS)) / 1e3)
+                delay_s = float(body.get("delay_ms", GEN_MS)) / 1e3
+                time.sleep(delay_s * 0.25)
+                req.prefill_end_us = req.first_token_us = now_us()
+                time.sleep(delay_s * 0.75)
                 n = int(body.get("max_new_tokens", 4))
-                self._send(200, {"id": f"{TASK_ID}-{_state['completed']}",
-                                 "tokens": list(range(n)),
+                req.out_tokens = list(range(n))
+                req.decode_steps = max(0, n - 1)
+                req._finish(notify=False)
+                with _lock:
+                    _hists["e2e"].observe(
+                        (req.finished_us - req.submitted_us) / 1e6)
+                    _hists["queue_wait"].observe(
+                        (req.admitted_us - req.submitted_us) / 1e6)
+                    _hists["ttft"].observe(
+                        (req.first_token_us - req.submitted_us) / 1e6)
+                    if len(req.out_tokens) > 1:
+                        _hists["tpot"].observe(
+                            (req.finished_us - req.first_token_us) / 1e6
+                            / (len(req.out_tokens) - 1))
+                if _tracer is not None:
+                    # Record + flush BEFORE the response leaves: by the
+                    # time the caller can ask for the trace, it exists.
+                    _tracer.record(req)
+                    _tracer.flush()
+                self._send(200, {"id": req.id,
+                                 "tokens": list(req.out_tokens),
                                  "replica": TASK_ID})
             finally:
                 _slots_sem.release()
@@ -140,6 +201,12 @@ def make_session():
 
 
 _session = make_session()
+_tracer = None
+if _session is not None:
+    _tracer = RequestTracer(
+        _session, ALLOCATION_ID,
+        sample=float(os.environ.get("DET_FAKE_TRACE_SAMPLE", "1.0")),
+        slo_ms=float(os.environ.get("DET_FAKE_SLO_MS", "0") or 0) or None)
 
 
 def beat():

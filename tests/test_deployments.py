@@ -92,14 +92,15 @@ def test_expconf_heartbeat_period():
 # ---------------------------------------------------------------------------
 
 
-def _http(method, url, body=None, token=None, timeout=60.0):
+def _http(method, url, body=None, token=None, timeout=60.0, headers=None):
     """Raw request returning (status, headers, parsed-json) — unlike
     Devcluster.api it surfaces 4xx/5xx instead of raising."""
     req = urllib.request.Request(
         url,
         data=json.dumps(body).encode() if body is not None else None,
         headers={"Content-Type": "application/json",
-                 **({"Authorization": f"Bearer {token}"} if token else {})},
+                 **({"Authorization": f"Bearer {token}"} if token else {}),
+                 **(headers or {})},
         method=method)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
@@ -173,10 +174,17 @@ def _replica_addr(detail, task_id):
     raise KeyError(task_id)
 
 
-def _generate(c, token, dep_id, body=None, timeout=60.0):
+def _generate(c, token, dep_id, body=None, timeout=60.0, headers=None):
     return _http("POST", f"{c.master_url}/serve/{dep_id}/v1/generate",
                  body or {"max_new_tokens": 4}, token=token,
-                 timeout=timeout)
+                 timeout=timeout, headers=headers)
+
+
+def _trace(c, token, dep_id, rid):
+    return _http(
+        "GET",
+        f"{c.master_url}/api/v1/deployments/{dep_id}/requests/{rid}/trace",
+        token=token)
 
 
 # ---------------------------------------------------------------------------
@@ -376,12 +384,22 @@ def test_router_failover_ejection_and_readmission(fleet):
     assert retries and int(retries[0].split()[-1]) >= 1, retries
 
     # Survivor kept serving throughout; victim respawns (restarts >= 1)
-    # and is re-admitted by the router after the breaker hold.
+    # and is re-admitted by the router after the breaker hold. Poll the
+    # restarts bump FIRST: right after the burst the dead replica can
+    # still look RUNNING with a fresh-enough heartbeat until the agent's
+    # exit report lands, so a bare ready-check can win the race against
+    # the requeue (same pattern as test_replica_death_respawns_to_target).
+    deadline = time.time() + 120
+    task = {}
+    while time.time() < deadline:
+        task = c.api("GET", f"/api/v1/serving/{victim['task_id']}",
+                     token=token)["task"]
+        if int(task.get("restarts") or 0) >= 1:
+            break
+        time.sleep(0.2)
+    assert int(task.get("restarts") or 0) >= 1, task
     detail = _wait_ready(c, token, dep_id, 2, timeout=120)
     assert {r["task_id"] for r in detail["replicas"]} == tids
-    task = c.api("GET", f"/api/v1/serving/{victim['task_id']}",
-                 token=token)["task"]
-    assert int(task.get("restarts") or 0) >= 1
     deadline = time.time() + 60
     seen = set()
     while time.time() < deadline and len(seen) < 2:
@@ -521,6 +539,223 @@ def test_replica_death_respawns_to_target(fleet):
     assert int(task.get("restarts") or 0) >= 1, task
     detail = _wait_ready(c, token, dep_id, 1, timeout=120)
     assert detail["replicas"][0]["task_id"] == tid
+
+
+# ---------------------------------------------------------------------------
+# Request-path observability: per-request traces, latency aggregation,
+# slow-request ring (ISSUE 12; docs/serving.md "Request latency & SLOs").
+# ---------------------------------------------------------------------------
+
+
+def test_request_trace_end_to_end_with_waterfall(fleet):
+    """The acceptance contract: a request served through
+    /serve/{deployment} yields a PERSISTED span tree with router-dispatch,
+    queue-wait, prefill, and decode phases, and `det serve trace` renders
+    it as a waterfall."""
+    from determined_tpu.common.trace import render_waterfall
+
+    c = fleet
+    token = c.login()
+    resp = c.api("POST", "/api/v1/deployments",
+                 {"config": _dep_config(target=2)}, token=token)
+    dep_id = resp["id"]
+    _wait_ready(c, token, dep_id, 2)
+
+    # Caller-supplied X-Request-Id is adopted and echoed.
+    rid = "trace-me-1"
+    status, headers, body = _generate(
+        c, token, dep_id, {"max_new_tokens": 4},
+        headers={"X-Request-Id": rid})
+    assert status == 200, body
+    assert headers.get("X-Request-Id") == rid
+    assert body["id"] == rid  # the replica served under the same id
+
+    status, _, trace = _trace(c, token, dep_id, rid)
+    assert status == 200, trace
+    spans = trace["spans"]
+    names = {s["name"] for s in spans}
+    assert {"serve.request", "serve.router.dispatch", "serve.queue_wait",
+            "serve.prefill", "serve.decode"} <= names, names
+    # One trace: every span rides the request id; the root IS the id.
+    assert all(s["trace_id"] == rid for s in spans)
+    root = [s for s in spans if s["name"] == "serve.request"][0]
+    assert root["span_id"] == rid
+    for s in spans:
+        if s["name"] != "serve.request":
+            assert s["parent"] == rid, s
+    # Phase attrs made it through the store.
+    prefill = [s for s in spans if s["name"] == "serve.prefill"][0]
+    assert prefill["attrs"]["suffix_len"] >= 1
+    dispatch = [s for s in spans if s["name"] == "serve.router.dispatch"][0]
+    assert dispatch["attrs"]["status"] == 200
+    assert dispatch["attrs"]["retried"] is False
+    # Spans are closed and ordered on one timeline.
+    assert all(s["end_us"] >= s["start_us"] > 0 for s in spans)
+    # The CLI waterfall renders it (same renderer as `det trial trace`).
+    out = render_waterfall(spans)
+    assert "serve.router.dispatch" in out and "serve.decode" in out
+    assert "#" in out  # duration bars drawn
+
+    # Router-minted ids: no header → a fresh rq-* id comes back and its
+    # trace is just as queryable (by deployment NAME too).
+    status, headers, body = _generate(c, token, dep_id,
+                                      {"max_new_tokens": 2})
+    assert status == 200
+    minted = headers.get("X-Request-Id", "")
+    assert minted.startswith("rq-")
+    status, _, trace = _trace(c, token, "fake-dep", minted)
+    assert status == 200 and trace["deployment_id"] == dep_id
+
+    # Unknown request id → 404 that names the miss, not a routing 404.
+    status, _, body = _trace(c, token, dep_id, "rq-never-happened")
+    assert status == 404 and "no spans" in body["error"]
+
+
+def test_request_trace_retried_dispatch_shows_both_attempts(fleet):
+    """A connection-refused dispatch that retries onto the survivor leaves
+    BOTH attempts in the trace: attempt 0 with the error, attempt 1 with
+    the 200 — the 'why was THIS request slow' answer for failover."""
+    c = fleet
+    token = c.login()
+    resp = c.api("POST", "/api/v1/deployments",
+                 {"config": _dep_config(target=2, max_r=2)}, token=token)
+    dep_id = resp["id"]
+    detail = _wait_ready(c, token, dep_id, 2)
+    victim = detail["replicas"][0]
+    try:
+        _http("POST", f"{victim['proxy_address']}/die", {}, timeout=5)
+    except Exception:
+        pass  # the process may die before finishing the response
+
+    # The router learns of the death only by connecting: issue requests
+    # until one draws the dead replica first (tie rotation alternates, so
+    # this converges in a couple of tries).
+    retried_trace = None
+    for i in range(12):
+        rid = f"retry-{i}"
+        status, _, body = _generate(
+            c, token, dep_id, {"max_new_tokens": 2, "delay_ms": 1},
+            headers={"X-Request-Id": rid})
+        if status != 200:
+            continue  # in-flight edge cases surface as explicit errors
+        status, _, trace = _trace(c, token, dep_id, rid)
+        if status != 200:
+            continue
+        dispatches = [s for s in trace["spans"]
+                      if s["name"] == "serve.router.dispatch"]
+        if len(dispatches) == 2:
+            retried_trace = (rid, trace, dispatches)
+            break
+    assert retried_trace is not None, "no request drew the dead replica"
+    rid, trace, dispatches = retried_trace
+    dispatches.sort(key=lambda s: s["attrs"]["attempt"])
+    first, second = dispatches
+    assert first["attrs"]["attempt"] == 0 and "error" in first["attrs"]
+    assert first["attrs"]["replica"] == victim["task_id"]
+    assert second["attrs"]["attempt"] == 1
+    assert second["attrs"]["retried"] is True
+    assert second["attrs"]["status"] == 200
+    # The replica-side phases exist alongside both dispatch attempts.
+    names = {s["name"] for s in trace["spans"]}
+    assert {"serve.request", "serve.prefill", "serve.decode"} <= names
+
+
+def test_deployment_latency_aggregation_and_slow_ring(fleet):
+    """Replica heartbeats carry TTFT/TPOT/e2e/queue-wait histograms; the
+    master aggregates fresh ones into per-deployment p50/p99 on the
+    detail API, exposes det_serve_request_seconds{deployment=...} on
+    /metrics, and records SLO breaches in the slow-request ring."""
+    c = fleet
+    token = c.login()
+    cfg = _dep_config(target=2, heartbeat_s=0.2)
+    # Every fake generation takes ~30 ms — a 1 ms SLO makes each one a
+    # breach, so the ring fills deterministically.
+    cfg["serving"]["slo_ms"] = 1
+    resp = c.api("POST", "/api/v1/deployments", {"config": cfg},
+                 token=token)
+    dep_id = resp["id"]
+    _wait_ready(c, token, dep_id, 2)
+
+    rids = []
+    for i in range(6):
+        status, headers, _ = _generate(c, token, dep_id,
+                                       {"max_new_tokens": 4})
+        assert status == 200
+        rids.append(headers["X-Request-Id"])
+
+    # Aggregation rides the heartbeat: poll until all 6 requests landed.
+    deadline = time.time() + 30
+    lat = {}
+    while time.time() < deadline:
+        detail = c.api("GET", f"/api/v1/deployments/{dep_id}",
+                       token=token)["deployment"]
+        lat = detail.get("latency") or {}
+        if (lat.get("e2e") or {}).get("count", 0) >= 6:
+            break
+        time.sleep(0.2)
+    assert lat["e2e"]["count"] >= 6, detail
+    for key in ("ttft", "tpot", "e2e", "queue_wait"):
+        h = lat[key]
+        assert h["count"] >= 1 and h["p99_ms"] >= h["p50_ms"] >= 0, (key, h)
+    # TTFT ≈ 25% of the ~30 ms service time; e2e covers all of it.
+    assert lat["e2e"]["p50_ms"] > lat["ttft"]["p50_ms"] > 0
+    # Per-replica summaries ride the detail too.
+    assert any((r.get("latency") or {}).get("e2e", {}).get("count", 0) > 0
+               for r in detail["replicas"])
+
+    # The list API (what `det serve status` prints) carries the same
+    # aggregation.
+    deps = c.api("GET", "/api/v1/deployments", token=token)["deployments"]
+    mine = [d for d in deps if d["id"] == dep_id][0]
+    assert mine["latency"]["e2e"]["count"] >= 6
+
+    # Slow-request ring: every request breached the 1 ms SLO; entries are
+    # traceable ids, newest first.
+    assert detail["slo_ms"] == 1
+    ring = detail["slow_requests"]
+    assert ring, detail
+    assert all(s["ms"] > 1 and s["request_id"] for s in ring)
+    assert {s["request_id"] for s in ring} <= set(rids)
+
+    # CLI smoke: `det serve status` renders the p50/p99 latency columns
+    # and `det serve trace` renders a slow request's waterfall.
+    import argparse
+    import contextlib
+    import io
+
+    from determined_tpu.cli import cmd_serve
+    from determined_tpu.common.api import Session
+
+    sess = Session(c.master_url, token)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        cmd_serve(sess, argparse.Namespace(
+            target="status", extra=[], local=False, json=False))
+    out = buf.getvalue()
+    assert "ttft_ms" in out and "tpot_ms" in out and "e2e_ms" in out
+    assert dep_id in out
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        cmd_serve(sess, argparse.Namespace(
+            target="trace", extra=[dep_id, ring[0]["request_id"]],
+            local=False, json=False))
+    out = buf.getvalue()
+    assert "serve.router.dispatch" in out and "serve.decode" in out
+
+    # Master /metrics: per-deployment latency histogram + counters.
+    raw = urllib.request.urlopen(urllib.request.Request(
+        f"{c.master_url}/metrics",
+        headers={"Authorization": f"Bearer {token}"}), timeout=10
+    ).read().decode()
+    count_lines = [line for line in raw.splitlines() if line.startswith(
+        f'det_serve_request_seconds_count{{deployment="{dep_id}"}}')]
+    assert count_lines and int(count_lines[0].split()[-1]) >= 6, count_lines
+    spans_total = [line for line in raw.splitlines()
+                   if line.startswith("det_request_spans_ingested_total")]
+    assert spans_total and int(spans_total[0].split()[-1]) >= 6
+    breaches = [line for line in raw.splitlines()
+                if line.startswith("det_serve_slo_breaches_total")]
+    assert breaches and int(breaches[0].split()[-1]) >= 6
 
 
 # ---------------------------------------------------------------------------

@@ -806,6 +806,203 @@ def test_retry_after_hint_scales_with_backlog(tiny_params):
 
 
 # ---------------------------------------------------------------------------
+# Request-path observability: latency histograms, phase stamps, request
+# tracer + the serving.trace.drop contract (ISSUE 12; docs/serving.md
+# "Request latency & SLOs", docs/observability.md "Request spans").
+# ---------------------------------------------------------------------------
+
+
+def test_latency_hist_percentiles():
+    from determined_tpu.serve.scheduler import LatencyHist
+
+    h = LatencyHist(buckets=(0.01, 0.1, 1.0))
+    assert h.percentile(0.5) == 0.0  # empty
+    for _ in range(99):
+        h.observe(0.05)
+    h.observe(0.5)
+    assert h.count == 100 and 0.01 < h.percentile(0.5) <= 0.1
+    assert 0.1 < h.percentile(0.995) <= 1.0
+    # Over the top bucket: the estimate clamps to the last boundary.
+    h2 = LatencyHist(buckets=(0.01,))
+    h2.observe(5.0)
+    assert h2.percentile(0.99) == 0.01
+    wire = h.to_wire()
+    assert wire["count"] == 100 and len(wire["le"]) == len(wire["counts"])
+    # Cumulative counts are monotonic (Prometheus le semantics).
+    assert wire["counts"] == sorted(wire["counts"])
+    s = h.summary()
+    assert s["p99_ms"] >= s["p50_ms"] > 0
+
+
+def test_request_phase_stamps_and_histograms(tiny_params):
+    eng = make_engine(tiny_params, slots=2)
+    b = make_batcher(eng).start()
+    try:
+        reqs = [b.submit(_req(max_new=4, request_id=f"phase-{i}"))
+                for i in range(4)]
+        results = [r.result(timeout=120) for r in reqs]
+        for r, res in zip(reqs, results):
+            # submit ≤ admit ≤ prefill end = first token ≤ finish, all on
+            # the wall-clock span timeline.
+            assert (r.submitted_us <= r.admitted_us <= r.prefill_end_us
+                    == r.first_token_us <= r.finished_us)
+            assert r.decode_steps == 3  # 4 new tokens = prefill + 3 steps
+            assert res["ttft_ms"] >= 0 and res["tpot_ms"] >= 0
+            assert res["latency_ms"] >= res["ttft_ms"] >= res["queue_ms"]
+        # One observation per retired request in every histogram.
+        hb = b.heartbeat_stats()["latency"]
+        for key in ("ttft", "tpot", "e2e", "queue_wait"):
+            assert hb[key]["count"] == 4, (key, hb[key])
+        lat = b.stats()["latency"]
+        assert lat["e2e"]["p50_ms"] >= lat["ttft"]["p50_ms"] > 0
+    finally:
+        b.stop()
+
+
+def test_request_tracer_span_tree(tiny_params):
+    from determined_tpu.serve.tracing import RequestTracer
+
+    eng = make_engine(tiny_params, slots=2)
+    b = make_batcher(eng)
+    tracer = RequestTracer(None, "", sample=1.0)
+    b.tracer = tracer
+    b.start()
+    try:
+        b.submit(_req(n_prompt=4, max_new=4,
+                      request_id="tree-1")).result(timeout=120)
+        tracer.flush()
+        spans = [s for s in tracer.local_spans
+                 if s["trace_id"] == "tree-1"]
+        by_name = {s["name"]: s for s in spans}
+        assert set(by_name) == {"serve.request", "serve.queue_wait",
+                                "serve.prefill", "serve.decode"}
+        root = by_name["serve.request"]
+        assert root["span_id"] == "tree-1" and root["parent"] == ""
+        assert root["attrs"] == {"prompt_tokens": 4, "new_tokens": 4}
+        for name in ("serve.queue_wait", "serve.prefill", "serve.decode"):
+            assert by_name[name]["parent"] == "tree-1"
+        pf = by_name["serve.prefill"]["attrs"]
+        assert pf["suffix_len"] == 4 and pf["prefix_cache_hit"] is False
+        assert pf["bucket"] >= 4 and pf["blocks"] >= 1
+        dec = by_name["serve.decode"]["attrs"]
+        assert dec["tokens"] == 4 and dec["steps"] == 3
+        assert dec["occupancy_at_admit"] >= 1
+        # Phases nest inside the root on the timeline.
+        for name in ("serve.queue_wait", "serve.prefill", "serve.decode"):
+            s = by_name[name]
+            assert root["start_us"] <= s["start_us"] <= s["end_us"] \
+                <= root["end_us"]
+    finally:
+        b.stop()
+
+
+def test_request_tracer_sampling_error_and_slo():
+    """sample=0 suppresses healthy traces, but errors and SLO breaches
+    are ALWAYS traced — the 'why was THIS request slow' contract."""
+    from determined_tpu.serve.scheduler import now_us
+    from determined_tpu.serve.tracing import RequestTracer
+
+    def fake_request(rid, error=None, e2e_ms=5.0):
+        r = _req(request_id=rid)
+        r.admitted_us = r.submitted_us + 100
+        r.prefill_start_us = r.admitted_us
+        r.prefill_end_us = r.first_token_us = r.admitted_us + 200
+        r.out_tokens = [1, 2]
+        r.error = error
+        r.finished_us = r.submitted_us + int(e2e_ms * 1000)
+        return r
+
+    tracer = RequestTracer(None, "", sample=0.0, slo_ms=100.0)
+    assert tracer.record(fake_request("healthy")) is False
+    assert tracer.sampled_out == 1
+    assert tracer.record(fake_request("failed", error="boom")) is True
+    assert tracer.record(fake_request("slow", e2e_ms=500.0)) is True
+    assert tracer.slo_breaches == 1
+    tracer.flush()
+    traced = {s["trace_id"] for s in tracer.local_spans}
+    assert traced == {"failed", "slow"}
+    err_root = [s for s in tracer.local_spans
+                if s["trace_id"] == "failed"
+                and s["name"] == "serve.request"][0]
+    assert err_root["attrs"]["error"] == "boom"
+    # Fractional sampling stays within the fraction's ballpark.
+    tracer2 = RequestTracer(None, "", sample=0.5)
+    hits = sum(tracer2.record(fake_request(f"r{i}")) for i in range(200))
+    assert 50 <= hits <= 150
+
+
+def test_serving_trace_drop_generations_survive_span_sink_loss(tiny_params):
+    """The chaos satellite (docs/chaos.md): with `serving.trace.drop`
+    armed — and separately with a dead sink session — span batches drop
+    and NOT ONE generation blocks or fails (same contract as PR 8's
+    trace.span.drop)."""
+    from determined_tpu.serve.tracing import FAULT_TRACE_DROP, RequestTracer
+
+    class DeadSink:
+        posts = 0
+
+        def post(self, *a, **kw):
+            DeadSink.posts += 1
+            raise ConnectionError("span sink is gone")
+
+    eng = make_engine(tiny_params, slots=2)
+    b = make_batcher(eng)
+    tracer = RequestTracer(DeadSink(), "alloc-x", sample=1.0)
+    b.tracer = tracer
+    b.start()
+    try:
+        # Leg 1: the fault point eats the batch before it reaches any
+        # sink — flush returns 0, nothing raises.
+        faultpoint.arm(FAULT_TRACE_DROP, "drop", count=1)
+        r = b.submit(_req(max_new=3, request_id="drop-1"))
+        assert r.result(timeout=120)["tokens"]
+        assert tracer.pending() > 0
+        assert tracer.flush() == 0
+        assert tracer.dropped == 1 and DeadSink.posts == 0
+
+        # Leg 2: disarmed, the sink itself is dead — the POST raises
+        # inside flush, the batch drops, generations keep completing.
+        reqs = [b.submit(_req(max_new=3, request_id=f"drop-{i}"))
+                for i in range(2, 6)]
+        results = [r.result(timeout=120) for r in reqs]
+        assert all(res["tokens"] for res in results)
+        assert tracer.flush() == 0 and DeadSink.posts == 1
+        assert tracer.dropped == 2
+        # Zero failed requests — the acceptance gate.
+        assert b.failed == 0 and b.stats()["completed"] == 5
+    finally:
+        b.stop()
+
+
+def test_http_request_id_and_latency_exposition(http_replica):
+    """The replica front-end adopts X-Request-Id, echoes it, and /metrics
+    carries the four SLO histograms in exposition form."""
+    url, batcher = http_replica
+    req = urllib.request.Request(
+        url + "/v1/generate", method="POST",
+        data=json.dumps({"tokens": [1, 2, 3], "max_new_tokens": 3}).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Request-Id": "http-rid-1"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        assert resp.status == 200
+        assert resp.headers["X-Request-Id"] == "http-rid-1"
+        assert json.loads(resp.read())["id"] == "http-rid-1"
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as resp:
+        text = resp.read().decode()
+    for name in ("det_serve_ttft_seconds", "det_serve_tpot_seconds",
+                 "det_serve_e2e_seconds", "det_serve_queue_wait_seconds"):
+        assert f"# TYPE {name} histogram" in text
+        count = [line for line in text.splitlines()
+                 if line.startswith(f"{name}_count")]
+        assert count and int(count[0].split()[-1]) >= 1, (name, text)
+    # /v1/stats carries the summarized form next to the raw counters.
+    status, stats = _http("GET", url + "/v1/stats")
+    assert status == 200
+    assert stats["latency"]["e2e"]["count"] >= 1
+    assert stats["latency"]["e2e"]["p99_ms"] >= stats["latency"]["e2e"]["p50_ms"]
+
+
+# ---------------------------------------------------------------------------
 # Devcluster e2e (slow): submit → serve → drain → replica reschedule.
 # ---------------------------------------------------------------------------
 

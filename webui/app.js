@@ -977,13 +977,20 @@ async function pageServing() {
   // Deployments (docs/serving.md "Deployments & autoscaling"): replica
   // sets behind the /serve/{id} router; +/- adjust target within
   // [min, max], the reconciler drains or spawns to match.
+  // "p50/p99 ms" from the master's fresh-heartbeat latency aggregation
+  // (docs/serving.md "Request latency & SLOs").
+  const pp = (d, key) => {
+    const h = (d.latency || {})[key] || {};
+    return h.count ? `${h.p50_ms.toFixed(0)}/${h.p99_ms.toFixed(0)}` : "—";
+  };
   if (deployments.length) {
     view.append(el("h2", {}, "Deployments"));
     view.append(el("table", {},
-      el("tr", {}, ["ID", "Name", "State", "Replicas", "Range", "Load", ""]
+      el("tr", {}, ["ID", "Name", "State", "Replicas", "Range", "Load",
+        "TTFT p50/p99", "TPOT p50/p99", "e2e p50/p99", ""]
         .map((h) => el("th", {}, h))),
       deployments.map((d) => el("tr", {},
-        el("td", {}, d.id),
+        el("td", {}, el("a", { href: `#/serving/${d.id}` }, d.id)),
         el("td", {}, d.name),
         el("td", {}, stateBadge(d.state)),
         el("td", {}, `${d.replica_count ?? 0}/${d.target_replicas}`),
@@ -991,6 +998,9 @@ async function pageServing() {
           `[${d.min_replicas}, ${d.max_replicas}]`),
         el("td", { class: "muted" },
           d.smoothed_load != null ? d.smoothed_load.toFixed(2) : ""),
+        el("td", { class: "muted" }, pp(d, "ttft")),
+        el("td", { class: "muted" }, pp(d, "tpot")),
+        el("td", { class: "muted" }, pp(d, "e2e")),
         el("td", {}, d.state === "ACTIVE" ? [
           el("button", {
             onclick: async () => {
@@ -1039,6 +1049,74 @@ async function pageServing() {
       "no serving tasks — launch one with `det serve <config>`"));
   }
   view.append(err);
+}
+
+async function pageDeployment(id) {
+  // Deployment detail (docs/serving.md "Request latency & SLOs"):
+  // aggregated TTFT/TPOT/e2e/queue-wait percentiles, per-replica health,
+  // and the slow-request ring — request ids there feed
+  // `det serve trace <deployment> <request-id>`.
+  const { deployment: d } = await API.getDeploymentsId(id);
+  view.textContent = "";
+  view.append(el("h1", {}, `Deployment ${d.name || d.id}`));
+  view.append(el("p", { class: "muted" },
+    `${d.id} — target ${d.target_replicas} in ` +
+    `[${d.min_replicas}, ${d.max_replicas}], load ` +
+    `${(d.smoothed_load ?? 0).toFixed(2)}` +
+    (d.slo_ms ? `, SLO ${d.slo_ms} ms` : "")));
+  const lat = d.latency || {};
+  view.append(el("h2", {}, "Request latency"));
+  view.append(el("table", {},
+    el("tr", {}, ["Phase", "p50 ms", "p99 ms", "mean ms", "requests"]
+      .map((h) => el("th", {}, h))),
+    [["TTFT", "ttft"], ["TPOT (inter-token)", "tpot"], ["End-to-end", "e2e"],
+      ["Queue wait", "queue_wait"]].map(([label, key]) => {
+      const h = lat[key] || {};
+      return el("tr", {},
+        el("td", {}, label),
+        el("td", {}, h.count ? h.p50_ms.toFixed(1) : "—"),
+        el("td", {}, h.count ? h.p99_ms.toFixed(1) : "—"),
+        el("td", { class: "muted" },
+          h.mean_ms != null ? h.mean_ms.toFixed(1) : "—"),
+        el("td", { class: "muted" }, h.count ?? 0));
+    })));
+  view.append(el("h2", {}, "Replicas"));
+  view.append(el("table", {},
+    el("tr", {}, ["Task", "State", "Queue", "Active", "e2e p50/p99",
+      "Report age", ""].map((h) => el("th", {}, h))),
+    (d.replicas || []).map((r) => {
+      const e2e = (r.latency || {}).e2e || {};
+      return el("tr", {},
+        el("td", {}, el("a", { href: `#/tasks/${r.task_id}` }, r.task_id)),
+        el("td", {}, stateBadge(
+          r.retiring ? "RETIRING" : r.draining ? "DRAINING"
+            : (r.allocation_state ?? "PENDING"))),
+        el("td", { class: "muted" },
+          `${r.queue_depth}/${r.queue_capacity}`),
+        el("td", { class: "muted" }, `${r.active}/${r.slots}`),
+        el("td", { class: "muted" }, e2e.count
+          ? `${e2e.p50_ms.toFixed(0)}/${e2e.p99_ms.toFixed(0)}` : "—"),
+        el("td", { class: "muted" },
+          r.report_age_s >= 0 ? `${r.report_age_s.toFixed(1)}s` : "never"),
+        el("td", { class: "muted" }, r.breaker_open ? "ejected" : ""));
+    })));
+  view.append(el("h2", {}, "Slow requests"));
+  if ((d.slow_requests || []).length) {
+    view.append(el("table", {},
+      el("tr", {}, ["Request", "ms", "Replica", "Status"]
+        .map((h) => el("th", {}, h))),
+      d.slow_requests.map((s) => el("tr", {},
+        el("td", {}, s.request_id),
+        el("td", {}, (s.ms ?? 0).toFixed(1)),
+        el("td", { class: "muted" }, s.replica),
+        el("td", { class: "muted" }, s.status)))));
+    view.append(el("p", { class: "muted" },
+      "inspect one with `det serve trace " + d.id + " <request-id>`"));
+  } else {
+    view.append(el("p", { class: "muted" }, d.slo_ms
+      ? "no requests over the SLO"
+      : "set serving.slo_ms to record SLO-breaching requests here"));
+  }
 }
 
 async function pageTaskLogs(id) {
@@ -1153,6 +1231,8 @@ async function route() {
     const tk = hash.match(/^#\/tasks\/([\w\-]+)/);
     if (tk) return await pageTaskLogs(tk[1]);
     if (hash.startsWith("#/tasks")) return await pageTasks();
+    const dp = hash.match(/^#\/serving\/(deploy-[\w\-]+)/);
+    if (dp) return await pageDeployment(dp[1]);
     if (hash.startsWith("#/serving")) return await pageServing();
     if (hash.startsWith("#/admin")) return await pageAdmin();
     if (hash.startsWith("#/workspaces")) return await pageWorkspaces();
