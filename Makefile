@@ -3,7 +3,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint test chaos bench-input bench-serve bench-serve-fleet bench-trace bench-compile native native-test clean
+.PHONY: lint test chaos bench-input bench-serve bench-serve-fleet bench-capacity bench-trace bench-compile native native-test clean
 
 # The dogfood gate (docs/preflight.md): the platform's own models and
 # examples must pass the platform's own static analyzer. Fails on any
@@ -47,6 +47,16 @@ bench-serve:
 # Emits serve_fleet_tokens_per_s, serve_fleet_drain_dropped.
 bench-serve-fleet:
 	$(PY) bench.py --only serve_fleet
+
+# Closed capacity loop (docs/cluster-ops.md "Capacity loop"): a diurnal
+# traffic replay against the fake TPU API — the fleet grows nodes from
+# composed demand, loses every spot agent mid-plateau (drained inside
+# the notice deadline), shrinks back to ZERO nodes, then cold-starts
+# from zero within cold_start_budget_s on the warm-AOT path. Gates:
+# node count rises and falls, spot drains in deadline, cold start in
+# budget with engine_source=deserialize, dropped accepted requests == 0.
+bench-capacity:
+	$(PY) bench.py --only capacity
 
 # Elastic re-meshing: resize downtime (signal -> first post-resize step)
 # vs the restart-from-checkpoint requeue baseline for the same drain

@@ -1103,6 +1103,344 @@ def serve_fleet_bench() -> None:
         f"(gate: 1.8x; {detail})")
 
 
+def capacity_bench() -> None:
+    """`make bench-capacity` (docs/cluster-ops.md "Capacity loop"): the
+    closed capacity loop under a diurnal traffic replay.
+
+    One elastic fleet — master + GCP-shaped fake TPU API — where serving
+    demand drives MACHINES: ramp up (autoscaler raises replica target →
+    replica deficits summon nodes → this bench "boots" each created node
+    as a real agent, spot-tiered), plateau, a SPOT-KILL wave (preemption
+    notices on every spot agent + out-of-band node delete; replicas drain
+    inside the deadline while replacements re-target on-demand), ramp
+    down, idle (scale-to-zero drains the last replica, idle nodes are
+    deleted — the fleet returns to zero), then a COLD-START burst (the
+    router wakes target 0 -> 1, holds the first request within
+    cold_start_budget_s, and its trace shows serve.cold_start with
+    engine_source=deserialize — the warm-AOT path, never a re-trace).
+
+    Gates: node count demonstrably rises and falls with the replayed
+    demand, >= 1 spot agent drains inside its notice deadline, the
+    scale-to-zero -> cold-start cycle completes within the budget on the
+    warm AOT path, and dropped accepted requests == 0 across the whole
+    replay (429/503-with-Retry-After shedding is backpressure, not a
+    drop; anything else is)."""
+    import os
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    REPO = os.path.dirname(os.path.abspath(__file__))
+    subprocess.run(["make", "-C", os.path.join(REPO, "native")],
+                   check=True, capture_output=True)
+    import sys as _sys
+
+    for p in (REPO, os.path.join(REPO, "tests")):
+        if p not in _sys.path:
+            _sys.path.insert(0, p)
+    from tests.test_platform_e2e import Devcluster, _wait_http
+    from tests.test_provisioner import FakeTpuApi
+
+    tmp = tempfile.mkdtemp(prefix="bench_capacity_")
+    fake = FakeTpuApi()
+    cold_budget = 60.0
+    master_cfg = {
+        "agent_timeout_s": 15,
+        "provisioner": {
+            "type": "gcp",
+            "api_base": fake.url + "/v2",
+            "project": "p", "zone": "z",
+            "slots_per_node": 1,
+            "sustain_seconds": 0.4,
+            "cooldown_seconds": 0.8,
+            "idle_seconds": 3,
+            "reconcile_seconds": 0.3,
+            "demand_hysteresis_seconds": 2,
+            "spot": True,
+        },
+    }
+    gen_ms = 200
+    dep_cfg = {
+        "name": "diurnal",
+        "entrypoint": "python3 -m tests.fixtures.serving.fake_replica",
+        "serving": {
+            "model": "gpt2",
+            "heartbeat_period_s": 0.3,
+            "replicas": {
+                "min": 0, "max": 4, "target": 1,
+                "on_demand_floor": 1,
+                "cold_start_budget_s": cold_budget,
+                "scale_up_after_s": 1.0,
+                "scale_down_after_s": 2.5,
+                "scale_up_threshold": 0.5,
+                "scale_down_threshold": 0.1,
+            },
+        },
+        "resources": {"slots": 1},
+        "environment": {
+            "DET_FAKE_GEN_MS": str(gen_ms),
+            "DET_FAKE_SLOTS": "2",
+            "DET_FAKE_HEARTBEAT_S": "0.3",
+        },
+    }
+
+    cluster = Devcluster(tmp, os.path.join(REPO, "native", "bin"), slots=1)
+    cfg_path = os.path.join(tmp, "master.json")
+    with open(cfg_path, "w") as f:
+        json.dump(master_cfg, f)
+    cluster.master = subprocess.Popen(
+        [os.path.join(cluster.binaries, "determined-master"),
+         "--config", cfg_path, "--port", str(cluster.port),
+         "--host", "127.0.0.1", "--db", cluster.db_path],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    _wait_http(cluster.master_url + "/api/v1/master")
+
+    agents = {}          # node name -> Popen
+    node_counts = []     # (t, tracked agents alive) samples
+    dropped = []         # non-backpressure request failures
+    completed = [0]
+    stop_all = threading.Event()
+    token = cluster.login()
+    admin = cluster.login("admin")
+
+    def boot_watcher():
+        """Play the cloud: every node the provisioner creates 'boots' as
+        a real agent a moment later. Every SECOND node is spot-tiered
+        (preemptible), so the deployment floor has on-demand capacity to
+        live on and the surplus has spot to be reclaimed from."""
+        while not stop_all.is_set():
+            for i, create in enumerate(list(fake.creates)):
+                name = create["name"]
+                if name in agents or name not in fake.node_names():
+                    continue
+                spot = i % 2 == 1
+                env = dict(cluster.env)
+                if spot:
+                    env["DET_AGENT_PREEMPTIBLE"] = "1"
+                agents[name] = subprocess.Popen(
+                    [os.path.join(cluster.binaries, "determined-agent"),
+                     "--master-url", cluster.master_url, "--id", name,
+                     "--slots", "1", "--slot-type", "cpu",
+                     "--addr", "127.0.0.1",
+                     "--work-root", os.path.join(tmp, f"agent-{name}"),
+                     "--token-file", cluster.db_path + ".agent_token"],
+                    env=env, stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT)
+            time.sleep(0.2)
+
+    def sample_nodes():
+        while not stop_all.is_set():
+            node_counts.append((time.time(), len(fake.node_names())))
+            time.sleep(0.5)
+
+    def one_request(timeout=cold_budget + 30):
+        req = urllib.request.Request(
+            f"{cluster.master_url}/serve/diurnal/v1/generate",
+            data=json.dumps({"tokens": [5, 9, 17],
+                             "max_new_tokens": 8,
+                             "delay_ms": gen_ms}).encode(),
+            headers={"Content-Type": "application/json",
+                     "Authorization": f"Bearer {token}"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            out = json.loads(resp.read())
+            return resp.headers.get("X-Request-Id"), out
+
+    def client_loop(rate_hz):
+        """Closed-loop client at ~rate_hz; 429/503 honor Retry-After
+        (backpressure), anything else counts as a DROP."""
+        deadline_absent = object()
+        while not stop_all.is_set() and rate_hz[0] > 0:
+            t0 = time.time()
+            try:
+                one_request(timeout=30)
+                completed[0] += 1
+            except urllib.error.HTTPError as e:
+                if e.code in (429, 503):
+                    ra = e.headers.get("Retry-After", deadline_absent)
+                    if ra is deadline_absent:
+                        dropped.append(f"{e.code} without Retry-After")
+                    else:
+                        time.sleep(min(float(ra), 3.0))
+                else:
+                    dropped.append(f"HTTP {e.code}")
+            except Exception as e:  # noqa: BLE001
+                dropped.append(str(e)[:160])
+            sleep = 1.0 / max(rate_hz[0], 0.1) - (time.time() - t0)
+            if sleep > 0:
+                time.sleep(sleep)
+
+    threading.Thread(target=boot_watcher, daemon=True).start()
+    threading.Thread(target=sample_nodes, daemon=True).start()
+
+    phase_log = []
+    spot_drained_in_deadline = False
+    cold = {}
+    try:
+        dep = cluster.api("POST", "/api/v1/deployments",
+                          {"config": dep_cfg}, token=token)
+        assert dep["id"]
+
+        def detail():
+            return cluster.api("GET", f"/api/v1/deployments/{dep['id']}",
+                               token=token)["deployment"]
+
+        def live_replicas(d=None):
+            d = d or detail()
+            return [r for r in d["replicas"]
+                    if not r["retiring"]
+                    and r.get("allocation_state") == "RUNNING"
+                    and r.get("proxy_address")]
+
+        def wait_for(cond, timeout, what):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                v = cond()
+                if v:
+                    return v
+                time.sleep(0.3)
+            raise TimeoutError(f"capacity replay: {what}")
+
+        # --- ramp up -------------------------------------------------
+        phase_log.append(("ramp_up", time.time()))
+        wait_for(lambda: live_replicas() or None, 90,
+                 "first replica never came up")
+        rate = [2.0]
+        clients = [threading.Thread(target=client_loop, args=(rate,),
+                                    daemon=True) for _ in range(8)]
+        for c in clients:
+            c.start()
+        # Backpressure raises the target; deficits summon nodes.
+        wait_for(lambda: len(live_replicas()) >= 3 or None, 120,
+                 "autoscaler never grew the fleet under load")
+        peak_nodes = len(fake.node_names())
+
+        # --- plateau -------------------------------------------------
+        phase_log.append(("plateau", time.time()))
+        time.sleep(5)
+
+        # --- spot-kill wave -----------------------------------------
+        phase_log.append(("spot_kill", time.time()))
+        spot_agents = [a["id"] for a in cluster.api(
+            "GET", "/api/v1/agents", token=token)["agents"]
+            if a["preemptible"] and a["alive"]]
+        assert spot_agents, "replay never placed capacity on spot"
+        kill_deadline_s = 20.0
+        t_notice = time.time()
+        for aid in spot_agents:
+            cluster.api("POST", f"/api/v1/agents/{aid}/preempt_notice",
+                        {"deadline_seconds": kill_deadline_s,
+                         "reason": "spot_preemption"}, token=admin)
+
+        def spot_drained():
+            d = detail()
+            draining = [r for r in d["replicas"]
+                        if r.get("agent") in spot_agents
+                        and r.get("allocation_state") == "RUNNING"]
+            return not draining or None
+
+        wait_for(spot_drained, kill_deadline_s + 10,
+                 "spot replicas never finished draining")
+        spot_drained_in_deadline = \
+            time.time() - t_notice <= kill_deadline_s + 5
+        # The nodes actually vanish (the cloud reclaims them).
+        for aid in spot_agents:
+            fake.interrupt(aid)
+            p = agents.get(aid)
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait()
+        # Service continues on on-demand capacity.
+        wait_for(lambda: live_replicas() or None, 60,
+                 "no live replica after the spot wave")
+
+        # --- ramp down → idle → scale-to-zero ------------------------
+        phase_log.append(("ramp_down", time.time()))
+        rate[0] = 0
+        stop_all_clients = time.time()
+        for c in clients:
+            c.join(timeout=40)
+
+        def fleet_zero():
+            d = detail()
+            return (int(d["target_replicas"]) == 0 and not d["replicas"]
+                    and not fake.node_names()) or None
+
+        wait_for(fleet_zero, 150,
+                 "fleet never scaled to zero (replicas + nodes)")
+        phase_log.append(("zero", time.time()))
+        trough_nodes = len(fake.node_names())
+
+        # --- cold-start burst ---------------------------------------
+        phase_log.append(("cold_burst", time.time()))
+        t_cold = time.time()
+        rid, out = one_request()   # held through the wake, never shed
+        cold_wall_s = time.time() - t_cold
+        completed[0] += 1
+        trace = cluster.api(
+            "GET",
+            f"/api/v1/deployments/{dep['id']}/requests/{rid}/trace",
+            token=token)
+        spans = {s["name"]: s for s in trace["spans"]}
+        cold_span = spans.get("serve.cold_start")
+        cold = {
+            "wall_s": round(cold_wall_s, 2),
+            "within_budget": cold_wall_s <= cold_budget,
+            "span_present": cold_span is not None,
+            "engine_source": (cold_span or {}).get(
+                "attrs", {}).get("engine_source"),
+        }
+        # A few follow-ups ride the now-warm deployment.
+        for _ in range(4):
+            one_request(timeout=30)
+            completed[0] += 1
+    finally:
+        stop_all.set()
+        for p in agents.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        cluster.stop()
+        fake.stop()
+
+    counts = [n for _, n in node_counts]
+    detail_out = {
+        "phases": [(name, round(t - phase_log[0][1], 1))
+                   for name, t in phase_log],
+        "node_count_peak": max(counts) if counts else 0,
+        "node_count_final": trough_nodes,
+        "nodes_created_total": len(fake.creates),
+        "completed_requests": completed[0],
+        "dropped": dropped[:10],
+        "spot_agents_killed": len(spot_agents),
+        "spot_drained_in_deadline": spot_drained_in_deadline,
+        "cold_start": cold,
+        "idle_window_s": round(time.time() - stop_all_clients, 1),
+    }
+    print(json.dumps({
+        "metric": "capacity_diurnal_dropped",
+        "value": len(dropped),
+        "unit": "accepted requests dropped across the replay (gate: 0)",
+        "detail": detail_out,
+    }))
+    print(json.dumps({
+        "metric": "capacity_cold_start_s",
+        "value": cold.get("wall_s"),
+        "unit": f"scale-from-zero wake to first response "
+                f"(gate: <= {cold_budget}s, warm AOT)",
+        "detail": cold,
+    }))
+    assert max(counts) >= 3, f"fleet never grew: peak={max(counts)}"
+    assert trough_nodes == 0, "fleet never shrank back to zero nodes"
+    assert spot_drained_in_deadline, "spot wave missed its drain deadline"
+    assert not dropped, f"dropped accepted requests: {dropped[:5]}"
+    assert cold["within_budget"] and cold["span_present"], cold
+    assert cold["engine_source"] == "deserialize", cold
+    assert peak_nodes >= 2
+
+
 def pp_compile_check() -> None:
     """AOT-compile the bf16 pipeline-parallel train step against a v5e 2x2
     TPU topology (deviceless — works with the single bench chip).
@@ -1185,6 +1523,7 @@ def main() -> int:
         "input": input_pipeline_bench,
         "serve": serve_bench,
         "serve_fleet": serve_fleet_bench,
+        "capacity": capacity_bench,
         "elastic": elastic_bench,
         "trace": trace_bench,
         "compile": compile_bench,

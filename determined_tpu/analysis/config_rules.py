@@ -195,6 +195,46 @@ def check_config(config: Dict[str, Any]) -> List[Diagnostic]:
                     "could ever be admitted; raise kv_num_blocks or lower "
                     "max_seq_len"))
 
+    # DTL207 — capacity-loop knobs (docs/cluster-ops.md "Capacity loop"):
+    # the scale-to-zero / spot-floor configuration must be satisfiable, or
+    # the deployment either can't be created (master re-check) or pins
+    # behavior the operator didn't mean (a floor above max would force
+    # every replica on-demand forever).
+    if isinstance(serving, dict) and isinstance(serving.get("replicas"),
+                                                dict):
+        rep = serving["replicas"]
+
+        def _int(key, default):
+            v = rep.get(key, default)
+            return v if isinstance(v, int) and not isinstance(v, bool) \
+                else default
+
+        mn = _int("min", 1)
+        tgt = _int("target", mn)
+        mx = _int("max", max(1, mn, tgt))
+        if mn < 0:
+            diags.append(RULES["DTL207"].diag(
+                f"serving.replicas.min={mn} is negative; 0 "
+                "(scale-to-zero) is the smallest legal floor"))
+        elif mn > mx:
+            diags.append(RULES["DTL207"].diag(
+                f"serving.replicas.min={mn} exceeds max={mx}"))
+        floor = rep.get("on_demand_floor", max(mn, 0))
+        if isinstance(floor, int) and not isinstance(floor, bool) and (
+                floor < 0 or floor > mx):
+            diags.append(RULES["DTL207"].diag(
+                f"serving.replicas.on_demand_floor={floor} must be within "
+                f"[0, max={mx}]: a floor above max can never be satisfied "
+                "and would pin every replica to on-demand capacity"))
+        budget = rep.get("cold_start_budget_s")
+        if budget is not None and (
+                isinstance(budget, bool)
+                or not isinstance(budget, (int, float)) or budget <= 0):
+            diags.append(RULES["DTL207"].diag(
+                "serving.replicas.cold_start_budget_s must be a positive "
+                "number of seconds: it bounds how long the router holds a "
+                "request while a scale-from-zero replica restores"))
+
     # DTL203 — restarts configured but nothing to restart from. Only an
     # EXPLICIT min_checkpoint_period: 0 fires (key present): the default is
     # also 0 batches and flagging every config would be pure noise.

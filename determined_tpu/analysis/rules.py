@@ -113,6 +113,14 @@ _ALL = [
          "for at least one max_seq_len sequence — otherwise the replica "
          "fails at engine startup (or requests can never be admitted) "
          "instead of at config time"),
+    Rule("DTL207", "serving-capacity-knobs", "error", "config",
+         "a deployment's capacity-loop knobs are unsatisfiable "
+         "(docs/cluster-ops.md 'Capacity loop'): serving.replicas.min "
+         "must be >= 0 (0 = scale-to-zero) and <= max, "
+         "on_demand_floor must fit within [0, max] (a floor above max "
+         "can never be met), and cold_start_budget_s must be a positive "
+         "number — it bounds how long the router holds a request while a "
+         "scale-from-zero replica restores"),
 ]
 
 RULES: Dict[str, Rule] = {r.code: r for r in _ALL}

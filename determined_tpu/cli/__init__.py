@@ -701,6 +701,9 @@ def cmd_agent_list(session: Session, args) -> int:
         {
             "id": a["id"],
             "pool": a["resource_pool"],
+            # Capacity tier (docs/cluster-ops.md "Capacity loop"): spot
+            # nodes are reclaimable surplus; deployment floors avoid them.
+            "class": "spot" if a.get("preemptible") else "on-demand",
             "alive": a["alive"],
             "state": a.get("state", "ENABLED")
             + (f" ({a['drain_reason']})" if a.get("drain_reason") else ""),
@@ -709,7 +712,8 @@ def cmd_agent_list(session: Session, args) -> int:
         }
         for a in agents
     ]
-    _print_table(rows, ["id", "pool", "alive", "state", "slots", "used"])
+    _print_table(rows, ["id", "pool", "class", "alive", "state", "slots",
+                        "used"])
     return 0
 
 

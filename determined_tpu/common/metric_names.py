@@ -77,6 +77,20 @@ MASTER_METRICS: Dict[str, Tuple[str, str]] = {
     "det_serve_slo_breaches_total": (
         "counter", "Routed generations whose wall time exceeded the "
         "deployment's serving.slo_ms"),
+    "det_serve_cold_starts_total": (
+        "counter", "Scale-from-zero demand wakes: the router bumped a "
+        "deployment's target 0 -> 1 and held the request "
+        "(docs/serving.md 'Scale to zero')"),
+    "det_provisioner_demand_slots": (
+        "gauge", "Composed provisioner demand by pool and source "
+        "(pending/elastic/serving/compile; docs/cluster-ops.md "
+        "'Capacity loop')"),
+    "det_provisioner_nodes": (
+        "gauge", "Provisioner-managed cloud nodes by pool and state "
+        "(CREATING/READY/DELETING)"),
+    "det_provisioner_create_failures_total": (
+        "counter", "Cloud node-create failures (each arms the per-pool "
+        "exponential backoff)"),
     "det_api_requests_total": ("counter", "API requests by status code"),
     "det_api_request_seconds": (
         "histogram", "API request latency by route family"),
@@ -166,6 +180,11 @@ SPAN_NAMES: Dict[str, Tuple[str, str]] = {
     "serve.router.dispatch": (
         "master", "One router forward attempt: replica chosen, retries, "
         "breaker state in attrs (a retried request shows two)"),
+    "serve.cold_start": (
+        "master", "Scale-from-zero hold: how long the router parked the "
+        "waking request and whether the replica's engine deserialized "
+        "(warm AOT) or traced — wait_ms/budget_s/replica/engine_source "
+        "in attrs"),
 }
 
 _METRIC_RE = re.compile(r"^det(_[a-z0-9]+)+$")

@@ -193,6 +193,26 @@ class FarmClient:
         t.start()
         self._threads.append(t)
 
+    def save_local(self, files: Dict[str, bytes]) -> bool:
+        """Write artifacts into the node-local AOT dir (the same place the
+        agent pre-warms into), so the NEXT process on this node warm-loads
+        them even without a master round-trip — serving replicas use this
+        for scale-from-zero cold starts. Best-effort like upload."""
+        if not self.signature or not self.aot_dir or not files:
+            return False
+        try:
+            d = os.path.join(self.aot_dir, self.signature)
+            os.makedirs(d, exist_ok=True)
+            for name, data in files.items():
+                tmp = os.path.join(d, name + ".tmp")
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, os.path.join(d, name))
+            return True
+        except OSError:
+            logger.debug("local AOT save failed", exc_info=True)
+            return False
+
     def collect_new_cache_files(self) -> Dict[str, bytes]:
         return new_cache_files(self.xla_cache_dir, self._cache_before)
 

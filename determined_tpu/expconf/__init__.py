@@ -388,7 +388,7 @@ def _validate_serving(block: Any, errors: List[str]) -> None:
              "kv_num_blocks", "prefix_cache", "attention_impl",
              "prefill_buckets", "queue_depth", "port", "seed",
              "stats_log_period_s", "replicas", "heartbeat_period_s",
-             "trace_sample", "slo_ms"}
+             "trace_sample", "slo_ms", "warm_aot"}
     unknown = sorted(set(block) - valid)
     if unknown:
         errors.append(
@@ -414,6 +414,9 @@ def _validate_serving(block: Any, errors: List[str]) -> None:
     pc = block.get("prefix_cache")
     if pc is not None and not isinstance(pc, bool):
         errors.append("serving.prefix_cache must be a boolean")
+    wa = block.get("warm_aot")
+    if wa is not None and not isinstance(wa, bool):
+        errors.append("serving.warm_aot must be a boolean")
     impl = block.get("attention_impl")
     if impl is not None and impl not in ("auto", "pallas", "reference",
                                          "dense"):
@@ -463,7 +466,11 @@ def _validate_serving_replicas(block: Any, errors: List[str]) -> None:
     """`serving.replicas:` — a deployment (docs/serving.md "Deployments &
     autoscaling"): the master keeps `target` replicas within [min, max],
     and the autoscaler moves target from sustained backpressure / idle
-    cooldown when min < max."""
+    cooldown when min < max. `min: 0` enables scale-to-zero: an idle
+    deployment drains its last replica, and the router's demand wake
+    respawns one within `cold_start_budget_s`. `on_demand_floor` replicas
+    (default: min) avoid preemptible agents; everything above the floor
+    is reclaimable spot surplus."""
     if block is None:
         return
     if not isinstance(block, dict):
@@ -471,7 +478,8 @@ def _validate_serving_replicas(block: Any, errors: List[str]) -> None:
         return
     valid = {"min", "max", "target", "scale_up_after_s",
              "scale_down_after_s", "scale_up_threshold",
-             "scale_down_threshold"}
+             "scale_down_threshold", "on_demand_floor",
+             "cold_start_budget_s"}
     unknown = sorted(set(block) - valid)
     if unknown:
         errors.append(
@@ -482,18 +490,44 @@ def _validate_serving_replicas(block: Any, errors: List[str]) -> None:
         v = block.get(key)
         if v is None:
             continue
-        if isinstance(v, bool) or not isinstance(v, int) or v < 1:
-            errors.append(f"serving.replicas.{key} must be a positive int")
+        if key == "max":
+            if isinstance(v, bool) or not isinstance(v, int) or v < 1:
+                errors.append(
+                    f"serving.replicas.{key} must be a positive int")
+            else:
+                counts[key] = v
+        elif isinstance(v, bool) or not isinstance(v, int) or v < 0:
+            # min: 0 (and target: 0 with it) is scale-to-zero, legal.
+            errors.append(
+                f"serving.replicas.{key} must be a non-negative int")
         else:
             counts[key] = v
     lo = counts.get("min", 1)
-    hi = counts.get("max", max(lo, counts.get("target", lo)))
+    hi = counts.get("max", max(lo, counts.get("target", lo), 1))
     target = counts.get("target", lo)
     if "min" in counts and "max" in counts and lo > hi:
         errors.append("serving.replicas.min must be <= max")
     elif not (lo <= target <= hi):
         errors.append(
             "serving.replicas.target must be within [min, max]")
+    floor = block.get("on_demand_floor")
+    if floor is not None:
+        if isinstance(floor, bool) or not isinstance(floor, int) or floor < 0:
+            errors.append(
+                "serving.replicas.on_demand_floor must be a non-negative "
+                "int")
+        elif "max" in counts and floor > counts["max"]:
+            errors.append(
+                "serving.replicas.on_demand_floor must be <= max (a floor "
+                "above max can never be satisfied)")
+    budget = block.get("cold_start_budget_s")
+    if budget is not None and (
+        isinstance(budget, bool) or not isinstance(budget, (int, float))
+        or budget <= 0
+    ):
+        errors.append(
+            "serving.replicas.cold_start_budget_s must be a positive "
+            "number")
     for key in ("scale_up_after_s", "scale_down_after_s"):
         v = block.get(key)
         if v is not None and (
@@ -738,7 +772,8 @@ def apply_defaults(config: Dict[str, Any]) -> Dict[str, Any]:
             rep = s["replicas"]
             rep.setdefault("min", 1)
             rep.setdefault("target", rep["min"])
-            rep.setdefault("max", max(rep["min"], rep["target"]))
+            # max must stay >= 1 even under min: 0 (scale-to-zero).
+            rep.setdefault("max", max(rep["min"], rep["target"], 1))
         # No searcher/validation machinery for a deployment config.
         return c
     searcher = c.setdefault("searcher", {})

@@ -174,6 +174,12 @@ class ServingEngine:
         self._compiled_sample = None
         self._compiled_copy_block = None
         self.compile_stats: Dict[str, float] = {}
+        # Warm-AOT provenance (docs/serving.md "Scale to zero"): how this
+        # engine got its executables — "deserialize" when every piece came
+        # from the compile-farm artifact store (a scale-from-zero cold
+        # start that never re-traced), "mixed" for a partial hit, "trace"
+        # for a cold compile.
+        self.aot_source = "trace"
         # device-call counters (drained into /v1/stats)
         self.decode_steps = 0
         self.prefills = 0
@@ -231,14 +237,50 @@ class ServingEngine:
 
     # -- compilation ---------------------------------------------------
 
-    def compile(self) -> Dict[str, float]:
+    def compile(self, farm=None) -> Dict[str, float]:
         """AOT-compile decode + every prefill bucket + the sampler.
 
         Runs before the HTTP front-end admits anything, so request latency
         never includes a trace/compile (and a config the model can't
         compile fails the replica at startup, not mid-traffic).
+
+        With a `farm` (compile.runtime.FarmClient scoped to the serving
+        signature), each executable is first warm-loaded from the PR-9
+        artifact store — node-local AOT dir, then master — and only
+        compiled when no artifact exists; fresh compiles are saved back
+        (locally and uploaded) so the NEXT cold start deserializes in
+        tens of milliseconds instead of tracing. This is what makes a
+        scale-from-zero respawn fit inside cold_start_budget_s.
         """
         import jax
+
+        from determined_tpu.compile import runtime as _crt
+
+        fresh_artifacts: Dict[str, bytes] = {}
+        hits = misses = 0
+
+        def acquire(key, build):
+            """Farm-load executable `key` or compile it fresh (queuing the
+            serialized result for save-back). Farm failures degrade to the
+            plain compile — the farm is an accelerator, not a dependency."""
+            nonlocal hits, misses
+            if farm is not None:
+                loaded = farm.load_executable(key)
+                if loaded is not None:
+                    hits += 1
+                    self.compile_stats[f"{key}_source"] = "deserialize"
+                    return loaded
+            compiled = build()
+            misses += 1
+            if farm is not None:
+                self.compile_stats[f"{key}_source"] = "trace"
+                try:
+                    fresh_artifacts[_crt.aot_artifact_name(key)] = \
+                        _crt.serialize_compiled(compiled)
+                except Exception:
+                    logger.debug("serve AOT serialize failed for %s", key,
+                                 exc_info=True)
+            return compiled
 
         t_all = time.monotonic()
         cfg, rules = self.cfg, self.rules
@@ -263,61 +305,89 @@ class ServingEngine:
 
         t0 = time.monotonic()
         if self.paged:
-            decode = jax.jit(
-                lambda p, c, t, pos, tbl: smodel.paged_decode_step(
-                    p, c, t, pos, tbl, cfg, rules, attention_impl=impl),
-                donate_argnums=(1,))
-            self._compiled_decode = decode.lower(
-                params_sd, cache_sd, sds((self.slots,), i32),
-                sds((self.slots,), i32), sds((self.slots, mb), i32)).compile()
+            def build_decode():
+                decode = jax.jit(
+                    lambda p, c, t, pos, tbl: smodel.paged_decode_step(
+                        p, c, t, pos, tbl, cfg, rules, attention_impl=impl),
+                    donate_argnums=(1,))
+                return decode.lower(
+                    params_sd, cache_sd, sds((self.slots,), i32),
+                    sds((self.slots,), i32),
+                    sds((self.slots, mb), i32)).compile()
         else:
-            decode = jax.jit(
-                lambda p, c, t, pos: smodel.decode_step(
-                    p, c, t, pos, cfg, rules),
-                donate_argnums=(1,))
-            self._compiled_decode = decode.lower(
-                params_sd, cache_sd,
-                sds((self.slots,), i32), sds((self.slots,), i32)).compile()
+            def build_decode():
+                decode = jax.jit(
+                    lambda p, c, t, pos: smodel.decode_step(
+                        p, c, t, pos, cfg, rules),
+                    donate_argnums=(1,))
+                return decode.lower(
+                    params_sd, cache_sd,
+                    sds((self.slots,), i32), sds((self.slots,), i32)).compile()
+        self._compiled_decode = acquire("decode", build_decode)
         self.compile_stats["decode_s"] = round(time.monotonic() - t0, 3)
 
         for bucket in self.prefill_buckets:
             t0 = time.monotonic()
             if self.paged:
-                pf = jax.jit(
-                    lambda p, c, t, ln, pfx, tbl: smodel.paged_prefill(
-                        p, c, t, ln, pfx, tbl, cfg, rules),
-                    donate_argnums=(1,))
-                self._compiled_prefill[bucket] = pf.lower(
-                    params_sd, cache_sd, sds((bucket,), i32),
-                    sds((), i32), sds((), i32), sds((mb,), i32)).compile()
+                def build_prefill(bucket=bucket):
+                    pf = jax.jit(
+                        lambda p, c, t, ln, pfx, tbl: smodel.paged_prefill(
+                            p, c, t, ln, pfx, tbl, cfg, rules),
+                        donate_argnums=(1,))
+                    return pf.lower(
+                        params_sd, cache_sd, sds((bucket,), i32),
+                        sds((), i32), sds((), i32), sds((mb,), i32)).compile()
             else:
-                pf = jax.jit(
-                    lambda p, c, t, ln, sl: smodel.prefill(
-                        p, c, t, ln, sl, cfg, rules),
-                    donate_argnums=(1,))
-                self._compiled_prefill[bucket] = pf.lower(
-                    params_sd, cache_sd, sds((bucket,), i32),
-                    sds((), i32), sds((), i32)).compile()
+                def build_prefill(bucket=bucket):
+                    pf = jax.jit(
+                        lambda p, c, t, ln, sl: smodel.prefill(
+                            p, c, t, ln, sl, cfg, rules),
+                        donate_argnums=(1,))
+                    return pf.lower(
+                        params_sd, cache_sd, sds((bucket,), i32),
+                        sds((), i32), sds((), i32)).compile()
+            self._compiled_prefill[bucket] = acquire(
+                f"prefill_{bucket}", build_prefill)
             self.compile_stats[f"prefill_{bucket}_s"] = round(
                 time.monotonic() - t0, 3)
 
         if self.paged:
             t0 = time.monotonic()
-            cp = jax.jit(smodel.copy_paged_block, donate_argnums=(0,))
-            self._compiled_copy_block = cp.lower(
-                cache_sd, sds((), i32), sds((), i32)).compile()
+
+            def build_copy():
+                cp = jax.jit(smodel.copy_paged_block, donate_argnums=(0,))
+                return cp.lower(
+                    cache_sd, sds((), i32), sds((), i32)).compile()
+            self._compiled_copy_block = acquire("copy_block", build_copy)
             self.compile_stats["copy_block_s"] = round(
                 time.monotonic() - t0, 3)
 
         t0 = time.monotonic()
-        sample = jax.jit(smodel.sample)
-        self._compiled_sample = sample.lower(
-            sds((self.slots, cfg.vocab_size), f32),
-            sds((self.slots,), f32),
-            sds((2,), np.uint32)).compile()
+
+        def build_sample():
+            sample = jax.jit(smodel.sample)
+            return sample.lower(
+                sds((self.slots, cfg.vocab_size), f32),
+                sds((self.slots,), f32),
+                sds((2,), np.uint32)).compile()
+        self._compiled_sample = acquire("sample", build_sample)
         self.compile_stats["sample_s"] = round(time.monotonic() - t0, 3)
         self.compile_stats["total_s"] = round(time.monotonic() - t_all, 3)
-        logger.info("serving engine compiled: %s", self.compile_stats)
+        if hits > 0:
+            self.aot_source = "deserialize" if misses == 0 else "mixed"
+        else:
+            self.aot_source = "trace"
+        self.compile_stats["aot_hits"] = hits
+        self.compile_stats["aot_misses"] = misses
+        if farm is not None and fresh_artifacts:
+            # Save-back off the serving path: node-local first (the next
+            # respawn on this node needs no master), then the farm store.
+            farm.save_local(fresh_artifacts)
+            farm.upload_async(
+                fresh_artifacts,
+                compile_ms=self.compile_stats["total_s"] * 1e3)
+        logger.info("serving engine compiled (%s): %s", self.aot_source,
+                    self.compile_stats)
         return dict(self.compile_stats)
 
     def bucket_for(self, length: int) -> Optional[int]:

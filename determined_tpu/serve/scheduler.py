@@ -374,7 +374,11 @@ class ContinuousBatcher:
     def start(self) -> "ContinuousBatcher":
         if self._thread is not None:
             return self
-        self.engine.compile()  # AOT everything before the first admit
+        # AOT everything before the first admit; an attached FarmClient
+        # (engine.farm, set by the task entrypoint) warm-loads executables
+        # from the PR-9 artifact store instead of tracing — the
+        # scale-from-zero cold-start path (docs/serving.md).
+        self.engine.compile(farm=getattr(self.engine, "farm", None))
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="serve-batcher")
         self._thread.start()
@@ -691,6 +695,10 @@ class ContinuousBatcher:
             "prefix_cache_hit_rate": kv.get("prefix_cache_hit_rate", 0.0),
             "draining": self.queue.draining,
             "retry_after_hint_s": self.retry_after_hint(),
+            # Warm-AOT provenance: "deserialize" proves a cold start
+            # restored executables instead of tracing (the master's
+            # serve.cold_start span resurfaces it).
+            "engine_source": getattr(self.engine, "aot_source", "trace"),
             # Mergeable latency histograms (boundaries + cumulative
             # counts): the master sums counts across fresh replicas into
             # the per-deployment p50/p99 on the deployment APIs and the

@@ -124,6 +124,25 @@ def build_model(serving: Dict[str, Any]):
     )
 
 
+def serving_signature(serving: Dict[str, Any]) -> str:
+    """Compile-farm signature for a serving config: every shape-affecting
+    knob (model geometry, slots, buckets, paged-KV layout) plus the
+    runtime tag, so two replicas of the same deployment — or a respawn
+    after scale-to-zero — address the same AOT artifacts, and a config
+    change can never load a stale executable."""
+    import hashlib
+
+    from determined_tpu.compile.signature import runtime_tag
+
+    shape_keys = ("model", "model_config", "max_batch_size", "max_seq_len",
+                  "kv_block_size", "kv_num_blocks", "prefill_buckets",
+                  "attention_impl", "seed")
+    key = {k: serving.get(k) for k in shape_keys}
+    key["runtime_tag"] = runtime_tag()
+    blob = json.dumps(key, sort_keys=True, default=str).encode()
+    return "serve-" + hashlib.sha256(blob).hexdigest()[:32]
+
+
 def _trial_id_for(serving: Dict[str, Any]) -> int:
     from determined_tpu.core._checkpoint import _STATE_ID_RE
 
@@ -167,6 +186,15 @@ def build_replica(config: Dict[str, Any], session=None):
         kv_block_size=block_size,
         kv_num_blocks=int(num_blocks) if num_blocks else None,
     )
+    # Warm AOT (docs/serving.md "Scale to zero"): scope a compile-farm
+    # client to this config's serving signature so compile() deserializes
+    # executables from the node-local AOT dir / master artifact store and
+    # saves fresh compiles back. Opt out with serving.warm_aot: false.
+    if serving.get("warm_aot", True):
+        from determined_tpu.compile.runtime import FarmClient
+
+        engine.farm = FarmClient(
+            session=session, signature=serving_signature(serving))
     if engine.paged:
         # The device pool IS the budget: the manager mirrors it exactly.
         blocks = BlockManager(

@@ -61,6 +61,12 @@ struct AgentOptions {
   std::string master_cert_file;
   int slots_override = -1;  // DET_AGENT_SLOTS / --slots ("artificial")
   std::string slot_type = "auto";
+  // Capacity class declared to the master at registration: a preemptible
+  // (spot) node is reclaimable surplus — the scheduler keeps deployment
+  // floors off it and places surplus serve replicas on it first
+  // (docs/cluster-ops.md "Capacity loop"). Deploy tooling wires this from
+  // the instance's schedulingConfig.
+  bool preemptible = false;
   double poll_timeout_s = 20.0;
   // Spot-capacity survival (docs/cluster-ops.md "Preemption & drain"):
   // grace the agent advertises when IT is told to terminate (SIGTERM),
@@ -1027,6 +1033,7 @@ bool register_with_master(const AgentOptions& opts, bool reconnect) {
   body["resource_pool"] = opts.resource_pool;
   body["addr"] = opts.addr;
   body["reconnect"] = reconnect;
+  body["preemptible"] = opts.preemptible;
   AgentOptions mut = opts;
   Json slots = detect_slots(mut);
   g_slots = static_cast<int>(slots.as_array().size());
@@ -1373,6 +1380,9 @@ int main(int argc, char** argv) {
       opts.slots_override = static_cast<int>(j["slots"].as_int());
     }
     if (j["slot_type"].is_string()) opts.slot_type = j["slot_type"].as_string();
+    if (j["preemptible"].is_bool()) {
+      opts.preemptible = j["preemptible"].as_bool();
+    }
     if (j["term_grace_s"].is_number()) {
       opts.term_grace_s = j["term_grace_s"].as_double();
     }
@@ -1392,6 +1402,9 @@ int main(int argc, char** argv) {
     opts.slots_override = atoi(p);
   }
   if (const char* p = getenv("DET_AGENT_TOKEN_FILE")) opts.token_file = p;
+  if (const char* p = getenv("DET_AGENT_PREEMPTIBLE")) {
+    opts.preemptible = std::string(p) == "1" || std::string(p) == "true";
+  }
   if (const char* p = getenv("DET_MASTER_CERT_FILE")) {
     opts.master_cert_file = p;
   }
@@ -1420,6 +1433,7 @@ int main(int argc, char** argv) {
     else if (a == "--addr") opts.addr = next();
     else if (a == "--slots") opts.slots_override = atoi(next().c_str());
     else if (a == "--slot-type") opts.slot_type = next();
+    else if (a == "--preemptible") opts.preemptible = true;
     else if (a == "--work-root") opts.work_root = next();
     else if (a == "--token-file") opts.token_file = next();
     else if (a == "--master-cert-file") opts.master_cert_file = next();
@@ -1431,7 +1445,7 @@ int main(int argc, char** argv) {
     else if (a == "--help" || a == "-h") {
       std::cout << "determined-agent [--config agent.json] --master-url URL "
                    "[--id ID] [--resource-pool P] [--addr A] [--slots N] "
-                   "[--slot-type tpu|cpu] [--work-root DIR] "
+                   "[--slot-type tpu|cpu] [--preemptible] [--work-root DIR] "
                    "[--token-file PATH] [--term-grace SECONDS] "
                    "[--notice-source gce] [--notice-file PATH] "
                    "[--metrics-port N  (0 off, -1 ephemeral)]\n";

@@ -205,6 +205,20 @@ MasterConfig MasterConfig::from_json(const Json& j) {
     p.boot_grace_s = prov["boot_grace_seconds"].as_double(p.boot_grace_s);
     p.spot = prov["spot"].as_bool(p.spot);
     p.node_prefix = prov["node_prefix"].as_string(p.node_prefix);
+    // Capacity-loop knobs (docs/cluster-ops.md "Capacity loop").
+    p.demand_hysteresis_s =
+        prov["demand_hysteresis_seconds"].as_double(p.demand_hysteresis_s);
+    p.create_backoff_base_s =
+        prov["create_backoff_base_seconds"].as_double(p.create_backoff_base_s);
+    p.create_backoff_max_s =
+        prov["create_backoff_max_seconds"].as_double(p.create_backoff_max_s);
+    p.compile_demand_weight = static_cast<int>(
+        prov["compile_demand_weight"].as_int(p.compile_demand_weight));
+    p.compile_demand_max_slots = static_cast<int>(
+        prov["compile_demand_max_slots"].as_int(p.slots_per_node));
+  }
+  if (c.provisioner.compile_demand_max_slots < 0) {
+    c.provisioner.compile_demand_max_slots = c.provisioner.slots_per_node;
   }
   return c;
 }
@@ -1070,6 +1084,31 @@ HttpResponse Master::handle_prometheus_metrics() {
       out << "det_compile_jobs{state=\"" << r["state"].as_string("")
           << "\"} " << r["n"].as_int(0) << "\n";
     }
+    // Capacity loop (docs/cluster-ops.md): the composed demand the
+    // provisioner last saw, by pool and source — the attribution that
+    // answers "what is summoning these machines".
+    if (!prov_demand_.empty()) {
+      out << "# TYPE det_provisioner_demand_slots gauge\n";
+      for (const auto& [pool, sources] : prov_demand_) {
+        for (const auto& [source, slots] : sources) {
+          out << "det_provisioner_demand_slots{pool=\"" << pool
+              << "\",source=\"" << source << "\"} " << slots << "\n";
+        }
+      }
+    }
+    if (provisioner_ && provisioner_->enabled()) {
+      std::map<std::string, std::map<std::string, int>> by_pool_state;
+      for (const auto& n : provisioner_->nodes()) {
+        by_pool_state[n.pool][n.state]++;
+      }
+      out << "# TYPE det_provisioner_nodes gauge\n";
+      for (const auto& [pool, states] : by_pool_state) {
+        for (const auto& [state, count] : states) {
+          out << "det_provisioner_nodes{pool=\"" << pool << "\",state=\""
+              << state << "\"} " << count << "\n";
+        }
+      }
+    }
     // Serving deployments (docs/serving.md "Deployments & autoscaling"):
     // per-deployment replica-state gauges — ready (routable), starting
     // (placed but not yet registered), draining (scale-down or preempt in
@@ -1167,7 +1206,13 @@ HttpResponse Master::handle_prometheus_metrics() {
       << fleet_.request_spans_ingested.load() << "\n"
       << "# TYPE det_serve_slo_breaches_total counter\n"
       << "det_serve_slo_breaches_total " << fleet_.slo_breaches.load()
-      << "\n";
+      << "\n"
+      << "# TYPE det_serve_cold_starts_total counter\n"
+      << "det_serve_cold_starts_total " << fleet_.cold_starts.load()
+      << "\n"
+      << "# TYPE det_provisioner_create_failures_total counter\n"
+      << "det_provisioner_create_failures_total "
+      << (provisioner_ ? provisioner_->create_failures_total() : 0) << "\n";
   {
     std::lock_guard<std::mutex> lock(api_stats_.mu);
     out << "# TYPE det_api_requests_total counter\n";

@@ -62,6 +62,7 @@ HttpResponse Master::handle_agents_api(const HttpRequest& req,
           {"addr", Json(a.addr)},
           {"alive", Json(a.alive)},
           {"state", Json(state)},
+          {"preemptible", Json(a.preemptible)},
           {"drain_reason", Json(a.drain_reason)},
           {"drain_deadline_seconds",
            Json(a.draining && a.drain_deadline > 0
@@ -105,6 +106,10 @@ HttpResponse Master::handle_agents_api(const HttpRequest& req,
     a.id = id;
     a.resource_pool = body["resource_pool"].as_string(cfg_.default_pool);
     a.addr = body["addr"].as_string(req.remote_addr);
+    // Spot/preemptible capacity class (docs/cluster-ops.md "Capacity
+    // loop"): declared at registration (agent --preemptible / config);
+    // a reconnect without the field keeps the previous declaration.
+    a.preemptible = body["preemptible"].as_bool(a.preemptible);
     a.last_heartbeat = now();
     a.alive = true;
     if (fresh) {
@@ -490,20 +495,96 @@ void Master::check_agents_locked() {
   // scaled down. Every pool with demand OR capacity OR a tracked node
   // gets an observation — scale-DOWN decisions need ticks with zero
   // pending demand, which the old demand-only enumeration never gave.
+  //
+  // Demand is COMPOSED (docs/cluster-ops.md "Capacity loop"), not just
+  // queued-allocation slots: serving replica deficits, elastic trials at
+  // their MIN size, and the compile backlog all count, each under its own
+  // source label (det_provisioner_demand_slots{source=}).
   if (provisioner_ && provisioner_->enabled()) {
     std::map<std::string, ScalingSnapshot> pools;
     for (const auto& aid : pending_) {
       auto it = allocations_.find(aid);
       if (it == allocations_.end() || it->second.state != "PENDING") continue;
-      ScalingSnapshot& s = pools[it->second.resource_pool];
-      s.pending_slots += it->second.slots;
+      const Allocation& alloc = it->second;
+      ScalingSnapshot& s = pools[alloc.resource_pool];
       s.pending_allocations += 1;
+      int slots = alloc.slots;
+      std::string source = "pending";
+      auto env_it = alloc.extra_env.find("DET_TASK_TYPE");
+      if (env_it != alloc.extra_env.end() &&
+          env_it->second.as_string() == "SERVING") {
+        // A serve replica needs a host even at zero chips.
+        source = "serving";
+        slots = std::max(1, slots);
+      } else {
+        ExperimentState* exp = find_experiment_locked(alloc.experiment_id);
+        if (exp != nullptr && exp->elastic()) {
+          // An elastic trial can START at min and grow back later — a
+          // queued one demanding its preferred size would summon nodes
+          // the fleet doesn't strictly need.
+          source = "elastic";
+          slots = std::min(slots, exp->elastic_min_slots);
+        }
+      }
+      s.demand[source] += slots;
+    }
+    // Deployment replica deficits not yet spawned (the reconciler
+    // throttles spawns to one batch per second; a deficit must drive
+    // machines the moment it exists, not once the spawn lands).
+    for (const auto& [dep_id, dep] : deployments_) {
+      std::string pool = dep.config["resources"]["resource_pool"].as_string(
+          cfg_.default_pool);
+      int per_replica = std::max<int>(
+          1, static_cast<int>(dep.config["resources"]["slots"].as_int(
+                 dep.config["resources"]["slots_per_trial"].as_int(0))));
+      int accounted = 0;  // schedulable or already queued (counted above)
+      for (const auto& [tid, r] : dep.replicas) {
+        if (r.retiring) continue;
+        for (const auto& [aid, a] : allocations_) {
+          if (a.task_id == tid && a.state != "TERMINATED") {
+            ++accounted;
+            break;
+          }
+        }
+      }
+      int deficit = dep.target - accounted;
+      if (deficit > 0) {
+        pools[pool].demand["serving"] += deficit * per_replica;
+      } else if (dep.target > 0) {
+        pools[pool];  // ensure the pool is observed (scale-down ticks)
+      }
+    }
+    // Compile backlog (docs/compile-farm.md): queued AOT jobs attract
+    // capacity too — weighted and capped so a deep queue summons at most
+    // compile_demand_max_slots of extra machine. Refreshed at most every
+    // 2s; a cold fleet (zero agents) is exactly when this matters, so it
+    // cannot ride dispatch_compile_jobs_locked (which early-outs with no
+    // idle agents).
+    if (cfg_.provisioner.compile_demand_weight > 0) {
+      if (compile_queue_maybe_ && t - compile_queued_at_ > 2.0) {
+        compile_queued_at_ = t;
+        auto rows = db_.query(
+            "SELECT COUNT(*) AS n FROM compile_jobs WHERE state='QUEUED'");
+        compile_queued_cache_ =
+            rows.empty() ? 0 : static_cast<int>(rows[0]["n"].as_int(0));
+      }
+      if (!compile_queue_maybe_) compile_queued_cache_ = 0;
+      if (compile_queued_cache_ > 0) {
+        pools[cfg_.default_pool].demand["compile"] = std::min(
+            compile_queued_cache_ * cfg_.provisioner.compile_demand_weight,
+            cfg_.provisioner.compile_demand_max_slots);
+      }
     }
     for (const auto& [id, a] : agents_) {
       if (a.alive) pools[a.resource_pool];  // ensure pool present
     }
     for (const auto& n : provisioner_->nodes()) pools[n.pool];
+    prov_demand_.clear();
     for (auto& [pool, snap] : pools) {
+      for (const auto& [source, slots] : snap.demand) {
+        snap.pending_slots += slots;
+      }
+      prov_demand_[pool] = snap.demand;
       ScalingSnapshot cap = rm_->scaling(pool);
       snap.total_slots = cap.total_slots;
       snap.free_slots = cap.free_slots;
@@ -622,7 +703,38 @@ void Master::schedule_locked() {
   for (const auto& aid : queue) {
     auto it = allocations_.find(aid);
     if (it == allocations_.end() || it->second.state != "PENDING") continue;
-    if (rm_->allocate(it->second)) {
+    bool placed = rm_->allocate(it->second);
+    if (!placed) {
+      // Elastic shrink-to-start (docs/elasticity.md, docs/cluster-ops.md
+      // "Capacity loop"): a queued elastic trial whose PREFERRED size
+      // doesn't fit may start anywhere in [min, preferred) and grow back
+      // later — this is what lets provisioner demand count elastic
+      // trials at MIN size: the capacity the fleet summons for them may
+      // be exactly min-sized, and it must not strand them in the queue.
+      ExperimentState* exp = find_experiment_locked(it->second.experiment_id);
+      if (exp != nullptr && exp->elastic() &&
+          it->second.slots > exp->elastic_min_slots) {
+        Allocation& alloc = it->second;
+        int target = elastic_fit_target_locked(
+            alloc, exp->elastic_min_slots,
+            std::min(alloc.slots - 1, exp->elastic_max_slots));
+        if (target > 0) {
+          int from = alloc.slots;
+          alloc.slots = target;
+          placed = rm_->allocate(alloc);
+          if (placed) {
+            std::cerr << "master: allocation " << alloc.id
+                      << " elastic start at " << target << " slots ("
+                      << from << " preferred does not fit)" << std::endl;
+            db_.exec("UPDATE allocations SET slots=? WHERE id=?",
+                     {Json(static_cast<int64_t>(target)), Json(alloc.id)});
+          } else {
+            alloc.slots = from;  // raced away; keep queue-demand honest
+          }
+        }
+      }
+    }
+    if (placed) {
       // Placement is the RM's; binding the trial + persisting is ours.
       Allocation& alloc = it->second;
       ExperimentState* exp = find_experiment_locked(alloc.experiment_id);
@@ -719,10 +831,12 @@ bool Master::try_fit_locked(Allocation& alloc) {
   // standalone, reference fitting_test.go discipline).
   std::vector<AgentState*> pool_agents;
   std::vector<HostFreeView> views;
+  bool pool_has_on_demand = false;
   for (auto& [id, a] : agents_) {
     if (!a.alive || a.resource_pool != alloc.resource_pool) continue;
     if (a.draining) continue;  // node is going away: no new placements
     if (alloc.excluded_agents.count(id)) continue;  // exclude_node policy
+    if (!a.preemptible) pool_has_on_demand = true;
     HostFreeView v;
     v.id = a.id;
     v.total_slots = static_cast<int>(a.slots.size());
@@ -732,7 +846,45 @@ bool Master::try_fit_locked(Allocation& alloc) {
     pool_agents.push_back(&a);
     views.push_back(std::move(v));
   }
-  auto picks = find_fit(alloc.slots, views);
+  // Capacity-class placement (docs/cluster-ops.md "Capacity loop"):
+  // deployment floor replicas ("on_demand") never land on preemptible
+  // agents — unless the pool has NONE on-demand, where availability beats
+  // tier purity — and surplus replicas ("spot_first") try preemptible
+  // capacity before competing with the floor for guaranteed nodes.
+  auto class_views = [&](bool want_preemptible) {
+    std::vector<HostFreeView> out;
+    for (size_t i = 0; i < views.size(); ++i) {
+      if (pool_agents[i]->preemptible == want_preemptible) {
+        out.push_back(views[i]);
+      }
+    }
+    return out;
+  };
+  std::vector<std::pair<size_t, std::vector<int>>> picks;
+  auto restrict_fit = [&](bool want_preemptible) {
+    // find_fit indexes into the restricted view set; map back to the
+    // full pool_agents index by agent id.
+    auto sub = class_views(want_preemptible);
+    auto sub_picks = find_fit(alloc.slots, sub);
+    std::vector<std::pair<size_t, std::vector<int>>> mapped;
+    for (auto& [idx, slot_ids] : sub_picks) {
+      for (size_t i = 0; i < views.size(); ++i) {
+        if (views[i].id == sub[idx].id) {
+          mapped.push_back({i, slot_ids});
+          break;
+        }
+      }
+    }
+    return mapped;
+  };
+  if (alloc.capacity_class == "on_demand" && pool_has_on_demand) {
+    picks = restrict_fit(/*want_preemptible=*/false);
+  } else if (alloc.capacity_class == "spot_first") {
+    picks = restrict_fit(/*want_preemptible=*/true);
+    if (picks.empty()) picks = find_fit(alloc.slots, views);
+  } else {
+    picks = find_fit(alloc.slots, views);
+  }
   if (picks.empty()) return false;  // no fit (or no alive agents at all)
 
   std::vector<std::pair<AgentState*, std::vector<int>>> assignment;

@@ -20,6 +20,7 @@
 // TPU-native master growing one as a first-class subsystem.
 
 #include <algorithm>
+#include <cmath>
 #include <iostream>
 
 #include "../common/trace.h"
@@ -114,6 +115,17 @@ constexpr int kBreakerThreshold = 3;
 constexpr double kBreakerHoldS = 5.0;
 constexpr double kBreakerHoldMaxS = 30.0;
 
+// Retry-After for a cold deployment (zero READY replicas, nonzero
+// target): the last observed wake-to-ready time when one exists, else a
+// quarter of the cold-start budget — "spawn + warm-AOT restore" measured,
+// not guessed. Clamped to something a client will actually honor.
+int64_t cold_retry_after_s(double last_cold_start_ms, double budget_s) {
+  double est = last_cold_start_ms > 0 ? last_cold_start_ms / 1e3
+                                      : budget_s / 4.0;
+  return static_cast<int64_t>(
+      std::max(2.0, std::min(60.0, std::ceil(est))));
+}
+
 bool is_connect_failure(const std::string& what) {
   // common/http.cc throws distinct messages for failures BEFORE any
   // request bytes reached the replica ("connect failed: ...",
@@ -154,9 +166,25 @@ std::string Master::spawn_deployment_replica_locked(DeploymentState& dep) {
       "(deployment_id, task_id, state) VALUES (?, ?, 'STARTING')",
       {Json(dep.id), Json(task_id)});
 
+  // Spot-aware placement (docs/cluster-ops.md "Capacity loop"): replicas
+  // up to serving.replicas.on_demand_floor (default: min) are the
+  // guaranteed floor and avoid preemptible agents; everything above the
+  // floor is reclaimable surplus and goes to spot first.
+  const Json& repcfg = dep.config["serving"]["replicas"];
+  int floor = static_cast<int>(
+      repcfg["on_demand_floor"].as_int(dep.min_replicas));
+  floor = std::max(0, std::min(floor, dep.max_replicas));
+  int on_demand_live = 0;
+  for (const auto& [tid, r] : dep.replicas) {
+    if (!r.retiring && r.capacity_class == "on_demand") ++on_demand_live;
+  }
+  std::string capacity_class =
+      on_demand_live < floor ? "on_demand" : "spot_first";
+
   Allocation alloc;
   alloc.id = "alloc-" + task_id;
   alloc.task_id = task_id;
+  alloc.capacity_class = capacity_class;
   alloc.resource_pool =
       config["resources"]["resource_pool"].as_string(cfg_.default_pool);
   alloc.slots = static_cast<int>(config["resources"]["slots"].as_int(
@@ -190,6 +218,7 @@ std::string Master::spawn_deployment_replica_locked(DeploymentState& dep) {
 
   ReplicaHealth r;
   r.task_id = task_id;
+  r.capacity_class = capacity_class;
   dep.replicas[task_id] = std::move(r);
   dep.last_spawn = now();
   cv_.notify_all();
@@ -275,6 +304,36 @@ void Master::reconcile_deployments_locked() {
           {Json(std::string(retiring ? "RETIRED" : "DEAD")), Json(dep.id),
            Json(tid)});
       dep.replicas.erase(tid);
+    }
+
+    // 1b. Spot reclamation re-target (docs/cluster-ops.md "Capacity
+    // loop"): a replica whose agent got a PR-5 termination notice is
+    // LEAVING — mark it retiring NOW so (a) the converge pass below
+    // spawns its replacement immediately (on-demand if the floor needs
+    // it) instead of waiting for the drain to finish, and (b) its
+    // eventual clean exit is terminal rather than requeued on top of the
+    // replacement. The replica itself still drains cooperatively inside
+    // the notice deadline — zero dropped accepted requests.
+    for (auto& [tid, r] : dep.replicas) {
+      if (r.retiring) continue;
+      for (const auto& [aid, a] : allocations_) {
+        if (a.task_id != tid || a.state == "TERMINATED") continue;
+        bool on_draining_agent = false;
+        for (const auto& res : a.resources) {
+          auto ait = agents_.find(res.agent_id);
+          if (ait != agents_.end() && ait->second.draining) {
+            on_draining_agent = true;
+            break;
+          }
+        }
+        if (on_draining_agent) {
+          std::cerr << "master: deployment " << dep.id << " replica "
+                    << tid << " on draining agent; spawning replacement"
+                    << std::endl;
+          retire_deployment_replica_locked(dep, tid);
+        }
+        break;
+      }
     }
 
     // 2. Converge on target. Spawns are throttled to one batch per
@@ -444,13 +503,23 @@ HttpResponse Master::handle_deployments(
     const Json& rep = config["serving"]["replicas"];
     int minr = 1, maxr = 1, target = 1;
     if (rep.is_object()) {
+      // min: 0 is legal (docs/serving.md "Scale to zero"): an idle
+      // deployment drains its last replica and costs zero nodes; the
+      // router's demand wake respawns one within cold_start_budget_s.
       minr = static_cast<int>(rep["min"].as_int(1));
       target = static_cast<int>(rep["target"].as_int(minr));
-      maxr = static_cast<int>(rep["max"].as_int(std::max(minr, target)));
+      maxr = static_cast<int>(
+          rep["max"].as_int(std::max(1, std::max(minr, target))));
     }
-    if (minr < 1 || maxr < minr || target < minr || target > maxr) {
+    if (minr < 0 || maxr < 1 || maxr < minr || target < minr ||
+        target > maxr) {
       return json_resp(400, err_body(
-          "serving.replicas requires 1 <= min <= target <= max"));
+          "serving.replicas requires 0 <= min <= target <= max, max >= 1"));
+    }
+    int floorr = static_cast<int>(rep["on_demand_floor"].as_int(minr));
+    if (floorr < 0 || floorr > maxr) {
+      return json_resp(400, err_body(
+          "serving.replicas.on_demand_floor must be within [0, max]"));
     }
     {
       // Preflight gate (docs/preflight.md): DTL206 paged-KV geometry —
@@ -678,6 +747,8 @@ HttpResponse Master::handle_deployments(
           rj["latency"] = std::move(lat);
         }
         rj["draining"] = r.draining;
+        rj["capacity_class"] = r.capacity_class;
+        rj["engine_source"] = r.engine_source;
         rj["inflight"] = r.inflight;
         rj["consecutive_failures"] =
             static_cast<int64_t>(r.consecutive_failures);
@@ -688,6 +759,9 @@ HttpResponse Master::handle_deployments(
           if (a.task_id == tid && a.state != "TERMINATED") {
             rj["allocation_state"] = a.state;
             rj["preempting"] = a.preempting;
+            if (!a.resources.empty()) {
+              rj["agent"] = a.resources[0].agent_id;
+            }
             if (!a.proxy_addresses.empty()) {
               rj["proxy_address"] = a.proxy_addresses.begin()->second;
             }
@@ -726,6 +800,9 @@ HttpResponse Master::handle_serve_stats(const HttpRequest& req,
   }
   ReplicaHealth& r = dep->replicas[it->second.task_id];
   r.task_id = it->second.task_id;
+  // First heartbeat = the replica is warm: wake any cold-start holds
+  // parked on cv_ (handle_serve_router) waiting for exactly this.
+  bool first_report = r.last_report == 0;
   r.last_report = now();
   r.queue_depth = body["queue_depth"].as_int(0);
   r.queue_capacity = std::max<int64_t>(1, body["queue_capacity"].as_int(1));
@@ -742,11 +819,18 @@ HttpResponse Master::handle_serve_stats(const HttpRequest& req,
   // boundaries + cumulative counts) — the deployment APIs aggregate them
   // into per-deployment p50/p99 so an operator never scrapes replicas.
   if (body["latency"].is_object()) r.latency = body["latency"];
+  // Warm-AOT provenance (docs/serving.md "Scale to zero"): how this
+  // replica's engine got its executables — "deserialize" proves the
+  // PR-9 path restored a cold start without re-tracing.
+  if (body["engine_source"].is_string()) {
+    r.engine_source = body["engine_source"].as_string();
+  }
   db_.exec(
       "UPDATE deployment_replicas SET state='ACTIVE' WHERE deployment_id=? "
       "AND task_id=? AND state='STARTING'",
       {Json(dep->id), Json(r.task_id)});
   it->second.last_activity = now();
+  if (first_report) cv_.notify_all();
   return json_resp(200, Json::object());
 }
 
@@ -863,6 +947,7 @@ HttpResponse Master::handle_serve_router(
   // Resolve by id or name.
   std::string dep_id = parts[1];
   double slo_ms = 0;
+  double cold_budget = 30.0;
   {
     std::lock_guard<std::mutex> lock(mu_);
     if (!deployments_.count(dep_id)) {
@@ -878,6 +963,8 @@ HttpResponse Master::handle_serve_router(
       return json_resp(404, err_body("no such deployment"));
     }
     slo_ms = dit->second.config["serving"]["slo_ms"].as_double(0);
+    cold_budget = dit->second.config["serving"]["replicas"]
+                      ["cold_start_budget_s"].as_double(30.0);
   }
 
   // Request identity (docs/observability.md "Request spans"): mint an
@@ -918,6 +1005,121 @@ HttpResponse Master::handle_serve_router(
   // health probes through the router would be pure table noise.
   const bool traced =
       req.method == "POST" && fwd_path.rfind("/v1/generate", 0) == 0;
+
+  // --- Scale-to-zero wake + cold-start hold (docs/serving.md "Scale to
+  // zero") --- A request for a deployment with zero READY replicas is
+  // NOT shed when the deployment can be woken: target 0 bumps to 1 on
+  // the spot (the demand wake) and the request is HELD — parked on the
+  // master's condition variable — until a replica is up or
+  // cold_start_budget_s lapses. A cold deployment that is NOT waking
+  // (replicas crashed / still starting with target already nonzero)
+  // answers 503 with a Retry-After computed from the observed spawn +
+  // warm-AOT restore time instead of surfacing a connection error.
+  {
+    bool record_cold = false;
+    int64_t hold_start_us = 0, hold_end_us = 0;
+    double cold_wait_ms = 0;
+    std::string cold_replica, cold_source;
+    std::unique_lock<std::mutex> lock(mu_);
+    auto dit = deployments_.find(dep_id);
+    if (dit == deployments_.end()) {
+      return json_resp(404, err_body("no such deployment"));
+    }
+    DeploymentState& dep = dit->second;
+    // READY = routable now; `warm` additionally requires a first
+    // heartbeat so a held request lands on a replica that is actually
+    // answering, not one that just bound its port.
+    auto ready_count = [&](bool warm) {
+      int n = 0;
+      for (const auto& [tid, r] : dep.replicas) {
+        if (r.retiring || r.draining) continue;
+        if (warm && r.last_report == 0) continue;
+        for (const auto& [aid, a] : allocations_) {
+          if (a.task_id == tid && a.state == "RUNNING" && !a.preempting &&
+              !a.proxy_addresses.empty()) {
+            ++n;
+            break;
+          }
+        }
+      }
+      return n;
+    };
+    if (ready_count(/*warm=*/false) == 0) {
+      double t = now();
+      if (dep.target == 0) {
+        fleet_.cold_starts.fetch_add(1);
+        dep.cold_start_since = t;
+        set_deployment_target_locked(dep, 1,
+                                     "scale-from-zero demand wake");
+        // Spawn on THIS request, not the next 200ms scheduler tick.
+        reconcile_deployments_locked();
+      }
+      bool cold_waking = dep.cold_start_since > 0 &&
+                         t - dep.cold_start_since < cold_budget;
+      if (!cold_waking) {
+        HttpResponse resp = json_resp(
+            503, err_body("no ready replicas (deployment starting or "
+                          "recovering); retry after the cold-start "
+                          "estimate"));
+        resp.headers["Retry-After"] = std::to_string(
+            cold_retry_after_s(dep.last_cold_start_ms, cold_budget));
+        resp.headers["X-Request-Id"] = rid;
+        return resp;
+      }
+      hold_start_us = trace::now_us();
+      auto deadline =
+          Clock::now() + std::chrono::milliseconds(static_cast<int64_t>(
+                             (dep.cold_start_since + cold_budget - t) *
+                             1000));
+      cv_.wait_until(lock, deadline, [&] {
+        return !running_ || ready_count(/*warm=*/true) > 0;
+      });
+      hold_end_us = trace::now_us();
+      if (ready_count(/*warm=*/false) == 0) {
+        // Budget burned with nothing routable: shed, keep the wake
+        // clock running so the next request re-enters the hold only if
+        // budget remains.
+        HttpResponse resp = json_resp(
+            503, err_body("cold start exceeded cold_start_budget_s"));
+        resp.headers["Retry-After"] = std::to_string(
+            cold_retry_after_s(dep.last_cold_start_ms, cold_budget));
+        resp.headers["X-Request-Id"] = rid;
+        return resp;
+      }
+      cold_wait_ms = (hold_end_us - hold_start_us) / 1e3;
+      // Several requests can hold through one wake; the first to exit
+      // records the wake-to-ready time and clears the clock.
+      if (dep.cold_start_since > 0) {
+        dep.last_cold_start_ms = (now() - dep.cold_start_since) * 1e3;
+        dep.cold_start_since = 0;
+      }
+      for (const auto& [tid, r] : dep.replicas) {
+        if (r.retiring || r.draining || r.last_report == 0) continue;
+        cold_replica = tid;
+        cold_source = r.engine_source;
+        break;
+      }
+      record_cold = traced;
+    } else {
+      dep.cold_start_since = 0;
+    }
+    lock.unlock();
+    if (record_cold) {
+      // The first request across a scale-from-zero wake carries the
+      // cold-start phase on its trace: how long the router held it and
+      // whether the replica's engine deserialized (warm AOT) or traced.
+      Json attrs = Json::object();
+      attrs["deployment"] = dep_id;
+      attrs["budget_s"] = cold_budget;
+      attrs["wait_ms"] = cold_wait_ms;
+      attrs["replica"] = cold_replica;
+      attrs["engine_source"] = cold_source;
+      record_request_span(
+          dep_id, rid,
+          trace::make_span(rid, "serve.cold_start", hold_start_us,
+                           hold_end_us, rid, attrs));
+    }
+  }
 
   // At most two attempts: the retry is ONLY taken for a connection-level
   // failure (nothing reached the replica, so nothing can be generating);
@@ -990,7 +1192,8 @@ HttpResponse Master::handle_serve_router(
         HttpResponse resp = json_resp(
             503, err_body("no ready replicas (deployment starting, "
                           "draining, or all ejected)"));
-        resp.headers["Retry-After"] = "2";
+        resp.headers["Retry-After"] = std::to_string(
+            cold_retry_after_s(dep.last_cold_start_ms, cold_budget));
         resp.headers["X-Request-Id"] = rid;
         return resp;
       }
@@ -1118,9 +1321,14 @@ HttpResponse Master::handle_serve_router(
       out.headers["X-Request-Id"] = rid;
       return out;
     }
-    // Failure path: breaker bookkeeping, then maybe retry.
+    // Failure path: breaker bookkeeping, then maybe retry. A replica that
+    // has never heartbeated is still STARTING (engine loading behind a
+    // bound proxy address): its refusals are boot noise, not health
+    // signal — counting them would open the breaker against a replica
+    // that was never up, then hold the first real traffic out.
     bool connect_fail = is_connect_failure(fail);
-    if (r != nullptr) {
+    bool starting = r != nullptr && r->last_report == 0;
+    if (r != nullptr && !starting) {
       r->consecutive_failures++;
       if (probe || r->consecutive_failures >= kBreakerThreshold) {
         int over = std::max(0, r->consecutive_failures - kBreakerThreshold);

@@ -533,6 +533,7 @@ bool Master::requeue_serving_task_locked(const Allocation& old_alloc) {
              std::to_string(restarts + 1);
   alloc.task_id = old_alloc.task_id;
   alloc.resource_pool = old_alloc.resource_pool;
+  alloc.capacity_class = old_alloc.capacity_class;
   alloc.slots = old_alloc.slots;
   alloc.priority = old_alloc.priority;
   alloc.submitted_at = now();

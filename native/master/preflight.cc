@@ -346,6 +346,50 @@ Json preflight_config(const Json& config) {
                 "kv_num_blocks or lower max_seq_len"));
       }
     }
+    // DTL207 — capacity-loop knobs (docs/cluster-ops.md "Capacity
+    // loop"): the native mirror of the Python expconf checks for
+    // scale-to-zero and spot-floor configuration. The master is the
+    // authority — a CLI that skipped client-side validation must still
+    // be refused here.
+    const Json& rep = serving["replicas"];
+    if (rep.is_object()) {
+      int64_t mn = rep["min"].as_int(1);
+      int64_t tgt = rep["target"].as_int(mn);
+      int64_t mx = rep["max"].as_int(
+          std::max<int64_t>(1, std::max(mn, tgt)));
+      if (mn < 0) {
+        out.push_back(diag(
+            "DTL207", "error",
+            "serving.replicas.min=" + std::to_string(mn) +
+                " is negative; 0 (scale-to-zero) is the smallest legal "
+                "floor"));
+      } else if (mn > mx) {
+        out.push_back(diag(
+            "DTL207", "error",
+            "serving.replicas.min=" + std::to_string(mn) +
+                " exceeds max=" + std::to_string(mx)));
+      }
+      // Default floor derives from min but is clamped to max so a
+      // min>max config yields one finding, not a derived-floor echo.
+      int64_t floor = rep["on_demand_floor"].as_int(
+          std::min(std::max<int64_t>(mn, 0), mx));
+      if (floor < 0 || floor > mx) {
+        out.push_back(diag(
+            "DTL207", "error",
+            "serving.replicas.on_demand_floor=" + std::to_string(floor) +
+                " must be within [0, max=" + std::to_string(mx) +
+                "]: a floor above max can never be satisfied and would "
+                "pin every replica to on-demand capacity"));
+      }
+      if (!rep["cold_start_budget_s"].is_null() &&
+          rep["cold_start_budget_s"].as_double(0) <= 0) {
+        out.push_back(diag(
+            "DTL207", "error",
+            "serving.replicas.cold_start_budget_s must be a positive "
+            "number of seconds: it bounds how long the router holds a "
+            "request while a scale-from-zero replica restores"));
+      }
+    }
   }
 
   // DTL203 — restarts configured but nothing to restart from. Only an

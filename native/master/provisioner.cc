@@ -32,6 +32,7 @@
 #include <iostream>
 #include <thread>
 
+#include "../common/faultpoint.h"
 #include "../common/http.h"
 #include "rm.h"
 
@@ -70,6 +71,32 @@ std::vector<ProvNode> Provisioner::nodes() const {
   std::vector<ProvNode> out;
   for (const auto& [name, n] : st_->nodes) out.push_back(n);
   return out;
+}
+
+int64_t Provisioner::create_failures_total() const {
+  std::lock_guard<std::mutex> lock(st_->mu);
+  return st_->create_failures_total;
+}
+
+// Demand-drop hysteresis (docs/cluster-ops.md "Capacity loop"): increases
+// are believed immediately; a decrease is adopted only after it persists
+// demand_hysteresis_s. A deployment autoscaler flapping its target (or a
+// searcher closing and reopening rungs) therefore cannot unlock an idle
+// scale-down — or reset the launch sustain clock — on a transient dip.
+int Provisioner::effective_demand(const std::string& pool, int inst,
+                                  double now) {
+  DemandHold& h = demand_hold_[pool];
+  if (inst >= h.slots) {
+    h.slots = inst;
+    h.since = now;
+    return inst;
+  }
+  if (now - h.since >= cfg_.demand_hysteresis_s) {
+    h.slots = inst;
+    h.since = now;
+    return inst;
+  }
+  return h.slots;  // hold the higher demand until the drop persists
 }
 
 std::string Provisioner::nodes_path() const {
@@ -130,14 +157,30 @@ bool Provisioner::observe_gcp(const std::string& pool,
   }
 
   // ---- launch ----
+  // The composed demand signal (queued slots + elastic-at-min + serving
+  // deficits + compile backlog) drives launches INSTANTANEOUSLY —
+  // sustain_s + cooldown_s already debounce them. The drop-hysteresis
+  // below guards only the shrink side: demand that vanished because it
+  // was PLACED (converted to busy slots) must not be held against the
+  // pool, or a just-satisfied queue would look like fresh unmet demand.
+  int held_demand = effective_demand(pool, snap.pending_slots, now);
   int effective_free = snap.free_slots + joining;
   if (snap.pending_slots > effective_free) {
     auto it = demand_since_.find(pool);
     if (it == demand_since_.end()) {
       demand_since_[pool] = now;
     } else if (now - it->second >= cfg_.sustain_s) {
+      // Create-failure backoff: after a cloud-executor error the pool
+      // sits out base * 2^(n-1) seconds (capped) instead of re-firing on
+      // the next cooldown lapse.
+      bool backed_off;
+      {
+        std::lock_guard<std::mutex> lock(st_->mu);
+        auto bit = st_->backoff_until.find(pool);
+        backed_off = bit != st_->backoff_until.end() && now < bit->second;
+      }
       double& last = last_fired_[pool];
-      if (last == 0 || now - last >= cfg_.cooldown_s) {
+      if (!backed_off && (last == 0 || now - last >= cfg_.cooldown_s)) {
         int deficit = snap.pending_slots - effective_free;
         int want_nodes =
             (deficit + cfg_.slots_per_node - 1) / cfg_.slots_per_node;
@@ -181,7 +224,10 @@ bool Provisioner::observe_gcp(const std::string& pool,
       continue;
     }
     if (now - iit->second < cfg_.idle_s) continue;
-    if (snap.pending_slots > 0) continue;  // capacity still wanted
+    if (held_demand > 0) continue;  // capacity still wanted — held demand
+                                    // counts, so a flapping autoscaler
+                                    // target can't unlock a shrink
+                                    // mid-flap (demand_hysteresis_s)
     std::cerr << "provisioner: node " << aid << " idle "
               << static_cast<long>(now - iit->second)
               << "s, scaling down" << std::endl;
@@ -243,24 +289,52 @@ void Provisioner::launch_node(const std::string& pool, double now) {
   body["labels"] = labels;
 
   auto st = st_;
+  // Failure path shared by the fault point and real API errors: drop the
+  // tracked node, bump the counters, and arm the capped exponential
+  // backoff so the next retry waits base * 2^(n-1) seconds.
+  double backoff_base = cfg_.create_backoff_base_s;
+  double backoff_max = cfg_.create_backoff_max_s;
+  auto on_create_failure = [st, name, pool, now, backoff_base, backoff_max](
+                               const std::string& why) {
+    std::cerr << "provisioner: create " << name << " failed: " << why
+              << std::endl;
+    std::lock_guard<std::mutex> lock(st->mu);
+    st->nodes.erase(name);
+    int& consec = st->create_failures[pool];
+    consec = std::min(consec + 1, 30);  // 2^30 s is already "forever"
+    st->create_failures_total++;
+    double hold = backoff_base;
+    for (int i = 1; i < consec && hold < backoff_max; ++i) hold *= 2;
+    hold = std::min(hold, backoff_max);
+    st->backoff_until[pool] = now + hold;
+    std::cerr << "provisioner: pool " << pool << " create backoff "
+              << hold << "s (" << consec << " consecutive failure(s))"
+              << std::endl;
+  };
+  // Chaos (docs/chaos.md): a deterministic cloud-executor failure without
+  // a failing fake API — the e2e backoff test arms this at runtime.
+  if (FAULT_POINT("provisioner.create.fail") == faults::Action::kError) {
+    on_create_failure("injected fault: provisioner.create.fail");
+    return;
+  }
   std::string url = api_url_;
   std::string path = nodes_path() + "?nodeId=" + name;
   std::string payload = body.dump();
   auto headers = auth_headers();
-  std::thread([st, url, path, payload, headers, name] {
+  std::thread([st, url, path, payload, headers, name, pool,
+               on_create_failure] {
     try {
       auto r = http_request("POST", url, path, payload, 30.0, headers);
       if (!r.ok()) {
-        std::cerr << "provisioner: create " << name << " failed ("
-                  << r.status << "): " << r.body << std::endl;
-        std::lock_guard<std::mutex> lock(st->mu);
-        st->nodes.erase(name);
+        on_create_failure("HTTP " + std::to_string(r.status) + ": " +
+                          r.body);
+        return;
       }
-    } catch (const std::exception& e) {
-      std::cerr << "provisioner: create " << name << " failed: " << e.what()
-                << std::endl;
       std::lock_guard<std::mutex> lock(st->mu);
-      st->nodes.erase(name);
+      st->create_failures.erase(pool);
+      st->backoff_until.erase(pool);
+    } catch (const std::exception& e) {
+      on_create_failure(e.what());
     }
   }).detach();
 }

@@ -43,8 +43,22 @@ class Db;
 struct ScalingSnapshot {
   int total_slots = 0;
   int free_slots = 0;
-  int pending_slots = 0;        // demanded by queued allocations
+  // Composed demand in slots (docs/cluster-ops.md "Capacity loop"): the
+  // sum of `demand` below. Historically this was queued-allocation slots
+  // only; now serving replica deficits, elastic trials at MIN size, and
+  // compile backlog all feed it, so machines follow every demand source —
+  // not just the training queue.
+  int pending_slots = 0;
   int pending_allocations = 0;  // queue depth
+  // Per-source breakdown, exported as
+  // det_provisioner_demand_slots{pool=,source=}:
+  //   "pending"  queued non-elastic allocations at full size
+  //   "elastic"  queued elastic trials at elastic_min_slots (a trial that
+  //              can START small must not demand its preferred size)
+  //   "serving"  deployment replica deficits (target minus schedulable
+  //              replicas) x slots per replica
+  //   "compile"  compile-farm backlog weight
+  std::map<std::string, int> demand;
   // Node-level view for scale-down and launch accounting: all alive
   // agents in the pool, and the subset with every slot free.
   std::vector<std::string> agents;
@@ -225,6 +239,23 @@ struct ProvisionerConfig {
                                 // delete + stop counting as capacity
   bool spot = false;         // request preemptible capacity
   std::string node_prefix = "det-prov";
+  // Demand hysteresis (docs/cluster-ops.md "Capacity loop"): a demand
+  // DROP must persist this long before the provisioner believes it, so a
+  // flapping autoscaler target (2 → 3 → 2 within seconds) can neither
+  // thrash launches nor unlock an idle scale-down mid-flap. Increases are
+  // believed immediately (sustain_s already debounces launches).
+  double demand_hysteresis_s = 5;
+  // Node-create failure backoff: after a cloud-executor error the pool
+  // waits base * 2^(consecutive-1) seconds (capped) before the next
+  // create attempt — a 100%-failure storm must not retry every tick.
+  double create_backoff_base_s = 1;
+  double create_backoff_max_s = 60;
+  // Compile-farm backlog as provisioner demand: queued AOT jobs count
+  // weight slots each, capped so the backlog attracts at most
+  // compile_demand_max_slots of extra capacity (default: one node's
+  // worth). 0 weight removes compile demand from the composed signal.
+  int compile_demand_weight = 1;
+  int compile_demand_max_slots = -1;  // <0 = slots_per_node
 };
 
 struct ProvNode {
@@ -254,6 +285,8 @@ class Provisioner {
 
   // Introspection (tests + /metrics).
   std::vector<ProvNode> nodes() const;
+  // Total node-create failures (det_provisioner_create_failures_total).
+  int64_t create_failures_total() const;
 
  private:
   // Node tracking shared with the detached I/O threads: they capture the
@@ -262,6 +295,12 @@ class Provisioner {
     std::mutex mu;
     std::map<std::string, ProvNode> nodes;  // instances WE manage
     int seq = 0;
+    // Create-failure backoff, written by the detached create threads and
+    // read by the launch decision: consecutive failures per pool, the
+    // earliest next attempt per pool, and the lifetime failure counter.
+    std::map<std::string, int> create_failures;
+    std::map<std::string, double> backoff_until;
+    int64_t create_failures_total = 0;
   };
 
   bool observe_webhook(const std::string& pool, const ScalingSnapshot& snap,
@@ -282,6 +321,15 @@ class Provisioner {
   std::map<std::string, double> demand_since_;  // pool → first unmet time
   std::map<std::string, double> last_fired_;
   std::map<std::string, double> idle_since_;   // agent id → idle start
+  // Demand-drop hysteresis: the highest recent demand per pool and when
+  // it was last confirmed; drops are adopted only after
+  // demand_hysteresis_s (see effective_demand).
+  struct DemandHold {
+    int slots = 0;
+    double since = 0;
+  };
+  std::map<std::string, DemandHold> demand_hold_;
+  int effective_demand(const std::string& pool, int inst, double now);
   double last_reconcile_ = 0;
 };
 

@@ -566,6 +566,47 @@ static void test_preflight_shape_sweep() {
   CHECK(d3.as_array()[0]["suppressed"].as_bool(false));
 }
 
+static void test_preflight_capacity_knobs() {
+  // DTL207 — capacity-loop knobs (native mirror of the Python expconf
+  // checks; docs/cluster-ops.md "Capacity loop").
+  auto cfg_with = [](int64_t mn, int64_t mx) {
+    Json cfg = Json::object();
+    Json serving = Json::object();
+    Json rep = Json::object();
+    rep["min"] = mn;
+    rep["max"] = mx;
+    serving["replicas"] = rep;
+    cfg["serving"] = serving;
+    return cfg;
+  };
+  // Scale-to-zero is legal: min 0, max 2 -> clean.
+  CHECK(det::preflight_config(cfg_with(0, 2)).as_array().empty());
+  // Negative min -> DTL207 error.
+  Json d = det::preflight_config(cfg_with(-1, 2));
+  CHECK_EQ(d.as_array().size(), static_cast<size_t>(1));
+  CHECK_EQ(d.as_array()[0]["code"].as_string(), "DTL207");
+  CHECK_EQ(d.as_array()[0]["level"].as_string(), "error");
+  // min > max -> DTL207.
+  d = det::preflight_config(cfg_with(3, 2));
+  CHECK_EQ(d.as_array().size(), static_cast<size_t>(1));
+  CHECK_EQ(d.as_array()[0]["code"].as_string(), "DTL207");
+  // Floor above max -> DTL207; within -> clean.
+  Json cfg = cfg_with(0, 2);
+  cfg["serving"]["replicas"]["on_demand_floor"] = static_cast<int64_t>(3);
+  d = det::preflight_config(cfg);
+  CHECK_EQ(d.as_array().size(), static_cast<size_t>(1));
+  CHECK_EQ(d.as_array()[0]["code"].as_string(), "DTL207");
+  cfg["serving"]["replicas"]["on_demand_floor"] = static_cast<int64_t>(1);
+  CHECK(det::preflight_config(cfg).as_array().empty());
+  // Non-positive cold-start budget -> DTL207; positive -> clean.
+  cfg["serving"]["replicas"]["cold_start_budget_s"] = 0.0;
+  d = det::preflight_config(cfg);
+  CHECK_EQ(d.as_array().size(), static_cast<size_t>(1));
+  CHECK_EQ(d.as_array()[0]["code"].as_string(), "DTL207");
+  cfg["serving"]["replicas"]["cold_start_budget_s"] = 30.0;
+  CHECK(det::preflight_config(cfg).as_array().empty());
+}
+
 static void test_preflight_serving_kv_geometry() {
   // Serving config, block size does not divide max_seq -> DTL206 error.
   Json cfg = Json::object();
@@ -671,6 +712,7 @@ int main() {
        test_preflight_restarts_without_checkpoints},
       {"preflight_shape_sweep", test_preflight_shape_sweep},
       {"preflight_serving_kv_geometry", test_preflight_serving_kv_geometry},
+      {"preflight_capacity_knobs", test_preflight_capacity_knobs},
       {"preflight_suppress_and_gate", test_preflight_suppress_and_gate},
   };
   for (auto& t : tests) {

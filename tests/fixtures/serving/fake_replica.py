@@ -95,6 +95,11 @@ def heartbeat_stats():
             "kv_blocks_total": 64,
             "draining": _state["draining"],
             "retry_after_hint_s": 1,
+            # The real replica reports how its engine got executables
+            # (warm-AOT "deserialize" vs cold "trace"); the fake defaults
+            # to the warm path so cold-start tests see the real contract.
+            "engine_source": os.environ.get("DET_FAKE_ENGINE_SOURCE",
+                                            "deserialize"),
             "latency": latency,
         }
 
@@ -220,13 +225,31 @@ def beat():
 
 
 def main():
-    httpd = ThreadingHTTPServer(("0.0.0.0", 0), Handler)
-    httpd.daemon_threads = True
-    threading.Thread(target=httpd.serve_forever, daemon=True).start()
     import socket
 
-    addr = f"http://{socket.gethostname()}:{httpd.server_address[1]}"
-    report_proxy_address(addr)
+    # DET_FAKE_STARTING_S models a replica whose proxy address is known
+    # before the engine is actually up (the real engine compiles/restores
+    # after the port is chosen): the address is reported, then the socket
+    # stays CLOSED for the window — connections are refused, exactly the
+    # STARTING shape the router's breaker guard must not count.
+    starting_s = float(os.environ.get("DET_FAKE_STARTING_S", "0") or 0)
+    if starting_s > 0:
+        probe = socket.socket()
+        probe.bind(("0.0.0.0", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        addr = f"http://{socket.gethostname()}:{port}"
+        report_proxy_address(addr)
+        print(f"fake replica {TASK_ID} STARTING at {addr} "
+              f"({starting_s}s)", flush=True)
+        time.sleep(starting_s)
+        httpd = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    else:
+        httpd = ThreadingHTTPServer(("0.0.0.0", 0), Handler)
+        addr = f"http://{socket.gethostname()}:{httpd.server_address[1]}"
+        report_proxy_address(addr)
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
     print(f"fake replica {TASK_ID} at {addr}", flush=True)
 
     preempt = PreemptContext(_session, ALLOCATION_ID or None)

@@ -66,17 +66,64 @@ def test_expconf_replicas_valid_and_defaults():
 
 
 @pytest.mark.parametrize("bad,needle", [
-    ({"min": 0}, "positive int"),
+    ({"min": -1}, "non-negative int"),
+    ({"min": 0, "max": 0}, "positive int"),       # max >= 1 always
     ({"min": 3, "max": 2}, "min must be <= max"),
     ({"min": 1, "max": 2, "target": 5}, "within [min, max]"),
     ({"min": 1, "bogus": 2}, "unknown keys"),
     ({"min": 1, "scale_up_after_s": -1}, "non-negative"),
     ({"min": 1, "scale_up_threshold": 3}, "(0, 2]"),
+    ({"min": 0, "max": 2, "on_demand_floor": 3}, "on_demand_floor"),
+    ({"min": 0, "max": 2, "on_demand_floor": -1}, "on_demand_floor"),
+    ({"min": 0, "max": 2, "cold_start_budget_s": 0}, "cold_start_budget_s"),
+    ({"min": 0, "max": 2, "cold_start_budget_s": -5},
+     "cold_start_budget_s"),
     ("two", "must be a mapping"),
 ])
 def test_expconf_replicas_invalid(bad, needle):
     errors = expconf.validate(_serving_cfg(bad))
     assert any(needle in e for e in errors), (bad, errors)
+
+
+def test_expconf_scale_to_zero_and_capacity_knobs():
+    """min: 0 (scale-to-zero) is legal, defaults stay consistent, and the
+    spot/cold-start knobs validate ± (docs/serving.md 'Scale to zero')."""
+    cfg = expconf.check(_serving_cfg({"min": 0, "max": 2}))
+    rep = cfg["serving"]["replicas"]
+    assert (rep["min"], rep["target"], rep["max"]) == (0, 0, 2)
+    # min: 0 alone: target defaults to 0, max defaults to 1 (never 0).
+    cfg = expconf.check(_serving_cfg({"min": 0}))
+    rep = cfg["serving"]["replicas"]
+    assert (rep["min"], rep["target"], rep["max"]) == (0, 0, 1)
+    # Capacity knobs pass through.
+    cfg = expconf.check(_serving_cfg(
+        {"min": 0, "max": 3, "on_demand_floor": 1,
+         "cold_start_budget_s": 20.5}))
+    rep = cfg["serving"]["replicas"]
+    assert rep["on_demand_floor"] == 1
+    assert rep["cold_start_budget_s"] == 20.5
+
+
+def test_preflight_dtl207_capacity_knobs_mirror():
+    """The Python preflight's DTL207 fires on unsatisfiable capacity
+    knobs and stays silent on legal scale-to-zero configs (the native
+    master mirror is exercised via the deployment-create gate)."""
+    from determined_tpu.analysis.config_rules import check_config
+
+    def codes(cfg):
+        return [d.code for d in check_config(cfg)]
+
+    ok = _serving_cfg({"min": 0, "max": 2, "on_demand_floor": 1,
+                       "cold_start_budget_s": 30})
+    assert "DTL207" not in codes(ok)
+    bad_floor = _serving_cfg({"min": 0, "max": 2, "on_demand_floor": 5})
+    assert "DTL207" in codes(bad_floor)
+    bad_budget = _serving_cfg(
+        {"min": 0, "max": 2, "cold_start_budget_s": -1})
+    assert "DTL207" in codes(bad_budget)
+    bad_min = dict(_serving_cfg({"min": 1}))
+    bad_min["serving"]["replicas"]["min"] = -2
+    assert "DTL207" in codes(bad_min)
 
 
 def test_expconf_heartbeat_period():
@@ -511,6 +558,183 @@ def test_autoscaler_scales_up_on_backpressure_down_when_idle(fleet):
                    "timeout_seconds=0", token=token)
     assert any(e["payload"].get("direction") == "up"
                for e in stream["events"]), stream
+
+
+def test_scale_to_zero_idle_drain_and_demand_wake_cold_start(fleet):
+    """docs/serving.md "Scale to zero": min 0 lets the idle cooldown
+    drain the LAST replica (the deployment costs nothing while idle); the
+    next request is NOT shed — the router wakes target 0 -> 1, HOLDS the
+    request within cold_start_budget_s, and serves it, leaving a
+    serve.cold_start span (engine_source=deserialize: the warm-AOT path,
+    never a re-trace) on the request's trace."""
+    c = fleet
+    token = c.login()
+    cfg = _dep_config(min_r=0, max_r=1, target=1, heartbeat_s=0.3,
+                      scale_down_after_s=1.0, scale_down_threshold=0.5,
+                      cold_start_budget_s=45)
+    dep_id = c.api("POST", "/api/v1/deployments", {"config": cfg},
+                   token=token)["id"]
+    _wait_ready(c, token, dep_id, 1)
+
+    # Idle cooldown drains to ZERO replicas.
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        d = c.api("GET", f"/api/v1/deployments/{dep_id}",
+                  token=token)["deployment"]
+        if int(d["target_replicas"]) == 0 and not d["replicas"]:
+            break
+        time.sleep(0.3)
+    else:
+        raise TimeoutError(f"never drained to zero: {d}")
+
+    # The wake: one request, held through the cold start, answered 200.
+    t0 = time.time()
+    status, headers, body = _generate(c, token, dep_id, timeout=90.0)
+    assert status == 200, (status, body)
+    rid = headers.get("X-Request-Id")
+    assert rid
+    # Target is back at 1 and the replica that answered is live.
+    d = c.api("GET", f"/api/v1/deployments/{dep_id}",
+              token=token)["deployment"]
+    assert int(d["target_replicas"]) == 1
+    # The trace carries the cold-start phase with warm-AOT provenance.
+    status, _, trace = _trace(c, token, dep_id, rid)
+    assert status == 200, trace
+    by_name = {s["name"]: s for s in trace["spans"]}
+    assert "serve.cold_start" in by_name, sorted(by_name)
+    cold = by_name["serve.cold_start"]
+    assert cold["attrs"]["engine_source"] == "deserialize", cold
+    assert 0 <= cold["attrs"]["wait_ms"] <= (time.time() - t0) * 1000 + 1
+    assert cold["attrs"]["budget_s"] == 45
+
+
+def test_cold_deployment_answers_503_with_computed_retry_after(master_only):
+    """A deployment with zero READY replicas but NONZERO target (replicas
+    still starting — here: no agent exists at all) answers 503 with a
+    Retry-After computed from the spawn + warm-AOT budget, never a
+    connection error, and never opens breakers against replicas that have
+    not started."""
+    c = master_only
+    token = c.login()
+    cfg = _dep_config(min_r=1, max_r=1, target=1,
+                      cold_start_budget_s=20)
+    dep_id = c.api("POST", "/api/v1/deployments", {"config": cfg},
+                   token=token)["id"]
+    status, headers, body = _generate(c, token, dep_id)
+    assert status == 503, (status, body)
+    # No observed cold start yet -> budget/4 = 5s.
+    assert headers.get("Retry-After") == "5", headers
+    # Repeatable — shedding, not an error path.
+    status, headers, _ = _generate(c, token, dep_id)
+    assert status == 503 and headers.get("Retry-After") == "5"
+
+
+def test_breaker_ignores_starting_replica_refusals(fleet):
+    """A replica whose proxy address is registered but whose engine is
+    still loading refuses connections; those refusals are boot noise and
+    must NOT open the circuit breaker — the first real request after the
+    engine comes up goes straight through."""
+    c = fleet
+    token = c.login()
+    cfg = _dep_config(min_r=1, max_r=1, target=1, heartbeat_s=0.3)
+    cfg["environment"]["DET_FAKE_STARTING_S"] = "4"
+    dep_id = c.api("POST", "/api/v1/deployments", {"config": cfg},
+                   token=token)["id"]
+
+    # Wait for the proxy address (replica looks routable, engine is not).
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        d = c.api("GET", f"/api/v1/deployments/{dep_id}",
+                  token=token)["deployment"]
+        reps = [r for r in d["replicas"] if r.get("proxy_address")
+                and r.get("allocation_state") == "RUNNING"]
+        if reps:
+            break
+        time.sleep(0.2)
+    else:
+        raise TimeoutError(f"replica never registered a proxy: {d}")
+
+    # Hammer it during the STARTING window: refusals surface (502) but
+    # must not count toward the breaker.
+    refusals = 0
+    for _ in range(4):
+        status, _, _ = _generate(c, token, dep_id, timeout=20.0)
+        if status in (502, 503):
+            refusals += 1
+        time.sleep(0.2)
+    assert refusals >= 3, "expected connection refusals while STARTING"
+    d = c.api("GET", f"/api/v1/deployments/{dep_id}",
+              token=token)["deployment"]
+    rep = d["replicas"][0]
+    assert rep["consecutive_failures"] == 0, rep
+    assert not rep["breaker_open"], rep
+
+    # Engine up (first heartbeat arrives) -> immediate success, no
+    # breaker hold to wait out.
+    _wait_ready(c, token, dep_id, 1)
+    status, _, body = _generate(c, token, dep_id)
+    assert status == 200, (status, body)
+
+
+def test_spot_placement_floor_and_drain_retarget(tmp_path, native_binaries):  # noqa: F811
+    """Spot-aware serving (docs/cluster-ops.md "Capacity loop"): the
+    on_demand_floor replica lands on non-preemptible capacity, the
+    surplus replica lands on the spot agent first; a PR-5 preemption
+    notice on the spot agent drains its replica cooperatively (zero
+    dropped) while the reconciler immediately spawns the replacement on
+    surviving on-demand capacity."""
+    c = Devcluster(str(tmp_path), native_binaries, slots=4)
+    c.start_master()
+    c.start_agent("agent-od")
+    c.start_agent("agent-spot", extra_env={"DET_AGENT_PREEMPTIBLE": "1"})
+    try:
+        token = c.login()
+        agents = {a["id"]: a for a in
+                  c.api("GET", "/api/v1/agents", token=token)["agents"]}
+        assert agents["agent-spot"]["preemptible"] is True
+        assert agents["agent-od"]["preemptible"] is False
+
+        cfg = _dep_config(min_r=2, max_r=2, target=2, heartbeat_s=0.3,
+                          on_demand_floor=1)
+        cfg["resources"]["slots"] = 1
+        dep_id = c.api("POST", "/api/v1/deployments", {"config": cfg},
+                       token=token)["id"]
+        detail = _wait_ready(c, token, dep_id, 2)
+        placed = {r["capacity_class"]: r for r in detail["replicas"]}
+        assert set(placed) == {"on_demand", "spot_first"}, detail
+        assert placed["on_demand"]["agent"] == "agent-od", detail
+        assert placed["spot_first"]["agent"] == "agent-spot", detail
+        spot_task = placed["spot_first"]["task_id"]
+
+        # Spot reclamation: termination notice on the spot agent. The
+        # replica drains inside the deadline; the replacement respawns on
+        # the on-demand agent; requests keep flowing throughout.
+        c.api("POST", "/api/v1/agents/agent-spot/preempt_notice",
+              {"deadline_seconds": 20, "reason": "spot_preemption"},
+              token=c.login("admin"))
+        status, _, body = _generate(c, token, dep_id)
+        assert status == 200, (status, body)  # zero dropped during drain
+
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            d = c.api("GET", f"/api/v1/deployments/{dep_id}",
+                      token=token)["deployment"]
+            live = [r for r in d["replicas"]
+                    if not r["retiring"]
+                    and r.get("allocation_state") == "RUNNING"
+                    and r.get("proxy_address")]
+            if (len(live) == 2
+                    and all(r["agent"] == "agent-od" for r in live)
+                    and spot_task not in [r["task_id"] for r in live]):
+                break
+            time.sleep(0.3)
+        else:
+            raise TimeoutError(f"replacement never landed on-demand: {d}")
+        # The drained spot replica finished cleanly (drain, not a kill).
+        status, _, body = _generate(c, token, dep_id)
+        assert status == 200, (status, body)
+    finally:
+        c.stop()
 
 
 def test_replica_death_respawns_to_target(fleet):

@@ -212,6 +212,78 @@ def test_cached_decode_matches_full_forward(tiny_params):
     assert out == reference_greedy(tiny_params, prompt, 8)
 
 
+def test_engine_warm_aot_deserializes_on_second_boot(tiny_params, tmp_path):
+    """The scale-to-zero cold-start contract (docs/serving.md "Scale to
+    zero"): the FIRST engine for a serving signature traces and saves its
+    executables into the node-local AOT dir; the SECOND engine with the
+    same signature deserializes every piece (aot_source "deserialize",
+    never a re-trace) and generates identically."""
+    from determined_tpu.compile.runtime import FarmClient
+
+    sig = "serve-warmaot-test"
+    aot_dir = str(tmp_path / "aot")
+
+    import os as _os
+
+    def boot():
+        """One replica boot: engine + farm + batcher (the batcher syncs
+        block geometry, then compiles through the farm — exactly the
+        serve task's startup order)."""
+        eng = make_engine(tiny_params, slots=2, max_seq=16,
+                          buckets=(8, 16))
+        eng.farm = FarmClient(session=None, signature=sig,
+                              aot_dir=aot_dir)
+        b = make_batcher(eng, block_size=8)
+        b.start()
+        return eng, b
+
+    cold, b1 = boot()
+    try:
+        assert cold.aot_source == "trace"
+        assert cold.compile_stats["aot_misses"] > 0
+        # Artifacts landed locally (decode, prefill buckets, sampler,
+        # CoW block copy).
+        saved = _os.listdir(_os.path.join(aot_dir, sig))
+        assert any(n.startswith("aot-decode") for n in saved), saved
+
+        req = b1.submit(Request(np.asarray([5, 9, 17], np.int32),
+                                max_new_tokens=4))
+        req.result(timeout=60)
+        want = reference_greedy(tiny_params, [5, 9, 17], 4)
+        assert list(req.out_tokens) == want
+    finally:
+        b1.stop()
+
+    warm, b2 = boot()
+    try:
+        assert warm.aot_source == "deserialize", warm.compile_stats
+        assert warm.compile_stats["aot_misses"] == 0
+        assert warm.compile_stats["decode_source"] == "deserialize"
+        # Warm executables behave identically.
+        req = b2.submit(Request(np.asarray([5, 9, 17], np.int32),
+                                max_new_tokens=4))
+        req.result(timeout=60)
+        assert list(req.out_tokens) == want
+    finally:
+        b2.stop()
+
+
+def test_serving_signature_stable_and_shape_sensitive():
+    """Same serving config -> same signature (replicas share artifacts);
+    any shape-affecting knob change -> a different signature (a respawn
+    can never load a stale executable)."""
+    from determined_tpu.serve.task import serving_signature
+
+    base = {"model": "gpt2", "model_config": {"model_size": "tiny"},
+            "max_batch_size": 4, "max_seq_len": 64, "kv_block_size": 16}
+    assert serving_signature(dict(base)) == serving_signature(dict(base))
+    changed = dict(base, max_seq_len=128)
+    assert serving_signature(changed) != serving_signature(base)
+    # Non-shape knobs (ports, sampling) don't fragment the cache.
+    assert serving_signature(dict(base, port=9999)) == \
+        serving_signature(base)
+
+
 def test_bucket_selection(tiny_params):
     eng = make_engine(tiny_params, buckets=(8, 16, 32))
     assert eng.bucket_for(1) == 8

@@ -897,11 +897,16 @@ async function pageCluster() {
   view.textContent = "";
   view.append(el("h1", {}, "Cluster"));
   view.append(el("table", {},
-    el("tr", {}, ["Agent", "Pool", "Address", "Alive", "State", "Slots (chips)"]
+    el("tr", {}, ["Agent", "Pool", "Class", "Address", "Alive", "State", "Slots (chips)"]
       .map((h) => el("th", {}, h))),
     agents.map((a) => el("tr", {},
       el("td", {}, a.id),
       el("td", {}, a.resource_pool),
+      // Spot badge: preemptible capacity is reclaimable surplus — a
+      // deployment's on_demand_floor replicas never land here.
+      el("td", {}, a.preemptible
+        ? el("span", { class: "badge spot", title: "preemptible (spot) capacity" }, "spot")
+        : "on-demand"),
       el("td", { class: "muted" }, a.addr),
       el("td", {}, a.alive ? "yes" : "no"),
       el("td", a.state === "DRAINING" ? { title: a.drain_reason } : {},
