@@ -3,7 +3,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint test chaos bench-input bench-serve bench-serve-fleet bench-capacity bench-trace bench-compile native native-test clean
+.PHONY: lint test chaos bench-input bench-serve bench-serve-fleet bench-lifecycle bench-capacity bench-trace bench-compile native native-test clean
 
 # The dogfood gate (docs/preflight.md): the platform's own models and
 # examples must pass the platform's own static analyzer. Fails on any
@@ -47,6 +47,16 @@ bench-serve:
 # Emits serve_fleet_tokens_per_s, serve_fleet_drain_dropped.
 bench-serve-fleet:
 	$(PY) bench.py --only serve_fleet
+
+# Model lifecycle (docs/serving.md "Model lifecycle"): a rolling
+# blue-green weight swap under sustained load (spawn-at-new before
+# drain-at-old; gate: ZERO dropped accepted requests) and a 10% canary
+# split whose OBSERVED traffic fraction must land within ±5 points of
+# the configured fraction, with canary-vs-stable p50/p99 reported from
+# the per-version latency aggregation. Emits lifecycle_swap_dropped,
+# lifecycle_canary_observed_fraction.
+bench-lifecycle:
+	$(PY) bench.py --only lifecycle
 
 # Closed capacity loop (docs/cluster-ops.md "Capacity loop"): a diurnal
 # traffic replay against the fake TPU API — the fleet grows nodes from

@@ -1103,6 +1103,219 @@ def serve_fleet_bench() -> None:
         f"(gate: 1.8x; {detail})")
 
 
+def lifecycle_bench() -> None:
+    """`make bench-lifecycle` (docs/serving.md "Model lifecycle"): the
+    train→serve delivery loop under load on the REAL master.
+
+    Phase 1 — **rolling weight swap under sustained load**: a 2-replica
+    deployment serves a continuous client burst while `update` rolls it
+    from version 1 to version 2 (spawn-at-new before drain-at-old).
+    Gate: ZERO dropped accepted requests, and the deployment ends with
+    every replica at v2.
+
+    Phase 2 — **canary fraction fidelity**: a 10% canary on version 3
+    takes a counted 200-request burst; the router's deterministic debt
+    split must put the OBSERVED canary fraction within ±5 points of the
+    configured 0.10 (the acceptance gate), with canary-vs-stable p50/p99
+    reported from the per-version latency aggregation.
+
+    Replicas are the fake-replica fixture (slot-capacity-bound, fixed
+    service time) for the same reason as bench-serve-fleet: the subsystem
+    under test is the master's lifecycle controller + router, and `make
+    bench-serve` already gates the real engine.
+    """
+    import os
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.request
+
+    REPO = os.path.dirname(os.path.abspath(__file__))
+    subprocess.run(["make", "-C", os.path.join(REPO, "native")],
+                   check=True, capture_output=True)
+    import sys as _sys
+
+    if os.path.join(REPO, "tests") not in _sys.path:
+        _sys.path.insert(0, os.path.join(REPO, "tests"))
+    from tests.test_platform_e2e import Devcluster
+
+    tmp = tempfile.mkdtemp(prefix="bench_lifecycle_")
+    gen_ms = 100
+    config = {
+        "name": "bench-lifecycle",
+        "entrypoint": "python3 -m tests.fixtures.serving.fake_replica",
+        "serving": {
+            "model": "gpt2",
+            "model_version": "bench:1",
+            "heartbeat_period_s": 0.3,
+            # Autoscaling quiesced: replica counts move only through the
+            # lifecycle verbs under measurement.
+            "replicas": {"min": 1, "max": 4, "target": 2,
+                         "scale_up_threshold": 2.0,
+                         "scale_up_after_s": 3600},
+        },
+        "resources": {"slots_per_trial": 0},
+        "environment": {
+            "DET_FAKE_GEN_MS": str(gen_ms),
+            "DET_FAKE_SLOTS": "4",
+            "DET_FAKE_HEARTBEAT_S": "0.3",
+        },
+    }
+    canary_fraction, canary_n = 0.10, 200
+
+    cluster = Devcluster(tmp, os.path.join(REPO, "native", "bin"), slots=1)
+    try:
+        cluster.start_master()
+        cluster.start_agent("lc-a")
+        cluster.start_agent("lc-b")
+        token = cluster.login()
+        # Registry: three committed versions of model `bench`.
+        cluster.api("POST", "/api/v1/models",
+                    {"name": "bench", "metadata": {}, "labels": []},
+                    token=token)
+        for uuid in ("bench-ck-1", "bench-ck-2", "bench-ck-3"):
+            cluster.api("POST", "/api/v1/checkpoints",
+                        {"uuid": uuid, "state": "COMPLETED"}, token=token)
+            cluster.api("POST", "/api/v1/models/bench/versions",
+                        {"checkpoint_uuid": uuid}, token=token)
+        dep_id = cluster.api("POST", "/api/v1/deployments",
+                             {"config": config}, token=token)["id"]
+
+        def _detail():
+            return cluster.api("GET", f"/api/v1/deployments/{dep_id}",
+                               token=token)["deployment"]
+
+        def _wait(pred, timeout=300.0, what="condition"):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                d = _detail()
+                if pred(d):
+                    return d
+                time.sleep(0.3)
+            raise TimeoutError(f"never reached {what}: {d}")
+
+        def _ready(d, n):
+            live = [r for r in d["replicas"]
+                    if r.get("allocation_state") == "RUNNING"
+                    and r.get("proxy_address") and not r["retiring"]
+                    and 0 <= (r.get("report_age_s") or -1) < 10]
+            return len(live) >= n
+
+        def _generate(timeout=120.0):
+            req = urllib.request.Request(
+                f"{cluster.master_url}/serve/{dep_id}/v1/generate",
+                data=json.dumps({"tokens": [5, 9, 17, 3],
+                                 "max_new_tokens": 8,
+                                 "delay_ms": gen_ms,
+                                 "timeout_s": timeout}).encode(),
+                headers={"Content-Type": "application/json",
+                         "Authorization": f"Bearer {token}"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=timeout + 30) as resp:
+                return json.loads(resp.read())
+
+        _wait(lambda d: _ready(d, 2), what="2 ready replicas")
+
+        # --- Phase 1: rolling swap under sustained load ---------------
+        stop_load = threading.Event()
+        done, errors = [], []
+
+        def _loader():
+            import urllib.error
+
+            while not stop_load.is_set():
+                try:
+                    out = _generate()
+                    done.append(out.get("model_version", ""))
+                except urllib.error.HTTPError as e:
+                    if e.code in (429, 503):
+                        ra = e.headers.get("Retry-After")
+                        time.sleep(min(float(ra or 1), 5.0))
+                        continue
+                    errors.append(f"HTTP {e.code}")
+                except Exception as e:  # noqa: BLE001
+                    errors.append(str(e)[:200])
+
+        threads = [threading.Thread(target=_loader) for _ in range(8)]
+        for t in threads:
+            t.start()
+        time.sleep(2.0)  # load established on v1
+        t_swap = time.time()
+        cluster.api("POST", f"/api/v1/deployments/{dep_id}/update",
+                    {"model": "bench", "version": 2}, token=token)
+        d = _wait(
+            lambda d: (len(d["replicas"]) == 2 and "swap" not in d
+                       and all(r["model_version"] == "bench:2"
+                               for r in d["replicas"])),
+            what="swap complete")
+        swap_s = time.time() - t_swap
+        time.sleep(2.0)  # load continues on v2
+        stop_load.set()
+        for t in threads:
+            t.join(timeout=120)
+        served_v1 = sum(1 for v in done if v == "bench:1")
+        served_v2 = sum(1 for v in done if v == "bench:2")
+
+        # --- Phase 2: canary fraction fidelity ------------------------
+        cluster.api("POST", f"/api/v1/deployments/{dep_id}/canary",
+                    {"model": "bench", "version": 3,
+                     "fraction": canary_fraction}, token=token)
+        _wait(lambda d: any(
+            r.get("canary") and r.get("allocation_state") == "RUNNING"
+            and r.get("proxy_address")
+            and 0 <= (r.get("report_age_s") or -1) < 10
+            for r in d["replicas"]), what="canary replica ready")
+        canary_hits = 0
+        for _ in range(canary_n):
+            out = _generate()
+            if out.get("model_version") == "bench:3":
+                canary_hits += 1
+        observed = canary_hits / canary_n
+        d = _detail()
+        by_version = {}
+        for version, lat in (d.get("latency_by_version") or {}).items():
+            e2e = lat.get("e2e") or {}
+            by_version[version] = {
+                "p50_ms": e2e.get("p50_ms"), "p99_ms": e2e.get("p99_ms"),
+                "requests": e2e.get("count")}
+        cluster.api("POST", f"/api/v1/deployments/{dep_id}/canary",
+                    {"abort": True}, token=token)
+    finally:
+        cluster.stop()
+
+    detail = {
+        "replica": f"4 slots x {gen_ms}ms service time (controller bench; "
+                   "see docstring)",
+        "swap_seconds": round(swap_s, 2),
+        "swap_served_v1": served_v1,
+        "swap_served_v2": served_v2,
+        "swap_errors": errors[:5],
+        "canary_requests": canary_n,
+        "canary_hits": canary_hits,
+        "latency_by_version_ms": by_version,
+    }
+    print(json.dumps({
+        "metric": "lifecycle_swap_dropped",
+        "value": len(errors),
+        "unit": "requests dropped during a rolling weight swap under "
+                "sustained load (gate: 0)",
+        "detail": detail,
+    }))
+    print(json.dumps({
+        "metric": "lifecycle_canary_observed_fraction",
+        "value": round(observed, 3),
+        "unit": f"observed canary traffic fraction over {canary_n} "
+                f"requests (configured {canary_fraction}; gate: within "
+                "±0.05)",
+        "detail": {"by_version": by_version},
+    }))
+    assert len(errors) == 0, f"rolling swap dropped: {errors[:5]}"
+    assert served_v1 > 0 and served_v2 > 0, detail
+    assert abs(observed - canary_fraction) <= 0.05, (
+        f"canary observed {observed:.3f} vs configured {canary_fraction} "
+        f"(gate ±0.05; {detail})")
+
+
 def capacity_bench() -> None:
     """`make bench-capacity` (docs/cluster-ops.md "Capacity loop"): the
     closed capacity loop under a diurnal traffic replay.
@@ -1523,6 +1736,7 @@ def main() -> int:
         "input": input_pipeline_bench,
         "serve": serve_bench,
         "serve_fleet": serve_fleet_bench,
+        "lifecycle": lifecycle_bench,
         "capacity": capacity_bench,
         "elastic": elastic_bench,
         "trace": trace_bench,

@@ -235,6 +235,22 @@ def check_config(config: Dict[str, Any]) -> List[Diagnostic]:
                 "number of seconds: it bounds how long the router holds a "
                 "request while a scale-from-zero replica restores"))
 
+    # DTL208 — canary traffic fraction (docs/serving.md "Model
+    # lifecycle"): a config-declared canary must split a REAL fraction of
+    # traffic — 0 burns a replica for no signal, 1 is a rollout wearing a
+    # canary costume (use `det serve update`). Mirrored in
+    # native/master/preflight.cc; the deployment-create gate enforces it.
+    if isinstance(serving, dict) and isinstance(serving.get("canary"), dict):
+        cb = serving["canary"]
+        frac = cb.get("fraction")
+        if frac is not None and (
+                isinstance(frac, bool) or not isinstance(frac, (int, float))
+                or not 0 < frac < 1):
+            diags.append(RULES["DTL208"].diag(
+                f"serving.canary.fraction={frac!r} must be strictly "
+                "inside (0, 1): 0 routes nothing to the canary and 1 is "
+                "a full rollout — use `det serve update` for that"))
+
     # DTL203 — restarts configured but nothing to restart from. Only an
     # EXPLICIT min_checkpoint_period: 0 fires (key present): the default is
     # also 0 batches and flagging every config would be pure noise.

@@ -121,6 +121,13 @@ _ALL = [
          "can never be met), and cold_start_budget_s must be a positive "
          "number — it bounds how long the router holds a request while a "
          "scale-from-zero replica restores"),
+    Rule("DTL208", "serving-canary-fraction", "error", "config",
+         "a config-declared canary split (serving.canary) must carry a "
+         "traffic fraction strictly inside (0, 1): 0 routes nothing to "
+         "the canary (it burns a replica for no signal) and 1 is a full "
+         "rollout that should be a rolling update instead — the router's "
+         "deterministic debt split is only meaningful for a real "
+         "fraction (docs/serving.md 'Model lifecycle')"),
 ]
 
 RULES: Dict[str, Rule] = {r.code: r for r in _ALL}

@@ -521,7 +521,26 @@ def cmd_serve(session, args) -> int:
             tid = args.extra[0]
             if tid.startswith("deploy-"):
                 resp = session.get(f"/api/v1/deployments/{tid}")
-                print(json.dumps(resp.get("deployment", resp), indent=2))
+                d = resp.get("deployment", resp)
+                # Canary-vs-stable latency side by side (docs/serving.md
+                # "Model lifecycle") before the full JSON dump.
+                byv = d.get("latency_by_version") or {}
+                if len(byv) > 1:
+                    rows = []
+                    for version, lat in sorted(byv.items()):
+                        row = {"version": version}
+                        for key in ("ttft", "tpot", "e2e"):
+                            h = lat.get(key) or {}
+                            row[f"{key}_ms"] = (
+                                f"{h['p50_ms']:.0f}/{h['p99_ms']:.0f}"
+                                if h.get("count") else "-")
+                        rows.append(row)
+                    _print_table(rows,
+                                 ["version", "ttft_ms", "tpot_ms",
+                                  "e2e_ms"])
+                    print("  (per-version p50/p99 ms over fresh replica "
+                          "heartbeats)")
+                print(json.dumps(d, indent=2))
                 return 0
             resp = session.get(f"/api/v1/serving/{tid}")
             print(json.dumps(resp.get("task", resp), indent=2))
@@ -538,6 +557,22 @@ def cmd_serve(session, args) -> int:
                     return "-"
                 return f"{h['p50_ms']:.0f}/{h['p99_ms']:.0f}"
 
+            def _version_col(d):
+                """Served version (+ swap arrow while rolling) and the
+                canary split, compact enough for a table cell."""
+                v = d.get("model_version") or ""
+                v = v.replace("checkpoint:", "ckpt:")
+                if d.get("swapping"):
+                    v = f"->{v}"
+                return v
+
+            def _canary_col(d):
+                c = d.get("canary")
+                if not c:
+                    return ""
+                return (f"{c.get('version')}@{c.get('fraction')}"
+                        f" (obs {c.get('observed_fraction', 0):.2f})")
+
             _print_table(
                 [
                     {
@@ -548,6 +583,8 @@ def cmd_serve(session, args) -> int:
                                      f"/{d.get('target_replicas', 0)}"),
                         "range": (f"[{d.get('min_replicas')}, "
                                   f"{d.get('max_replicas')}]"),
+                        "version": _version_col(d),
+                        "canary": _canary_col(d),
                         "load": round(d.get("smoothed_load") or 0.0, 3),
                         "ttft_ms": _pp(d, "ttft"),
                         "tpot_ms": _pp(d, "tpot"),
@@ -555,8 +592,8 @@ def cmd_serve(session, args) -> int:
                     }
                     for d in deployments
                 ],
-                ["id", "name", "state", "replicas", "range", "load",
-                 "ttft_ms", "tpot_ms", "e2e_ms"])
+                ["id", "name", "state", "replicas", "range", "version",
+                 "canary", "load", "ttft_ms", "tpot_ms", "e2e_ms"])
             print("  (latency columns are p50/p99 ms over fresh replica "
                   "heartbeats)")
         resp = session.get("/api/v1/serving")
@@ -582,6 +619,72 @@ def cmd_serve(session, args) -> int:
                             body={"target": n})
         print(f"deployment {resp.get('id', dep)} target -> "
               f"{resp.get('target', n)}")
+        return 0
+    if target == "update":
+        # `det serve update <deployment> <model[:version] | checkpoint>`
+        # — rolling blue-green weight swap (docs/serving.md "Model
+        # lifecycle"): spawn-at-new before drain-at-old, one replica at
+        # a time, zero dropped. Rollback = update back to the prior
+        # version (registered versions stay resident in the registry).
+        if len(args.extra) != 2:
+            raise SystemExit(
+                "usage: det serve update <deployment> "
+                "<model[:version] | checkpoint-id>")
+        dep, spec = args.extra
+        resp = session.post(f"/api/v1/deployments/{dep}/update",
+                            body=_version_spec_body(spec))
+        if resp.get("rolling"):
+            print(f"deployment {resp.get('id', dep)} rolling to "
+                  f"{resp.get('model_version')} "
+                  f"(checkpoint {resp.get('checkpoint')})")
+            print(f"  watch:  det serve status {resp.get('id', dep)}")
+        else:
+            print(f"deployment {resp.get('id', dep)} already serves "
+                  f"{resp.get('model_version')}")
+        return 0
+    if target == "canary":
+        # `det serve canary <deployment> <version> --fraction 0.05`,
+        # then `--promote` (fold into the deployment via a rolling swap)
+        # or `--abort` (drain the canary, stable untouched).
+        if not args.extra:
+            raise SystemExit(
+                "usage: det serve canary <deployment> "
+                "[<model[:version] | checkpoint>] [--fraction F] "
+                "[--replicas N] | --promote | --abort")
+        dep = args.extra[0]
+        if getattr(args, "promote", False):
+            resp = session.post(f"/api/v1/deployments/{dep}/canary",
+                                body={"promote": True})
+            stats = resp.get("canary_stats") or {}
+            print(f"promoted {resp.get('promoted')} on "
+                  f"{resp.get('id', dep)} (canary served "
+                  f"{stats.get('routed', 0)} of "
+                  f"{stats.get('routed', 0) + stats.get('routed_stable', 0)}"
+                  " generations); remaining replicas rolling over")
+            return 0
+        if getattr(args, "abort", False):
+            resp = session.post(f"/api/v1/deployments/{dep}/canary",
+                                body={"abort": True})
+            print(f"aborted canary {resp.get('aborted')} on "
+                  f"{resp.get('id', dep)}; canary replicas draining")
+            return 0
+        if len(args.extra) != 2:
+            raise SystemExit(
+                "usage: det serve canary <deployment> "
+                "<model[:version] | checkpoint> --fraction F")
+        body = _version_spec_body(args.extra[1])
+        body["fraction"] = float(getattr(args, "fraction", 0.05) or 0.05)
+        if getattr(args, "replicas", None):
+            body["replicas"] = int(args.replicas)
+        resp = session.post(f"/api/v1/deployments/{dep}/canary", body=body)
+        print(f"canary {resp.get('canary')} on {resp.get('id', dep)}: "
+              f"{resp.get('fraction')} of traffic, "
+              f"{resp.get('replicas')} replica(s)")
+        print(f"  compare: det serve status {resp.get('id', dep)} "
+              "(per-version p50/p99)")
+        print(f"  promote: det serve canary {resp.get('id', dep)} "
+              "--promote")
+        print(f"  abort:   det serve canary {resp.get('id', dep)} --abort")
         return 0
     if target == "trace":
         # `det serve trace <deployment> <request-id>` — the request's
@@ -898,9 +1001,27 @@ def cmd_model(session: Session, args) -> int:
     elif args.action == "versions":
         _print_table(
             session.get(f"/api/v1/models/{args.name}/versions")["model_versions"],
-            ["id", "version", "checkpoint_uuid", "creation_time"],
+            ["id", "version", "checkpoint_uuid", "source_experiment_id",
+             "source_trial_id", "steps_completed", "creation_time"],
         )
     return 0
+
+
+def _version_spec_body(spec: str) -> dict:
+    """'<model>:<version>' / '<model>:latest' → registry coordinates;
+    anything without a colon is a raw checkpoint storage id."""
+    if ":" in spec:
+        model, _, ver = spec.rpartition(":")
+        body = {"model": model}
+        if ver and ver != "latest":
+            try:
+                body["version"] = int(ver)
+            except ValueError:
+                raise SystemExit(
+                    f"bad version spec {spec!r}: want <model>:<int> or "
+                    "<model>:latest")
+        return body
+    return {"checkpoint": spec}
 
 
 def cmd_template(session: Session, args) -> int:
@@ -1150,18 +1271,31 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument(
         "target",
         help="serving config file to launch, or 'status' / 'scale' / "
-             "'kill' / 'trace'")
+             "'kill' / 'trace' / 'update' / 'canary'")
     sv.add_argument(
         "extra", nargs="*",
         help="context dir (launch), task/deployment id (status/kill), "
-             "<deployment-id> <target> (scale), or "
-             "<deployment> <request-id> (trace)")
+             "<deployment-id> <target> (scale), "
+             "<deployment> <request-id> (trace), or "
+             "<deployment> <model[:version]|checkpoint> (update/canary)")
     sv.add_argument(
         "--local", action="store_true",
         help="run the replica in-process against local storage (no master)")
     sv.add_argument(
         "--json", action="store_true",
         help="raw span JSON instead of the waterfall (trace)")
+    sv.add_argument(
+        "--fraction", type=float, default=0.05,
+        help="canary traffic fraction in (0, 1) (canary; default 0.05)")
+    sv.add_argument(
+        "--replicas", type=int, default=None,
+        help="canary replica count (canary; default 1)")
+    sv.add_argument(
+        "--promote", action="store_true",
+        help="fold the canary version into the deployment (canary)")
+    sv.add_argument(
+        "--abort", action="store_true",
+        help="drain the canary replicas, keep stable untouched (canary)")
     sv.set_defaults(func=cmd_serve)
 
     pf = sub.add_parser(

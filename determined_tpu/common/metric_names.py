@@ -81,6 +81,16 @@ MASTER_METRICS: Dict[str, Tuple[str, str]] = {
         "counter", "Scale-from-zero demand wakes: the router bumped a "
         "deployment's target 0 -> 1 and held the request "
         "(docs/serving.md 'Scale to zero')"),
+    "det_deployment_swaps_total": (
+        "counter", "Completed rolling weight swaps: every serving "
+        "replica reached the updated model version "
+        "(docs/serving.md 'Model lifecycle')"),
+    "det_model_versions_registered_total": (
+        "counter", "Model versions registered (API registration + "
+        "registry: auto-promotion on experiment completion)"),
+    "det_serve_canary_requests_total": (
+        "counter", "Routed generations by version group "
+        "(canary/stable) per deployment while a canary split is active"),
     "det_provisioner_demand_slots": (
         "gauge", "Composed provisioner demand by pool and source "
         "(pending/elastic/serving/compile; docs/cluster-ops.md "
@@ -185,6 +195,10 @@ SPAN_NAMES: Dict[str, Tuple[str, str]] = {
         "waking request and whether the replica's engine deserialized "
         "(warm AOT) or traced — wait_ms/budget_s/replica/engine_source "
         "in attrs"),
+    "serve.swap": (
+        "master", "One rolling weight swap, update to last stale "
+        "replica drained — from/to versions and replicas_swapped in "
+        "attrs (docs/serving.md 'Model lifecycle')"),
 }
 
 _METRIC_RE = re.compile(r"^det(_[a-z0-9]+)+$")

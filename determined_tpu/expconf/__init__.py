@@ -221,6 +221,7 @@ def validate(config: Dict[str, Any]) -> List[str]:
     if mr is not None and (not isinstance(mr, int) or mr < 0):
         errors.append("max_restarts must be a non-negative int")
 
+    _validate_registry(config.get("registry"), serving, errors)
     _validate_environment(config.get("environment"), errors)
     _validate_log_policies(config.get("log_policies"), errors)
     _validate_preflight(config.get("preflight"), errors)
@@ -376,6 +377,40 @@ def _validate_health(block: Any, errors: List[str]) -> None:
                       "number (0 disables the watchdog)")
 
 
+def _validate_registry(block: Any, serving: Any,
+                       errors: List[str]) -> None:
+    """`registry:` — train→serve auto-promotion (docs/serving.md "Model
+    lifecycle"): when the experiment COMPLETES, the master registers its
+    winning checkpoint as the next version of `model` — the searcher-best
+    validation checkpoint (`promote: best`, the default) or the newest
+    COMPLETED one (`promote: latest`)."""
+    if block is None:
+        return
+    if not isinstance(block, dict):
+        errors.append("registry must be a mapping")
+        return
+    if serving is not None:
+        errors.append(
+            "registry: promotion belongs to training configs — a serving "
+            "config consumes registered versions, it does not produce "
+            "them")
+    valid = {"model", "promote"}
+    unknown = sorted(set(block) - valid)
+    if unknown:
+        errors.append(
+            f"registry: unknown keys {unknown}; valid: {sorted(valid)}")
+    model = block.get("model")
+    if not isinstance(model, str) or not model:
+        errors.append("registry.model must be a non-empty model name")
+    elif ":" in model:
+        errors.append(
+            "registry.model must be a bare model name (the registry "
+            "assigns the version number)")
+    promote = block.get("promote")
+    if promote is not None and promote not in ("best", "latest"):
+        errors.append("registry.promote must be one of: best, latest")
+
+
 def _validate_serving(block: Any, errors: List[str]) -> None:
     """`serving:` — a `det serve` deployment (docs/serving.md): which
     checkpoint to load, the model family/config to rebuild it into, and
@@ -388,7 +423,8 @@ def _validate_serving(block: Any, errors: List[str]) -> None:
              "kv_num_blocks", "prefix_cache", "attention_impl",
              "prefill_buckets", "queue_depth", "port", "seed",
              "stats_log_period_s", "replicas", "heartbeat_period_s",
-             "trace_sample", "slo_ms", "warm_aot"}
+             "trace_sample", "slo_ms", "warm_aot", "adapters", "canary",
+             "model_version"}
     unknown = sorted(set(block) - valid)
     if unknown:
         errors.append(
@@ -459,7 +495,110 @@ def _validate_serving(block: Any, errors: List[str]) -> None:
         or slo <= 0
     ):
         errors.append("serving.slo_ms must be a positive number")
+    mv = block.get("model_version")
+    if mv is not None and (not isinstance(mv, str) or not mv):
+        errors.append(
+            "serving.model_version must be a registry label "
+            "('<model>' or '<model>:<version>')")
+    _validate_serving_adapters(block.get("adapters"), errors)
+    _validate_serving_canary(block.get("canary"), errors)
     _validate_serving_replicas(block.get("replicas"), errors)
+
+
+def _validate_serving_adapters(adapters: Any, errors: List[str]) -> None:
+    """`serving.adapters:` — multi-adapter replicas (docs/serving.md
+    "Model lifecycle"): LoRA-style head-delta fine-tunes resident beside
+    one base executable, routed per request by `model:` name. Each entry
+    names an adapter and the committed checkpoint its weights come from."""
+    if adapters is None:
+        return
+    if not isinstance(adapters, list):
+        errors.append(
+            "serving.adapters must be a list of {name, checkpoint}")
+        return
+    seen = set()
+    for i, a in enumerate(adapters):
+        if not isinstance(a, dict):
+            errors.append(
+                f"serving.adapters[{i}] must be a mapping with "
+                "`name` and `checkpoint`")
+            continue
+        unknown = sorted(set(a) - {"name", "checkpoint"})
+        if unknown:
+            errors.append(
+                f"serving.adapters[{i}]: unknown keys {unknown}; "
+                "valid: name, checkpoint")
+        name = a.get("name")
+        if not isinstance(name, str) or not name:
+            errors.append(
+                f"serving.adapters[{i}].name must be a non-empty string")
+        elif name in seen:
+            # Duplicate names would make per-request `model:` routing
+            # ambiguous — which fine-tune did the caller mean?
+            errors.append(
+                f"serving.adapters[{i}].name {name!r} is a duplicate "
+                "(adapter names route requests and must be unique)")
+        elif name == "base":
+            errors.append(
+                "serving.adapters: the name 'base' is reserved for the "
+                "deployment's base checkpoint")
+        else:
+            seen.add(name)
+        ck = a.get("checkpoint")
+        if not isinstance(ck, str) or not ck:
+            errors.append(
+                f"serving.adapters[{i}].checkpoint must be a checkpoint "
+                "storage id")
+
+
+def _validate_serving_canary(block: Any, errors: List[str]) -> None:
+    """`serving.canary:` — a config-declared canary split (docs/serving.md
+    "Model lifecycle"): the deployment starts with `fraction` of traced
+    generations routed to `model:version` (or `checkpoint`) replicas.
+    The fraction rule is mirrored as DTL208 in native preflight — the
+    deployment-create gate enforces it master-side."""
+    if block is None:
+        return
+    if not isinstance(block, dict):
+        errors.append("serving.canary must be a mapping")
+        return
+    valid = {"model", "version", "checkpoint", "fraction", "replicas"}
+    unknown = sorted(set(block) - valid)
+    if unknown:
+        errors.append(
+            f"serving.canary: unknown keys {unknown}; "
+            f"valid: {sorted(valid)}")
+    has_model = isinstance(block.get("model"), str) and block.get("model")
+    has_ckpt = (isinstance(block.get("checkpoint"), str)
+                and block.get("checkpoint"))
+    if not has_model and not has_ckpt:
+        errors.append(
+            "serving.canary requires `model` (a registry name) or "
+            "`checkpoint` (a storage id) naming the canary version")
+    v = block.get("version")
+    if v is not None and (
+        isinstance(v, bool) or not isinstance(v, int) or v < 1
+    ):
+        errors.append(
+            "serving.canary.version must be a positive int "
+            "(a registered model version number)")
+    if v is not None and not has_model:
+        errors.append(
+            "serving.canary.version requires `model` (versions are "
+            "registry coordinates, not checkpoint ids)")
+    frac = block.get("fraction")
+    if frac is not None and (
+        isinstance(frac, bool) or not isinstance(frac, (int, float))
+        or not 0 < frac < 1
+    ):
+        errors.append(
+            "serving.canary.fraction must be strictly inside (0, 1) "
+            "(DTL208): 0 routes nothing, 1 is a rolling update")
+    reps = block.get("replicas")
+    if reps is not None and (
+        isinstance(reps, bool) or not isinstance(reps, int) or reps < 1
+    ):
+        errors.append("serving.canary.replicas must be a positive int")
 
 
 def _validate_serving_replicas(block: Any, errors: List[str]) -> None:
@@ -774,8 +913,14 @@ def apply_defaults(config: Dict[str, Any]) -> Dict[str, Any]:
             rep.setdefault("target", rep["min"])
             # max must stay >= 1 even under min: 0 (scale-to-zero).
             rep.setdefault("max", max(rep["min"], rep["target"], 1))
+        if isinstance(s.get("canary"), dict):
+            cb = s["canary"]
+            cb.setdefault("fraction", 0.05)
+            cb.setdefault("replicas", 1)
         # No searcher/validation machinery for a deployment config.
         return c
+    if isinstance(c.get("registry"), dict):
+        c["registry"].setdefault("promote", "best")
     searcher = c.setdefault("searcher", {})
     searcher.setdefault("smaller_is_better", True)
     name = searcher.get("name")

@@ -146,8 +146,10 @@ class ServingEngine:
         attention_impl: str = "auto",
         kv_block_size: int = 16,
         kv_num_blocks: Optional[int] = None,
+        adapters: Optional[Dict[str, Any]] = None,
     ):
         import jax
+        import jax.numpy as jnp
 
         if slots <= 0:
             raise ValueError("slots must be positive")
@@ -160,6 +162,35 @@ class ServingEngine:
         self.prefill_buckets = buckets
         self.rules = rules or LogicalRules()
         self.params = jax.device_put(params)
+        # Multi-adapter serving (docs/serving.md "Model lifecycle"):
+        # adapter name → params tree of a head-tuned fine-tune. Only the
+        # (tied) embedding/LM-head table participates: the stack
+        # [A+1, V, D] (index 0 = base) rides every compiled call and a
+        # per-slot index selects each lane's table — one executable, one
+        # KV pool, N fine-tunes. The transformer body stays the base's;
+        # an adapter checkpoint whose body drifted from the base would
+        # serve the base body silently, so we refuse anything but an
+        # exact wte-shape match and document the contract.
+        self.adapter_ids: Dict[str, int] = {"base": 0}
+        self._adapter_stack = None
+        self._slot_adapters = None
+        if adapters:
+            base_wte = self.params["wte"]
+            tables = [base_wte]
+            for name, tree in adapters.items():
+                wte = tree.get("wte") if isinstance(tree, dict) else None
+                if wte is None:
+                    raise ValueError(
+                        f"adapter {name!r}: checkpoint has no 'wte' table")
+                if tuple(wte.shape) != tuple(base_wte.shape):
+                    raise ValueError(
+                        f"adapter {name!r}: wte shape {tuple(wte.shape)} "
+                        f"!= base {tuple(base_wte.shape)} — adapters must "
+                        "share the base model's geometry")
+                self.adapter_ids[name] = len(tables)
+                tables.append(jnp.asarray(wte, base_wte.dtype))
+            self._adapter_stack = jax.device_put(jnp.stack(tables))
+            self._slot_adapters = np.zeros((slots,), np.int32)
         self.attention_impl = resolve_attention_impl(attention_impl)
         self.paged = self.attention_impl != "dense"
         self.block_size = int(kv_block_size)
@@ -200,6 +231,34 @@ class ServingEngine:
         # A pool smaller than one max_seq sequence is legal here (tests
         # build tiny backpressure pools); configs are gated by DTL206,
         # and the batcher rejects any request the pool can never cover.
+
+    # -- adapters ------------------------------------------------------
+
+    @property
+    def has_adapters(self) -> bool:
+        return self._adapter_stack is not None
+
+    @property
+    def adapter_names(self) -> List[str]:
+        return [n for n in self.adapter_ids if n != "base"]
+
+    def adapter_index(self, name: Optional[str]) -> int:
+        """Stack index for a per-request `model:` name; '' / None /
+        'base' = the base checkpoint. Unknown names raise ValueError —
+        the HTTP front-end turns that into a 400, never a silent
+        base-model answer the caller did not ask for."""
+        if not name or name == "base":
+            return 0
+        idx = self.adapter_ids.get(name)
+        if idx is None:
+            raise ValueError(
+                f"unknown adapter {name!r}; resident: "
+                f"{self.adapter_names or '(none)'}")
+        return idx
+
+    def set_slot_adapter(self, slot: int, adapter: int) -> None:
+        if self._slot_adapters is not None:
+            self._slot_adapters[slot] = adapter
 
     @property
     def max_blocks_per_seq(self) -> int:
@@ -303,9 +362,30 @@ class ServingEngine:
         mb = self.max_blocks_per_seq
         impl = self.attention_impl
 
+        # Adapter stack aval (multi-adapter replicas): every decode/
+        # prefill executable takes the [A+1, V, D] table stack plus the
+        # per-lane index as INPUTS — adapter routing changes operands,
+        # never executables, so N fine-tunes share one compile.
+        stack_sd = None
+        if self.has_adapters:
+            stack_sd = sds(self._adapter_stack.shape,
+                           self._adapter_stack.dtype)
+
         t0 = time.monotonic()
         if self.paged:
             def build_decode():
+                if stack_sd is not None:
+                    decode = jax.jit(
+                        lambda p, c, t, pos, tbl, ad, sa:
+                            smodel.paged_decode_step(
+                                p, c, t, pos, tbl, cfg, rules,
+                                attention_impl=impl, adapters=ad,
+                                slot_adapters=sa),
+                        donate_argnums=(1,))
+                    return decode.lower(
+                        params_sd, cache_sd, sds((self.slots,), i32),
+                        sds((self.slots,), i32), sds((self.slots, mb), i32),
+                        stack_sd, sds((self.slots,), i32)).compile()
                 decode = jax.jit(
                     lambda p, c, t, pos, tbl: smodel.paged_decode_step(
                         p, c, t, pos, tbl, cfg, rules, attention_impl=impl),
@@ -316,6 +396,16 @@ class ServingEngine:
                     sds((self.slots, mb), i32)).compile()
         else:
             def build_decode():
+                if stack_sd is not None:
+                    decode = jax.jit(
+                        lambda p, c, t, pos, ad, sa: smodel.decode_step(
+                            p, c, t, pos, cfg, rules, adapters=ad,
+                            slot_adapters=sa),
+                        donate_argnums=(1,))
+                    return decode.lower(
+                        params_sd, cache_sd, sds((self.slots,), i32),
+                        sds((self.slots,), i32), stack_sd,
+                        sds((self.slots,), i32)).compile()
                 decode = jax.jit(
                     lambda p, c, t, pos: smodel.decode_step(
                         p, c, t, pos, cfg, rules),
@@ -330,6 +420,17 @@ class ServingEngine:
             t0 = time.monotonic()
             if self.paged:
                 def build_prefill(bucket=bucket):
+                    if stack_sd is not None:
+                        pf = jax.jit(
+                            lambda p, c, t, ln, pfx, tbl, ad, sa:
+                                smodel.paged_prefill(
+                                    p, c, t, ln, pfx, tbl, cfg, rules,
+                                    adapters=ad, slot_adapter=sa),
+                            donate_argnums=(1,))
+                        return pf.lower(
+                            params_sd, cache_sd, sds((bucket,), i32),
+                            sds((), i32), sds((), i32), sds((mb,), i32),
+                            stack_sd, sds((), i32)).compile()
                     pf = jax.jit(
                         lambda p, c, t, ln, pfx, tbl: smodel.paged_prefill(
                             p, c, t, ln, pfx, tbl, cfg, rules),
@@ -339,6 +440,16 @@ class ServingEngine:
                         sds((), i32), sds((), i32), sds((mb,), i32)).compile()
             else:
                 def build_prefill(bucket=bucket):
+                    if stack_sd is not None:
+                        pf = jax.jit(
+                            lambda p, c, t, ln, sl, ad, sa: smodel.prefill(
+                                p, c, t, ln, sl, cfg, rules, adapters=ad,
+                                slot_adapter=sa),
+                            donate_argnums=(1,))
+                        return pf.lower(
+                            params_sd, cache_sd, sds((bucket,), i32),
+                            sds((), i32), sds((), i32), stack_sd,
+                            sds((), i32)).compile()
                     pf = jax.jit(
                         lambda p, c, t, ln, sl: smodel.prefill(
                             p, c, t, ln, sl, cfg, rules),
@@ -431,14 +542,19 @@ class ServingEngine:
     def prefill_request(self, slot: int, tokens: np.ndarray,
                         temperature: float = 0.0,
                         block_table: Optional[Sequence[int]] = None,
-                        cached_len: int = 0) -> int:
+                        cached_len: int = 0, adapter: int = 0) -> int:
         """Prefill `tokens` into the slot's cache; returns the first
         generated token. Compiled-bucket dispatch by NOVEL length: with
         `cached_len > 0` (prefix-cache hit) only the suffix
         `tokens[cached_len:]` runs through the model — the bucket, and
-        therefore the prefill cost, shrinks to the novel part."""
+        therefore the prefill cost, shrinks to the novel part. `adapter`
+        selects the slot's table from the adapter stack (0 = base); the
+        slot keeps it for every decode step until release."""
         if self._compiled_decode is None:
             self.compile()
+        if adapter and not self.has_adapters:
+            raise ValueError("engine has no adapters resident")
+        self.set_slot_adapter(slot, adapter)
         length = int(tokens.shape[0])
         if not self.paged:
             if cached_len:
@@ -451,9 +567,11 @@ class ServingEngine:
                     f"bucket ({self.prefill_buckets[-1]})")
             padded = np.zeros((bucket,), np.int32)
             padded[:length] = tokens
-            self._cache, logits = self._compiled_prefill[bucket](
-                self.params, self._cache, padded,
-                np.int32(length), np.int32(slot))
+            args = [self.params, self._cache, padded,
+                    np.int32(length), np.int32(slot)]
+            if self.has_adapters:
+                args += [self._adapter_stack, np.int32(adapter)]
+            self._cache, logits = self._compiled_prefill[bucket](*args)
             self.prefills += 1
             return self._sample_first(logits, temperature)
         if not 0 <= cached_len < length:
@@ -476,9 +594,11 @@ class ServingEngine:
                 f"bucket ({self.prefill_buckets[-1]})")
         padded = np.zeros((bucket,), np.int32)
         padded[:s_len] = suffix
-        self._cache, logits = self._compiled_prefill[bucket](
-            self.params, self._cache, padded,
-            np.int32(s_len), np.int32(cached_len), table)
+        args = [self.params, self._cache, padded,
+                np.int32(s_len), np.int32(cached_len), table]
+        if self.has_adapters:
+            args += [self._adapter_stack, np.int32(adapter)]
+        self._cache, logits = self._compiled_prefill[bucket](*args)
         self._tables[slot] = table
         self.prefills += 1
         return self._sample_first(logits, temperature)
@@ -495,9 +615,11 @@ class ServingEngine:
 
     def release_slot(self, slot: int) -> None:
         """Point a retired slot's table at the trash block so later
-        decode steps can never touch its (possibly reallocated) blocks."""
+        decode steps can never touch its (possibly reallocated) blocks,
+        and hand the lane's adapter back to base."""
         if self.paged and self._tables is not None:
             self._tables[slot] = self.trash_block
+        self.set_slot_adapter(slot, 0)
 
     def decode(self, tokens: np.ndarray, positions: np.ndarray,
                temperatures: np.ndarray) -> np.ndarray:
@@ -508,15 +630,13 @@ class ServingEngine:
         boundaries in the batcher thread)."""
         if self._compiled_decode is None:
             self.compile()
+        args = [self.params, self._cache, np.asarray(tokens, np.int32),
+                np.asarray(positions, np.int32)]
         if self.paged:
-            self._cache, logits = self._compiled_decode(
-                self.params, self._cache,
-                np.asarray(tokens, np.int32),
-                np.asarray(positions, np.int32), self._tables)
-        else:
-            self._cache, logits = self._compiled_decode(
-                self.params, self._cache,
-                np.asarray(tokens, np.int32), np.asarray(positions, np.int32))
+            args.append(self._tables)
+        if self.has_adapters:
+            args += [self._adapter_stack, self._slot_adapters.copy()]
+        self._cache, logits = self._compiled_decode(*args)
         toks = self._compiled_sample(
             logits, np.asarray(temperatures, np.float32), self._next_rng())
         self.decode_steps += 1
@@ -525,6 +645,7 @@ class ServingEngine:
     def stats(self) -> Dict[str, Any]:
         return {
             "slots": self.slots,
+            "adapters": self.adapter_names,
             "max_seq_len": self.max_seq_len,
             "prefill_buckets": list(self.prefill_buckets),
             "attention_impl": self.attention_impl,

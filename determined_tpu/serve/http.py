@@ -155,15 +155,23 @@ def _make_handler(batcher: ContinuousBatcher):
             # `det serve trace <deployment> <request-id>` finds the whole
             # router→replica tree under one id.
             rid = (self.headers.get("X-Request-Id") or "").strip() or None
+            # Adapter routing (docs/serving.md "Model lifecycle"): the
+            # `model` body field (or X-Model header) names a resident
+            # fine-tune; unknown names 400 below via submit()'s
+            # validation — never a silent base-model answer.
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
+                model = (str(body.get("model")
+                             or self.headers.get("X-Model") or "").strip()
+                         or None)
                 req = Request(
                     tokens=body["tokens"],
                     max_new_tokens=int(body.get("max_new_tokens", 16)),
                     temperature=float(body.get("temperature", 0.0)),
                     eos_id=body.get("eos_id"),
                     request_id=rid,
+                    model=model,
                 )
                 timeout = float(
                     body.get("timeout_s", DEFAULT_REQUEST_TIMEOUT_S))

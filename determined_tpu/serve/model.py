@@ -109,6 +109,51 @@ def _finish(params, x, cfg: Config, rules: Optional[LogicalRules]):
     return shard_logical(logits, ("batch", "seq", "vocab"), rules)
 
 
+# ------------------------------------------------------------- adapters
+#
+# Multi-adapter serving (docs/serving.md "Model lifecycle"): many
+# fine-tunes share ONE base executable and ONE KV pool. An adapter is the
+# (tied) embedding/LM-head table of a head-tuned checkpoint; the stack
+# `[A+1, vocab, d_model]` (index 0 = the base table) rides every compiled
+# call like params do, and a per-slot adapter index selects each lane's
+# table at the only two places the table is read — token embedding and the
+# final logits projection. The transformer body (and therefore the cached
+# K/V) stays the base's for every adapter, which is exactly what lets one
+# executable and one block pool serve thousands of fine-tunes: selection
+# is a gather + a batched matmul, never a recompile.
+
+
+def _embed_adapter(adapters: jax.Array, idx: jax.Array,
+                   tokens: jax.Array, dtype) -> jax.Array:
+    """Per-lane token embedding from the adapter stack.
+
+    adapters: [A+1, V, D]. idx scalar (prefill: one lane, tokens [S] →
+    [S, D]) or [slots] (decode: one token per lane, tokens [slots] →
+    [slots, D]). Same gather `wte[tokens]` as _embed_tokens, with wte
+    selected per lane (serving runs unsharded — the one-hot Megatron
+    path is a training concern)."""
+    sel = jnp.take(adapters, idx, axis=0).astype(dtype)
+    if idx.ndim == 0:
+        return sel[tokens]  # [S, D]
+    return jnp.take_along_axis(
+        sel, tokens[:, None, None], axis=1)[:, 0, :]  # [slots, D]
+
+
+def _finish_adapter(params, x, adapters: jax.Array, idx: jax.Array,
+                    cfg: Config, rules: Optional[LogicalRules]):
+    """_finish with the LM head selected per lane from the adapter stack.
+
+    x: [B, S, D]; idx: [] (prefill, B==1) or [slots] (decode, S==1)."""
+    x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"],
+                    cfg.layer_norm_eps)
+    sel = jnp.take(adapters, idx, axis=0).astype(cfg.dtype)
+    if idx.ndim == 0:
+        logits = jnp.einsum("bsd,vd->bsv", x, sel)
+    else:
+        logits = jnp.einsum("sqd,svd->sqv", x, sel)
+    return shard_logical(logits, ("batch", "seq", "vocab"), rules)
+
+
 # ---------------------------------------------------------------- prefill
 
 
@@ -120,6 +165,8 @@ def prefill(
     slot: jax.Array,     # scalar int32: cache lane to fill
     cfg: Config,
     rules: Optional[LogicalRules] = None,
+    adapters: Optional[jax.Array] = None,   # [A+1, V, D] stack
+    slot_adapter: Optional[jax.Array] = None,  # scalar int32 stack index
 ) -> Tuple[Dict[str, jax.Array], jax.Array]:
     """Run the prompt through the model, filling cache lane `slot`.
 
@@ -129,7 +176,10 @@ def prefill(
     """
     s = tokens.shape[0]
     dt = cfg.dtype
-    x = _embed_tokens(params, tokens[None], cfg, rules, dt)
+    if adapters is None:
+        x = _embed_tokens(params, tokens[None], cfg, rules, dt)
+    else:
+        x = _embed_adapter(adapters, slot_adapter, tokens, dt)[None]
     x = x + params["wpe"].astype(dt)[:s][None]
     x = shard_logical(x, ("batch", "seq", "embed"), rules)
     causal = jnp.tril(jnp.ones((s, s), jnp.bool_))
@@ -166,7 +216,11 @@ def prefill(
 
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params["blocks"], cache["k"], cache["v"]))
-    logits = _finish(params, x, cfg, rules)  # [1, S, V]
+    if adapters is None:
+        logits = _finish(params, x, cfg, rules)  # [1, S, V]
+    else:
+        logits = _finish_adapter(params, x, adapters, slot_adapter, cfg,
+                                 rules)
     last = jax.lax.dynamic_index_in_dim(
         logits[0], jnp.maximum(length - 1, 0), axis=0, keepdims=False)
     return {"k": new_k, "v": new_v}, last.astype(jnp.float32)
@@ -182,6 +236,8 @@ def decode_step(
     positions: jax.Array,  # [slots] int32: index this step writes/attends at
     cfg: Config,
     rules: Optional[LogicalRules] = None,
+    adapters: Optional[jax.Array] = None,      # [A+1, V, D] stack
+    slot_adapters: Optional[jax.Array] = None,  # [slots] int32 stack index
 ) -> Tuple[Dict[str, jax.Array], jax.Array]:
     """One decode step for every slot → (cache', logits [slots, vocab]).
 
@@ -192,7 +248,10 @@ def decode_step(
     slots = tokens.shape[0]
     max_seq = cache["k"].shape[2]
     dt = cfg.dtype
-    x = _embed_tokens(params, tokens[:, None], cfg, rules, dt)  # [slots,1,D]
+    if adapters is None:
+        x = _embed_tokens(params, tokens[:, None], cfg, rules, dt)
+    else:
+        x = _embed_adapter(adapters, slot_adapters, tokens, dt)[:, None]
     pos_emb = jnp.take(params["wpe"].astype(dt), positions, axis=0)
     x = x + pos_emb[:, None]
     x = shard_logical(x, ("batch", "seq", "embed"), rules)
@@ -230,7 +289,11 @@ def decode_step(
 
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params["blocks"], cache["k"], cache["v"]))
-    logits = _finish(params, x, cfg, rules)  # [slots, 1, V]
+    if adapters is None:
+        logits = _finish(params, x, cfg, rules)  # [slots, 1, V]
+    else:
+        logits = _finish_adapter(params, x, adapters, slot_adapters, cfg,
+                                 rules)
     return {"k": new_k, "v": new_v}, logits[:, 0].astype(jnp.float32)
 
 
@@ -275,6 +338,8 @@ def paged_prefill(
     block_table: jax.Array,  # [max_blocks] int32: the sequence's table
     cfg: Config,
     rules: Optional[LogicalRules] = None,
+    adapters: Optional[jax.Array] = None,   # [A+1, V, D] stack
+    slot_adapter: Optional[jax.Array] = None,  # scalar int32 stack index
 ) -> Tuple[Dict[str, jax.Array], jax.Array]:
     """Prefill the suffix `tokens[prefix_len:]` of a prompt whose first
     `prefix_len` tokens' K/V already sit in `block_table`'s blocks.
@@ -289,7 +354,10 @@ def paged_prefill(
     bs = cache["k"].shape[2]
     trash = cache["k"].shape[1] - 1
     dt = cfg.dtype
-    x = _embed_tokens(params, tokens[None], cfg, rules, dt)
+    if adapters is None:
+        x = _embed_tokens(params, tokens[None], cfg, rules, dt)
+    else:
+        x = _embed_adapter(adapters, slot_adapter, tokens, dt)[None]
     # Absolute positions prefix_len + i (clip keeps padded lanes in-table;
     # their queries are garbage the `last` index never selects).
     pos_ids = jnp.minimum(prefix_len + jnp.arange(s),
@@ -337,7 +405,11 @@ def paged_prefill(
 
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params["blocks"], cache["k"], cache["v"]))
-    logits = _finish(params, x, cfg, rules)  # [1, S, V]
+    if adapters is None:
+        logits = _finish(params, x, cfg, rules)  # [1, S, V]
+    else:
+        logits = _finish_adapter(params, x, adapters, slot_adapter, cfg,
+                                 rules)
     last = jax.lax.dynamic_index_in_dim(
         logits[0], jnp.maximum(suffix_len - 1, 0), axis=0, keepdims=False)
     return {"k": new_k, "v": new_v}, last.astype(jnp.float32)
@@ -352,6 +424,8 @@ def paged_decode_step(
     cfg: Config,
     rules: Optional[LogicalRules] = None,
     attention_impl: str = "reference",
+    adapters: Optional[jax.Array] = None,      # [A+1, V, D] stack
+    slot_adapters: Optional[jax.Array] = None,  # [slots] int32 stack index
 ) -> Tuple[Dict[str, jax.Array], jax.Array]:
     """One paged decode step for every slot → (cache', logits [slots, V]).
 
@@ -367,7 +441,10 @@ def paged_decode_step(
     bs = cache["k"].shape[2]
     mb = block_tables.shape[1]
     dt = cfg.dtype
-    x = _embed_tokens(params, tokens[:, None], cfg, rules, dt)  # [slots,1,D]
+    if adapters is None:
+        x = _embed_tokens(params, tokens[:, None], cfg, rules, dt)
+    else:
+        x = _embed_adapter(adapters, slot_adapters, tokens, dt)[:, None]
     pos_emb = jnp.take(params["wpe"].astype(dt), positions, axis=0)
     x = x + pos_emb[:, None]
     x = shard_logical(x, ("batch", "seq", "embed"), rules)
@@ -399,7 +476,11 @@ def paged_decode_step(
 
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params["blocks"], cache["k"], cache["v"]))
-    logits = _finish(params, x, cfg, rules)  # [slots, 1, V]
+    if adapters is None:
+        logits = _finish(params, x, cfg, rules)  # [slots, 1, V]
+    else:
+        logits = _finish_adapter(params, x, adapters, slot_adapters, cfg,
+                                 rules)
     return {"k": new_k, "v": new_v}, logits[:, 0].astype(jnp.float32)
 
 

@@ -144,8 +144,13 @@ class Request:
         temperature: float = 0.0,
         eos_id: Optional[int] = None,
         request_id: Optional[str] = None,
+        model: Optional[str] = None,
     ):
         self.id = request_id or f"req-{next(_req_counter)}"
+        # Per-request adapter routing (docs/serving.md "Model
+        # lifecycle"): which resident fine-tune serves this request;
+        # None/"base" = the base checkpoint.
+        self.model = model or None
         self.tokens = np.asarray(tokens, np.int32).reshape(-1)
         if self.tokens.size == 0:
             raise ValueError("prompt must contain at least one token")
@@ -354,6 +359,9 @@ class ContinuousBatcher:
         self.completed = 0
         self.generated_tokens = 0
         self.failed = 0
+        # Per-adapter admission counts ("base" + each resident fine-tune)
+        # — the multi-tenant visibility knob on /v1/stats.
+        self.adapter_requests: Dict[str, int] = {}
         # EWMA of admit→finish seconds, updated at retire: the basis of
         # the computed Retry-After hint (429s carry an actionable backoff
         # instead of a bare "1"; the master router propagates it).
@@ -387,6 +395,10 @@ class ContinuousBatcher:
     def submit(self, req: Request) -> Request:
         # Validate against engine limits at the front door — a prompt no
         # bucket covers would otherwise poison the batcher thread.
+        if req.model is not None:
+            # Unknown adapter names 400 here, not in the batcher thread —
+            # and never silently fall back to the base model.
+            self.engine.adapter_index(req.model)
         if self.engine.bucket_for(int(req.tokens.size)) is None:
             raise ValueError(
                 f"prompt length {req.tokens.size} exceeds the largest "
@@ -513,6 +525,11 @@ class ContinuousBatcher:
                 int(req.tokens.size) - cached_len) or 0
             req.prefill_start_us = req.admitted_us
             try:
+                # Adapter routing: resolve the request's `model:` name to
+                # its stack index (0 = base). Validated at submit; a
+                # request that snuck past still fails HERE as a per-
+                # request error, never a batcher crash.
+                adapter = self.engine.adapter_index(req.model)
                 # Device-side copy-on-write BEFORE any write can land in
                 # a block other sequences still reference.
                 for src, dst in cow_pairs:
@@ -520,10 +537,12 @@ class ContinuousBatcher:
                 if paged:
                     first = self.engine.prefill_request(
                         slot_id, req.tokens, req.temperature,
-                        block_table=table, cached_len=cached_len)
+                        block_table=table, cached_len=cached_len,
+                        adapter=adapter)
                 else:
                     first = self.engine.prefill_request(
-                        slot_id, req.tokens, req.temperature)
+                        slot_id, req.tokens, req.temperature,
+                        adapter=adapter)
             except Exception as e:
                 # discard=True: the blocks' K/V were never (fully)
                 # written; they must not linger in the prefix cache.
@@ -538,6 +557,9 @@ class ContinuousBatcher:
             req.out_tokens.append(first)
             with self._lock:
                 self.events.append(("admit", req.id, self.steps))
+                name = req.model or "base"
+                self.adapter_requests[name] = \
+                    self.adapter_requests.get(name, 0) + 1
             self.generated_tokens += 1
             if self._finished(req, first):
                 self._retire(slot_id, req, admitted_only=True)
@@ -663,6 +685,7 @@ class ContinuousBatcher:
                 "rejected_full": self.queue.rejected_full,
                 "rejected_draining": self.queue.rejected_draining,
                 "dropped": self.queue.dropped,
+                "adapter_requests": dict(self.adapter_requests),
                 "kv_blocks": self.blocks.stats(),
                 "latency": {
                     "ttft": self.ttft_hist.summary(),

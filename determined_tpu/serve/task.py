@@ -62,9 +62,20 @@ class ReplicaHeartbeat:
         if self._session is None or not self._allocation_id:
             return
         try:
+            stats = self._batcher.heartbeat_stats()
+            # Model-lifecycle confirmation (docs/serving.md "Model
+            # lifecycle"): echo the version label the master pinned at
+            # spawn — the deployment detail shows what each replica
+            # ACTUALLY serves, not only what the controller intended.
+            mv = os.environ.get("DET_MODEL_VERSION")
+            if mv:
+                stats["model_version"] = mv
+            adapters = getattr(self._batcher.engine, "adapter_names", None)
+            if adapters:
+                stats["adapters"] = list(adapters)
             self._session.post(
                 f"/api/v1/allocations/{self._allocation_id}/serve_stats",
-                body=self._batcher.heartbeat_stats())
+                body=stats)
         except Exception:
             logger.debug("serve_stats heartbeat failed", exc_info=True)
 
@@ -136,7 +147,7 @@ def serving_signature(serving: Dict[str, Any]) -> str:
 
     shape_keys = ("model", "model_config", "max_batch_size", "max_seq_len",
                   "kv_block_size", "kv_num_blocks", "prefill_buckets",
-                  "attention_impl", "seed")
+                  "attention_impl", "seed", "adapters")
     key = {k: serving.get(k) for k in shape_keys}
     key["runtime_tag"] = runtime_tag()
     blob = json.dumps(key, sort_keys=True, default=str).encode()
@@ -172,6 +183,24 @@ def build_replica(config: Dict[str, Any], session=None):
     params = load_checkpoint_params(
         ckpt_ctx, str(serving.get("checkpoint", "latest")))
 
+    # Multi-adapter replicas (docs/serving.md "Model lifecycle"): each
+    # serving.adapters entry restores a head-tuned fine-tune through the
+    # same verified-COMPLETED path as the base, then lives as one table
+    # in the engine's adapter stack — per-request `model:` names select
+    # it. Adapter checkpoints may come from other trials; each resolves
+    # its own lineage scope from its checkpoint id.
+    adapters = {}
+    for a in serving.get("adapters") or []:
+        a_ckpt = str(a["checkpoint"])
+        from determined_tpu.core._checkpoint import _STATE_ID_RE
+
+        m = _STATE_ID_RE.match(a_ckpt)
+        a_ctx = CheckpointContext(
+            session, storage,
+            trial_id=int(m.group(1)) if m else _trial_id_for(serving),
+            async_save=False)
+        adapters[str(a["name"])] = load_checkpoint_params(a_ctx, a_ckpt)
+
     slots = int(serving.get("max_batch_size", 8))
     max_seq = int(serving.get("max_seq_len", min(cfg.n_positions, 1024)))
     block_size = int(serving.get("kv_block_size", 16))
@@ -185,6 +214,7 @@ def build_replica(config: Dict[str, Any], session=None):
         attention_impl=str(serving.get("attention_impl", "auto")),
         kv_block_size=block_size,
         kv_num_blocks=int(num_blocks) if num_blocks else None,
+        adapters=adapters or None,
     )
     # Warm AOT (docs/serving.md "Scale to zero"): scope a compile-farm
     # client to this config's serving signature so compile() deserializes

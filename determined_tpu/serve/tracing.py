@@ -119,9 +119,19 @@ class RequestTracer:
         # Root: span_id == trace_id == request id (the trial.lifecycle
         # convention) so the router's dispatch span parents to it without
         # any replica↔master coordination.
+        # Version attrs (docs/serving.md "Model lifecycle"): which model
+        # version this replica serves (DET_MODEL_VERSION, pinned by the
+        # deployment controller at spawn) and which adapter the request
+        # routed to — the trace answers "which weights answered this".
+        import os as _os
+
+        model_version = _os.environ.get("DET_MODEL_VERSION")
         root = span("serve.request", req.submitted_us, end_us, "", {
             "prompt_tokens": int(req.tokens.size),
             "new_tokens": len(req.out_tokens),
+            **({"model_version": model_version} if model_version else {}),
+            **({"model": req.model}
+               if getattr(req, "model", None) else {}),
             **({"error": req.error} if req.error else {}),
         })
         root.span_id = rid

@@ -607,6 +607,23 @@ CREATE UNIQUE INDEX idx_request_spans_span
   ON request_spans(request_id, span_id);
 CREATE INDEX idx_request_spans_created ON request_spans(created_at);
 )sql"},
+      // Model lifecycle (docs/serving.md "Model lifecycle"): registered
+      // model versions record WHERE they came from (experiment/trial/
+      // step) so train→serve promotion is auditable, and the checkpoint
+      // index lets checkpoint GC exclude registered checkpoints with one
+      // seek (same guard pattern as compile_artifacts). Deployments
+      // persist the model version they serve plus the canary split so a
+      // master restart resumes a half-finished rollout where it stood.
+      {26, R"sql(
+ALTER TABLE model_versions ADD COLUMN source_experiment_id INTEGER;
+ALTER TABLE model_versions ADD COLUMN source_trial_id INTEGER;
+ALTER TABLE model_versions ADD COLUMN steps_completed INTEGER;
+CREATE INDEX idx_model_versions_ckpt ON model_versions(checkpoint_uuid);
+ALTER TABLE deployments ADD COLUMN model_version TEXT NOT NULL DEFAULT '';
+ALTER TABLE deployments ADD COLUMN canary TEXT NOT NULL DEFAULT '';
+ALTER TABLE deployment_replicas ADD COLUMN model_version TEXT NOT NULL DEFAULT '';
+ALTER TABLE deployment_replicas ADD COLUMN canary INTEGER NOT NULL DEFAULT 0;
+)sql"},
   };
   return kMigrations;
 }

@@ -1169,6 +1169,24 @@ HttpResponse Master::handle_prometheus_metrics() {
             << "det_serve_request_seconds_count{deployment=\"" << dep_id
             << "\"} " << h["count"].as_int(0) << "\n";
       }
+      // Canary split accounting (docs/serving.md "Model lifecycle"):
+      // generations routed to the canary vs stable group per deployment
+      // — the observed fraction a scrape can alert on.
+      bool any_canary = false;
+      for (const auto& [dep_id, dep] : deployments_) {
+        any_canary |= dep.canary_active();
+      }
+      if (any_canary) {
+        out << "# TYPE det_serve_canary_requests_total counter\n";
+        for (const auto& [dep_id, dep] : deployments_) {
+          if (!dep.canary_active()) continue;
+          out << "det_serve_canary_requests_total{deployment=\"" << dep_id
+              << "\",group=\"canary\"} " << dep.canary.routed << "\n"
+              << "det_serve_canary_requests_total{deployment=\"" << dep_id
+              << "\",group=\"stable\"} " << dep.canary.routed_stable
+              << "\n";
+        }
+      }
     }
   }
   out << "# TYPE det_preemptions_total counter\n"
@@ -1210,6 +1228,12 @@ HttpResponse Master::handle_prometheus_metrics() {
       << "# TYPE det_serve_cold_starts_total counter\n"
       << "det_serve_cold_starts_total " << fleet_.cold_starts.load()
       << "\n"
+      << "# TYPE det_deployment_swaps_total counter\n"
+      << "det_deployment_swaps_total " << fleet_.deploy_swaps.load()
+      << "\n"
+      << "# TYPE det_model_versions_registered_total counter\n"
+      << "det_model_versions_registered_total "
+      << fleet_.model_versions_registered.load() << "\n"
       << "# TYPE det_provisioner_create_failures_total counter\n"
       << "det_provisioner_create_failures_total "
       << (provisioner_ ? provisioner_->create_failures_total() : 0) << "\n";

@@ -151,7 +151,65 @@ HttpResponse Master::handle_projects(const HttpRequest& req,
 }
 
 // Model registry (reference internal/model/; versions reference
-// checkpoints by uuid).
+// checkpoints by uuid). Versions are IMMUTABLE: registering pins the
+// checkpoint against GC (docs/checkpointing.md "GC exclusions") and
+// `det serve update <dep> <model>:<version>` resolves through here
+// forever after (docs/serving.md "Model lifecycle").
+
+Json Master::register_model_version_locked(const std::string& model_name,
+                                           const std::string& checkpoint_uuid,
+                                           int64_t experiment_id,
+                                           int64_t trial_id, int64_t steps,
+                                           int64_t user_id,
+                                           const std::string& comment) {
+  auto mrows = db_.query("SELECT id FROM models WHERE name=?",
+                         {Json(model_name)});
+  int64_t mid;
+  if (mrows.empty()) {
+    // Auto-promotion creates the model on first use — `registry: {model:
+    // x}` must not require a separate create step before the experiment
+    // completes.
+    mid = db_.insert(
+        "INSERT INTO models (name, description, user_id) VALUES (?, ?, ?)",
+        {Json(model_name),
+         Json(std::string("auto-created by registry promotion")),
+         Json(user_id)});
+  } else {
+    mid = mrows[0]["id"].as_int();
+  }
+  auto vrows = db_.query(
+      "SELECT COALESCE(MAX(version),0)+1 AS v FROM model_versions "
+      "WHERE model_id=?",
+      {Json(mid)});
+  int64_t version = vrows[0]["v"].as_int();
+  db_.exec(
+      "INSERT INTO model_versions (model_id, version, checkpoint_uuid, "
+      "comment, user_id, source_experiment_id, source_trial_id, "
+      "steps_completed) VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
+      {Json(mid), Json(version), Json(checkpoint_uuid), Json(comment),
+       Json(user_id), experiment_id > 0 ? Json(experiment_id) : Json(),
+       trial_id > 0 ? Json(trial_id) : Json(),
+       steps >= 0 ? Json(steps) : Json()});
+  db_.exec("UPDATE models SET last_updated_time=datetime('now') WHERE id=?",
+           {Json(mid)});
+  fleet_.model_versions_registered.fetch_add(1);
+  Json out = Json::object();
+  out["model"] = model_name;
+  out["model_id"] = mid;
+  out["version"] = version;
+  out["checkpoint_uuid"] = checkpoint_uuid;
+  if (experiment_id > 0) out["source_experiment_id"] = experiment_id;
+  if (trial_id > 0) out["source_trial_id"] = trial_id;
+  // Model-version changes stream to clients (the reference's
+  // model-version watch): CLI/WebUI watchers learn about a promotion
+  // without polling the registry.
+  publish_locked("models", Json(JsonObject{
+      {"model", Json(model_name)},
+      {"version", Json(version)},
+      {"checkpoint_uuid", Json(checkpoint_uuid)}}));
+  return out;
+}
+
 HttpResponse Master::handle_models(const HttpRequest& req,
                                    const std::vector<std::string>& parts) {
   if (parts.size() == 1 && req.method == "GET") {
@@ -219,25 +277,54 @@ HttpResponse Master::handle_models(const HttpRequest& req,
       }
       if (req.method == "POST") {
         Json body = Json::parse(req.body);
-        auto vrows = db_.query(
-            "SELECT COALESCE(MAX(version),0)+1 AS v FROM model_versions "
-            "WHERE model_id=?",
-            {Json(mid)});
-        int64_t version = vrows[0]["v"].as_int();
-        int64_t ver_id = db_.insert(
-            "INSERT INTO model_versions (model_id, version, checkpoint_uuid, "
-            "name, comment, metadata) VALUES (?, ?, ?, ?, ?, ?)",
-            {Json(mid), Json(version), body["checkpoint_uuid"],
-             Json(body["name"].as_string()), Json(body["comment"].as_string()),
-             Json(body["metadata"].dump())});
-        db_.exec(
-            "UPDATE models SET last_updated_time=datetime('now') WHERE id=?",
-            {Json(mid)});
+        const std::string uuid = body["checkpoint_uuid"].as_string();
+        if (uuid.empty()) {
+          return json_resp(400, err_body("checkpoint_uuid required"));
+        }
+        // Only COMMITTED checkpoints become versions: a version is a
+        // serving promise, and serving a PARTIAL (or unknown) checkpoint
+        // would fail integrity verification at replica boot anyway
+        // (docs/checkpointing.md two-phase commit).
+        auto crows = db_.query(
+            "SELECT state, trial_id, steps_completed FROM checkpoints "
+            "WHERE uuid=?",
+            {Json(uuid)});
+        if (crows.empty()) {
+          return json_resp(404, err_body(
+              "no such checkpoint: " + uuid));
+        }
+        if (crows[0]["state"].as_string() != "COMPLETED") {
+          return json_resp(400, err_body(
+              "checkpoint " + uuid + " is " +
+              crows[0]["state"].as_string() +
+              ", not COMPLETED — only committed checkpoints can be "
+              "registered"));
+        }
+        AuthCtx vctx = auth_ctx(req);
+        std::lock_guard<std::mutex> lock(mu_);
+        Json ver = register_model_version_locked(
+            name, uuid, body["source_experiment_id"].as_int(-1),
+            crows[0]["trial_id"].as_int(-1),
+            crows[0]["steps_completed"].as_int(-1), vctx.uid,
+            body["comment"].as_string());
         Json out = Json::object();
-        out["model_version"] = Json(JsonObject{
-            {"id", Json(ver_id)}, {"version", Json(version)}});
+        out["model_version"] = std::move(ver);
         return json_resp(200, out);
       }
+    }
+    // GET /api/v1/models/{name}/versions/{v} — one version's detail
+    // (checkpoint uuid + provenance), the resolution target of
+    // `det serve update <deployment> <name>:<v>`.
+    if (parts.size() == 4 && parts[2] == "versions" && req.method == "GET") {
+      auto vrows = db_.query(
+          "SELECT * FROM model_versions WHERE model_id=? AND version=?",
+          {Json(mid), Json(to_id(parts[3]))});
+      if (vrows.empty()) {
+        return json_resp(404, err_body("no such model version"));
+      }
+      Json out = Json::object();
+      out["model_version"] = row_to_json(vrows[0]);
+      return json_resp(200, out);
     }
     if (parts.size() == 2 && req.method == "DELETE") {
       db_.exec("UPDATE models SET archived=1 WHERE id=?", {Json(mid)});

@@ -149,13 +149,22 @@ DeploymentState* Master::deployment_for_task_locked(
   return nullptr;
 }
 
-std::string Master::spawn_deployment_replica_locked(DeploymentState& dep) {
+std::string Master::spawn_deployment_replica_locked(
+    DeploymentState& dep, const std::string& version,
+    const std::string& checkpoint, bool canary) {
   // Mirrors the POST /api/v1/serving create path (master_ntsc.cc): one
   // SERVING task + one allocation; the replica rebuilds its engine purely
   // from DET_SERVING_CONFIG and registers a proxy address when ready.
   std::string task_id = "serving-" + random_hex(6);
   for (auto& c : task_id) c = static_cast<char>(tolower(c));
-  const Json& config = dep.config;
+  // Replicas are immutable: the version a replica serves is fixed at
+  // spawn (docs/serving.md "Model lifecycle") — a weight change is a new
+  // replica, never a hot edit, which is what makes swap rollback trivial
+  // (spawn at the prior version) and bit-identity provable (a post-swap
+  // replica IS a fresh deployment of that version).
+  std::string model_version = version.empty() ? dep.model_version : version;
+  Json config = dep.config;
+  if (!checkpoint.empty()) config["serving"]["checkpoint"] = checkpoint;
   db_.exec(
       "INSERT INTO tasks (id, type, state, config, owner_id, workspace_id) "
       "VALUES (?, 'SERVING', 'ACTIVE', ?, ?, ?)",
@@ -163,8 +172,10 @@ std::string Master::spawn_deployment_replica_locked(DeploymentState& dep) {
        Json(dep.workspace_id)});
   db_.exec(
       "INSERT OR REPLACE INTO deployment_replicas "
-      "(deployment_id, task_id, state) VALUES (?, ?, 'STARTING')",
-      {Json(dep.id), Json(task_id)});
+      "(deployment_id, task_id, state, model_version, canary) "
+      "VALUES (?, ?, 'STARTING', ?, ?)",
+      {Json(dep.id), Json(task_id), Json(model_version),
+       Json(static_cast<int64_t>(canary ? 1 : 0))});
 
   // Spot-aware placement (docs/cluster-ops.md "Capacity loop"): replicas
   // up to serving.replicas.on_demand_floor (default: min) are the
@@ -204,6 +215,9 @@ std::string Master::spawn_deployment_replica_locked(DeploymentState& dep) {
   alloc.extra_env["DET_TASK_TYPE"] = Json(std::string("SERVING"));
   alloc.extra_env["DET_SERVING_CONFIG"] = Json(config.dump());
   alloc.extra_env["DET_DEPLOYMENT_ID"] = Json(dep.id);
+  if (!model_version.empty()) {
+    alloc.extra_env["DET_MODEL_VERSION"] = Json(model_version);
+  }
   for (const auto& [k, v] : config["environment"].as_object()) {
     if (v.is_string()) alloc.extra_env[k] = v;
   }
@@ -219,6 +233,8 @@ std::string Master::spawn_deployment_replica_locked(DeploymentState& dep) {
   ReplicaHealth r;
   r.task_id = task_id;
   r.capacity_class = capacity_class;
+  r.model_version = model_version;
+  r.canary = canary;
   dep.replicas[task_id] = std::move(r);
   dep.last_spawn = now();
   cv_.notify_all();
@@ -336,11 +352,25 @@ void Master::reconcile_deployments_locked() {
       }
     }
 
-    // 2. Converge on target. Spawns are throttled to one batch per
+    // 2. Model lifecycle pass (docs/serving.md "Model lifecycle"):
+    // rolling weight swap (spawn-at-new before drain-at-old, one per
+    // tick) and canary replica-set convergence. Runs before the plain
+    // converge so its surge replica is never mistaken for surplus.
+    reconcile_deployment_versions_locked(dep, t);
+
+    // 3. Converge on target. Spawns are throttled to one batch per
     // second so a crash-looping config cannot flood the task table.
-    int live = 0;
+    // Canary replicas ride on top of target (the split is additive
+    // capacity, priced separately) and swap-stale replicas still count —
+    // the swap pass owns their replacement.
+    int live = 0, stale = 0;
     for (const auto& [tid, r] : dep.replicas) {
-      if (!r.retiring) ++live;
+      if (r.retiring || r.canary) continue;
+      ++live;
+      if (!dep.model_version.empty() &&
+          r.model_version != dep.model_version) {
+        ++stale;
+      }
     }
     if (live < dep.target) {
       if (t - dep.last_spawn >= 1.0 || dep.last_spawn == 0) {
@@ -348,13 +378,15 @@ void Master::reconcile_deployments_locked() {
           spawn_deployment_replica_locked(dep);
         }
       }
-    } else if (live > dep.target) {
+    } else if (live > dep.target && stale == 0) {
       // Drain the lowest-loaded replicas first (cheapest zero-dropped
       // finish); ties break on newest task id so the oldest replicas —
-      // warmest caches — survive.
+      // warmest caches — survive. While a swap is rolling (stale > 0)
+      // the swap pass owns every drain decision: its surge replica must
+      // not be culled as surplus.
       std::vector<std::pair<int64_t, std::string>> order;
       for (const auto& [tid, r] : dep.replicas) {
-        if (r.retiring) continue;
+        if (r.retiring || r.canary) continue;
         order.emplace_back(r.queue_depth + r.active + r.inflight, tid);
       }
       std::sort(order.begin(), order.end(),
@@ -368,6 +400,217 @@ void Master::reconcile_deployments_locked() {
       }
     }
   }
+}
+
+// ---------------------------------------------------------------------------
+// Model lifecycle: rolling swaps + canary replica set
+// (docs/serving.md "Model lifecycle").
+// ---------------------------------------------------------------------------
+
+void Master::reconcile_deployment_versions_locked(DeploymentState& dep,
+                                                  double t) {
+  // Replica is routable at its version: RUNNING, proxy up, heartbeated.
+  auto warm = [&](const ReplicaHealth& r) {
+    if (r.last_report == 0) return false;
+    for (const auto& [aid, a] : allocations_) {
+      if (a.task_id == r.task_id && a.state == "RUNNING" && !a.preempting &&
+          !a.proxy_addresses.empty()) {
+        return true;
+      }
+    }
+    return false;
+  };
+
+  // --- canary replica-set convergence ---
+  int canary_live = 0;
+  std::vector<std::string> canary_tids;
+  for (const auto& [tid, r] : dep.replicas) {
+    if (r.canary && !r.retiring) {
+      ++canary_live;
+      canary_tids.push_back(tid);
+    }
+  }
+  if (dep.canary_active()) {
+    if (canary_live < dep.canary.replicas &&
+        (t - dep.last_spawn >= 1.0 || dep.last_spawn == 0)) {
+      spawn_deployment_replica_locked(dep, dep.canary.version,
+                                      dep.canary.checkpoint,
+                                      /*canary=*/true);
+    }
+  } else {
+    // Aborted (or promoted) canary: any leftover canary replicas drain.
+    for (const auto& tid : canary_tids) {
+      retire_deployment_replica_locked(dep, tid);
+    }
+  }
+
+  // --- rolling weight swap ---
+  if (dep.model_version.empty()) return;
+  int live = 0, fresh_warm = 0;
+  std::vector<std::pair<int64_t, std::string>> stale;  // (load, tid)
+  for (const auto& [tid, r] : dep.replicas) {
+    if (r.retiring || r.canary) continue;
+    ++live;
+    if (r.model_version == dep.model_version) {
+      if (warm(r)) ++fresh_warm;
+    } else {
+      stale.emplace_back(r.queue_depth + r.active + r.inflight, tid);
+    }
+  }
+  if (stale.empty()) {
+    // Swap complete: every serving (non-canary) replica is at the
+    // desired version. Close the serve.swap span once per update.
+    if (dep.swap_start_us != 0) {
+      int64_t end_us = trace::now_us();
+      Json attrs = Json::object();
+      attrs["deployment"] = dep.id;
+      attrs["from"] = dep.swap_from;
+      attrs["to"] = dep.model_version;
+      attrs["replicas_swapped"] = dep.swap_replaced;
+      record_request_span(
+          dep.id, dep.swap_id,
+          trace::make_span(dep.swap_id, "serve.swap", dep.swap_start_us,
+                           end_us, dep.swap_id, attrs));
+      fleet_.deploy_swaps.fetch_add(1);
+      std::cerr << "master: deployment " << dep.id
+                << " rolling swap complete " << dep.swap_from << " -> "
+                << dep.model_version << " (" << dep.swap_replaced
+                << " replica(s), "
+                << (end_us - dep.swap_start_us) / 1e6 << "s)" << std::endl;
+      publish_locked(
+          "deployments",
+          Json(JsonObject{{"id", Json(dep.id)},
+                          {"swap_complete", Json(true)},
+                          {"swap_id", Json(dep.swap_id)},
+                          {"model_version", Json(dep.model_version)}}));
+      dep.swap_start_us = 0;
+      dep.swap_from.clear();
+      dep.swap_id.clear();
+      dep.swap_replaced = 0;
+    }
+    return;
+  }
+  // Surge by exactly one: spawn the replacement BEFORE any old replica
+  // drains, one per tick (the spawn throttle doubles as the pace).
+  if (live <= dep.target &&
+      (t - dep.last_spawn >= 1.0 || dep.last_spawn == 0)) {
+    spawn_deployment_replica_locked(dep);
+  }
+  // Drain one stale replica per tick, and only while enough NEW-version
+  // replicas are warm to cover every drain so far: dispatchable capacity
+  // never dips below target, and an accepted request still completes on
+  // the draining replica (the zero-dropped drain contract).
+  int tolerated = std::max(0, dep.target - fresh_warm);
+  if (static_cast<int>(stale.size()) > tolerated) {
+    std::sort(stale.begin(), stale.end());
+    retire_deployment_replica_locked(dep, stale[0].second);
+    dep.swap_replaced++;
+  }
+}
+
+bool Master::resolve_model_version_locked(const Json& body,
+                                          std::string* label,
+                                          std::string* checkpoint,
+                                          std::string* err) {
+  // {checkpoint: "<storage id>"} — pin a raw checkpoint, or
+  // {model: "<name>", version: N} — resolve through the registry
+  // (version omitted / <= 0 = the model's newest version). A registered
+  // version is immutable, so resolving it twice always lands on the same
+  // checkpoint — that is what makes "update back to the prior version" a
+  // complete rollback story.
+  if (body["checkpoint"].is_string() &&
+      !body["checkpoint"].as_string().empty()) {
+    *checkpoint = body["checkpoint"].as_string();
+    *label = "checkpoint:" + *checkpoint;
+    return true;
+  }
+  std::string model = body["model"].as_string();
+  if (model.empty()) {
+    *err = "update requires {model[, version]} or {checkpoint}";
+    return false;
+  }
+  auto mrows = db_.query("SELECT id FROM models WHERE name=?",
+                         {Json(model)});
+  if (mrows.empty()) {
+    *err = "no such model: " + model;
+    return false;
+  }
+  int64_t mid = mrows[0]["id"].as_int();
+  int64_t version = body["version"].as_int(0);
+  std::vector<Row> vrows;
+  if (version > 0) {
+    vrows = db_.query(
+        "SELECT version, checkpoint_uuid FROM model_versions "
+        "WHERE model_id=? AND version=?",
+        {Json(mid), Json(version)});
+  } else {
+    vrows = db_.query(
+        "SELECT version, checkpoint_uuid FROM model_versions "
+        "WHERE model_id=? ORDER BY version DESC LIMIT 1",
+        {Json(mid)});
+  }
+  if (vrows.empty()) {
+    *err = version > 0
+               ? "model " + model + " has no version " +
+                     std::to_string(version)
+               : "model " + model + " has no registered versions";
+    return false;
+  }
+  *checkpoint = vrows[0]["checkpoint_uuid"].as_string();
+  *label = model + ":" + std::to_string(vrows[0]["version"].as_int());
+  return true;
+}
+
+void Master::begin_deployment_swap_locked(DeploymentState& dep,
+                                          const std::string& label,
+                                          const std::string& checkpoint) {
+  if (label == dep.model_version) return;  // already there: no-op
+  std::string from = dep.model_version;
+  dep.config["serving"]["checkpoint"] = checkpoint;
+  dep.model_version = label;
+  // A fresh swap restarts the span clock; an update landing mid-swap
+  // re-targets the same rollout (the span reports the FINAL version).
+  if (dep.swap_start_us == 0) {
+    dep.swap_start_us = trace::now_us();
+    dep.swap_from = from;
+    std::string sid = "swap-" + random_hex(6);
+    for (auto& c : sid) c = static_cast<char>(tolower(c));
+    dep.swap_id = sid;
+    dep.swap_replaced = 0;
+  }
+  db_.exec(
+      "UPDATE deployments SET config=?, model_version=? WHERE id=?",
+      {Json(dep.config.dump()), Json(label), Json(dep.id)});
+  std::cerr << "master: deployment " << dep.id << " rolling swap "
+            << (from.empty() ? "(initial)" : from) << " -> " << label
+            << std::endl;
+  publish_locked("deployments",
+                 Json(JsonObject{{"id", Json(dep.id)},
+                                 {"model_version", Json(label)},
+                                 {"swap_from", Json(from)}}));
+  cv_.notify_all();
+}
+
+std::set<std::string> Master::lifecycle_pinned_checkpoints_locked() {
+  std::set<std::string> pinned;
+  // Every registered model version pins its checkpoint — a version is a
+  // promise that `det serve update <dep> model:N` works forever (or
+  // until the version is deleted), so GC must never break it.
+  for (auto& row : db_.query(
+           "SELECT DISTINCT checkpoint_uuid FROM model_versions")) {
+    std::string u = row["checkpoint_uuid"].as_string();
+    if (!u.empty()) pinned.insert(u);
+  }
+  // Live deployments pin whatever they currently serve: the stable
+  // version's checkpoint AND an in-flight canary's.
+  for (const auto& [id, dep] : deployments_) {
+    std::string ck = dep.config["serving"]["checkpoint"].as_string();
+    if (!ck.empty() && ck != "latest") pinned.insert(ck);
+    if (dep.canary_active() && !dep.canary.checkpoint.empty()) {
+      pinned.insert(dep.canary.checkpoint);
+    }
+  }
+  return pinned;
 }
 
 // ---------------------------------------------------------------------------
@@ -452,8 +695,8 @@ void Master::autoscale_deployments_locked() {
 void Master::restore_deployments_locked() {
   for (auto& row : db_.query(
            "SELECT id, name, config, min_replicas, max_replicas, "
-           "target_replicas, owner_id, workspace_id FROM deployments "
-           "WHERE end_time IS NULL")) {
+           "target_replicas, owner_id, workspace_id, model_version, "
+           "canary FROM deployments WHERE end_time IS NULL")) {
     DeploymentState dep;
     dep.id = row["id"].as_string();
     dep.name = row["name"].as_string();
@@ -463,13 +706,29 @@ void Master::restore_deployments_locked() {
     dep.target = static_cast<int>(row["target_replicas"].as_int(1));
     dep.owner_id = row["owner_id"].as_int(1);
     dep.workspace_id = row["workspace_id"].as_int(1);
+    // Lifecycle state survives the restart: a half-finished rollout
+    // resumes where it stood (replicas at the old version are still
+    // stale; the swap pass keeps rolling), and a canary split keeps its
+    // fraction (debt/counters reset — they are a rate, not a ledger).
+    dep.model_version = row["model_version"].as_string("");
+    Json cj = Json::parse_or_null(row["canary"].as_string(""));
+    if (cj.is_object() && cj["version"].is_string()) {
+      dep.canary.version = cj["version"].as_string();
+      dep.canary.checkpoint = cj["checkpoint"].as_string();
+      dep.canary.fraction = cj["fraction"].as_double(0.05);
+      dep.canary.replicas =
+          static_cast<int>(std::max<int64_t>(1, cj["replicas"].as_int(1)));
+    }
     for (auto& rrow : db_.query(
-             "SELECT task_id, state FROM deployment_replicas WHERE "
-             "deployment_id=? AND state IN ('STARTING','ACTIVE','RETIRING')",
+             "SELECT task_id, state, model_version, canary FROM "
+             "deployment_replicas WHERE deployment_id=? AND state IN "
+             "('STARTING','ACTIVE','RETIRING')",
              {Json(dep.id)})) {
       ReplicaHealth r;
       r.task_id = rrow["task_id"].as_string();
       r.retiring = rrow["state"].as_string() == "RETIRING";
+      r.model_version = rrow["model_version"].as_string("");
+      r.canary = rrow["canary"].as_int(0) != 0;
       dep.replicas[r.task_id] = std::move(r);
     }
     // Load/breaker state is soft: heartbeats repopulate it within one
@@ -543,22 +802,83 @@ HttpResponse Master::handle_deployments(
     dep.target = target;
     dep.owner_id = ctx.uid;
     dep.workspace_id = ws;
+    // Initial model version (docs/serving.md "Model lifecycle"): a
+    // `serving.model_version: "name:N"` label resolves through the
+    // registry (the deployment starts ON a registered version); else the
+    // pinned checkpoint names the version.
+    {
+      const Json& mv = config["serving"]["model_version"];
+      if (mv.is_string() && !mv.as_string().empty()) {
+        std::string spec = mv.as_string();
+        size_t colon = spec.rfind(':');
+        Json resolve = Json::object();
+        resolve["model"] = spec.substr(0, colon);
+        if (colon != std::string::npos) {
+          try {
+            resolve["version"] =
+                static_cast<int64_t>(std::stoll(spec.substr(colon + 1)));
+          } catch (...) {
+          }
+        }
+        std::string label, ck, rerr;
+        if (!resolve_model_version_locked(resolve, &label, &ck, &rerr)) {
+          return json_resp(400, err_body("serving.model_version: " + rerr));
+        }
+        dep.model_version = label;
+        dep.config["serving"]["checkpoint"] = ck;
+      } else {
+        dep.model_version =
+            "checkpoint:" +
+            config["serving"]["checkpoint"].as_string("latest");
+      }
+    }
     db_.exec(
         "INSERT INTO deployments (id, name, config, min_replicas, "
-        "max_replicas, target_replicas, owner_id, workspace_id) "
-        "VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
-        {Json(dep.id), Json(dep.name), Json(config.dump()),
+        "max_replicas, target_replicas, owner_id, workspace_id, "
+        "model_version) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+        {Json(dep.id), Json(dep.name), Json(dep.config.dump()),
          Json(static_cast<int64_t>(minr)), Json(static_cast<int64_t>(maxr)),
-         Json(static_cast<int64_t>(target)), Json(ctx.uid), Json(ws)});
+         Json(static_cast<int64_t>(target)), Json(ctx.uid), Json(ws),
+         Json(dep.model_version)});
     auto [it, _] = deployments_.emplace(dep.id, std::move(dep));
     Json replicas = Json::array();
     for (int i = 0; i < it->second.target; ++i) {
       replicas.push_back(Json(spawn_deployment_replica_locked(it->second)));
     }
+    // A config-declared canary (`serving.canary`, validated by expconf +
+    // DTL208) arms the split from birth — the examples/gpt2/
+    // serve-canary.yaml flow.
+    {
+      const Json& cb = it->second.config["serving"]["canary"];
+      if (cb.is_object()) {
+        DeploymentState& d2 = it->second;
+        std::string label, ck, rerr;
+        if (resolve_model_version_locked(cb, &label, &ck, &rerr)) {
+          d2.canary.version = label;
+          d2.canary.checkpoint = ck;
+          d2.canary.fraction = cb["fraction"].as_double(0.05);
+          d2.canary.replicas =
+              std::max<int64_t>(1, cb["replicas"].as_int(1));
+          db_.exec("UPDATE deployments SET canary=? WHERE id=?",
+                   {Json(Json(JsonObject{
+                        {"version", Json(label)},
+                        {"checkpoint", Json(ck)},
+                        {"fraction", Json(d2.canary.fraction)},
+                        {"replicas", Json(static_cast<int64_t>(
+                             d2.canary.replicas))}}).dump()),
+                    Json(d2.id)});
+          reconcile_deployments_locked();
+        } else {
+          std::cerr << "master: deployment " << it->second.id
+                    << " serving.canary ignored: " << rerr << std::endl;
+        }
+      }
+    }
     Json out = Json::object();
     out["id"] = it->second.id;
     out["name"] = it->second.name;
     out["target"] = static_cast<int64_t>(it->second.target);
+    out["model_version"] = it->second.model_version;
     out["replicas"] = replicas;
     return json_resp(200, out);
   }
@@ -582,6 +902,23 @@ HttpResponse Master::handle_deployments(
         d["smoothed_load"] = it->second.load_ewma;
         // Aggregated token-latency p50/p99 (`det serve status` columns).
         d["latency"] = deployment_latency_locked(it->second);
+        // Model lifecycle: the served version, an in-flight swap, and
+        // the canary split (`det serve status` columns).
+        d["model_version"] = it->second.model_version;
+        d["swapping"] = it->second.swap_start_us != 0;
+        if (it->second.canary_active()) {
+          const CanaryState& c = it->second.canary;
+          int64_t total = c.routed + c.routed_stable;
+          d["canary"] = Json(JsonObject{
+              {"version", Json(c.version)},
+              {"fraction", Json(c.fraction)},
+              {"routed", Json(c.routed)},
+              {"observed_fraction",
+               Json(total > 0 ? static_cast<double>(c.routed) / total
+                              : 0.0)}});
+        }
+        d["latency_by_version"] =
+            deployment_latency_by_version_locked(it->second);
       }
       deps.push_back(std::move(d));
     }
@@ -638,6 +975,147 @@ HttpResponse Master::handle_deployments(
     out["deployment_id"] = dep_id;
     out["request_id"] = rid;
     out["spans"] = std::move(spans);
+    return json_resp(200, out);
+  }
+
+  // POST /api/v1/deployments/{id}/update {model[, version] | checkpoint}
+  // — rolling blue-green weight swap (docs/serving.md "Model
+  // lifecycle"): resolve the target version, rewrite the deployment's
+  // serving.checkpoint, and let the reconciler roll replicas over one at
+  // a time (spawn-at-new before drain-at-old; zero dropped). Rollback is
+  // the same call with the prior version.
+  if (parts.size() == 3 && parts[2] == "update" && req.method == "POST") {
+    Json body = Json::parse_or_null(req.body);
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = deployments_.find(dep_id);
+    if (it == deployments_.end()) {
+      return json_resp(404, err_body("no such deployment"));
+    }
+    DeploymentState& dep = it->second;
+    AuthCtx ctx = auth_ctx(req);
+    if (!can_edit(ctx, dep.owner_id, dep.workspace_id)) {
+      return json_resp(403, err_body("not authorized for this deployment"));
+    }
+    std::string label, checkpoint, err;
+    if (!resolve_model_version_locked(body, &label, &checkpoint, &err)) {
+      return json_resp(400, err_body(err));
+    }
+    bool noop = label == dep.model_version;
+    begin_deployment_swap_locked(dep, label, checkpoint);
+    if (!noop) reconcile_deployments_locked();
+    Json out = Json::object();
+    out["id"] = dep.id;
+    out["model_version"] = label;
+    out["checkpoint"] = checkpoint;
+    out["rolling"] = !noop;
+    return json_resp(200, out);
+  }
+
+  // POST /api/v1/deployments/{id}/canary — start/promote/abort a canary
+  // split (docs/serving.md "Model lifecycle"):
+  //   {model|checkpoint, fraction, replicas?}  start: spawn canary
+  //     replicas at the version and route `fraction` of generations there
+  //   {promote: true}  fold the canary version into the deployment (the
+  //     remaining stable replicas roll over via the swap path)
+  //   {abort: true}    drain the canary replicas, keep stable untouched
+  if (parts.size() == 3 && parts[2] == "canary" && req.method == "POST") {
+    Json body = Json::parse_or_null(req.body);
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = deployments_.find(dep_id);
+    if (it == deployments_.end()) {
+      return json_resp(404, err_body("no such deployment"));
+    }
+    DeploymentState& dep = it->second;
+    AuthCtx ctx = auth_ctx(req);
+    if (!can_edit(ctx, dep.owner_id, dep.workspace_id)) {
+      return json_resp(403, err_body("not authorized for this deployment"));
+    }
+    if (body["promote"].as_bool(false)) {
+      if (!dep.canary_active()) {
+        return json_resp(400, err_body("no canary to promote"));
+      }
+      std::string label = dep.canary.version;
+      std::string ck = dep.canary.checkpoint;
+      // The canary replicas are already at the promoted version: convert
+      // them to regular replicas so the swap pass counts them as fresh
+      // capacity instead of draining them.
+      for (auto& [tid, r] : dep.replicas) {
+        if (r.canary && !r.retiring) {
+          r.canary = false;
+          db_.exec(
+              "UPDATE deployment_replicas SET canary=0 WHERE "
+              "deployment_id=? AND task_id=?",
+              {Json(dep.id), Json(tid)});
+        }
+      }
+      Json canary_stats = Json(JsonObject{
+          {"routed", Json(dep.canary.routed)},
+          {"routed_stable", Json(dep.canary.routed_stable)}});
+      dep.canary = CanaryState();
+      db_.exec("UPDATE deployments SET canary='' WHERE id=?",
+               {Json(dep.id)});
+      begin_deployment_swap_locked(dep, label, ck);
+      reconcile_deployments_locked();
+      Json out = Json::object();
+      out["id"] = dep.id;
+      out["promoted"] = label;
+      out["canary_stats"] = std::move(canary_stats);
+      return json_resp(200, out);
+    }
+    if (body["abort"].as_bool(false)) {
+      if (!dep.canary_active()) {
+        return json_resp(400, err_body("no canary to abort"));
+      }
+      std::string label = dep.canary.version;
+      dep.canary = CanaryState();
+      db_.exec("UPDATE deployments SET canary='' WHERE id=?",
+               {Json(dep.id)});
+      reconcile_deployments_locked();  // drains the canary replicas
+      publish_locked("deployments",
+                     Json(JsonObject{{"id", Json(dep.id)},
+                                     {"canary_aborted", Json(label)}}));
+      Json out = Json::object();
+      out["id"] = dep.id;
+      out["aborted"] = label;
+      return json_resp(200, out);
+    }
+    double fraction = body["fraction"].as_double(0);
+    if (!(fraction > 0.0 && fraction < 1.0)) {
+      return json_resp(400, err_body(
+          "canary fraction must be in (0, 1) — 0 means no canary, 1 "
+          "means a full rollout (use /update)"));
+    }
+    std::string label, checkpoint, err;
+    if (!resolve_model_version_locked(body, &label, &checkpoint, &err)) {
+      return json_resp(400, err_body(err));
+    }
+    if (label == dep.model_version) {
+      return json_resp(400, err_body(
+          "canary version equals the deployment's stable version"));
+    }
+    dep.canary = CanaryState();
+    dep.canary.version = label;
+    dep.canary.checkpoint = checkpoint;
+    dep.canary.fraction = fraction;
+    dep.canary.replicas = std::max<int64_t>(1, body["replicas"].as_int(1));
+    db_.exec("UPDATE deployments SET canary=? WHERE id=?",
+             {Json(Json(JsonObject{
+                  {"version", Json(label)},
+                  {"checkpoint", Json(checkpoint)},
+                  {"fraction", Json(fraction)},
+                  {"replicas",
+                   Json(static_cast<int64_t>(dep.canary.replicas))}}).dump()),
+              Json(dep.id)});
+    reconcile_deployments_locked();  // spawn the canary replica(s) now
+    publish_locked("deployments",
+                   Json(JsonObject{{"id", Json(dep.id)},
+                                   {"canary", Json(label)},
+                                   {"fraction", Json(fraction)}}));
+    Json out = Json::object();
+    out["id"] = dep.id;
+    out["canary"] = label;
+    out["fraction"] = fraction;
+    out["replicas"] = static_cast<int64_t>(dep.canary.replicas);
     return json_resp(200, out);
   }
 
@@ -717,6 +1195,37 @@ HttpResponse Master::handle_deployments(
       d["smoothed_load"] = dep.load_ewma;
       d["scale_ups"] = dep.scale_ups;
       d["scale_downs"] = dep.scale_downs;
+      // Model lifecycle (docs/serving.md "Model lifecycle"): served
+      // version, in-flight swap progress, canary split with the
+      // OBSERVED fraction (deterministic debt accounting), and latency
+      // aggregated per version — canary-vs-stable p50/p99 in one call.
+      d["model_version"] = dep.model_version;
+      if (dep.swap_start_us != 0) {
+        d["swap"] = Json(JsonObject{
+            {"from", Json(dep.swap_from)},
+            {"to", Json(dep.model_version)},
+            {"replicas_swapped", Json(dep.swap_replaced)},
+            {"swap_id", Json(dep.swap_id)},
+            {"started_us", Json(dep.swap_start_us)}});
+      }
+      // The raw row's canary column is persistence detail; the API shape
+      // is the structured object (null when no split is active).
+      d["canary"] = Json();
+      if (dep.canary_active()) {
+        const CanaryState& c = dep.canary;
+        int64_t total = c.routed + c.routed_stable;
+        d["canary"] = Json(JsonObject{
+            {"version", Json(c.version)},
+            {"checkpoint", Json(c.checkpoint)},
+            {"fraction", Json(c.fraction)},
+            {"replicas", Json(static_cast<int64_t>(c.replicas))},
+            {"routed", Json(c.routed)},
+            {"routed_stable", Json(c.routed_stable)},
+            {"observed_fraction",
+             Json(total > 0 ? static_cast<double>(c.routed) / total
+                            : 0.0)}});
+      }
+      d["latency_by_version"] = deployment_latency_by_version_locked(dep);
       // Request-latency SLO view (docs/serving.md "Request latency &
       // SLOs"): merged TTFT/TPOT/e2e/queue-wait p50/p99 plus the
       // slow-request ring (newest first; armed by serving.slo_ms).
@@ -749,6 +1258,8 @@ HttpResponse Master::handle_deployments(
         rj["draining"] = r.draining;
         rj["capacity_class"] = r.capacity_class;
         rj["engine_source"] = r.engine_source;
+        rj["model_version"] = r.model_version;
+        rj["canary"] = r.canary;
         rj["inflight"] = r.inflight;
         rj["consecutive_failures"] =
             static_cast<int64_t>(r.consecutive_failures);
@@ -825,6 +1336,13 @@ HttpResponse Master::handle_serve_stats(const HttpRequest& req,
   if (body["engine_source"].is_string()) {
     r.engine_source = body["engine_source"].as_string();
   }
+  // Model-version confirmation (docs/serving.md "Model lifecycle"): the
+  // replica echoes the version it actually serves (DET_MODEL_VERSION).
+  // Spawn-time state is authoritative; the heartbeat only fills a blank
+  // (a replica adopted before the lifecycle columns existed).
+  if (r.model_version.empty() && body["model_version"].is_string()) {
+    r.model_version = body["model_version"].as_string();
+  }
   db_.exec(
       "UPDATE deployment_replicas SET state='ACTIVE' WHERE deployment_id=? "
       "AND task_id=? AND state='STARTING'",
@@ -853,6 +1371,33 @@ Json Master::deployment_latency_locked(const DeploymentState& dep) const {
   out["tpot"] = tpot.summary();
   out["e2e"] = e2e.summary();
   out["queue_wait"] = queue_wait.summary();
+  return out;
+}
+
+Json Master::deployment_latency_by_version_locked(
+    const DeploymentState& dep) const {
+  // Canary-vs-stable side by side: the same fresh-replica merge as
+  // deployment_latency_locked, keyed by each replica's model version —
+  // one version per replica, so the split needs no per-request tagging
+  // beyond the router's dispatch choice.
+  double t = now();
+  std::map<std::string, std::map<std::string, MergedHist>> by_version;
+  for (const auto& [tid, r] : dep.replicas) {
+    if (r.retiring || !r.latency.is_object()) continue;
+    if (r.last_report == 0 || t - r.last_report > kReportStaleS) continue;
+    std::string v = r.model_version.empty() ? "unversioned"
+                                            : r.model_version;
+    auto& hists = by_version[v];
+    for (const char* key : {"ttft", "tpot", "e2e", "queue_wait"}) {
+      hists[key].add(r.latency[key]);
+    }
+  }
+  Json out = Json::object();
+  for (auto& [version, hists] : by_version) {
+    Json v = Json::object();
+    for (auto& [key, h] : hists) v[key] = h.summary();
+    out[version] = std::move(v);
+  }
   return out;
 }
 
@@ -1130,6 +1675,8 @@ HttpResponse Master::handle_serve_router(
     std::string target_task, target_addr;
     bool probe = false;
     int pick_failures = 0;
+    std::string pick_version;
+    bool pick_canary = false;
     int64_t full_retry_after = 0;
     {
       std::lock_guard<std::mutex> lock(mu_);
@@ -1145,6 +1692,7 @@ HttpResponse Master::handle_serve_router(
         double score;
         bool probe;
         bool full;
+        bool canary;
         int64_t retry_after;
       };
       std::vector<Cand> cands;
@@ -1175,8 +1723,8 @@ HttpResponse Master::handle_serve_router(
             static_cast<double>(r.queue_depth + r.inflight) /
                 static_cast<double>(std::max<int64_t>(1, r.queue_capacity)) +
             (r.slots > 0 ? static_cast<double>(r.active) / r.slots : 0.0);
-        cands.push_back(
-            {tid, addr, score, half_open, full, r.retry_after_hint});
+        cands.push_back({tid, addr, score, half_open, full, r.canary,
+                         r.retry_after_hint});
       }
       if (cands.empty()) {
         if (attempt > 0) {
@@ -1196,6 +1744,38 @@ HttpResponse Master::handle_serve_router(
             cold_retry_after_s(dep.last_cold_start_ms, cold_budget));
         resp.headers["X-Request-Id"] = rid;
         return resp;
+      }
+      // --- canary split (docs/serving.md "Model lifecycle") --- A
+      // deterministic debt accumulator decides each traced generation's
+      // version group: debt grows by `fraction` per request and a canary
+      // dispatch pays 1, so the observed split converges on the
+      // configured fraction with zero randomness (the bench gate
+      // measures it within tolerance). Only first attempts split — a
+      // connection-refusal retry goes wherever capacity is. A missing
+      // group (canary still booting, or stable mid-swap) falls back to
+      // the other: availability beats split fidelity, and the debt cap
+      // keeps the catch-up burst from dogpiling a replica that just
+      // recovered.
+      if (traced && attempt == 0 && dep.canary_active()) {
+        std::vector<Cand> canary_cands, stable_cands;
+        for (const auto& c : cands) {
+          (c.canary ? canary_cands : stable_cands).push_back(c);
+        }
+        CanaryState& cs = dep.canary;
+        bool want_canary = cs.debt + cs.fraction >= 1.0;
+        if (want_canary && !canary_cands.empty()) {
+          cs.debt += cs.fraction - 1.0;
+          cs.routed++;
+          cands = std::move(canary_cands);
+        } else if (!stable_cands.empty()) {
+          cs.debt = std::min(2.0, cs.debt + cs.fraction);
+          cs.routed_stable++;
+          cands = std::move(stable_cands);
+        } else if (!canary_cands.empty()) {
+          // Only canary capacity exists (stable mid-roll): serve there.
+          cs.routed++;
+          cands = std::move(canary_cands);
+        }
       }
       bool all_full = true;
       for (const auto& c : cands) all_full &= c.full;
@@ -1229,6 +1809,8 @@ HttpResponse Master::handle_serve_router(
       probe = pick.probe;
       ReplicaHealth& r = dep.replicas[target_task];
       pick_failures = r.consecutive_failures;
+      pick_version = r.model_version;
+      pick_canary = r.canary;
       r.inflight++;
       if (probe) r.half_open_probe = true;
       for (auto& [aid, a] : allocations_) {
@@ -1259,6 +1841,10 @@ HttpResponse Master::handle_serve_router(
       attrs["retried"] = attempt > 0;
       attrs["half_open_probe"] = probe;
       attrs["breaker_failures"] = static_cast<int64_t>(pick_failures);
+      // Which model version served this request (docs/serving.md "Model
+      // lifecycle") — the trace answers "did the canary serve it".
+      if (!pick_version.empty()) attrs["model_version"] = pick_version;
+      if (pick_canary) attrs["canary"] = true;
       if (fail.empty()) {
         attrs["status"] = static_cast<int64_t>(pr.status);
       } else {

@@ -206,9 +206,91 @@ void Master::set_experiment_state_locked(ExperimentState& exp,
       {"id", Json(exp.id)}, {"state", Json(state)}}));
   if (is_terminal(state)) {
     fire_webhooks_locked(exp);
+    // Registry auto-promotion runs BEFORE checkpoint GC so the freshly
+    // registered version is already pinned when GC computes its doomed
+    // set (docs/serving.md "Model lifecycle").
+    if (state == "COMPLETED") promote_experiment_to_registry_locked(exp);
     launch_checkpoint_gc_locked(exp);
   }
   cv_.notify_all();
+}
+
+// Train→serve promotion (docs/serving.md "Model lifecycle"): an
+// experiment config carrying `registry: {model, promote: best|latest}`
+// registers its winning checkpoint as the model's next version when the
+// experiment COMPLETES — the searcher-best validation checkpoint
+// ("best", the default) or the newest COMPLETED checkpoint ("latest").
+void Master::promote_experiment_to_registry_locked(ExperimentState& exp) {
+  const Json& reg = exp.config["registry"];
+  if (!reg.is_object()) return;
+  std::string model = reg["model"].as_string();
+  if (model.empty()) return;
+  std::string mode = reg["promote"].as_string("best");
+  std::string metric_name = exp.config["searcher"]["metric"].as_string("");
+  bool smaller = exp.config["searcher"]["smaller_is_better"].as_bool(true);
+
+  std::string uuid;
+  int64_t trial_id = -1, steps = -1;
+  auto rows = db_.query(
+      "SELECT c.uuid, c.trial_id, c.steps_completed, "
+      "(SELECT m.metrics FROM raw_metrics m WHERE m.trial_id=c.trial_id "
+      " AND m.group_name='validation' AND m.total_batches=c.steps_completed "
+      " ORDER BY m.id DESC LIMIT 1) AS vmetrics "
+      "FROM checkpoints c JOIN trials t ON c.trial_id = t.id "
+      "WHERE t.experiment_id=? AND c.state='COMPLETED' "
+      "ORDER BY c.report_time, c.rowid",
+      {Json(exp.id)});
+  if (mode == "latest") {
+    if (!rows.empty()) {
+      auto& row = rows.back();
+      uuid = row["uuid"].as_string();
+      trial_id = row["trial_id"].as_int(-1);
+      steps = row["steps_completed"].as_int(-1);
+    }
+  } else {
+    // Searcher-best: the checkpoint whose same-step validation metric is
+    // best (normalized so smaller wins), falling back to the newest
+    // checkpoint when no validation metrics exist at all.
+    bool have_best = false;
+    double best = 0;
+    for (auto& row : rows) {
+      double v = 0;
+      bool has = false;
+      if (row["vmetrics"].is_string() && !metric_name.empty()) {
+        Json m = Json::parse_or_null(row["vmetrics"].as_string());
+        if (m[metric_name].is_number()) {
+          v = smaller ? m[metric_name].as_double()
+                      : -m[metric_name].as_double();
+          has = true;
+        }
+      }
+      if (has && (!have_best || v < best)) {
+        have_best = true;
+        best = v;
+        uuid = row["uuid"].as_string();
+        trial_id = row["trial_id"].as_int(-1);
+        steps = row["steps_completed"].as_int(-1);
+      }
+    }
+    if (!have_best && !rows.empty()) {
+      auto& row = rows.back();
+      uuid = row["uuid"].as_string();
+      trial_id = row["trial_id"].as_int(-1);
+      steps = row["steps_completed"].as_int(-1);
+    }
+  }
+  if (uuid.empty()) {
+    std::cerr << "master: experiment " << exp.id << " registry promotion "
+              << "skipped: no COMPLETED checkpoint to promote" << std::endl;
+    return;
+  }
+  Json ver = register_model_version_locked(
+      model, uuid, exp.id, trial_id, steps, exp.owner_id,
+      "auto-promoted (" + mode + ") from experiment " +
+          std::to_string(exp.id));
+  std::cerr << "master: experiment " << exp.id << " promoted checkpoint "
+            << uuid << " -> " << model << ":" << ver["version"].as_int()
+            << " (" << mode << ")" << std::endl;
 }
 
 // Checkpoint GC (reference checkpoint_gc.go:76 + exec/gc_checkpoints.py):
@@ -316,6 +398,14 @@ void Master::launch_checkpoint_gc_locked(ExperimentState& exp) {
         {Json(exp.id)});
     for (auto& row : lrows) keep.insert(row["latest_checkpoint"].as_string());
   }
+  // Lifecycle exclusions (docs/checkpointing.md "GC exclusions", same
+  // guard pattern as the compile_artifacts blob refcount): a checkpoint
+  // referenced by a registered model version or pinned by a live
+  // deployment (stable or canary) must survive retention — deleting it
+  // would break `det serve update <dep> model:N` and every replica
+  // respawn of a deployment that serves it.
+  std::set<std::string> pinned = lifecycle_pinned_checkpoints_locked();
+  keep.insert(pinned.begin(), pinned.end());
   Json doomed = Json::array();
   for (const auto& ck : cks) {
     if (!keep.count(ck.uuid)) doomed.push_back(Json(ck.uuid));
@@ -340,7 +430,11 @@ void Master::launch_checkpoint_gc_locked(ExperimentState& exp) {
         {Json(exp.id),
          Json("-" + std::to_string(partial_ttl) + " seconds")});
     for (auto& row : prows) {
-      stale_partials.push_back(Json(row["uuid"].as_string()));
+      // The lifecycle pins guard this sweep too: a pinned id is never
+      // handed to the GC task, whatever state its row claims.
+      if (!pinned.count(row["uuid"].as_string())) {
+        stale_partials.push_back(Json(row["uuid"].as_string()));
+      }
     }
   }
 

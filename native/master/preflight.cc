@@ -390,6 +390,25 @@ Json preflight_config(const Json& config) {
             "request while a scale-from-zero replica restores"));
       }
     }
+
+    // DTL208 — canary traffic fraction (docs/serving.md "Model
+    // lifecycle"): mirror of analysis/config_rules.py. A declared
+    // serving.canary.fraction must sit strictly inside (0, 1); the
+    // deployment-create gate refuses anything else.
+    const Json& canary = serving["canary"];
+    if (canary.is_object() && !canary["fraction"].is_null()) {
+      double frac = canary["fraction"].is_number()
+                        ? canary["fraction"].as_double()
+                        : -1.0;
+      if (!(frac > 0.0 && frac < 1.0)) {
+        out.push_back(diag(
+            "DTL208", "error",
+            "serving.canary.fraction=" + canary["fraction"].dump() +
+                " must be strictly inside (0, 1): 0 routes nothing to "
+                "the canary and 1 is a full rollout — use `det serve "
+                "update` for that"));
+      }
+    }
   }
 
   // DTL203 — restarts configured but nothing to restart from. Only an

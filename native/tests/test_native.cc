@@ -607,6 +607,52 @@ static void test_preflight_capacity_knobs() {
   CHECK(det::preflight_config(cfg).as_array().empty());
 }
 
+static void test_preflight_canary_fraction() {
+  // DTL208 — canary traffic fraction (native mirror of
+  // analysis/config_rules.py; docs/serving.md "Model lifecycle").
+  auto cfg_with = [](Json fraction) {
+    Json cfg = Json::object();
+    Json serving = Json::object();
+    Json canary = Json::object();
+    canary["model"] = "m";
+    if (!fraction.is_null()) canary["fraction"] = fraction;
+    serving["canary"] = canary;
+    serving["checkpoint"] = "latest";
+    cfg["serving"] = serving;
+    return cfg;
+  };
+  // A real fraction is clean.
+  CHECK(det::preflight_config(cfg_with(Json(0.05))).as_array().empty());
+  CHECK(det::preflight_config(cfg_with(Json(0.999))).as_array().empty());
+  // Omitted fraction: the create path defaults it — clean.
+  CHECK(det::preflight_config(cfg_with(Json())).as_array().empty());
+  // 0, 1, negative, and non-numeric all fire DTL208 errors.
+  for (const Json& bad :
+       {Json(0.0), Json(1.0), Json(-0.2), Json(static_cast<int64_t>(2)),
+        Json(std::string("lots"))}) {
+    Json d = det::preflight_config(cfg_with(bad));
+    CHECK_EQ(d.as_array().size(), static_cast<size_t>(1));
+    CHECK_EQ(d.as_array()[0]["code"].as_string(), "DTL208");
+    CHECK_EQ(d.as_array()[0]["level"].as_string(), "error");
+  }
+  // No canary block: never fires.
+  Json cfg = Json::object();
+  Json serving = Json::object();
+  serving["checkpoint"] = "latest";
+  cfg["serving"] = serving;
+  CHECK(det::preflight_config(cfg).as_array().empty());
+  // Suppressible like every DTL2xx rule.
+  Json bad = cfg_with(Json(0.0));
+  Json sup = Json::object();
+  Json codes = Json::array();
+  codes.push_back(Json(std::string("DTL208")));
+  sup["suppress"] = codes;
+  bad["preflight"] = sup;
+  Json d = det::preflight_config(bad);
+  CHECK_EQ(d.as_array().size(), static_cast<size_t>(1));
+  CHECK(d.as_array()[0]["suppressed"].as_bool(false));
+}
+
 static void test_preflight_serving_kv_geometry() {
   // Serving config, block size does not divide max_seq -> DTL206 error.
   Json cfg = Json::object();
@@ -713,6 +759,7 @@ int main() {
       {"preflight_shape_sweep", test_preflight_shape_sweep},
       {"preflight_serving_kv_geometry", test_preflight_serving_kv_geometry},
       {"preflight_capacity_knobs", test_preflight_capacity_knobs},
+      {"preflight_canary_fraction", test_preflight_canary_fraction},
       {"preflight_suppress_and_gate", test_preflight_suppress_and_gate},
   };
   for (auto& t : tests) {

@@ -165,7 +165,11 @@ ROUTES = [
     ("delete", "/api/v1/models/{name}", "models", "Archive model"),
     ("get", "/api/v1/models/{name}/versions", "models", "List versions"),
     ("post", "/api/v1/models/{name}/versions", "models",
-     "Register a checkpoint as a version"),
+     "Register a COMMITTED checkpoint as the next immutable version "
+     "(pins it against GC; docs/serving.md 'Model lifecycle')"),
+    ("get", "/api/v1/models/{name}/versions/{v}", "models",
+     "Get one version (checkpoint uuid + train provenance) — the "
+     "resolution target of `det serve update <dep> <name>:<v>`"),
     ("get", "/api/v1/templates", "templates", "List"),
     ("post", "/api/v1/templates", "templates", "Create/replace"),
     ("get", "/api/v1/templates/{name}", "templates", "Get"),
@@ -216,6 +220,14 @@ ROUTES += [
      "`det serve trace <deployment> <request-id>`"),
     ("post", "/api/v1/deployments/{id}/scale", "serving",
      "Manually set target replicas within [min, max]"),
+    ("post", "/api/v1/deployments/{id}/update", "serving",
+     "Rolling blue-green weight swap to {model[:version]} or "
+     "{checkpoint}: spawn-at-new before drain-at-old, one replica at a "
+     "time, zero dropped (docs/serving.md 'Model lifecycle')"),
+    ("post", "/api/v1/deployments/{id}/canary", "serving",
+     "Start ({model|checkpoint, fraction, replicas?}), promote "
+     "({promote: true}) or abort ({abort: true}) a canary traffic "
+     "split with per-version latency aggregation"),
     ("post", "/api/v1/deployments/{id}/kill", "serving",
      "Kill the deployment and every replica (hard stop; scale to min "
      "first for a graceful teardown)"),

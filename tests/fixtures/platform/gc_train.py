@@ -1,10 +1,16 @@
 """Fixture trial for the checkpoint-GC e2e: checkpoints + validates every
 2 steps with a non-monotonic metric (best at mid-training), so the
-retention policy has distinct best/latest/doomed checkpoints to act on."""
+retention policy has distinct best/latest/doomed checkpoints to act on.
+
+DET_GC_HOLD_FILE (optional): after training, wait (<=60s) until the named
+file exists before exiting — the GC-exclusion tests use the window to
+register a model version / pin a deployment against checkpoints that are
+already COMPLETED, BEFORE experiment completion launches the GC task."""
 
 import json
 import os
 import sys
+import time
 
 from determined_tpu import core
 
@@ -26,6 +32,11 @@ def main() -> int:
                         with open(os.path.join(path, "state.json"), "w") as f:
                             json.dump({"steps": steps}, f)
             op.report_completed(0.0)
+        hold = os.environ.get("DET_GC_HOLD_FILE")
+        if hold:
+            deadline = time.time() + 60
+            while not os.path.exists(hold) and time.time() < deadline:
+                time.sleep(0.2)
         print(f"gc fixture trained {steps} steps")
     return 0
 

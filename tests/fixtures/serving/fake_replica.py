@@ -49,6 +49,12 @@ from determined_tpu.serve.tracing import RequestTracer  # noqa: E402
 
 TASK_ID = os.environ.get("DET_TASK_ID", "fake")
 ALLOCATION_ID = os.environ.get("DET_ALLOCATION_ID", "")
+# Model lifecycle (docs/serving.md "Model lifecycle"): the version label
+# the deployment controller pinned at spawn — echoed on the heartbeat
+# (the real replica does the same) and on generate responses so swap/
+# canary tests and the lifecycle bench can attribute every request to
+# the version that served it.
+MODEL_VERSION = os.environ.get("DET_MODEL_VERSION", "")
 GEN_MS = float(os.environ.get("DET_FAKE_GEN_MS", "30"))
 HEARTBEAT_S = float(os.environ.get("DET_FAKE_HEARTBEAT_S", "0.5"))
 # Per-replica service capacity: at most SLOTS generates run concurrently,
@@ -85,6 +91,8 @@ def heartbeat_stats():
             stats = dict(_state["override"])
             stats.setdefault("draining", _state["draining"])
             stats.setdefault("latency", latency)
+            if MODEL_VERSION:
+                stats.setdefault("model_version", MODEL_VERSION)
             return stats
         return {
             "queue_depth": _state["waiting"],
@@ -100,6 +108,7 @@ def heartbeat_stats():
             # to the warm path so cold-start tests see the real contract.
             "engine_source": os.environ.get("DET_FAKE_ENGINE_SOURCE",
                                             "deserialize"),
+            "model_version": MODEL_VERSION,
             "latency": latency,
         }
 
@@ -179,7 +188,8 @@ class Handler(BaseHTTPRequestHandler):
                     _tracer.flush()
                 self._send(200, {"id": req.id,
                                  "tokens": list(req.out_tokens),
-                                 "replica": TASK_ID})
+                                 "replica": TASK_ID,
+                                 "model_version": MODEL_VERSION})
             finally:
                 _slots_sem.release()
                 with _lock:

@@ -126,6 +126,33 @@ def test_preflight_dtl207_capacity_knobs_mirror():
     assert "DTL207" in codes(bad_min)
 
 
+def test_preflight_dtl208_canary_fraction_mirror():
+    """DTL208 fires on a canary fraction outside (0, 1) and stays silent
+    on real fractions / omitted fraction (the native master mirror is
+    exercised via the deployment-create gate in
+    test_lifecycle_expconf_and_create_gate)."""
+    from determined_tpu.analysis.config_rules import check_config
+
+    def codes(cfg):
+        return [d.code for d in check_config(cfg)]
+
+    def cfg_with(**canary):
+        c = _serving_cfg({"min": 1})
+        c["serving"]["canary"] = {"model": "m", **canary}
+        return c
+
+    assert "DTL208" not in codes(cfg_with(fraction=0.05))
+    assert "DTL208" not in codes(cfg_with())  # defaulted at create
+    for bad in (0, 1, 1.5, -0.1, True, "lots"):
+        assert "DTL208" in codes(cfg_with(fraction=bad)), bad
+    # Suppressible like every DTL2xx rule.
+    from determined_tpu.analysis import filter_suppressed
+
+    diags = filter_suppressed(
+        check_config(cfg_with(fraction=0)), ["DTL208"])
+    assert [d.code for d in diags] == ["DTL208"] and diags[0].suppressed
+
+
 def test_expconf_heartbeat_period():
     cfg = _serving_cfg({"min": 1})
     cfg["serving"]["heartbeat_period_s"] = 0.5
@@ -980,6 +1007,367 @@ def test_deployment_latency_aggregation_and_slow_ring(fleet):
     breaches = [line for line in raw.splitlines()
                 if line.startswith("det_serve_slo_breaches_total")]
     assert breaches and int(breaches[0].split()[-1]) >= 6
+
+
+# ---------------------------------------------------------------------------
+# Model lifecycle: registry-driven rolling swaps, canary routing, version
+# surfacing (docs/serving.md "Model lifecycle").
+# ---------------------------------------------------------------------------
+
+
+def _register_versions(c, token, model, uuids):
+    """Trial-less COMPLETED checkpoint rows + registry versions 1..N for
+    them; returns nothing (versions are 1-based in uuid order)."""
+    _http("POST", f"{c.master_url}/api/v1/models",
+          {"name": model, "metadata": {}, "labels": []}, token=token)
+    for uuid in uuids:
+        c.api("POST", "/api/v1/checkpoints",
+              {"uuid": uuid, "state": "COMPLETED"}, token=token)
+        c.api("POST", f"/api/v1/models/{model}/versions",
+              {"checkpoint_uuid": uuid}, token=token)
+
+
+def _live_versions(detail):
+    return sorted((r["model_version"], r.get("canary", False))
+                  for r in detail["replicas"] if not r["retiring"])
+
+
+def test_register_version_requires_committed_checkpoint(master_only):
+    """Registry versions are immutable promises: only COMPLETED
+    checkpoints register; unknown/PARTIAL refuse; numbering is
+    sequential; the version detail carries the checkpoint; registration
+    publishes a `models` stream event."""
+    c = master_only
+    token = c.login()
+    c.api("POST", "/api/v1/models",
+          {"name": "m", "metadata": {}, "labels": []}, token=token)
+    # Unknown checkpoint: 404.
+    status, _, body = _http(
+        "POST", f"{c.master_url}/api/v1/models/m/versions",
+        {"checkpoint_uuid": "nope"}, token=token)
+    assert status == 404, body
+    # PARTIAL checkpoint: 400 (torsos never become versions).
+    c.api("POST", "/api/v1/checkpoints",
+          {"uuid": "ck-partial", "state": "PARTIAL"}, token=token)
+    status, _, body = _http(
+        "POST", f"{c.master_url}/api/v1/models/m/versions",
+        {"checkpoint_uuid": "ck-partial"}, token=token)
+    assert status == 400 and "PARTIAL" in body["error"], body
+    # COMPLETED registers, versions count up, detail resolves.
+    _register_versions(c, token, "m", ["ck-1", "ck-2"])
+    vers = c.api("GET", "/api/v1/models/m/versions",
+                 token=token)["model_versions"]
+    assert [v["version"] for v in vers] == [1, 2]
+    one = c.api("GET", "/api/v1/models/m/versions/2",
+                token=token)["model_version"]
+    assert one["checkpoint_uuid"] == "ck-2"
+    stream = c.api("GET", "/api/v1/stream?entities=models&timeout_seconds=0",
+                   token=token)
+    assert any(e["payload"].get("version") == 2
+               and e["payload"].get("model") == "m"
+               for e in stream["events"]), stream
+
+
+def test_rolling_update_swap_and_rollback(fleet):
+    """`det serve update` semantics: the deployment rolls to the new
+    version one replica at a time — spawn-at-new BEFORE drain-at-old
+    (live never exceeds target+1, dispatch never fails) — and rolling
+    back is the same call with the prior version. The completed swap
+    leaves a serve.swap span reachable through the stream's swap_id."""
+    c = fleet
+    token = c.login()
+    cfg = _dep_config(min_r=1, max_r=4, target=2, heartbeat_s=0.3)
+    dep_id = c.api("POST", "/api/v1/deployments", {"config": cfg},
+                   token=token)["id"]
+    detail = _wait_ready(c, token, dep_id, 2)
+    # Initial version label derives from the pinned checkpoint.
+    assert detail["model_version"] == "checkpoint:latest"
+    v0_tasks = {r["task_id"] for r in detail["replicas"]}
+
+    _register_versions(c, token, "m", ["ck-v1", "ck-v2"])
+    resp = c.api("POST", f"/api/v1/deployments/{dep_id}/update",
+                 {"model": "m", "version": 2}, token=token)
+    assert resp["rolling"] and resp["model_version"] == "m:2"
+    assert resp["checkpoint"] == "ck-v2"
+
+    # Roll to completion: every generation keeps succeeding, live
+    # non-retiring never exceeds target+1 (the one-at-a-time surge).
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        status, _, out = _generate(c, token, dep_id)
+        assert status == 200, out
+        detail = c.api("GET", f"/api/v1/deployments/{dep_id}",
+                       token=token)["deployment"]
+        live = [r for r in detail["replicas"] if not r["retiring"]]
+        assert len(live) <= 3, _live_versions(detail)
+        if (len(detail["replicas"]) == 2
+                and all(r["model_version"] == "m:2"
+                        for r in detail["replicas"])
+                and "swap" not in detail):
+            break
+        time.sleep(0.3)
+    assert detail["model_version"] == "m:2"
+    assert all(r["model_version"] == "m:2" for r in detail["replicas"]), \
+        _live_versions(detail)
+    # Blue-green for real: the v2 set is a fresh replica set.
+    assert not v0_tasks & {r["task_id"] for r in detail["replicas"]}
+    # A generation now reports the new version (fake echoes
+    # DET_MODEL_VERSION, exactly like the real replica's heartbeat).
+    status, _, out = _generate(c, token, dep_id)
+    assert status == 200 and out["model_version"] == "m:2", out
+
+    # serve.swap span: the stream's swap_complete event names the span's
+    # request-id scope; the trace endpoint serves it back.
+    stream = c.api(
+        "GET", "/api/v1/stream?entities=deployments&timeout_seconds=0",
+        token=token)
+    done = [e["payload"] for e in stream["events"]
+            if e["payload"].get("swap_complete")]
+    assert done and done[-1]["model_version"] == "m:2", stream
+    status, _, tr = _trace(c, token, dep_id, done[-1]["swap_id"])
+    assert status == 200
+    swap_spans = [s for s in tr["spans"] if s["name"] == "serve.swap"]
+    assert swap_spans, tr
+    attrs = swap_spans[0]["attrs"]
+    assert attrs["to"] == "m:2" and attrs["replicas_swapped"] == 2, attrs
+
+    # Rollback = update back to the prior version (still registered).
+    resp = c.api("POST", f"/api/v1/deployments/{dep_id}/update",
+                 {"model": "m", "version": 1}, token=token)
+    assert resp["model_version"] == "m:1"
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        detail = c.api("GET", f"/api/v1/deployments/{dep_id}",
+                       token=token)["deployment"]
+        if (len(detail["replicas"]) == 2
+                and all(r["model_version"] == "m:1"
+                        for r in detail["replicas"])):
+            break
+        time.sleep(0.3)
+    assert all(r["model_version"] == "m:1" for r in detail["replicas"]), \
+        _live_versions(detail)
+    # No-op update answers rolling=false.
+    resp = c.api("POST", f"/api/v1/deployments/{dep_id}/update",
+                 {"model": "m", "version": 1}, token=token)
+    assert resp["rolling"] is False
+    # Unknown version/model: 400 with a useful message.
+    status, _, body = _http(
+        "POST", f"{c.master_url}/api/v1/deployments/{dep_id}/update",
+        {"model": "m", "version": 9}, token=token)
+    assert status == 400 and "no version 9" in body["error"], body
+    status, _, body = _http(
+        "POST", f"{c.master_url}/api/v1/deployments/{dep_id}/update",
+        {"model": "ghost"}, token=token)
+    assert status == 400 and "no such model" in body["error"], body
+
+
+def test_canary_split_observed_fraction_and_promote(fleet):
+    """Canary routing: a 0.25 split sends EXACTLY every 4th traced
+    generation to the canary replica (deterministic debt accounting),
+    per-version latency aggregates separately, and promote folds the
+    canary version into the deployment via the rolling-swap path."""
+    c = fleet
+    token = c.login()
+    cfg = _dep_config(min_r=1, max_r=2, target=1, heartbeat_s=0.3)
+    dep_id = c.api("POST", "/api/v1/deployments", {"config": cfg},
+                   token=token)["id"]
+    _wait_ready(c, token, dep_id, 1)
+    _register_versions(c, token, "m", ["ck-v1", "ck-v2"])
+
+    # Fraction gate: the API refuses anything outside (0, 1) — the
+    # DTL208 contract at the verb.
+    for bad in (0, 1.0, -0.25, 2):
+        status, _, body = _http(
+            "POST", f"{c.master_url}/api/v1/deployments/{dep_id}/canary",
+            {"model": "m", "version": 2, "fraction": bad}, token=token)
+        assert status == 400 and "(0, 1)" in body["error"], (bad, body)
+    # Promote/abort without a canary: 400.
+    for verb in ({"promote": True}, {"abort": True}):
+        status, _, body = _http(
+            "POST", f"{c.master_url}/api/v1/deployments/{dep_id}/canary",
+            verb, token=token)
+        assert status == 400, (verb, body)
+
+    resp = c.api("POST", f"/api/v1/deployments/{dep_id}/canary",
+                 {"model": "m", "version": 2, "fraction": 0.25},
+                 token=token)
+    assert resp["canary"] == "m:2" and resp["fraction"] == 0.25
+
+    # Wait for the canary replica to become routable beside stable.
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        detail = c.api("GET", f"/api/v1/deployments/{dep_id}",
+                       token=token)["deployment"]
+        ready_canary = [
+            r for r in detail["replicas"]
+            if r.get("canary") and r.get("allocation_state") == "RUNNING"
+            and r.get("proxy_address")
+            and 0 <= (r.get("report_age_s") or -1) < 10]
+        if ready_canary:
+            break
+        time.sleep(0.2)
+    assert ready_canary, detail
+    assert detail["canary"]["version"] == "m:2"
+
+    # 40 traced generations: the debt accumulator routes exactly 10 to
+    # the canary (both groups stayed routable throughout).
+    by_version = {}
+    for _ in range(40):
+        status, _, out = _generate(c, token, dep_id)
+        assert status == 200, out
+        v = out.get("model_version") or "stable"
+        by_version[v] = by_version.get(v, 0) + 1
+    assert by_version.get("m:2") == 10, by_version
+
+    detail = c.api("GET", f"/api/v1/deployments/{dep_id}",
+                   token=token)["deployment"]
+    canary = detail["canary"]
+    assert canary["routed"] == 10 and canary["routed_stable"] == 30, canary
+    assert abs(canary["observed_fraction"] - 0.25) < 1e-9
+    # Canary-vs-stable latency side by side (after the next heartbeat
+    # ships the histograms).
+    deadline = time.time() + 15
+    byv = {}
+    while time.time() < deadline:
+        detail = c.api("GET", f"/api/v1/deployments/{dep_id}",
+                       token=token)["deployment"]
+        byv = detail.get("latency_by_version") or {}
+        if len(byv) >= 2 and all(
+                (v.get("e2e") or {}).get("count") for v in byv.values()):
+            break
+        time.sleep(0.3)
+    assert "m:2" in byv and len(byv) == 2, byv
+    # The split shows up on master /metrics.
+    raw = urllib.request.urlopen(urllib.request.Request(
+        f"{c.master_url}/metrics",
+        headers={"Authorization": f"Bearer {token}"}), timeout=10
+    ).read().decode()
+    assert (f'det_serve_canary_requests_total{{deployment="{dep_id}"'
+            ',group="canary"} 10') in raw, raw
+
+    # Promote: the canary replica becomes the stable set; the old stable
+    # replica drains; deployment lands on m:2 with target replicas.
+    canary_task = ready_canary[0]["task_id"]
+    resp = c.api("POST", f"/api/v1/deployments/{dep_id}/canary",
+                 {"promote": True}, token=token)
+    assert resp["promoted"] == "m:2"
+    assert resp["canary_stats"]["routed"] == 10
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        detail = c.api("GET", f"/api/v1/deployments/{dep_id}",
+                       token=token)["deployment"]
+        if (len(detail["replicas"]) == 1
+                and detail["replicas"][0]["model_version"] == "m:2"
+                and not detail["replicas"][0]["retiring"]):
+            break
+        time.sleep(0.3)
+    assert detail["model_version"] == "m:2"
+    assert detail.get("canary") is None
+    # The promoted replica IS the canary task (already at m:2 — no
+    # needless respawn), demoted to a regular replica.
+    assert detail["replicas"][0]["task_id"] == canary_task
+    assert detail["replicas"][0]["canary"] is False
+
+
+def test_canary_abort_drains_canary_only(fleet):
+    """Abort drains the canary replicas and leaves stable untouched —
+    the cheap exit when the canary's p99 looks wrong."""
+    c = fleet
+    token = c.login()
+    cfg = _dep_config(min_r=1, max_r=2, target=1, heartbeat_s=0.3)
+    dep_id = c.api("POST", "/api/v1/deployments", {"config": cfg},
+                   token=token)["id"]
+    detail = _wait_ready(c, token, dep_id, 1)
+    stable_task = detail["replicas"][0]["task_id"]
+    _register_versions(c, token, "m", ["ck-v1"])
+    c.api("POST", f"/api/v1/deployments/{dep_id}/canary",
+          {"model": "m", "version": 1, "fraction": 0.5}, token=token)
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        detail = c.api("GET", f"/api/v1/deployments/{dep_id}",
+                       token=token)["deployment"]
+        if any(r.get("canary") for r in detail["replicas"]):
+            break
+        time.sleep(0.2)
+    assert any(r.get("canary") for r in detail["replicas"]), detail
+
+    resp = c.api("POST", f"/api/v1/deployments/{dep_id}/canary",
+                 {"abort": True}, token=token)
+    assert resp["aborted"] == "m:1"
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        detail = c.api("GET", f"/api/v1/deployments/{dep_id}",
+                       token=token)["deployment"]
+        if (len(detail["replicas"]) == 1
+                and not detail["replicas"][0].get("canary")):
+            break
+        time.sleep(0.3)
+    assert detail["replicas"][0]["task_id"] == stable_task, detail
+    assert detail.get("canary") is None
+    assert detail["model_version"] == "checkpoint:latest"
+    # Post-abort traffic is 100% stable (the initial checkpoint label).
+    status, _, out = _generate(c, token, dep_id)
+    assert status == 200, out
+    assert out.get("model_version") == "checkpoint:latest", out
+
+
+def test_lifecycle_expconf_and_create_gate(master_only):
+    """Config-declared lifecycle blocks: serving.canary arms the split at
+    deployment create (resolved through the registry), and the DTL208
+    fraction gate refuses a bad fraction at POST /deployments when the
+    preflight gate is armed."""
+    c = master_only
+    token = c.login()
+    _register_versions(c, token, "m", ["ck-v1", "ck-v2"])
+    cfg = _dep_config(min_r=1, max_r=2, target=1)
+    cfg["serving"]["canary"] = {"model": "m", "version": 2,
+                                "fraction": 0.1}
+    cfg = expconf.check(cfg)  # client-side validation passes + defaults
+    assert cfg["serving"]["canary"]["replicas"] == 1
+    dep_id = c.api("POST", "/api/v1/deployments", {"config": cfg},
+                   token=token)["id"]
+    detail = c.api("GET", f"/api/v1/deployments/{dep_id}",
+                   token=token)["deployment"]
+    assert detail["canary"]["version"] == "m:2"
+    assert detail["canary"]["fraction"] == 0.1
+    # One canary replica spawns beside the stable target within a couple
+    # of reconcile ticks (the crash-loop spawn throttle spaces it from
+    # the stable spawn; no agent in this cluster, so they stay PENDING —
+    # fine for the check).
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        detail = c.api("GET", f"/api/v1/deployments/{dep_id}",
+                       token=token)["deployment"]
+        if sum(1 for r in detail["replicas"] if r["canary"]) == 1:
+            break
+        time.sleep(0.3)
+    assert sum(1 for r in detail["replicas"] if r["canary"]) == 1, detail
+
+    # Master-side DTL208 gate (same gate:error semantics as experiments).
+    bad = _dep_config(min_r=1, max_r=2, target=1)
+    bad["serving"]["canary"] = {"model": "m", "fraction": 1.5}
+    bad["preflight"] = {"gate": "error"}
+    status, _, body = _http("POST", f"{c.master_url}/api/v1/deployments",
+                            {"config": bad}, token=token)
+    assert status == 400, body
+    assert any(d.get("code") == "DTL208"
+               for d in body.get("preflight", [])), body
+
+    # serving.model_version pins a registered version at create.
+    pinned = _dep_config(min_r=1, max_r=2, target=1)
+    pinned["serving"]["model_version"] = "m:1"
+    resp = c.api("POST", "/api/v1/deployments", {"config": pinned},
+                 token=token)
+    assert resp["model_version"] == "m:1"
+    detail = c.api("GET", f"/api/v1/deployments/{resp['id']}",
+                   token=token)["deployment"]
+    assert detail["model_version"] == "m:1"
+    assert all(r["model_version"] == "m:1" for r in detail["replicas"])
+    # Unknown registry label at create: 400, not a broken deployment.
+    pinned["serving"]["model_version"] = "ghost:7"
+    status, _, body = _http("POST", f"{c.master_url}/api/v1/deployments",
+                            {"config": pinned}, token=token)
+    assert status == 400 and "no such model" in body["error"], body
 
 
 # ---------------------------------------------------------------------------

@@ -375,6 +375,101 @@ class TestServingBlock:
         errs = expconf.validate({"name": "x", "entrypoint": "python3 t.py"})
         assert any("searcher is required" in e for e in errs)
 
+    # -- model lifecycle (docs/serving.md "Model lifecycle") ------------
+
+    def test_serving_adapters_valid(self):
+        cfg = self._config(adapters=[
+            {"name": "ft-a", "checkpoint": "ck-a"},
+            {"name": "ft-b", "checkpoint": "ck-b"},
+        ])
+        assert expconf.validate(cfg) == []
+
+    @pytest.mark.parametrize("adapters,needle", [
+        ("ft", "must be a list"),
+        ([["x"]], "must be a mapping"),
+        ([{"checkpoint": "ck"}], "name must be a non-empty string"),
+        ([{"name": "", "checkpoint": "ck"}], "non-empty string"),
+        ([{"name": "a", "checkpoint": "c1"},
+          {"name": "a", "checkpoint": "c2"}], "duplicate"),
+        ([{"name": "base", "checkpoint": "ck"}], "reserved"),
+        ([{"name": "a"}], "checkpoint must be a checkpoint storage id"),
+        ([{"name": "a", "checkpoint": "ck", "rank": 8}], "unknown keys"),
+    ])
+    def test_serving_adapters_invalid(self, adapters, needle):
+        errs = expconf.validate(self._config(adapters=adapters))
+        assert any(needle in e for e in errs), (adapters, errs)
+
+    def test_serving_canary_valid_and_defaults(self):
+        cfg = self._config(canary={"model": "m", "version": 2,
+                                   "fraction": 0.1})
+        assert expconf.validate(cfg) == []
+        out = expconf.check(cfg)
+        assert out["serving"]["canary"]["replicas"] == 1
+        # fraction defaults to 0.05 when omitted
+        out = expconf.check(self._config(canary={"checkpoint": "ck-2"}))
+        assert out["serving"]["canary"]["fraction"] == 0.05
+
+    @pytest.mark.parametrize("canary,needle", [
+        ("v2", "must be a mapping"),
+        ({"fraction": 0.1}, "requires `model`"),
+        ({"model": "m", "fraction": 0}, "(0, 1)"),
+        ({"model": "m", "fraction": 1}, "(0, 1)"),
+        ({"model": "m", "fraction": True}, "(0, 1)"),
+        ({"model": "m", "version": 0}, "positive int"),
+        ({"checkpoint": "ck", "version": 2}, "requires `model`"),
+        ({"model": "m", "replicas": 0}, "replicas must be a positive"),
+        ({"model": "m", "surge": 1}, "unknown keys"),
+    ])
+    def test_serving_canary_invalid(self, canary, needle):
+        errs = expconf.validate(self._config(canary=canary))
+        assert any(needle in e for e in errs), (canary, errs)
+
+    def test_serving_model_version_label(self):
+        assert expconf.validate(self._config(model_version="m:3")) == []
+        errs = expconf.validate(self._config(model_version=""))
+        assert any("model_version" in e for e in errs)
+
+
+class TestRegistryBlock:
+    """`registry:` — train→serve auto-promotion (docs/serving.md
+    'Model lifecycle')."""
+
+    def _config(self, registry):
+        return {
+            "name": "t",
+            "entrypoint": "python3 train.py",
+            "searcher": {"name": "single", "metric": "loss",
+                         "max_length": {"batches": 4}},
+            "registry": registry,
+        }
+
+    def test_valid_and_promote_default(self):
+        cfg = self._config({"model": "prod-gpt2"})
+        assert expconf.validate(cfg) == []
+        out = expconf.check(cfg)
+        assert out["registry"]["promote"] == "best"
+        assert expconf.validate(
+            self._config({"model": "m", "promote": "latest"})) == []
+
+    @pytest.mark.parametrize("registry,needle", [
+        ("m", "registry must be a mapping"),
+        ({}, "registry.model"),
+        ({"model": ""}, "registry.model"),
+        ({"model": 3}, "registry.model"),
+        ({"model": "m:2"}, "bare model name"),
+        ({"model": "m", "promote": "newest"}, "best, latest"),
+        ({"model": "m", "version": 2}, "unknown keys"),
+    ])
+    def test_invalid(self, registry, needle):
+        errs = expconf.validate(self._config(registry))
+        assert any(needle in e for e in errs), (registry, errs)
+
+    def test_registry_refused_on_serving_configs(self):
+        cfg = {"name": "d", "serving": {"model": "gpt2"},
+               "registry": {"model": "m"}}
+        errs = expconf.validate(cfg)
+        assert any("belongs to training configs" in e for e in errs)
+
 
 class TestCrossFieldDiagnostics:
     """Cross-field checks surface as DTL rules (the same codes the native

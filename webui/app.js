@@ -794,12 +794,19 @@ async function pageModels() {
     view.append(el("h2", {}, m.name,
       el("span", { class: "muted" }, `  ${m.description ?? ""}`)));
     view.append(el("table", {},
-      el("tr", {}, ["Version", "Checkpoint", "Registered"]
+      el("tr", {}, ["Version", "Checkpoint", "Source", "Registered"]
         .map((h) => el("th", {}, h))),
       model_versions.map((v) => {
+        // Train→serve provenance (docs/serving.md "Model lifecycle"):
+        // which experiment/trial/step produced this version.
+        const src = v.source_experiment_id
+          ? `exp ${v.source_experiment_id} · trial ${v.source_trial_id}` +
+            (v.steps_completed != null ? ` @ ${v.steps_completed}` : "")
+          : "";
         const row = el("tr", { class: "rowlink" },
           el("td", {}, v.version),
           el("td", { class: "muted" }, v.checkpoint_uuid),
+          el("td", { class: "muted" }, src),
           el("td", { class: "muted" }, v.creation_time ?? ""));
         row.addEventListener("click", async () => {
           // Version detail: the backing checkpoint's metadata/resources,
@@ -811,7 +818,7 @@ async function pageModels() {
           const { checkpoint } = await API.getCheckpointsUuid(
             v.checkpoint_uuid);
           row.after(el("tr", { class: "version-detail" },
-            el("td", { colspan: 3 }, el("pre", { class: "config" },
+            el("td", { colspan: 4 }, el("pre", { class: "config" },
               JSON.stringify({
                 trial_id: checkpoint.trial_id,
                 steps_completed: checkpoint.steps_completed,
@@ -988,10 +995,22 @@ async function pageServing() {
     const h = (d.latency || {})[key] || {};
     return h.count ? `${h.p50_ms.toFixed(0)}/${h.p99_ms.toFixed(0)}` : "—";
   };
+  // Model-lifecycle columns (docs/serving.md "Model lifecycle"): the
+  // served version (→ marks an in-flight rolling swap) and the canary
+  // split with its observed traffic fraction.
+  const versionCell = (d) => {
+    const v = (d.model_version || "").replace("checkpoint:", "ckpt:");
+    return d.swapping ? `→ ${v}` : v;
+  };
+  const canaryCell = (d) => d.canary
+    ? `${d.canary.version} @ ${d.canary.fraction}` +
+      ` (obs ${(d.canary.observed_fraction ?? 0).toFixed(2)})`
+    : "";
   if (deployments.length) {
     view.append(el("h2", {}, "Deployments"));
     view.append(el("table", {},
-      el("tr", {}, ["ID", "Name", "State", "Replicas", "Range", "Load",
+      el("tr", {}, ["ID", "Name", "State", "Replicas", "Range", "Version",
+        "Canary", "Load",
         "TTFT p50/p99", "TPOT p50/p99", "e2e p50/p99", ""]
         .map((h) => el("th", {}, h))),
       deployments.map((d) => el("tr", {},
@@ -1001,6 +1020,8 @@ async function pageServing() {
         el("td", {}, `${d.replica_count ?? 0}/${d.target_replicas}`),
         el("td", { class: "muted" },
           `[${d.min_replicas}, ${d.max_replicas}]`),
+        el("td", { class: "muted" }, versionCell(d)),
+        el("td", { class: "muted" }, canaryCell(d)),
         el("td", { class: "muted" },
           d.smoothed_load != null ? d.smoothed_load.toFixed(2) : ""),
         el("td", { class: "muted" }, pp(d, "ttft")),
@@ -1069,6 +1090,41 @@ async function pageDeployment(id) {
     `[${d.min_replicas}, ${d.max_replicas}], load ` +
     `${(d.smoothed_load ?? 0).toFixed(2)}` +
     (d.slo_ms ? `, SLO ${d.slo_ms} ms` : "")));
+  // Model lifecycle (docs/serving.md "Model lifecycle"): served version,
+  // rolling-swap progress, and the canary split.
+  view.append(el("p", {},
+    el("b", {}, "Version: "), d.model_version ?? "",
+    d.swap ? el("span", { class: "muted" },
+      `  (rolling from ${d.swap.from || "(initial)"}, ` +
+      `${d.swap.replicas_swapped} replica(s) swapped)`) : ""));
+  if (d.canary) {
+    view.append(el("p", {},
+      el("b", {}, "Canary: "),
+      `${d.canary.version} at ${d.canary.fraction} of traffic — ` +
+      `${d.canary.routed} canary / ${d.canary.routed_stable} stable ` +
+      `(observed ${(d.canary.observed_fraction ?? 0).toFixed(3)})`));
+  }
+  // Canary-vs-stable p50/p99 side by side, one row per served version.
+  const byv = d.latency_by_version || {};
+  if (Object.keys(byv).length > 1) {
+    view.append(el("h2", {}, "Latency by version"));
+    view.append(el("table", {},
+      el("tr", {}, ["Version", "TTFT p50/p99", "TPOT p50/p99",
+        "e2e p50/p99", "requests"].map((h) => el("th", {}, h))),
+      Object.entries(byv).map(([version, lat]) => {
+        const pp = (key) => {
+          const h = lat[key] || {};
+          return h.count
+            ? `${h.p50_ms.toFixed(0)}/${h.p99_ms.toFixed(0)}` : "—";
+        };
+        return el("tr", {},
+          el("td", {}, version),
+          el("td", { class: "muted" }, pp("ttft")),
+          el("td", { class: "muted" }, pp("tpot")),
+          el("td", { class: "muted" }, pp("e2e")),
+          el("td", { class: "muted" }, (lat.e2e || {}).count ?? 0));
+      })));
+  }
   const lat = d.latency || {};
   view.append(el("h2", {}, "Request latency"));
   view.append(el("table", {},
@@ -1087,8 +1143,8 @@ async function pageDeployment(id) {
     })));
   view.append(el("h2", {}, "Replicas"));
   view.append(el("table", {},
-    el("tr", {}, ["Task", "State", "Queue", "Active", "e2e p50/p99",
-      "Report age", ""].map((h) => el("th", {}, h))),
+    el("tr", {}, ["Task", "State", "Version", "Queue", "Active",
+      "e2e p50/p99", "Report age", ""].map((h) => el("th", {}, h))),
     (d.replicas || []).map((r) => {
       const e2e = (r.latency || {}).e2e || {};
       return el("tr", {},
@@ -1096,6 +1152,9 @@ async function pageDeployment(id) {
         el("td", {}, stateBadge(
           r.retiring ? "RETIRING" : r.draining ? "DRAINING"
             : (r.allocation_state ?? "PENDING"))),
+        el("td", { class: "muted" },
+          (r.model_version || "").replace("checkpoint:", "ckpt:") +
+          (r.canary ? " (canary)" : "")),
         el("td", { class: "muted" },
           `${r.queue_depth}/${r.queue_capacity}`),
         el("td", { class: "muted" }, `${r.active}/${r.slots}`),
