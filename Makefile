@@ -26,6 +26,7 @@ chaos:
 		tests/test_chaos.py tests/test_selfheal.py tests/test_preemption.py \
 		tests/test_serving.py tests/test_deployments.py tests/test_elastic.py \
 		tests/test_observability.py tests/test_compile_farm.py \
+		tests/test_fencing.py \
 		-q -m slow
 
 # Async input pipeline A/B: prefetch on/off step time + input_wait_ms

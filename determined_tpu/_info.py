@@ -34,6 +34,10 @@ class TrialInfo:
     config: Dict[str, Any]
     steps_completed: int = 0
     latest_checkpoint: Optional[str] = None
+    # Which run of the trial this process is (bumped on every requeue /
+    # restart); stamped onto metric reports as trial_run_id so reports
+    # from different runs never collide.
+    run_id: int = 0
 
     @classmethod
     def _from_env(cls) -> Optional["TrialInfo"]:
@@ -48,6 +52,7 @@ class TrialInfo:
             config=json.loads(_env("DET_EXPERIMENT_CONFIG", "{}")),
             steps_completed=int(_env("DET_STEPS_COMPLETED", "0")),
             latest_checkpoint=_env("DET_LATEST_CHECKPOINT"),
+            run_id=int(_env("DET_TRIAL_RUN_ID", "0")),
         )
 
 
@@ -74,6 +79,13 @@ class ClusterInfo:
     task_id: Optional[str] = None
     task_type: str = "TRIAL"
     allocation_id: Optional[str] = None
+    # Fencing epoch for this allocation run (docs/cluster-ops.md "Leases,
+    # fencing & split-brain"): minted by the master when the allocation
+    # was created, echoed on every state-mutating API call as
+    # X-Allocation-Epoch so a superseded (zombie) run's late writes are
+    # rejected with 409 instead of corrupting the successor's lineage.
+    # None = launched outside a fenced allocation (CLI, unmanaged trial).
+    allocation_epoch: Optional[int] = None
     session_token: Optional[str] = None
     run_dir: Optional[str] = None
     trial: Optional[TrialInfo] = None
@@ -110,6 +122,11 @@ class ClusterInfo:
             task_id=_env("DET_TASK_ID"),
             task_type=_env("DET_TASK_TYPE", "TRIAL"),
             allocation_id=_env("DET_ALLOCATION_ID"),
+            allocation_epoch=(
+                int(_env("DET_ALLOCATION_EPOCH", ""))
+                if _env("DET_ALLOCATION_EPOCH") is not None
+                else None
+            ),
             session_token=_env("DET_SESSION_TOKEN"),
             run_dir=run_dir,
             trial=TrialInfo._from_env(),

@@ -8,6 +8,7 @@ endpoints a trial container actually uses (SURVEY.md Appendix A).
 from __future__ import annotations
 
 import hashlib
+import http.client
 import json
 import os
 import random
@@ -82,6 +83,7 @@ class Session:
         timeout: float = 30.0,
         backoff_base: float = 0.1,
         backoff_cap: float = 5.0,
+        headers: Optional[Dict[str, str]] = None,
     ):
         self.master_url = master_url.rstrip("/")
         self.token = token
@@ -89,6 +91,11 @@ class Session:
         self.timeout = timeout
         self.backoff_base = backoff_base
         self.backoff_cap = backoff_cap
+        # Extra headers sent with every request — the allocation context
+        # installs X-Allocation-Epoch here so every state-mutating call
+        # carries the fencing token (docs/cluster-ops.md "Leases, fencing
+        # & split-brain").
+        self.headers = dict(headers) if headers else {}
         self._ssl_ctx = (
             _https_context() if self.master_url.startswith("https://") else None
         )
@@ -127,6 +134,7 @@ class Session:
             )
         data = json.dumps(body).encode() if body is not None else None
         headers = {"Content-Type": "application/json"}
+        headers.update(self.headers)
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
         if idempotent and method not in ("GET", "HEAD"):
@@ -162,7 +170,14 @@ class Session:
                     raise APIError(e.code, body_text, url) from None
             except ssl.SSLCertVerificationError:
                 raise  # retrying can't make an untrusted cert trusted
-            except (urllib.error.URLError, socket.timeout, ConnectionError, OSError) as e:
+            except (urllib.error.URLError, socket.timeout, ConnectionError,
+                    http.client.HTTPException, OSError) as e:
+                # http.client.HTTPException covers the mid-RESPONSE failure
+                # modes urlopen does NOT wrap in URLError: IncompleteRead /
+                # RemoteDisconnected when the peer resets after the status
+                # line or partway through the body. Connect-phase errors
+                # were always retried; a body cut off mid-read must back
+                # off the same way instead of crashing the caller.
                 reason = getattr(e, "reason", None)
                 if isinstance(reason, ssl.SSLCertVerificationError):
                     raise reason from None

@@ -104,6 +104,15 @@ MASTER_METRICS: Dict[str, Tuple[str, str]] = {
     "det_api_requests_total": ("counter", "API requests by status code"),
     "det_api_request_seconds": (
         "histogram", "API request latency by route family"),
+    "det_fenced_writes_total": (
+        "counter", "State-mutating API calls rejected with 409 because the "
+        "caller's X-Allocation-Epoch was superseded, by route "
+        "(docs/cluster-ops.md 'Leases, fencing & split-brain'). Nonzero "
+        "without a partition event means a zombie writer survived "
+        "reassignment"),
+    "det_lease_expirations_total": (
+        "counter", "Agent ownership leases that lapsed without a heartbeat "
+        "renewal; the agent is expected to have self-fenced its tasks"),
 }
 
 AGENT_METRICS: Dict[str, Tuple[str, str]] = {
@@ -113,6 +122,10 @@ AGENT_METRICS: Dict[str, Tuple[str, str]] = {
         "gauge", "Task-log lines queued or in flight to the master"),
     "det_agent_draining": (
         "gauge", "1 after a termination notice was posted, else 0"),
+    "det_agent_lease_remaining_seconds": (
+        "gauge", "Seconds until this agent's ownership lease lapses and it "
+        "self-fences its tasks (renewed by every heartbeat ack; "
+        "docs/cluster-ops.md 'Leases, fencing & split-brain')"),
     "det_agent_uptime_seconds": ("gauge", "Seconds since the agent started"),
 }
 
@@ -157,6 +170,10 @@ SPAN_NAMES: Dict[str, Tuple[str, str]] = {
     "agent.cache_warm": (
         "agent", "Compile-farm artifact prefetch, overlapped with image "
                  "setup"),
+    "agent.lease": (
+        "agent", "Ownership-lease lapse to self-fence kill on a partitioned "
+        "agent; lease_ttl_s and container_id in attrs (best-effort: lost "
+        "when the partition is real, delivered in chaos runs)"),
     "harness.compile": (
         "harness", "First executable acquisition (AOT load or "
                    "trace+compile); cache_hit/signature in attrs"),

@@ -136,10 +136,22 @@ def init(
     session: Optional[Session] = None
     trial_id, run_id, allocation_id = 0, 0, None
     if info is not None:
-        session = Session(info.master_url, info.session_token)
+        # Every state-mutating call from this context carries the fencing
+        # epoch the master minted for THIS allocation run: after a
+        # partition-driven reassignment bumps the run, a zombie of the old
+        # run gets a 409 instead of corrupting the successor's lineage
+        # (docs/cluster-ops.md "Leases, fencing & split-brain").
+        fence_headers = (
+            {"X-Allocation-Epoch": str(info.allocation_epoch)}
+            if info.allocation_epoch is not None
+            else None
+        )
+        session = Session(info.master_url, info.session_token,
+                          headers=fence_headers)
         allocation_id = info.allocation_id
         if info.trial is not None:
             trial_id = info.trial.trial_id
+            run_id = info.trial.run_id
         if info.trial and info.trial.config.get("checkpoint_storage"):
             storage_config = storage_config or info.trial.config["checkpoint_storage"]
 

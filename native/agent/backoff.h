@@ -1,0 +1,34 @@
+// backoff.h — capped exponential backoff with equal jitter for agent →
+// master reconnects. A healed partition un-silences every agent on the
+// segment at the same instant; if they all retry on the same schedule the
+// master eats a synchronized re-register herd exactly when it is busiest
+// restoring state. Equal jitter (AWS architecture blog's "Exponential
+// Backoff And Jitter") keeps a floor of half the ceiling — unlike full
+// jitter it can never collapse to ~0 and hammer anyway — while spreading
+// the other half uniformly.
+//
+// Header-only and pure (caller owns the rand_r seed) so the unit test can
+// assert the spread deterministically (tests/test_native.cc).
+
+#pragma once
+
+#include <algorithm>
+#include <cstdlib>
+
+namespace det {
+namespace backoff {
+
+// Delay in seconds for 0-based `attempt`: ceiling doubles per attempt from
+// base_s, capped at cap_s; the returned value is uniform in
+// [ceiling/2, ceiling).
+inline double jittered_delay_s(int attempt, unsigned* seed,
+                               double base_s = 1.0, double cap_s = 30.0) {
+  if (attempt < 0) attempt = 0;
+  double ceiling =
+      std::min(cap_s, base_s * static_cast<double>(1 << std::min(attempt, 5)));
+  double u = static_cast<double>(rand_r(seed) % 1000) / 1000.0;  // [0, 1)
+  return ceiling / 2.0 + u * (ceiling / 2.0);
+}
+
+}  // namespace backoff
+}  // namespace det

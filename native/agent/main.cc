@@ -40,6 +40,7 @@
 #include "../common/http.h"
 #include "../common/json.h"
 #include "../common/trace.h"
+#include "backoff.h"
 
 namespace {
 
@@ -68,6 +69,16 @@ struct AgentOptions {
   // the instance's schedulingConfig.
   bool preemptible = false;
   double poll_timeout_s = 20.0;
+  // Ownership lease TTL (docs/cluster-ops.md "Leases, fencing &
+  // split-brain"): if the agent cannot renew its lease against the master
+  // for this long — a partition, from this side — it SELF-FENCES: kills
+  // every local task before the master's reclaim deadline
+  // (agent_timeout_s) hands their allocations to another node, so two
+  // agents never run the same allocation concurrently. 0 (the default)
+  // adopts the master's lease_ttl_s from register/heartbeat responses,
+  // keeping both sides on one clock; an explicit value here PINS the TTL
+  // against the master's — an ops/chaos override.
+  double lease_ttl_s = 0;
   // Spot-capacity survival (docs/cluster-ops.md "Preemption & drain"):
   // grace the agent advertises when IT is told to terminate (SIGTERM),
   // and the pluggable termination-notice source. notice_source "gce"
@@ -127,6 +138,35 @@ std::map<std::string, std::shared_ptr<Task>> g_tasks;  // by container_id
 std::atomic<bool> g_draining{false};  // termination notice posted
 std::atomic<int> g_slots{0};          // slots registered with the master
 const auto g_started = std::chrono::steady_clock::now();
+
+// Ownership-lease state (docs/cluster-ops.md "Leases, fencing &
+// split-brain"). The lease is renewed by successful register/heartbeat
+// round-trips ONLY — the action long-poll doesn't count, mirroring the
+// master, so both sides judge the partition by the same channel.
+std::atomic<double> g_lease_ttl{30.0};
+std::atomic<bool> g_lease_ttl_pinned{false};  // explicit local config wins
+std::atomic<long long> g_lease_renewed_us{0};       // steady clock, us
+std::atomic<long long> g_lease_renewed_wall_us{0};  // wall clock, us (spans)
+std::atomic<bool> g_self_fenced{false};
+
+long long steady_us() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now() - g_started)
+      .count();
+}
+
+void renew_lease() {
+  g_lease_renewed_us = steady_us();
+  g_lease_renewed_wall_us = det::trace::now_us();
+  g_self_fenced = false;
+}
+
+double lease_remaining_s() {
+  long long renewed = g_lease_renewed_us.load();
+  if (renewed == 0) return g_lease_ttl.load();  // never registered yet
+  double elapsed = (steady_us() - renewed) / 1e6;
+  return g_lease_ttl.load() - elapsed;
+}
 
 // SIGTERM is a termination notice, not an exit: the handler only raises a
 // flag; the notice watcher turns it into a master notification and keeps
@@ -1053,6 +1093,10 @@ bool register_with_master(const AgentOptions& opts, bool reconnect) {
       return false;
     }
     Json resp = Json::parse_or_null(r.body);
+    if (!g_lease_ttl_pinned && resp["lease_ttl_s"].is_number()) {
+      g_lease_ttl = resp["lease_ttl_s"].as_double();
+    }
+    renew_lease();  // a successful register is a lease renewal
     // Kill anything the master no longer recognizes (reattach reconcile).
     std::vector<std::string> keep;
     for (const auto& k : resp["keep_allocations"].as_array()) {
@@ -1091,10 +1135,11 @@ void reconnect_master(const AgentOptions& opts) {
   for (int attempt = 0; g_running; ++attempt) {
     if (register_with_master(opts, true)) break;
     agent_login(opts.master_url, /*use_env_token=*/true);
-    double base = std::min(30.0, 1.0 * (1 << std::min(attempt, 5)));
-    double jitter = (rand_r(&seed) % 1000) / 1000.0 * base;
+    // Equal jitter (backoff.h): full jitter could draw ~0 repeatedly and
+    // still herd a restoring master.
+    double delay = det::backoff::jittered_delay_s(attempt, &seed);
     std::this_thread::sleep_for(
-        std::chrono::milliseconds(static_cast<int>(1000 * jitter)));
+        std::chrono::milliseconds(static_cast<int>(1000 * delay)));
   }
   std::vector<std::shared_ptr<Task>> live;
   {
@@ -1113,9 +1158,68 @@ void reconnect_master(const AgentOptions& opts) {
   g_reconnecting = false;
 }
 
+// Lease expiry = this side of a partition. Kill every local task NOW,
+// before the master's reclaim deadline (agent_timeout_s > lease_ttl_s)
+// reassigns their allocations to other nodes — otherwise two copies of
+// the same trial run concurrently and the zombie's writes only die at the
+// epoch fence (the backstop, not the plan). The agent itself stays up:
+// when the partition heals it re-registers and is schedulable again.
+void self_fence_tasks(const AgentOptions& opts) {
+  std::vector<std::shared_ptr<Task>> live;
+  {
+    std::lock_guard<std::mutex> lock(g_mu);
+    for (auto& [cid, t] : g_tasks) {
+      if (!t->exited) live.push_back(t);
+    }
+  }
+  if (live.empty()) return;
+  std::cerr << "agent: lease expired (" << g_lease_ttl.load()
+            << "s without a heartbeat ack); self-fencing " << live.size()
+            << " task(s) before the master reassigns" << std::endl;
+  long long t0 = g_lease_renewed_wall_us.load();
+  std::vector<std::string> allocs;
+  for (auto& t : live) {
+    if (!t->trace_id.empty()) {
+      Json spans = Json::array();
+      spans.push_back(det::trace::make_span(
+          t->trace_id, "agent.lease", t0 > 0 ? t0 : det::trace::now_us(),
+          det::trace::now_us(), "",
+          Json(JsonObject{{"event", Json(std::string("self_fence"))},
+                          {"lease_ttl_s", Json(g_lease_ttl.load())},
+                          {"container_id", Json(t->container_id)}})));
+      // Best-effort by nature: in a REAL partition this post is black-holed
+      // too and the span is simply lost; in chaos runs (agent-side fault,
+      // master reachable) it lands on the trial trace as evidence.
+      post_trial_spans(opts, t->trial_id, spans);
+    }
+    bool seen = false;
+    for (const auto& a : allocs) seen |= a == t->allocation_id;
+    if (!seen) allocs.push_back(t->allocation_id);
+  }
+  for (const auto& aid : allocs) kill_allocation(aid);
+}
+
 void heartbeat_loop(const AgentOptions& opts) {
   while (g_running) {
-    std::this_thread::sleep_for(std::chrono::seconds(10));
+    // Beat at TTL/3 (floor 0.5s, cap 10s) so a renewal can miss twice
+    // before the lease lapses, and short test TTLs still get beats.
+    double interval =
+        std::min(10.0, std::max(0.5, g_lease_ttl.load() / 3.0));
+    std::this_thread::sleep_for(
+        std::chrono::milliseconds(static_cast<int>(1000 * interval)));
+    // Expiry is judged BEFORE the partition faults below: a black-holed
+    // agent must still notice its lease lapsed and self-fence.
+    if (lease_remaining_s() <= 0 && !g_self_fenced.exchange(true)) {
+      self_fence_tasks(opts);
+    }
+    if (FAULT_POINT("agent.heartbeat.blackhole") !=
+        det::faults::Action::kNone) {
+      // Sustained partition (docs/chaos.md): unlike the one-shot
+      // agent.heartbeat.drop below, every heartbeat is swallowed while
+      // armed. The action long-poll honors the same point, so the master
+      // sees total silence and starts its reclaim clock.
+      continue;
+    }
     if (FAULT_POINT("agent.heartbeat.drop") == det::faults::Action::kDrop) {
       std::cerr << "agent: faultpoint dropped heartbeat" << std::endl;
       continue;
@@ -1135,13 +1239,18 @@ void heartbeat_loop(const AgentOptions& opts) {
         reconnect_master(opts);  // master restarted
       } else if (r.ok()) {
         Json doc = Json::parse_or_null(r.body);
+        if (!g_lease_ttl_pinned && doc["lease_ttl_s"].is_number()) {
+          g_lease_ttl = doc["lease_ttl_s"].as_double();
+        }
+        renew_lease();  // the ack IS the lease renewal
         for (const auto& aid : doc["kill_allocations"].as_array()) {
           kill_allocation(aid.as_string());
         }
       }
     } catch (const std::exception&) {
       // master temporarily unreachable; keep running tasks (reference
-      // reconnect-with-reattach, agent.go:330-362)
+      // reconnect-with-reattach, agent.go:330-362). The lease clock keeps
+      // ticking — sustained unreachability ends in self_fence_tasks above.
     }
   }
 }
@@ -1187,6 +1296,9 @@ det::HttpResponse agent_metrics_response() {
       << "det_agent_log_backlog_lines " << backlog << "\n"
       << "# TYPE det_agent_draining gauge\n"
       << "det_agent_draining " << (g_draining.load() ? 1 : 0) << "\n"
+      << "# TYPE det_agent_lease_remaining_seconds gauge\n"
+      << "det_agent_lease_remaining_seconds "
+      << std::max(0.0, lease_remaining_s()) << "\n"
       << "# TYPE det_agent_uptime_seconds gauge\n"
       << "det_agent_uptime_seconds " << uptime << "\n";
   det::HttpResponse r;
@@ -1249,6 +1361,52 @@ std::string poll_gce_notice(const AgentOptions& opts) {
     // not on GCE / metadata server unreachable: silently no notice
   }
   return "";
+}
+
+// Runtime fault seam (docs/chaos.md): the master arms its points mid-run
+// through POST /api/v1/debug/faults, but the agent has no admin API — so
+// chaos tests arm AGENT points mid-run through a watched file
+// (DET_AGENT_FAULTS_FILE), the same pattern as notice_file. When the file
+// appears (or its spec changes) the registry is reset and re-armed from
+// its content; when it disappears all points disarm — "healing" a
+// partition armed as agent.heartbeat.blackhole.
+void faults_file_watch_loop(const std::string& path) {
+  std::string current;
+  bool ever_seen = false;
+  while (g_running) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(300));
+    std::string spec;
+    {
+      std::ifstream f(path);
+      if (f) {
+        std::stringstream ss;
+        ss << f.rdbuf();
+        spec = ss.str();
+      }
+    }
+    while (!spec.empty() &&
+           (spec.back() == '\n' || spec.back() == '\r' ||
+            spec.back() == ' ' || spec.back() == '\t')) {
+      spec.pop_back();
+    }
+    if (spec == current) continue;
+    if (spec.empty() && !ever_seen) continue;  // no file yet, nothing armed
+    det::faults::disarm_all();
+    current = spec;
+    if (spec.empty()) {
+      std::cerr << "agent: faults file removed; all points disarmed"
+                << std::endl;
+      continue;
+    }
+    ever_seen = true;
+    std::string err;
+    if (det::faults::arm_from_spec(spec, &err)) {
+      std::cerr << "agent: armed faults from file: " << spec << std::endl;
+    } else {
+      std::cerr << "agent: bad faults file spec '" << spec << "': " << err
+                << std::endl;
+    }
+  }
 }
 
 void notice_watch_loop(const AgentOptions& opts) {
@@ -1395,6 +1553,9 @@ int main(int argc, char** argv) {
     if (j["metrics_port"].is_number()) {
       opts.metrics_port = static_cast<int>(j["metrics_port"].as_int());
     }
+    if (j["lease_ttl_s"].is_number()) {
+      opts.lease_ttl_s = j["lease_ttl_s"].as_double();
+    }
   }
 
   if (const char* p = getenv("DET_MASTER")) opts.master_url = p;
@@ -1421,6 +1582,9 @@ int main(int argc, char** argv) {
   if (const char* p = getenv("DET_AGENT_GCE_METADATA_URL")) {
     opts.gce_metadata_url = p;
   }
+  if (const char* p = getenv("DET_AGENT_LEASE_TTL_S")) {
+    opts.lease_ttl_s = atof(p);
+  }
 
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
@@ -1441,6 +1605,7 @@ int main(int argc, char** argv) {
     else if (a == "--notice-source") opts.notice_source = next();
     else if (a == "--notice-file") opts.notice_file = next();
     else if (a == "--metrics-port") opts.metrics_port = atoi(next().c_str());
+    else if (a == "--lease-ttl") opts.lease_ttl_s = atof(next().c_str());
     else if (a == "--config") next();
     else if (a == "--help" || a == "-h") {
       std::cout << "determined-agent [--config agent.json] --master-url URL "
@@ -1448,7 +1613,8 @@ int main(int argc, char** argv) {
                    "[--slot-type tpu|cpu] [--preemptible] [--work-root DIR] "
                    "[--token-file PATH] [--term-grace SECONDS] "
                    "[--notice-source gce] [--notice-file PATH] "
-                   "[--metrics-port N  (0 off, -1 ephemeral)]\n";
+                   "[--metrics-port N  (0 off, -1 ephemeral)] "
+                   "[--lease-ttl SECONDS]\n";
       return 0;
     }
   }
@@ -1463,6 +1629,13 @@ int main(int argc, char** argv) {
   // explicitly grants.
   signal(SIGTERM, handle_sigterm);
   det::faults::arm_from_env();  // DET_FAULTS chaos points (docs/chaos.md)
+  if (const char* p = getenv("DET_AGENT_FAULTS_FILE")) {
+    std::thread(faults_file_watch_loop, std::string(p)).detach();
+  }
+  if (opts.lease_ttl_s > 0) {
+    g_lease_ttl = opts.lease_ttl_s;
+    g_lease_ttl_pinned = true;
+  }
 
   // Install the bootstrap credential (env first, then token file), adopt
   // any tasks that survived a previous agent incarnation, then register
@@ -1472,9 +1645,19 @@ int main(int argc, char** argv) {
   agent_login(opts.master_url, /*use_env_token=*/true);
   mkdir(opts.work_root.c_str(), 0755);
   bool adopted = reattach_tasks(opts);
-  while (!register_with_master(opts, adopted)) {
+  // Jittered retry (backoff.h): a whole fleet booting against a master
+  // that isn't up yet must not re-register in lockstep once it is.
+  unsigned boot_seed = static_cast<unsigned>(getpid()) ^
+                       static_cast<unsigned>(
+                           std::chrono::steady_clock::now()
+                               .time_since_epoch()
+                               .count());
+  for (int attempt = 0; !register_with_master(opts, adopted); ++attempt) {
     agent_login(opts.master_url, /*use_env_token=*/true);
-    std::this_thread::sleep_for(std::chrono::seconds(2));
+    double delay =
+        det::backoff::jittered_delay_s(attempt, &boot_seed, 1.0, 10.0);
+    std::this_thread::sleep_for(
+        std::chrono::milliseconds(static_cast<int>(1000 * delay)));
   }
   std::cout << "agent " << opts.id << " registered with " << opts.master_url
             << std::endl;
@@ -1516,6 +1699,15 @@ int main(int argc, char** argv) {
                              "/actions?timeout_seconds=" +
                              std::to_string(opts.poll_timeout_s);
   while (g_running) {
+    if (FAULT_POINT("agent.heartbeat.blackhole") !=
+        det::faults::Action::kNone) {
+      // A partition silences EVERY master-bound channel, and the long-poll
+      // also refreshes master-side last_heartbeat — if it kept running the
+      // master would never start its reclaim clock and the blackhole would
+      // simulate nothing.
+      std::this_thread::sleep_for(std::chrono::milliseconds(500));
+      continue;
+    }
     try {
       auto r = master_call(opts.master_url, "GET", actions_path, "",
                            opts.poll_timeout_s + 10.0);

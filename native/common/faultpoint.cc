@@ -41,6 +41,14 @@ const KnownPoint kKnown[] = {
     {"agent.preempt.notice", "agent",
      "inject a spot/maintenance termination notice once a task is running "
      "(deadline from DET_AGENT_PREEMPT_DEADLINE_S, default 30)"},
+    {"agent.heartbeat.blackhole", "agent",
+     "sustained network partition: drop every heartbeat while armed "
+     "(vs the one-shot agent.heartbeat.drop)"},
+    {"master.lease.expire", "master",
+     "treat every agent lease as already expired on the next sweep"},
+    {"api.write.stale_epoch", "master",
+     "force the stale-epoch 409 fence on state-mutating POSTs that carry "
+     "X-Allocation-Epoch"},
 };
 
 struct FaultState {

@@ -624,6 +624,13 @@ ALTER TABLE deployments ADD COLUMN canary TEXT NOT NULL DEFAULT '';
 ALTER TABLE deployment_replicas ADD COLUMN model_version TEXT NOT NULL DEFAULT '';
 ALTER TABLE deployment_replicas ADD COLUMN canary INTEGER NOT NULL DEFAULT 0;
 )sql"},
+      // Split-brain safety (docs/cluster-ops.md "Leases, fencing &
+      // split-brain"): the fencing epoch an allocation run was minted at
+      // (snapshot of the trial's run_id), persisted so a master restart
+      // restores the fence along with the allocation.
+      {27, R"sql(
+ALTER TABLE allocations ADD COLUMN epoch INTEGER NOT NULL DEFAULT 0;
+)sql"},
   };
   return kMigrations;
 }

@@ -61,6 +61,7 @@ int main(int argc, char** argv) {
     else if (a == "--db") cfg.db_path = next();
     else if (a == "--cluster-name") cfg.cluster_name = next();
     else if (a == "--agent-timeout") cfg.agent_timeout_s = atof(next().c_str());
+    else if (a == "--lease-ttl") cfg.lease_ttl_s = atof(next().c_str());
     else if (a == "--webui-dir") cfg.webui_dir = next();
     else if (a == "--log-retention-days")
       cfg.log_retention_days = atoi(next().c_str());

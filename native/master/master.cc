@@ -137,6 +137,9 @@ MasterConfig MasterConfig::from_json(const Json& j) {
   if (j["agent_timeout_s"].is_number()) {
     c.agent_timeout_s = j["agent_timeout_s"].as_double();
   }
+  if (j["lease_ttl_s"].is_number()) {
+    c.lease_ttl_s = j["lease_ttl_s"].as_double();
+  }
   if (j["webui_dir"].is_string()) c.webui_dir = j["webui_dir"].as_string();
   if (j["log_retention_days"].is_number()) {
     c.log_retention_days = static_cast<int>(j["log_retention_days"].as_int());
@@ -449,7 +452,8 @@ HttpResponse Master::handle(const HttpRequest& req) {
 // re-applying the mutation — a re-sent metric report cannot double-count
 // and a re-sent checkpoint report cannot double-register. Keys are
 // scoped to the authenticated user so one caller can never replay
-// another's response, and swept after 24h (scheduler_loop).
+// another's response, and swept past the max(24h, 2 x lease_ttl_s)
+// horizon (scheduler_loop / idempotency_horizon_seconds).
 HttpResponse Master::route_idempotent(const HttpRequest& req) {
   if (req.method != "POST") return route(req);
   auto it = req.headers.find("x-idempotency-key");
@@ -624,6 +628,24 @@ HttpResponse Master::route(const HttpRequest& req) {
         out["compile_artifacts_evicted"] = sweep_compile_artifacts_locked();
         out["released"] = sweep_context_blobs_locked();
       }
+      return json_resp(200, out);
+    }
+    if (root == "master" && rest.size() == 2 &&
+        rest[1] == "sweep_idempotency" && req.method == "POST") {
+      // Manual idempotency-replay sweep (the hourly sweep's admin
+      // trigger). The horizon is pinned to the lease TTL: a replay entry
+      // must outlive the longest lease, or a fenced-then-retried POST
+      // could replay as fresh after its fence window closed.
+      if (!auth_ctx(req).admin) {
+        return json_resp(403, err_body("admin role required"));
+      }
+      int64_t horizon_s = idempotency_horizon_seconds();
+      Json out = Json::object();
+      out["deleted"] = db_.exec(
+          "DELETE FROM idempotency_keys WHERE created_at < "
+          "datetime('now', ?)",
+          {Json("-" + std::to_string(horizon_s) + " seconds")});
+      out["horizon_seconds"] = horizon_s;
       return json_resp(200, out);
     }
     if (root == "debug") return handle_debug(req, rest);
@@ -1236,7 +1258,18 @@ HttpResponse Master::handle_prometheus_metrics() {
       << fleet_.model_versions_registered.load() << "\n"
       << "# TYPE det_provisioner_create_failures_total counter\n"
       << "det_provisioner_create_failures_total "
-      << (provisioner_ ? provisioner_->create_failures_total() : 0) << "\n";
+      << (provisioner_ ? provisioner_->create_failures_total() : 0) << "\n"
+      << "# TYPE det_lease_expirations_total counter\n"
+      << "det_lease_expirations_total " << fleet_.lease_expirations.load()
+      << "\n";
+  {
+    std::lock_guard<std::mutex> lock(fence_stats_.mu);
+    out << "# TYPE det_fenced_writes_total counter\n";
+    for (const auto& [route, n] : fence_stats_.by_route) {
+      out << "det_fenced_writes_total{route=\"" << route << "\"} " << n
+          << "\n";
+    }
+  }
   {
     std::lock_guard<std::mutex> lock(api_stats_.mu);
     out << "# TYPE det_api_requests_total counter\n";
@@ -1255,6 +1288,55 @@ HttpResponse Master::handle_prometheus_metrics() {
   r.content_type = "text/plain; version=0.0.4";
   r.body = out.str();
   return r;
+}
+
+int64_t Master::idempotency_horizon_seconds() const {
+  return std::max<int64_t>(86400,
+                           static_cast<int64_t>(2 * cfg_.lease_ttl_s));
+}
+
+void Master::count_fenced_write(const std::string& route) {
+  std::lock_guard<std::mutex> lock(fence_stats_.mu);
+  fence_stats_.by_route[route]++;
+}
+
+// X-Allocation-Epoch fence (docs/cluster-ops.md "Leases, fencing &
+// split-brain"): a zombie writer — a task the master already reassigned —
+// carries the epoch of its dead run; its current trial run_id has moved
+// past it. Absent header = legacy/CLI/unmanaged caller, accepted as
+// before. Called with mu_ released; takes it briefly for the lookup.
+bool Master::fence_stale_epoch(const HttpRequest& req, int64_t trial_id,
+                               const std::string& route,
+                               HttpResponse* resp) {
+  auto hdr = req.headers.find("x-allocation-epoch");
+  if (hdr == req.headers.end()) return false;
+  int64_t claimed = to_id(hdr->second);
+  int64_t current = -1;
+  bool stale = false;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    ExperimentState* exp = nullptr;
+    TrialState* trial = find_trial_locked(trial_id, &exp);
+    if (trial != nullptr) {
+      current = trial->run_id;
+      stale = claimed < current;
+    }
+  }
+  // The fault forces the stale branch for any epoch-carrying write —
+  // including trials with no in-memory state (unmanaged), which is how
+  // the chaos suite drives the fence without a real reassignment.
+  if (FAULT_POINT("api.write.stale_epoch") != faults::Action::kNone) {
+    stale = true;
+  }
+  if (!stale) return false;
+  count_fenced_write(route);
+  Json body = err_body("stale allocation epoch: writer was fenced");
+  body["fenced"] = true;
+  body["route"] = route;
+  body["claimed_epoch"] = claimed;
+  body["current_epoch"] = current;
+  *resp = json_resp(409, body);
+  return true;
 }
 
 HttpResponse Master::handle_master_info(const HttpRequest& req) {

@@ -68,6 +68,10 @@ HttpResponse Master::handle_agents_api(const HttpRequest& req,
            Json(a.draining && a.drain_deadline > 0
                     ? std::max(0.0, a.drain_deadline - now())
                     : 0.0)},
+          {"lease_remaining_seconds",
+           Json(a.lease_expiry > 0 ? std::max(0.0, a.lease_expiry - now())
+                                   : 0.0)},
+          {"lease_expired", Json(a.lease_expired_counted)},
           {"slots", slots},
       }));
     }
@@ -112,6 +116,9 @@ HttpResponse Master::handle_agents_api(const HttpRequest& req,
     a.preemptible = body["preemptible"].as_bool(a.preemptible);
     a.last_heartbeat = now();
     a.alive = true;
+    // A (re)register renews the ownership lease like a heartbeat does.
+    a.lease_expiry = now() + cfg_.lease_ttl_s;
+    a.lease_expired_counted = false;
     if (fresh) {
       // A fresh boot is a new (or survived) machine: any spot/maintenance
       // notice that applied to the previous incarnation is moot.
@@ -155,6 +162,7 @@ HttpResponse Master::handle_agents_api(const HttpRequest& req,
     out["agent_id"] = id;
     out["keep_allocations"] = keep;
     out["master_time"] = now();
+    out["lease_ttl_s"] = cfg_.lease_ttl_s;
     return json_resp(200, out);
   }
 
@@ -245,6 +253,12 @@ HttpResponse Master::handle_agents_api(const HttpRequest& req,
     }
     it->second.last_heartbeat = now();
     it->second.alive = true;
+    // Heartbeat = lease renewal (docs/cluster-ops.md "Leases, fencing &
+    // split-brain"). The actions long-poll deliberately does NOT renew:
+    // the lease tracks the heartbeat channel alone, so a partition that
+    // silences heartbeats expires the lease even if a long-poll lingers.
+    it->second.lease_expiry = now() + cfg_.lease_ttl_s;
+    it->second.lease_expired_counted = false;
     // Reconcile: agent-side allocations the master no longer tracks → kill;
     // RESTORED resources the agent claims as running → re-adopted.
     Json kill = Json::array();
@@ -275,6 +289,7 @@ HttpResponse Master::handle_agents_api(const HttpRequest& req,
     if (reclaimed) cv_.notify_all();
     Json out = Json::object();
     out["kill_allocations"] = kill;
+    out["lease_ttl_s"] = cfg_.lease_ttl_s;
     return json_resp(200, out);
   }
 
@@ -385,10 +400,16 @@ void Master::scheduler_loop() {
           "DELETE FROM user_sessions WHERE expires_at IS NOT NULL AND "
           "expires_at < datetime('now')");
       // Idempotency keys outlive any plausible client retry window long
-      // before 24h.
+      // before 24h — and must also outlive the longest lease (2 ×
+      // lease_ttl_s floor), or a fenced-then-retried POST whose first
+      // attempt was recorded before the partition could replay as fresh
+      // after the sweep (docs/cluster-ops.md "Leases, fencing &
+      // split-brain").
       db_.exec(
           "DELETE FROM idempotency_keys WHERE created_at < "
-          "datetime('now', '-1 day')");
+          "datetime('now', ?)",
+          {Json("-" + std::to_string(idempotency_horizon_seconds()) +
+                " seconds")});
       // Request traces are an operational ring, not an archive: a day of
       // "why was THIS request slow" is plenty, and the table would
       // otherwise grow with every routed generation.
@@ -487,6 +508,23 @@ void Master::check_agents_locked() {
       std::cerr << "master: allocation " << aid
                 << " lost to lapsed drain deadline on " << id << std::endl;
       if (all_exited) on_allocation_exit_locked(alloc);
+    }
+  }
+  // Ownership-lease accounting (docs/cluster-ops.md "Leases, fencing &
+  // split-brain"): a lease that lapsed without renewal is counted once.
+  // The agent is expected to have self-terminated its tasks already —
+  // reclaim (sweep_dead_agents_locked at agent_timeout_s) and the epoch
+  // fence are the backstops, so nothing is killed here.
+  bool force_expire =
+      FAULT_POINT("master.lease.expire") != faults::Action::kNone;
+  for (auto& [id, a] : agents_) {
+    if (a.lease_expiry <= 0 || a.lease_expired_counted) continue;
+    if (t >= a.lease_expiry || force_expire) {
+      a.lease_expired_counted = true;
+      fleet_.lease_expirations.fetch_add(1);
+      std::cerr << "master: agent " << id << " lease expired ("
+                << cfg_.lease_ttl_s << "s TTL); expecting self-fence"
+                << std::endl;
     }
   }
   // Backend upkeep: dead-agent sweep (agent RM) / pod reconcile (k8s RM).
@@ -1083,6 +1121,10 @@ Json Master::build_task_env_locked(Allocation& alloc,
     if (!csig.empty()) env["DET_COMPILE_SIGNATURE"] = csig;
     env["DET_TRIAL_REQUEST_ID"] = trial->request_id;
     env["DET_TRIAL_RUN_ID"] = trial->run_id;
+    // Fencing epoch: the harness echoes this back as X-Allocation-Epoch
+    // on every state-mutating POST; a reassigned trial's zombie presents
+    // the old value and is 409-fenced.
+    env["DET_ALLOCATION_EPOCH"] = alloc.epoch;
     env["DET_TRIAL_SEED"] = trial->seed;
     env["DET_HPARAMS"] = trial->hparams.dump();
     env["DET_STEPS_COMPLETED"] = trial->steps_completed;

@@ -604,6 +604,8 @@ HttpResponse Master::handle_trials(const HttpRequest& req,
     auto trows = db_.query("SELECT trace_id FROM trials WHERE id=?",
                            {Json(tid)});
     if (trows.empty()) return json_resp(404, err_body("no such trial"));
+    HttpResponse fenced;
+    if (fence_stale_epoch(req, tid, "spans", &fenced)) return fenced;
     Json body = Json::parse_or_null(req.body);
     if (!body["spans"].is_array()) {
       return json_resp(400, err_body("spans array required"));
@@ -708,6 +710,8 @@ HttpResponse Master::handle_trials(const HttpRequest& req,
   if (parts.size() == 4 && parts[2] == "searcher" &&
       parts[3] == "completed_operation" && req.method == "POST") {
     Json body = Json::parse(req.body);
+    HttpResponse fenced;
+    if (fence_stale_epoch(req, tid, "searcher", &fenced)) return fenced;
     std::lock_guard<std::mutex> lock(mu_);
     ExperimentState* exp = nullptr;
     TrialState* trial = find_trial_locked(tid, &exp);
@@ -743,6 +747,8 @@ HttpResponse Master::handle_trials(const HttpRequest& req,
     // incrementally ON REPORT, so list views and the WebUI read
     // trials.summary_metrics instead of scanning raw_metrics).
     int64_t run_id = body["trial_run_id"].as_int(0);
+    HttpResponse fenced;
+    if (fence_stale_epoch(req, tid, "metrics", &fenced)) return fenced;
     db_.tx([&] {
       db_.exec(
           "INSERT INTO raw_metrics (trial_id, trial_run_id, group_name, "
@@ -959,6 +965,20 @@ HttpResponse Master::handle_allocations(const HttpRequest& req,
     Json body = Json::parse(req.body);
     std::string reason = body["reason"].as_string("");
     if (reason.empty()) return json_resp(400, err_body("reason required"));
+    // Fence before the write: the allocation row resolves the trial whose
+    // current run_id the header must match.
+    int64_t fence_tid = -1;
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      auto it = allocations_.find(aid);
+      if (it != allocations_.end()) fence_tid = it->second.trial_id;
+    }
+    if (fence_tid >= 0) {
+      HttpResponse fenced;
+      if (fence_stale_epoch(req, fence_tid, "exit_reason", &fenced)) {
+        return fenced;
+      }
+    }
     db_.exec("UPDATE allocations SET exit_reason=? WHERE id=?",
              {Json(reason), Json(aid)});
     std::lock_guard<std::mutex> lock(mu_);
@@ -1198,6 +1218,20 @@ HttpResponse Master::handle_checkpoints(const HttpRequest& req,
     std::string state = body["state"].as_string("COMPLETED");
     if (state != "COMPLETED" && state != "PARTIAL") {
       return json_resp(400, err_body("state must be COMPLETED or PARTIAL"));
+    }
+    // Epoch fence (docs/cluster-ops.md "Leases, fencing & split-brain"):
+    // a zombie's COMMIT must never advance latest_checkpoint, and its
+    // earlier PARTIAL must not linger as a torso — sweep it. The
+    // survivor's lineage is untouched (its saves use different uuids).
+    if (trial_id >= 0) {
+      HttpResponse fenced;
+      if (fence_stale_epoch(req, trial_id, "checkpoints", &fenced)) {
+        db_.exec(
+            "DELETE FROM checkpoints WHERE uuid=? AND trial_id=? AND "
+            "state='PARTIAL'",
+            {Json(uuid), Json(trial_id)});
+        return fenced;
+      }
     }
     db_.exec(
         "INSERT OR REPLACE INTO checkpoints (uuid, task_id, allocation_id, "

@@ -1339,9 +1339,24 @@ HttpResponse Master::handle_serve_stats(const HttpRequest& req,
   // Model-version confirmation (docs/serving.md "Model lifecycle"): the
   // replica echoes the version it actually serves (DET_MODEL_VERSION).
   // Spawn-time state is authoritative; the heartbeat only fills a blank
-  // (a replica adopted before the lifecycle columns existed).
-  if (r.model_version.empty() && body["model_version"].is_string()) {
-    r.model_version = body["model_version"].as_string();
+  // (a replica adopted before the lifecycle columns existed). An echo
+  // that CONTRADICTS the spawn-time label is a zombie from before a
+  // PR-14 swap replaced this task id — fence it like a stale-epoch
+  // write (docs/cluster-ops.md "Leases, fencing & split-brain").
+  // Comparing against dep.model_version instead would wrongly fence
+  // canary replicas, whose label differs by design.
+  if (body["model_version"].is_string()) {
+    const std::string echoed = body["model_version"].as_string();
+    if (r.model_version.empty()) {
+      r.model_version = echoed;
+    } else if (!echoed.empty() && echoed != r.model_version) {
+      count_fenced_write("serve_stats");
+      Json err = err_body("stale model version: replica was swapped");
+      err["fenced"] = true;
+      err["echoed_version"] = echoed;
+      err["expected_version"] = r.model_version;
+      return json_resp(409, err);
+    }
   }
   db_.exec(
       "UPDATE deployment_replicas SET state='ACTIVE' WHERE deployment_id=? "

@@ -563,15 +563,20 @@ void Master::request_allocation_locked(ExperimentState& exp,
   alloc.submitted_wall_us = trace::now_us();
   alloc.owner_id = exp.owner_id;
   alloc.excluded_agents = trial.excluded_agents;  // exclude_node policies
+  // Fencing epoch: snapshot the run_id this allocation run serves. Every
+  // requeue path bumps run_id first, so a zombie from the previous run
+  // presents an older epoch and gets the 409 fence.
+  alloc.epoch = trial.run_id;
   // A re-allocation after a container exit is a requeue the fleet
   // dashboards should see (spot churn / restart pressure).
   if (trial.run_id > 0) fleet_.requeues.fetch_add(1);
   trial.allocation_id = alloc.id;
   db_.exec(
-      "INSERT INTO allocations (id, task_id, trial_id, resource_pool, slots) "
-      "VALUES (?, ?, ?, ?, ?)",
+      "INSERT INTO allocations (id, task_id, trial_id, resource_pool, "
+      "slots, epoch) VALUES (?, ?, ?, ?, ?, ?)",
       {Json(alloc.id), Json(alloc.task_id), Json(trial.id),
-       Json(alloc.resource_pool), Json(static_cast<int64_t>(alloc.slots))});
+       Json(alloc.resource_pool), Json(static_cast<int64_t>(alloc.slots)),
+       Json(alloc.epoch)});
   std::string aid = alloc.id;
   allocations_[aid] = std::move(alloc);
   pending_.push_back(aid);
@@ -606,13 +611,18 @@ void Master::resize_allocation_locked(Allocation& alloc,
   // emergency checkpoint; run_id distinguishes its metric reports. The
   // move was elastic, not a failure: restarts stays where it was.
   trial.run_id += 1;
+  // The resized run is a new epoch on the SAME allocation row: any
+  // straggler process from the pre-resize mesh is fenced like any other
+  // zombie writer.
+  alloc.epoch = trial.run_id;
   db_.tx([&] {
     db_.exec("UPDATE trials SET run_id=? WHERE id=?",
              {Json(trial.run_id), Json(trial.id)});
     db_.exec(
         "UPDATE allocations SET state='PENDING', slots=?, resources='[]', "
-        "agent_id=NULL WHERE id=?",
-        {Json(static_cast<int64_t>(to)), Json(alloc.id)});
+        "agent_id=NULL, epoch=? WHERE id=?",
+        {Json(static_cast<int64_t>(to)), Json(alloc.epoch),
+         Json(alloc.id)});
     db_.exec(
         "INSERT INTO allocation_size_history (allocation_id, trial_id, "
         "from_slots, to_slots, reason) VALUES (?, ?, ?, ?, ?)",
@@ -1081,8 +1091,8 @@ void Master::restore_allocations_locked() {
   // the DB-vs-heartbeat reconciliation: orphans get killed by their
   // agent's reconcile (unknown → kill), live runs are re-adopted.
   auto rows = db_.query(
-      "SELECT id, task_id, trial_id, resource_pool, slots, resources "
-      "FROM allocations WHERE end_time IS NULL AND "
+      "SELECT id, task_id, trial_id, resource_pool, slots, resources, "
+      "epoch FROM allocations WHERE end_time IS NULL AND "
       "state IN ('ASSIGNED', 'RUNNING')");
   double deadline = now() + std::max(cfg_.agent_timeout_s, 15.0);
   for (auto& row : rows) {
@@ -1092,6 +1102,7 @@ void Master::restore_allocations_locked() {
     alloc.trial_id = row["trial_id"].as_int(-1);
     alloc.resource_pool = row["resource_pool"].as_string(cfg_.default_pool);
     alloc.slots = static_cast<int>(row["slots"].as_int(0));
+    alloc.epoch = row["epoch"].as_int(0);
     alloc.submitted_at = now();
     alloc.state = "RUNNING";
     alloc.restored_deadline = deadline;

@@ -904,7 +904,7 @@ async function pageCluster() {
   view.textContent = "";
   view.append(el("h1", {}, "Cluster"));
   view.append(el("table", {},
-    el("tr", {}, ["Agent", "Pool", "Class", "Address", "Alive", "State", "Slots (chips)"]
+    el("tr", {}, ["Agent", "Pool", "Class", "Address", "Alive", "Lease", "State", "Slots (chips)"]
       .map((h) => el("th", {}, h))),
     agents.map((a) => el("tr", {},
       el("td", {}, a.id),
@@ -916,6 +916,14 @@ async function pageCluster() {
         : "on-demand"),
       el("td", { class: "muted" }, a.addr),
       el("td", {}, a.alive ? "yes" : "no"),
+      // Ownership lease (docs/cluster-ops.md "Leases, fencing &
+      // split-brain"): time until the master counts this agent's lease
+      // lapsed and expects its tasks self-fenced.
+      el("td", a.lease_expired
+        ? { class: "muted", title: "lease lapsed; agent should have self-fenced its tasks" }
+        : {},
+        a.lease_expired ? "expired"
+          : `${Math.max(0, a.lease_remaining_seconds ?? 0).toFixed(0)}s`),
       el("td", a.state === "DRAINING" ? { title: a.drain_reason } : {},
         a.state === "DRAINING" ? `draining (${a.drain_reason})`
           : (a.state || "ENABLED").toLowerCase()),
