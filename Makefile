@@ -5,12 +5,18 @@ export JAX_PLATFORMS ?= cpu
 
 .PHONY: lint test chaos bench-input bench-serve bench-serve-fleet bench-lifecycle bench-capacity bench-trace bench-compile native native-test clean
 
-# The dogfood gate (docs/preflight.md): the platform's own models and
-# examples must pass the platform's own static analyzer. Fails on any
-# unsuppressed DTL finding; suppressions are in-line `# det: noqa[DTLnnn]`
-# comments so they stay reviewable.
+# The dogfood gate (docs/preflight.md + docs/static-analysis.md): one
+# aggregate. The Python pass runs the DTL tree lint over the platform's
+# own code, metric_lint (metric/span registry drift), and native_lint
+# (native locking conventions, fault-point registry ↔ docs/chaos.md,
+# REST routes ↔ OpenAPI). The native pass is the clang -Wthread-safety
+# compile gate — `make -C native tsa` detects the compiler and skips
+# with a notice when no thread-safety-capable clang is installed.
+# Fails on any unsuppressed DTL finding; suppressions are in-line
+# `# det: noqa[DTLnnn]` comments so they stay reviewable.
 lint:
 	$(PY) -m determined_tpu.analysis determined_tpu examples
+	$(MAKE) -C native tsa
 
 test:
 	$(PY) -m pytest tests/ -q -m 'not slow'

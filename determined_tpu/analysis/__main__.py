@@ -24,6 +24,9 @@ def main(argv=None) -> int:
     p.add_argument("--json", action="store_true", dest="as_json")
     p.add_argument("--no-metric-lint", action="store_true",
                    help="skip the metric/span name registry check")
+    p.add_argument("--no-native-lint", action="store_true",
+                   help="skip the native locking-convention / registry "
+                        "check (docs/static-analysis.md)")
     args = p.parse_args(argv)
 
     diags = astlint.lint_paths(args.paths or ["determined_tpu", "examples"])
@@ -38,6 +41,17 @@ def main(argv=None) -> int:
         metric_problems = metric_lint.lint_registry()
         for prob in metric_problems:
             print(f"metric-lint: {prob}")
+
+    # Native locking conventions + cross-language registries
+    # (docs/static-analysis.md): the textual half of the thread-safety
+    # gate — `make -C native tsa` is the compile-time half.
+    native_problems = []
+    if not args.as_json and not args.no_native_lint:
+        from determined_tpu.analysis import native_lint
+
+        native_problems = native_lint.lint_native()
+        for prob in native_problems:
+            print(f"native-lint: {prob}")
     if args.as_json:
         print(json.dumps([d.to_dict() for d in diags], indent=2))
     else:
@@ -47,9 +61,13 @@ def main(argv=None) -> int:
                 tag += " (suppressed)"
             print(f"{d.location()}: {tag}: {d.message}")
         n_sup = len(diags) - len(active)
+        from determined_tpu.analysis import native_lint as _nl
+
         print(f"lint: {len(active)} finding(s), {n_sup} suppressed; "
-              f"metric-lint: {len(metric_problems)} finding(s)")
-    return 1 if active or metric_problems else 0
+              f"metric-lint: {len(metric_problems)} finding(s); "
+              f"native-lint: {len(native_problems)} finding(s), "
+              f"{_nl.tsa_escape_count()}/{_nl.MAX_TSA_ESCAPES} tsa escapes")
+    return 1 if active or metric_problems or native_problems else 0
 
 
 if __name__ == "__main__":

@@ -316,6 +316,72 @@ class _RuleWalker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+def _thread_stop_findings(tree: ast.Module) -> List[Tuple[str, int, str]]:
+    """DTL106 — `_stop` shadowing on threading.Thread subclasses.
+
+    CPython's Thread keeps a private `_stop()` method that `join()` /
+    `_wait_for_tstate_lock()` call when the thread finishes.  A subclass
+    that rebinds `_stop` to an Event (the classic pre-3.x stop-flag idiom)
+    crashes with `TypeError: 'Event' object is not callable` at thread
+    exit; rebinding it to a method silently skips Thread's own state
+    bookkeeping.  Same-module subclass-of-subclass counts too.
+    """
+    classes = [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]
+    thread_classes: Set[str] = set()
+    changed = True
+    while changed:  # fixed point over same-module subclassing
+        changed = False
+        for cls in classes:
+            if cls.name in thread_classes:
+                continue
+            for b in cls.bases:
+                base = (_dotted(b) or "").split(".")[-1]
+                if base == "Thread" or base in thread_classes:
+                    thread_classes.add(cls.name)
+                    changed = True
+                    break
+
+    findings: List[Tuple[str, int, str]] = []
+
+    def _flag(node: ast.AST, cls: ast.ClassDef, what: str) -> None:
+        findings.append((
+            "DTL106", getattr(node, "lineno", 0),
+            f"Thread subclass '{cls.name}' defines {what} named '_stop', "
+            "shadowing threading.Thread._stop() (called by join() on "
+            "thread exit); rename it to '_stop_evt'"))
+
+    for cls in classes:
+        if cls.name not in thread_classes:
+            continue
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if stmt.name == "_stop":
+                    _flag(stmt, cls, "a method")
+                    continue
+                # self._stop = ... inside any method body.
+                for n in ast.walk(stmt):
+                    targets: List[ast.AST] = []
+                    if isinstance(n, ast.Assign):
+                        targets = list(n.targets)
+                    elif isinstance(n, (ast.AnnAssign, ast.AugAssign)):
+                        targets = [n.target]
+                    for t in targets:
+                        if isinstance(t, ast.Attribute) and \
+                                t.attr == "_stop" and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == "self":
+                            _flag(n, cls, "an instance attribute")
+            elif isinstance(stmt, ast.Assign):
+                if any(isinstance(t, ast.Name) and t.id == "_stop"
+                       for t in stmt.targets):
+                    _flag(stmt, cls, "a class attribute")
+            elif isinstance(stmt, ast.AnnAssign):
+                if isinstance(stmt.target, ast.Name) and \
+                        stmt.target.id == "_stop":
+                    _flag(stmt, cls, "a class attribute")
+    return findings
+
+
 _JNP_HEADS = {"jnp", "jax.numpy"}
 
 
@@ -401,6 +467,8 @@ def lint_source(
         for stmt in index.functions[qual].body:
             dl_walker.visit(stmt)
         _emit(dl_walker.findings)
+    # DTL106 applies to every Thread subclass in the module, traced or not.
+    _emit(_thread_stop_findings(tree))
     return diags
 
 

@@ -78,6 +78,14 @@ _ALL = [
          "pipeline already shards and device_puts batches with the mesh "
          "batch sharding, so the loader's transfer is paid twice — yield "
          "host (numpy) batches, or disable prefetch for this trial"),
+    Rule("DTL106", "thread-stop-shadowing", "error", "ast",
+         "a threading.Thread subclass defines an attribute, Event or method "
+         "named `_stop`: CPython's Thread uses self._stop() internally "
+         "(join / _wait_for_tstate_lock call it on thread exit), so "
+         "shadowing it with an Event raises `TypeError: 'Event' object is "
+         "not callable` when the thread finishes — name the flag "
+         "`_stop_evt` (the convention used by core/_profiler.py and "
+         "core/_preempt.py) instead"),
     # -- config cross-field checks --------------------------------------
     Rule("DTL201", "config-batch-mesh-mismatch", "error", "config",
          "hyperparameters.global_batch_size is not divisible by the mesh's "

@@ -39,6 +39,7 @@
 #include "../common/faultpoint.h"
 #include "../common/http.h"
 #include "../common/json.h"
+#include "../common/mutex.h"
 #include "../common/trace.h"
 #include "backoff.h"
 
@@ -131,8 +132,10 @@ struct Task {
   bool tails_spawned = false;
 };
 
-std::mutex g_mu;
-std::map<std::string, std::shared_ptr<Task>> g_tasks;  // by container_id
+det::Mutex g_mu;
+// by container_id; the shared_ptr pins a Task across a supervise thread's
+// lifetime — per-task mutable fields are atomics (Task definition above).
+std::map<std::string, std::shared_ptr<Task>> g_tasks GUARDED_BY(g_mu);
 
 // Observability state for /metrics (docs/observability.md).
 std::atomic<bool> g_draining{false};  // termination notice posted
@@ -175,7 +178,7 @@ std::atomic<bool> g_sigterm{false};
 void handle_sigterm(int) { g_sigterm.store(true); }
 
 bool has_running_tasks() {
-  std::lock_guard<std::mutex> lock(g_mu);
+  det::MutexLock lock(g_mu);
   for (const auto& [cid, t] : g_tasks) {
     if (!t->exited) return true;
   }
@@ -188,16 +191,18 @@ bool has_running_tasks() {
 // re-logins transparently on 401 (e.g. after a master restart wiped
 // sessions).
 
-std::mutex g_token_mu;
-std::string g_token;
+det::Mutex g_token_mu;
+std::string g_token GUARDED_BY(g_token_mu);
 
 std::map<std::string, std::string> auth_headers() {
-  std::lock_guard<std::mutex> lock(g_token_mu);
+  det::MutexLock lock(g_token_mu);
   if (g_token.empty()) return {};
   return {{"Authorization", "Bearer " + g_token}};
 }
 
-std::string g_token_file;  // set from options at startup
+// not-guarded: written once by option parsing before any thread starts,
+// read-only afterwards (agent_login re-reads the FILE, not this path).
+std::string g_token_file;
 
 bool agent_login(const std::string& master_url, bool use_env_token = true) {
   // The service account is token-only: DET_AGENT_TOKEN env, or the
@@ -209,7 +214,7 @@ bool agent_login(const std::string& master_url, bool use_env_token = true) {
   (void)master_url;
   if (use_env_token) {
     if (const char* t = getenv("DET_AGENT_TOKEN")) {
-      std::lock_guard<std::mutex> lock(g_token_mu);
+      det::MutexLock lock(g_token_mu);
       g_token = t;
       return true;
     }
@@ -218,7 +223,7 @@ bool agent_login(const std::string& master_url, bool use_env_token = true) {
     std::ifstream f(g_token_file);
     std::string tok;
     if (f && std::getline(f, tok) && !tok.empty()) {
-      std::lock_guard<std::mutex> lock(g_token_mu);
+      det::MutexLock lock(g_token_mu);
       if (g_token == tok && !use_env_token) return false;  // already stale
       g_token = tok;
       return true;
@@ -245,13 +250,13 @@ HttpClientResponse master_call(const std::string& master_url,
 struct LogEntry {
   Json entry;
 };
-std::mutex g_log_mu;
+det::Mutex g_log_mu;
 std::condition_variable g_log_cv;
-std::deque<Json> g_log_queue;
+std::deque<Json> g_log_queue GUARDED_BY(g_log_mu);
 // Undelivered line count per task id (queued + in-flight). Exit reporting
 // waits for THIS task's count to hit zero — completion implies logs
 // durable, and an unrelated chatty task can't stall the drain.
-std::map<std::string, long> g_log_pending;
+std::map<std::string, long> g_log_pending GUARDED_BY(g_log_mu);
 std::atomic<bool> g_running{true};
 
 void enqueue_log(const std::string& task_id, const std::string& alloc_id,
@@ -268,7 +273,7 @@ void enqueue_log(const std::string& task_id, const std::string& alloc_id,
   e["source"] = "task";
   e["level"] = stdtype == "stderr" ? "ERROR" : "INFO";
   e["log"] = line;
-  std::lock_guard<std::mutex> lock(g_log_mu);
+  det::MutexLock lock(g_log_mu);
   ++g_log_pending[task_id];
   g_log_queue.push_back(std::move(e));
   g_log_cv.notify_one();
@@ -276,7 +281,8 @@ void enqueue_log(const std::string& task_id, const std::string& alloc_id,
 
 // Called with g_log_mu held: account a batch's lines as delivered (or
 // dropped) and wake drain waiters.
-void settle_batch_locked(const std::vector<Json>& batch) {
+void settle_batch_locked(const std::vector<Json>& batch)
+    REQUIRES(g_log_mu) {
   for (const auto& e : batch) {
     auto it = g_log_pending.find(e["task_id"].as_string());
     if (it != g_log_pending.end() && --it->second <= 0) {
@@ -289,9 +295,11 @@ void shipper_loop(const AgentOptions& opts) {
   while (g_running) {
     std::vector<Json> batch;
     {
-      std::unique_lock<std::mutex> lock(g_log_mu);
-      g_log_cv.wait_for(lock, std::chrono::milliseconds(500),
-                        [] { return !g_log_queue.empty() || !g_running; });
+      det::MutexLock lock(g_log_mu);
+      g_log_cv.wait_for(lock.native(), std::chrono::milliseconds(500), [] {
+        g_log_mu.AssertHeld();
+        return !g_log_queue.empty() || !g_running;
+      });
       while (!g_log_queue.empty() && batch.size() < 500) {
         batch.push_back(std::move(g_log_queue.front()));
         g_log_queue.pop_front();
@@ -322,7 +330,7 @@ void shipper_loop(const AgentOptions& opts) {
       std::this_thread::sleep_for(std::chrono::seconds(1));
     }
     if (delivered || poisoned) {
-      std::lock_guard<std::mutex> lock(g_log_mu);
+      det::MutexLock lock(g_log_mu);
       settle_batch_locked(batch);
       g_log_cv.notify_all();
       continue;
@@ -332,7 +340,7 @@ void shipper_loop(const AgentOptions& opts) {
     // the FRONT (order-preserving) and let the loop retry; the exit
     // report's own retry loop waits behind the same master.
     {
-      std::lock_guard<std::mutex> lock(g_log_mu);
+      det::MutexLock lock(g_log_mu);
       for (auto it = batch.rbegin(); it != batch.rend(); ++it) {
         g_log_queue.push_front(std::move(*it));
       }
@@ -356,8 +364,9 @@ void drain_task_logs(std::shared_ptr<Task> task) {
          std::chrono::steady_clock::now() < deadline) {
     std::this_thread::sleep_for(std::chrono::milliseconds(50));
   }
-  std::unique_lock<std::mutex> lock(g_log_mu);
-  g_log_cv.wait_until(lock, deadline, [&task] {
+  det::MutexLock lock(g_log_mu);
+  g_log_cv.wait_until(lock.native(), deadline, [&task] {
+    g_log_mu.AssertHeld();
     return g_log_pending.find(task->task_id) == g_log_pending.end() ||
            !g_running;
   });
@@ -478,12 +487,14 @@ long long pid_starttime(pid_t pid) {
 // tasks that survived it (reference containers/manager.go:76
 // ReattachContainers).
 
-std::mutex g_registry_mu;  // one writer at a time for running.json
+det::Mutex g_registry_mu;  // one writer at a time for running.json
+// (serializes a temp-file+rename sequence, not a data field — nothing
+// is GUARDED_BY it)
 
 void persist_registry(const AgentOptions& opts) {
   Json arr = Json::array();
   {
-    std::lock_guard<std::mutex> lock(g_mu);
+    det::MutexLock lock(g_mu);
     for (const auto& [cid, t] : g_tasks) {
       JsonObject e{
           {"container_id", Json(t->container_id)},
@@ -505,7 +516,7 @@ void persist_registry(const AgentOptions& opts) {
   }
   // Serialize the write+rename: concurrent exiting tasks must not
   // interleave into a corrupt file.
-  std::lock_guard<std::mutex> lock(g_registry_mu);
+  det::MutexLock lock(g_registry_mu);
   std::string path = opts.work_root + "/running.json";
   std::string tmp = path + ".tmp";
   std::ofstream f(tmp, std::ios::trunc);
@@ -521,7 +532,7 @@ void registry_flusher(const AgentOptions& opts) {
     std::this_thread::sleep_for(std::chrono::seconds(2));
     bool any;
     {
-      std::lock_guard<std::mutex> lock(g_mu);
+      det::MutexLock lock(g_mu);
       any = !g_tasks.empty();
     }
     if (any) persist_registry(opts);
@@ -630,7 +641,7 @@ void finish_task(const AgentOptions& opts, std::shared_ptr<Task> task,
     std::this_thread::sleep_for(std::chrono::seconds(2));
   }
   {
-    std::lock_guard<std::mutex> lock(g_mu);
+    det::MutexLock lock(g_mu);
     g_tasks.erase(task->container_id);
   }
   persist_registry(opts);
@@ -924,7 +935,7 @@ void start_task(const AgentOptions& opts, const Json& action) {
   std::cerr << "agent: started " << task->container_id << " pid=" << pid
             << " workdir=" << workdir << std::endl;
   {
-    std::lock_guard<std::mutex> lock(g_mu);
+    det::MutexLock lock(g_mu);
     g_tasks[task->container_id] = task;
   }
   persist_registry(opts);
@@ -993,7 +1004,7 @@ bool reattach_tasks(const AgentOptions& opts) {
       // still be booting).
       int code = static_cast<int>(e["exit_code"].as_int());
       {
-        std::lock_guard<std::mutex> lock(g_mu);
+        det::MutexLock lock(g_mu);
         g_tasks[task->container_id] = task;
       }
       std::thread([task, opts, code] { finish_task(opts, task, code); })
@@ -1009,7 +1020,7 @@ bool reattach_tasks(const AgentOptions& opts) {
       std::cerr << "agent: reattached " << task->container_id << " pid="
                 << task->pid << std::endl;
       {
-        std::lock_guard<std::mutex> lock(g_mu);
+        det::MutexLock lock(g_mu);
         g_tasks[task->container_id] = task;
       }
       supervise(opts, task);
@@ -1024,7 +1035,7 @@ bool reattach_tasks(const AgentOptions& opts) {
                 << " died while we were down" << std::endl;
       int code = read_status_file(task->workdir, 0.5);
       {
-        std::lock_guard<std::mutex> lock(g_mu);
+        det::MutexLock lock(g_mu);
         g_tasks[task->container_id] = task;
       }
       // Ship whatever the dead task wrote after our previous incarnation's
@@ -1048,7 +1059,7 @@ bool reattach_tasks(const AgentOptions& opts) {
 void kill_allocation(const std::string& alloc_id) {
   std::vector<std::shared_ptr<Task>> victims;
   {
-    std::lock_guard<std::mutex> lock(g_mu);
+    det::MutexLock lock(g_mu);
     for (auto& [cid, t] : g_tasks) {
       if (t->allocation_id == alloc_id) victims.push_back(t);
     }
@@ -1104,7 +1115,7 @@ bool register_with_master(const AgentOptions& opts, bool reconnect) {
     }
     std::vector<std::string> to_kill;
     {
-      std::lock_guard<std::mutex> lock(g_mu);
+      det::MutexLock lock(g_mu);
       for (auto& [cid, t] : g_tasks) {
         bool ok = false;
         for (const auto& k : keep) ok |= k == t->allocation_id;
@@ -1143,7 +1154,7 @@ void reconnect_master(const AgentOptions& opts) {
   }
   std::vector<std::shared_ptr<Task>> live;
   {
-    std::lock_guard<std::mutex> lock(g_mu);
+    det::MutexLock lock(g_mu);
     for (auto& [cid, t] : g_tasks) {
       if (!t->exited) live.push_back(t);
     }
@@ -1167,7 +1178,7 @@ void reconnect_master(const AgentOptions& opts) {
 void self_fence_tasks(const AgentOptions& opts) {
   std::vector<std::shared_ptr<Task>> live;
   {
-    std::lock_guard<std::mutex> lock(g_mu);
+    det::MutexLock lock(g_mu);
     for (auto& [cid, t] : g_tasks) {
       if (!t->exited) live.push_back(t);
     }
@@ -1227,7 +1238,7 @@ void heartbeat_loop(const AgentOptions& opts) {
     Json body = Json::object();
     Json running = Json::array();
     {
-      std::lock_guard<std::mutex> lock(g_mu);
+      det::MutexLock lock(g_mu);
       for (auto& [cid, t] : g_tasks) running.push_back(Json(t->allocation_id));
     }
     body["running"] = running;
@@ -1268,7 +1279,7 @@ void heartbeat_loop(const AgentOptions& opts) {
 det::HttpResponse agent_metrics_response() {
   int running = 0, exited_pending = 0;
   {
-    std::lock_guard<std::mutex> lock(g_mu);
+    det::MutexLock lock(g_mu);
     for (const auto& [cid, t] : g_tasks) {
       if (t->exited) {
         ++exited_pending;
@@ -1279,7 +1290,7 @@ det::HttpResponse agent_metrics_response() {
   }
   long backlog = 0;
   {
-    std::lock_guard<std::mutex> lock(g_log_mu);
+    det::MutexLock lock(g_log_mu);
     for (const auto& [tid, n] : g_log_pending) backlog += n;
   }
   double uptime = std::chrono::duration<double>(
@@ -1434,7 +1445,7 @@ void notice_watch_loop(const AgentOptions& opts) {
       if (g_sigterm.load() && !has_running_tasks()) {
         bool drained;
         {
-          std::lock_guard<std::mutex> lock(g_log_mu);
+          det::MutexLock lock(g_log_mu);
           drained = g_log_queue.empty() && g_log_pending.empty();
         }
         if (drained) {

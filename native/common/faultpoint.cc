@@ -2,6 +2,8 @@
 
 #include "faultpoint.h"
 
+#include "mutex.h"
+
 #include <unistd.h>
 
 #include <chrono>
@@ -41,6 +43,12 @@ const KnownPoint kKnown[] = {
     {"agent.preempt.notice", "agent",
      "inject a spot/maintenance termination notice once a task is running "
      "(deadline from DET_AGENT_PREEMPT_DEADLINE_S, default 30)"},
+    {"master.resize.offer.drop", "master",
+     "swallow an elastic resize offer (the caller falls back to plain "
+     "preempt + requeue)"},
+    {"provisioner.create.fail", "master",
+     "fail every provisioner node-create call (exercises the create "
+     "backoff)"},
     {"agent.heartbeat.blackhole", "agent",
      "sustained network partition: drop every heartbeat while armed "
      "(vs the one-shot agent.heartbeat.drop)"},
@@ -61,13 +69,13 @@ struct FaultState {
   long fired = 0;
 };
 
-std::mutex g_mu;
-std::map<std::string, FaultState>& registry() {
+Mutex g_mu;
+std::map<std::string, FaultState>& registry() REQUIRES(g_mu) {
   static std::map<std::string, FaultState> r;
   return r;
 }
 
-std::mt19937_64& rng_locked() {
+std::mt19937_64& rng_locked() REQUIRES(g_mu) {
   static std::mt19937_64 rng = [] {
     const char* s = getenv("DET_FAULTS_SEED");
     return std::mt19937_64(s != nullptr ? strtoull(s, nullptr, 10)
@@ -106,7 +114,7 @@ Action fire(const char* point) {
   bool crash = false;
   Action action = Action::kNone;
   {
-    std::lock_guard<std::mutex> lock(g_mu);
+    MutexLock lock(g_mu);
     auto it = registry().find(point);
     if (it == registry().end()) return Action::kNone;
     FaultState& st = it->second;
@@ -146,7 +154,7 @@ bool arm(const std::string& point, const std::string& mode, long count,
   if (!parse_mode(mode, &st, err)) return false;
   st.remaining = count > 0 ? count : -1;
   st.probability = probability;
-  std::lock_guard<std::mutex> lock(g_mu);
+  MutexLock lock(g_mu);
   registry()[point] = st;
   g_armed.store(static_cast<int>(registry().size()),
                 std::memory_order_relaxed);
@@ -154,7 +162,7 @@ bool arm(const std::string& point, const std::string& mode, long count,
 }
 
 bool disarm(const std::string& point) {
-  std::lock_guard<std::mutex> lock(g_mu);
+  MutexLock lock(g_mu);
   bool erased = registry().erase(point) > 0;
   g_armed.store(static_cast<int>(registry().size()),
                 std::memory_order_relaxed);
@@ -162,7 +170,7 @@ bool disarm(const std::string& point) {
 }
 
 void disarm_all() {
-  std::lock_guard<std::mutex> lock(g_mu);
+  MutexLock lock(g_mu);
   registry().clear();
   g_armed.store(0, std::memory_order_relaxed);
 }
@@ -226,7 +234,7 @@ Json list() {
   }
   Json armed = Json::array();
   {
-    std::lock_guard<std::mutex> lock(g_mu);
+    MutexLock lock(g_mu);
     for (const auto& [point, st] : registry()) {
       armed.push_back(Json(JsonObject{
           {"point", Json(point)},

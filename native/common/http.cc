@@ -10,6 +10,8 @@
 
 #include <cstring>
 #include <mutex>
+
+#include "mutex.h"
 #include <sstream>
 #include <stdexcept>
 
@@ -346,19 +348,21 @@ int tcp_connect(const std::string& host, int port, double timeout_s) {
 
 namespace {
 
-std::mutex g_ca_mu;
-std::string g_https_ca_file;
+Mutex g_ca_mu;
+std::string g_https_ca_file GUARDED_BY(g_ca_mu);
 
 TlsCtx* https_client_ctx() {
   // One context per configured CA file; contexts live for the process.
-  static std::mutex mu;
+  // Function-local statics can't carry GUARDED_BY (clang only accepts it
+  // on members and globals); `cache` is only touched under `mu` below.
+  static Mutex mu;
   static std::map<std::string, TlsCtx*> cache;
   std::string ca;
   {
-    std::lock_guard<std::mutex> lock(g_ca_mu);
+    MutexLock lock(g_ca_mu);
     ca = g_https_ca_file;
   }
-  std::lock_guard<std::mutex> lock(mu);
+  MutexLock lock(mu);
   auto it = cache.find(ca);
   if (it != cache.end()) return it->second;
   TlsCtx* ctx = tls_client_ctx(ca);
@@ -369,7 +373,7 @@ TlsCtx* https_client_ctx() {
 }  // namespace
 
 void set_https_ca_file(const std::string& path) {
-  std::lock_guard<std::mutex> lock(g_ca_mu);
+  MutexLock lock(g_ca_mu);
   g_https_ca_file = path;
 }
 

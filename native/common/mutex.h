@@ -1,0 +1,65 @@
+// mutex.h — annotated mutex wrapper for the thread-safety gate.
+//
+// Clang's -Wthread-safety analysis only tracks capabilities it can see:
+// libstdc++'s std::mutex carries no capability attributes, so
+// `std::lock_guard<std::mutex>` is invisible to it. det::Mutex is a
+// zero-cost std::mutex wrapper that IS a capability, and det::MutexLock
+// is the scoped acquire the analysis understands. Everything that used
+// to be `std::mutex mu_; std::lock_guard<std::mutex> lock(mu_);` is now
+// `det::Mutex mu_; det::MutexLock lock(mu_);` — same codegen, provable
+// locking (docs/static-analysis.md).
+//
+// Condition variables: std::condition_variable needs the underlying
+// std::unique_lock<std::mutex>, exposed by MutexLock::native(). A wait
+// releases and reacquires the mutex internally — invisible to the
+// analysis, but sound for it: the capability is held on both sides of
+// the call, and every predicate runs under the mutex. Predicates are
+// lambdas the analysis checks as separate functions with no capability
+// context, so each one opens with `mu.AssertHeld()` to re-establish the
+// fact the wait contract guarantees.
+
+#pragma once
+
+#include <mutex>
+
+#include "thread_annotations.h"
+
+namespace det {
+
+class CAPABILITY("mutex") Mutex {
+ public:
+  Mutex() = default;
+  Mutex(const Mutex&) = delete;
+  Mutex& operator=(const Mutex&) = delete;
+
+  void lock() ACQUIRE() { mu_.lock(); }
+  void unlock() RELEASE() { mu_.unlock(); }
+
+  // Tells the analysis the mutex is held without acquiring it — for
+  // condition-variable wait predicates (see header comment). No runtime
+  // effect.
+  void AssertHeld() const ASSERT_CAPABILITY(this) {}
+
+ private:
+  friend class MutexLock;
+  std::mutex mu_;
+};
+
+// Scoped acquire (the std::lock_guard/std::unique_lock replacement).
+// Holds a std::unique_lock so condition variables can wait on native().
+class SCOPED_CAPABILITY MutexLock {
+ public:
+  explicit MutexLock(Mutex& mu) ACQUIRE(mu) : lock_(mu.mu_) {}
+  ~MutexLock() RELEASE() {}
+
+  MutexLock(const MutexLock&) = delete;
+  MutexLock& operator=(const MutexLock&) = delete;
+
+  // The underlying lock, for std::condition_variable::wait*() only.
+  std::unique_lock<std::mutex>& native() { return lock_; }
+
+ private:
+  std::unique_lock<std::mutex> lock_;
+};
+
+}  // namespace det

@@ -1,0 +1,79 @@
+// thread_annotations.h — Clang thread-safety capability macros.
+//
+// The native control plane's locking discipline is a convention: one
+// mutex per subsystem, `*_locked` suffixes on functions that require it
+// held, lock helpers at the public entry points. These macros turn that
+// convention into a compile-time contract (docs/static-analysis.md):
+// under a thread-safety-capable clang, `make tsa` builds every TU with
+// -Wthread-safety -Werror and proves
+//
+//   - every GUARDED_BY field is only touched with its mutex held,
+//   - every REQUIRES function is only called with the mutex held,
+//   - every EXCLUDES entry point is never re-entered under the mutex
+//     (the double-acquire deadlock class),
+//
+// instead of sampling those properties at runtime with TSan (`make tsan`
+// only catches races a test happens to execute). Under gcc — the default
+// build compiler — every macro expands to nothing, so the annotations
+// are free and the binaries are identical.
+//
+// NO_THREAD_SAFETY_ANALYSIS is the escape hatch. Policy (enforced by
+// determined_tpu/analysis/native_lint.py): at most 3 uses across native/,
+// each with an inline `// tsa:` comment justifying why the analysis
+// cannot see the invariant.
+
+#pragma once
+
+#if defined(__clang__) && defined(__has_attribute)
+#define DET_THREAD_ANNOTATION__(x) __attribute__((x))
+#else
+#define DET_THREAD_ANNOTATION__(x)  // no-op under gcc
+#endif
+
+// On a type: this class is a lockable capability ("mutex").
+#define CAPABILITY(x) DET_THREAD_ANNOTATION__(capability(x))
+
+// On a type: RAII object that acquires in its constructor and releases in
+// its destructor (std::lock_guard shape).
+#define SCOPED_CAPABILITY DET_THREAD_ANNOTATION__(scoped_lockable)
+
+// On a data member: only read/written with the named mutex held.
+#define GUARDED_BY(x) DET_THREAD_ANNOTATION__(guarded_by(x))
+
+// On a pointer member: the pointee (not the pointer) is guarded.
+#define PT_GUARDED_BY(x) DET_THREAD_ANNOTATION__(pt_guarded_by(x))
+
+// On a function: caller must hold the mutex (the `*_locked` contract).
+#define REQUIRES(...) \
+  DET_THREAD_ANNOTATION__(requires_capability(__VA_ARGS__))
+
+// On a function: caller must NOT hold the mutex (public entry points that
+// take it themselves — calling one under the mutex is a self-deadlock).
+#define EXCLUDES(...) DET_THREAD_ANNOTATION__(locks_excluded(__VA_ARGS__))
+
+// On lock helpers: the function acquires/releases the capability.
+#define ACQUIRE(...) \
+  DET_THREAD_ANNOTATION__(acquire_capability(__VA_ARGS__))
+#define RELEASE(...) \
+  DET_THREAD_ANNOTATION__(release_capability(__VA_ARGS__))
+#define TRY_ACQUIRE(...) \
+  DET_THREAD_ANNOTATION__(try_acquire_capability(__VA_ARGS__))
+
+// On a function: asserts (does not acquire) that the capability is held.
+// Used inside condition-variable wait predicates: the lambda runs with
+// the mutex held by wait()'s contract, but the analysis cannot see
+// through std::condition_variable.
+#define ASSERT_CAPABILITY(x) DET_THREAD_ANNOTATION__(assert_capability(x))
+
+// On a function returning a reference to a mutex.
+#define RETURN_CAPABILITY(x) DET_THREAD_ANNOTATION__(lock_returned(x))
+
+// Lock-order declarations.
+#define ACQUIRED_BEFORE(...) \
+  DET_THREAD_ANNOTATION__(acquired_before(__VA_ARGS__))
+#define ACQUIRED_AFTER(...) \
+  DET_THREAD_ANNOTATION__(acquired_after(__VA_ARGS__))
+
+// The escape hatch — see the policy note above.
+#define NO_THREAD_SAFETY_ANALYSIS \
+  DET_THREAD_ANNOTATION__(no_thread_safety_analysis)

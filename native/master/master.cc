@@ -349,12 +349,18 @@ Master::Master(MasterConfig cfg) : cfg_(std::move(cfg)), db_(cfg_.db_path) {
                 << std::endl;
     }
   }
-  restore_experiments();
-  // Deployments restore after experiments/allocations: replica tasks whose
-  // allocations were re-adopted above reconnect to their ReplicaHealth
-  // rows; anything that died with the old master is pruned (and respawned
-  // to target) by the first reconcile tick.
-  restore_deployments_locked();
+  {
+    // The constructor is single-threaded (no server, no scheduler yet)
+    // but the restore helpers mutate guarded state and call *_locked
+    // machinery, so the contract is satisfied for real, not waived.
+    MutexLock lock(mu_);
+    restore_experiments_locked();
+    // Deployments restore after experiments/allocations: replica tasks
+    // whose allocations were re-adopted above reconnect to their
+    // ReplicaHealth rows; anything that died with the old master is pruned
+    // (and respawned to target) by the first reconcile tick.
+    restore_deployments_locked();
+  }
 }
 
 Master::~Master() { stop(); }
@@ -390,9 +396,11 @@ void Master::run() {
 
 void Master::stop() {
   {
-    std::lock_guard<std::mutex> lock(mu_);
-    if (!running_) return;
-    running_ = false;
+    // running_ is atomic, but the flip still happens under mu_ so a
+    // long-poll thread can't check its predicate, miss the flip, and then
+    // sleep through the notify below (the lost-wakeup window).
+    MutexLock lock(mu_);
+    if (!running_.exchange(false)) return;
   }
   tunnels_run_ = false;  // live ws/tcp tunnels exit their pump loops
   cv_.notify_all();
@@ -413,7 +421,7 @@ HttpResponse Master::handle(const HttpRequest& req) {
       FAULT_POINT("api.response.5xx") == faults::Action::kError) {
     HttpResponse injected = HttpResponse::json(
         500, "{\"error\":\"injected fault: api.response.5xx\"}");
-    std::lock_guard<std::mutex> lock(api_stats_.mu);
+    MutexLock lock(api_stats_.mu);
     api_stats_.requests_by_status[500]++;
     return injected;
   }
@@ -428,7 +436,7 @@ HttpResponse Master::handle(const HttpRequest& req) {
   }
   {
     double secs = std::chrono::duration<double>(Clock::now() - t0).count();
-    std::lock_guard<std::mutex> lock(api_stats_.mu);
+    MutexLock lock(api_stats_.mu);
     api_stats_.requests_by_status[resp.status]++;
     api_stats_.seconds_sum += secs;
     api_stats_.seconds_count++;
@@ -622,7 +630,7 @@ HttpResponse Master::route(const HttpRequest& req) {
       }
       Json out = Json::object();
       {
-        std::lock_guard<std::mutex> lock(mu_);
+        MutexLock lock(mu_);
         // TTL-expired compile artifacts release their blob holds first so
         // this same sweep reclaims them (docs/compile-farm.md retention).
         out["compile_artifacts_evicted"] = sweep_compile_artifacts_locked();
@@ -961,11 +969,11 @@ HttpResponse Master::handle_stream(const HttpRequest& req) {
     // poll early with an empty batch.
     auto deadline = Clock::now() + std::chrono::milliseconds(
                                        static_cast<int64_t>(timeout * 1000));
-    std::unique_lock<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     collect(&events, &dropped);
     while (events.as_array().empty() && !dropped &&
            Clock::now() < deadline) {
-      if (cv_.wait_until(lock, deadline) == std::cv_status::timeout) break;
+      if (cv_.wait_until(lock.native(), deadline) == std::cv_status::timeout) break;
       collect(&events, &dropped);
     }
   }
@@ -1050,7 +1058,7 @@ HttpResponse Master::handle_prometheus_metrics() {
   // determined_tpu/common/metric_names.py, docs/observability.md).
   std::ostringstream out;
   {
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     int agents_alive = 0, slots_total = 0, slots_free = 0;
     int slots_allocated = 0, slots_draining = 0;
     for (const auto& [id, a] : agents_) {
@@ -1263,7 +1271,7 @@ HttpResponse Master::handle_prometheus_metrics() {
       << "det_lease_expirations_total " << fleet_.lease_expirations.load()
       << "\n";
   {
-    std::lock_guard<std::mutex> lock(fence_stats_.mu);
+    MutexLock lock(fence_stats_.mu);
     out << "# TYPE det_fenced_writes_total counter\n";
     for (const auto& [route, n] : fence_stats_.by_route) {
       out << "det_fenced_writes_total{route=\"" << route << "\"} " << n
@@ -1271,7 +1279,7 @@ HttpResponse Master::handle_prometheus_metrics() {
     }
   }
   {
-    std::lock_guard<std::mutex> lock(api_stats_.mu);
+    MutexLock lock(api_stats_.mu);
     out << "# TYPE det_api_requests_total counter\n";
     for (const auto& [code, n] : api_stats_.requests_by_status) {
       out << "det_api_requests_total{code=\"" << code << "\"} " << n << "\n";
@@ -1296,7 +1304,7 @@ int64_t Master::idempotency_horizon_seconds() const {
 }
 
 void Master::count_fenced_write(const std::string& route) {
-  std::lock_guard<std::mutex> lock(fence_stats_.mu);
+  MutexLock lock(fence_stats_.mu);
   fence_stats_.by_route[route]++;
 }
 
@@ -1314,7 +1322,7 @@ bool Master::fence_stale_epoch(const HttpRequest& req, int64_t trial_id,
   int64_t current = -1;
   bool stale = false;
   {
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     ExperimentState* exp = nullptr;
     TrialState* trial = find_trial_locked(trial_id, &exp);
     if (trial != nullptr) {

@@ -37,7 +37,7 @@ HttpResponse Master::handle_agents_api(const HttpRequest& req,
                                        const std::vector<std::string>& parts) {
   // GET /api/v1/agents — list for CLI/SDK.
   if (parts.size() == 1 && req.method == "GET") {
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     Json agents = Json::array();
     for (const auto& [id, a] : agents_) {
       Json slots = Json::array();
@@ -103,7 +103,7 @@ HttpResponse Master::handle_agents_api(const HttpRequest& req,
     Json body = Json::parse_or_null(req.body);
     const std::string& id = body["id"].as_string();
     if (id.empty()) return json_resp(400, err_body("agent id required"));
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     bool reconnect = body["reconnect"].as_bool(false);
     AgentState& a = agents_[id];
     bool fresh = a.id.empty() || !reconnect;
@@ -178,7 +178,7 @@ HttpResponse Master::handle_agents_api(const HttpRequest& req,
       return json_resp(403, err_body("admin role required"));
     }
     bool enable = parts[2] == "enable";
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     auto it = agents_.find(agent_id);
     if (it == agents_.end()) return json_resp(404, err_body("unknown agent"));
     for (auto& s : it->second.slots) s.enabled = enable;
@@ -208,7 +208,7 @@ HttpResponse Master::handle_agents_api(const HttpRequest& req,
       return json_resp(400, err_body("deadline_seconds must be >= 0"));
     }
     std::string reason = body["reason"].as_string("spot_preemption");
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     auto it = agents_.find(agent_id);
     if (it == agents_.end()) return json_resp(404, err_body("unknown agent"));
     drain_agent_locked(it->second, deadline_s, reason);
@@ -221,14 +221,15 @@ HttpResponse Master::handle_agents_api(const HttpRequest& req,
   // GET /api/v1/agents/{id}/actions?timeout_seconds=N — long-poll drain.
   if (parts[2] == "actions" && req.method == "GET") {
     double timeout = std::stod(req.query_param("timeout_seconds", "30"));
-    std::unique_lock<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     auto deadline = Clock::now() +
                     std::chrono::milliseconds(static_cast<int>(timeout * 1000));
     auto it = agents_.find(agent_id);
     if (it == agents_.end()) {
       return json_resp(404, err_body("unknown agent; re-register"));
     }
-    cv_.wait_until(lock, deadline, [&] {
+    cv_.wait_until(lock.native(), deadline, [&] {
+      mu_.AssertHeld();
       return !running_ || !agents_[agent_id].actions.empty();
     });
     AgentState& a = agents_[agent_id];
@@ -246,7 +247,7 @@ HttpResponse Master::handle_agents_api(const HttpRequest& req,
   // POST /api/v1/agents/{id}/heartbeat {running: [allocation ids]}
   if (parts[2] == "heartbeat" && req.method == "POST") {
     Json body = Json::parse_or_null(req.body);
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     auto it = agents_.find(agent_id);
     if (it == agents_.end()) {
       return json_resp(404, err_body("unknown agent; re-register"));
@@ -298,7 +299,7 @@ HttpResponse Master::handle_agents_api(const HttpRequest& req,
   if (parts.size() == 5 && parts[2] == "allocations" && parts[4] == "state" &&
       req.method == "POST") {
     Json body = Json::parse_or_null(req.body);
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     if (allocations_.find(parts[3]) == allocations_.end()) {
       return json_resp(404, err_body("unknown allocation"));
     }
@@ -357,42 +358,47 @@ void Master::apply_resource_state_locked(const std::string& alloc_id,
 void Master::scheduler_loop() {
   double last_log_sweep = now();
   while (true) {
-    std::unique_lock<std::mutex> lock(mu_);
-    cv_.wait_for(lock, std::chrono::milliseconds(200));
-    if (!running_) return;
-    check_agents_locked();
-    schedule_locked();
-    // Elastic grow-back: runs every tick (schedule_locked early-returns
-    // on an empty queue, and an empty queue is exactly when idle
-    // capacity can be handed to under-sized elastic trials).
-    maybe_grow_elastic_locked();
-    // Serving deployments (docs/serving.md "Deployments & autoscaling"):
-    // the autoscaler moves target from the smoothed replica signal, then
-    // the reconciler converges replica count onto it (spawn deficits land
-    // in pending_ for the placement pass of the NEXT tick).
-    autoscale_deployments_locked();
-    reconcile_deployments_locked();
-    // Compile farm (docs/compile-farm.md): AFTER placements and grow-back
-    // — only capacity nothing else wanted this tick compiles.
-    dispatch_compile_jobs_locked();
-    // Hourly task-log retention sweep (reference internal/logretention/).
-    // Runs with mu_ RELEASED — a big DELETE must not stall the scheduler
-    // or API handlers (the db has its own lock).
-    if (now() - last_log_sweep > 3600) {
-      last_log_sweep = now();
-      // Compile-artifact retention (compile_cache.ttl_days, docs/
-      // compile-farm.md): evict expired artifact rows FIRST so the blob
-      // sweep right after can drop their now-unreferenced blobs in the
-      // same pass.
-      sweep_compile_artifacts_locked();
-      // Context blobs of ended tasks: the terminal transitions release
-      // inline; this catches any path that missed (tasks orphaned by a
-      // master restart). Runs BEFORE unlock — under mu_ it cannot
-      // interleave with on_allocation_exit_locked between a task's
-      // end_time UPDATE and its inline release (the double-decrement
-      // race), and it decrements once per ended-task row.
-      sweep_context_blobs_locked();
-      lock.unlock();
+    bool sweep_now = false;
+    {
+      MutexLock lock(mu_);
+      cv_.wait_for(lock.native(), std::chrono::milliseconds(200));
+      if (!running_) return;
+      check_agents_locked();
+      schedule_locked();
+      // Elastic grow-back: runs every tick (schedule_locked early-returns
+      // on an empty queue, and an empty queue is exactly when idle
+      // capacity can be handed to under-sized elastic trials).
+      maybe_grow_elastic_locked();
+      // Serving deployments (docs/serving.md "Deployments & autoscaling"):
+      // the autoscaler moves target from the smoothed replica signal, then
+      // the reconciler converges replica count onto it (spawn deficits land
+      // in pending_ for the placement pass of the NEXT tick).
+      autoscale_deployments_locked();
+      reconcile_deployments_locked();
+      // Compile farm (docs/compile-farm.md): AFTER placements and grow-back
+      // — only capacity nothing else wanted this tick compiles.
+      dispatch_compile_jobs_locked();
+      if (now() - last_log_sweep > 3600) {
+        last_log_sweep = now();
+        sweep_now = true;
+        // Compile-artifact retention (compile_cache.ttl_days, docs/
+        // compile-farm.md): evict expired artifact rows FIRST so the blob
+        // sweep right after can drop their now-unreferenced blobs in the
+        // same pass.
+        sweep_compile_artifacts_locked();
+        // Context blobs of ended tasks: the terminal transitions release
+        // inline; this catches any path that missed (tasks orphaned by a
+        // master restart). Runs under mu_ so it cannot interleave with
+        // on_allocation_exit_locked between a task's end_time UPDATE and
+        // its inline release (the double-decrement race), and it
+        // decrements once per ended-task row.
+        sweep_context_blobs_locked();
+      }
+    }
+    // Hourly retention sweeps (reference internal/logretention/) run with
+    // mu_ RELEASED — a big DELETE must not stall the scheduler or API
+    // handlers (the db has its own lock).
+    if (sweep_now) {
       // Expired-session purge runs unconditionally: task containers mint
       // one 7-day token per launch, so the table grows forever without
       // it — log retention (default 0 = keep forever) must not gate it.
@@ -423,7 +429,6 @@ void Master::scheduler_loop() {
                     << std::endl;
         }
       }
-      lock.lock();
     }
   }
 }

@@ -73,7 +73,7 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
     if (!can_create(ctx, prows[0]["workspace_id"].as_int(1))) {
       return json_resp(403, err_body("viewer role cannot create experiments"));
     }
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     int64_t uid = ctx.uid;
     if (body["unmanaged"].as_bool(false)) {
       const Json& config = body["config"];
@@ -173,7 +173,7 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
     e["config"] = Json::parse_or_null(e["config"].as_string());
     e["preflight"] = Json::parse_or_null(e["preflight"].as_string("[]"));
     {
-      std::lock_guard<std::mutex> lock(mu_);
+      MutexLock lock(mu_);
       ExperimentState* exp = find_experiment_locked(eid);
       if (exp != nullptr) {
         e["state"] = exp->state;
@@ -190,7 +190,7 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
     if (!can_edit_experiment(auth_ctx(req), eid)) {
       return json_resp(403, err_body("not authorized for this experiment"));
     }
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     ExperimentState* exp = find_experiment_locked(eid);
     if (exp != nullptr && !is_terminal(exp->state)) {
       return json_resp(400, err_body("experiment still active"));
@@ -222,7 +222,7 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
         {Json(eid)});
     Json trials = Json::array();
     {
-      std::lock_guard<std::mutex> lock(mu_);
+      MutexLock lock(mu_);
       ExperimentState* exp = find_experiment_locked(eid);
       for (auto& row : rows) {
         Json t = row_to_json(row);
@@ -371,14 +371,15 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
   if (parts.size() == 3 && parts[2] == "searcher_events" &&
       req.method == "GET") {
     double timeout = std::stod(req.query_param("timeout_seconds", "30"));
-    std::unique_lock<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     auto deadline = Clock::now() + std::chrono::milliseconds(
                                        static_cast<int>(timeout * 1000));
     ExperimentState* exp = find_experiment_locked(eid);
     if (exp == nullptr || exp->searcher->custom() == nullptr) {
       return json_resp(404, err_body("not a custom-searcher experiment"));
     }
-    cv_.wait_until(lock, deadline, [&] {
+    cv_.wait_until(lock.native(), deadline, [&] {
+      mu_.AssertHeld();
       ExperimentState* e = find_experiment_locked(eid);
       return !running_ || e == nullptr ||
              e->searcher->custom()->has_events() || is_terminal(e->state);
@@ -396,7 +397,7 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
     if (!can_edit_experiment(auth_ctx(req), eid)) {
       return json_resp(403, err_body("not authorized for this experiment"));
     }
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     ExperimentState* exp = find_experiment_locked(eid);
     if (exp == nullptr || exp->searcher->custom() == nullptr) {
       return json_resp(404, err_body("not a custom-searcher experiment"));
@@ -436,7 +437,7 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
                {Json(verb == "archive" ? 1 : 0), Json(eid)});
       return json_resp(200, Json::object());
     }
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     ExperimentState* exp = find_experiment_locked(eid);
     if (exp == nullptr) return json_resp(404, err_body("no such experiment"));
     if (verb == "activate") {
@@ -538,7 +539,7 @@ HttpResponse Master::handle_trials(const HttpRequest& req,
     t["hparams"] = Json::parse_or_null(t["hparams"].as_string());
     t["summary_metrics"] = Json::parse_or_null(t["summary_metrics"].as_string());
     {
-      std::lock_guard<std::mutex> lock(mu_);
+      MutexLock lock(mu_);
       ExperimentState* exp = nullptr;
       TrialState* trial = find_trial_locked(tid, &exp);
       if (trial != nullptr) {
@@ -662,7 +663,7 @@ HttpResponse Master::handle_trials(const HttpRequest& req,
 
   // GET /api/v1/trials/{id}/progress (core/_searcher.py:88).
   if (parts.size() == 3 && parts[2] == "progress") {
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     ExperimentState* exp = nullptr;
     TrialState* trial = find_trial_locked(tid, &exp);
     Json out = Json::object();
@@ -677,7 +678,7 @@ HttpResponse Master::handle_trials(const HttpRequest& req,
       parts[3] == "operation" && req.method == "GET") {
     double timeout =
         std::stod(req.query_param("timeout_seconds", "30"));
-    std::unique_lock<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     auto deadline = Clock::now() + std::chrono::milliseconds(
                                        static_cast<int>(timeout * 1000));
     while (true) {
@@ -698,7 +699,7 @@ HttpResponse Master::handle_trials(const HttpRequest& req,
         out["op"] = std::move(op);
         return json_resp(200, out);
       }
-      if (cv_.wait_until(lock, deadline) == std::cv_status::timeout) {
+      if (cv_.wait_until(lock.native(), deadline) == std::cv_status::timeout) {
         return json_resp(200, out);  // no op yet; harness re-polls
       }
     }
@@ -712,7 +713,7 @@ HttpResponse Master::handle_trials(const HttpRequest& req,
     Json body = Json::parse(req.body);
     HttpResponse fenced;
     if (fence_stale_epoch(req, tid, "searcher", &fenced)) return fenced;
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     ExperimentState* exp = nullptr;
     TrialState* trial = find_trial_locked(tid, &exp);
     if (trial == nullptr) return json_resp(404, err_body("no such trial"));
@@ -817,7 +818,7 @@ HttpResponse Master::handle_trials(const HttpRequest& req,
           {Json(batches), Json(summary.dump()), Json(tid)});
     });
     {
-      std::lock_guard<std::mutex> lock(mu_);
+      MutexLock lock(mu_);
       ExperimentState* exp = nullptr;
       TrialState* trial = find_trial_locked(tid, &exp);
       if (trial != nullptr) {
@@ -864,7 +865,7 @@ HttpResponse Master::handle_trials(const HttpRequest& req,
   // POST /api/v1/trials/{id}/progress — chief-reported progress.
   if (parts.size() == 3 && parts[2] == "progress" && req.method == "POST") {
     Json body = Json::parse_or_null(req.body);
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     ExperimentState* exp = nullptr;
     TrialState* trial = find_trial_locked(tid, &exp);
     if (exp != nullptr) {
@@ -912,10 +913,11 @@ HttpResponse Master::handle_allocations(const HttpRequest& req,
   if (parts.size() == 4 && parts[2] == "signals" &&
       parts[3] == "preemption" && req.method == "GET") {
     double timeout = std::stod(req.query_param("timeout_seconds", "60"));
-    std::unique_lock<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     auto deadline = Clock::now() + std::chrono::milliseconds(
                                        static_cast<int>(timeout * 1000));
-    cv_.wait_until(lock, deadline, [&] {
+    cv_.wait_until(lock.native(), deadline, [&] {
+      mu_.AssertHeld();
       auto it = allocations_.find(aid);
       return !running_ || it == allocations_.end() || it->second.preempting ||
              it->second.state == "TERMINATED";
@@ -950,7 +952,7 @@ HttpResponse Master::handle_allocations(const HttpRequest& req,
   // POST /api/v1/allocations/{id}/signals/ack_preemption
   if (parts.size() == 4 && parts[2] == "signals" &&
       parts[3] == "ack_preemption") {
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     auto it = allocations_.find(aid);
     if (it != allocations_.end()) it->second.exit_reason = "preempted (acked)";
     return json_resp(200, Json::object());
@@ -969,7 +971,7 @@ HttpResponse Master::handle_allocations(const HttpRequest& req,
     // current run_id the header must match.
     int64_t fence_tid = -1;
     {
-      std::lock_guard<std::mutex> lock(mu_);
+      MutexLock lock(mu_);
       auto it = allocations_.find(aid);
       if (it != allocations_.end()) fence_tid = it->second.trial_id;
     }
@@ -981,7 +983,7 @@ HttpResponse Master::handle_allocations(const HttpRequest& req,
     }
     db_.exec("UPDATE allocations SET exit_reason=? WHERE id=?",
              {Json(reason), Json(aid)});
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     auto it = allocations_.find(aid);
     if (it != allocations_.end()) it->second.exit_reason = reason;
     return json_resp(200, Json::object());
@@ -992,10 +994,11 @@ HttpResponse Master::handle_allocations(const HttpRequest& req,
   // (task/rendezvous.go:94 try(); exec/prep_container.py:49).
   if (parts.size() == 3 && parts[2] == "rendezvous" && req.method == "GET") {
     double timeout = std::stod(req.query_param("timeout_seconds", "600"));
-    std::unique_lock<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     auto deadline = Clock::now() + std::chrono::milliseconds(
                                        static_cast<int>(timeout * 1000));
-    bool ok = cv_.wait_until(lock, deadline, [&] {
+    bool ok = cv_.wait_until(lock.native(), deadline, [&] {
+      mu_.AssertHeld();
       auto it = allocations_.find(aid);
       return !running_ || it == allocations_.end() ||
              it->second.state == "RUNNING" ||
@@ -1029,7 +1032,7 @@ HttpResponse Master::handle_allocations(const HttpRequest& req,
     int64_t num_peers = body["num_peers"].as_int(1);
     int64_t round = body["round"].as_int(0);
     double timeout = std::stod(req.query_param("timeout_seconds", "120"));
-    std::unique_lock<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     auto it = allocations_.find(aid);
     if (it == allocations_.end()) {
       return json_resp(404, err_body("unknown allocation"));
@@ -1039,7 +1042,8 @@ HttpResponse Master::handle_allocations(const HttpRequest& req,
     cv_.notify_all();
     auto deadline = Clock::now() + std::chrono::milliseconds(
                                        static_cast<int>(timeout * 1000));
-    bool ok = cv_.wait_until(lock, deadline, [&] {
+    bool ok = cv_.wait_until(lock.native(), deadline, [&] {
+      mu_.AssertHeld();
       auto it2 = allocations_.find(aid);
       if (it2 == allocations_.end()) return true;
       int64_t have = 0;
@@ -1070,7 +1074,7 @@ HttpResponse Master::handle_allocations(const HttpRequest& req,
     Json body = Json::parse_or_null(req.body);
     std::string task_id;
     {
-      std::lock_guard<std::mutex> lock(mu_);
+      MutexLock lock(mu_);
       auto it = allocations_.find(aid);
       if (it == allocations_.end()) {
         return json_resp(404, err_body("unknown allocation"));
@@ -1090,7 +1094,7 @@ HttpResponse Master::handle_allocations(const HttpRequest& req,
     if (ctx.role != "agent" && !can_edit(ctx, owner, ws)) {
       return json_resp(403, err_body("not authorized for this task"));
     }
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     auto it = allocations_.find(aid);
     if (it != allocations_.end()) {
       it->second.proxy_addresses[body["rank"].as_int()] =
@@ -1124,7 +1128,7 @@ HttpResponse Master::handle_allocations(const HttpRequest& req,
 
   // GET /api/v1/allocations/{id} — introspection.
   if (parts.size() == 2 && req.method == "GET") {
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     auto it = allocations_.find(aid);
     if (it == allocations_.end()) {
       auto rows = db_.query("SELECT * FROM allocations WHERE id=?", {Json(aid)});
@@ -1244,7 +1248,7 @@ HttpResponse Master::handle_checkpoints(const HttpRequest& req,
     if (trial_id >= 0 && state == "COMPLETED") {
       db_.exec("UPDATE trials SET latest_checkpoint=? WHERE id=?",
                {Json(uuid), Json(trial_id)});
-      std::lock_guard<std::mutex> lock(mu_);
+      MutexLock lock(mu_);
       ExperimentState* exp = nullptr;
       TrialState* trial = find_trial_locked(trial_id, &exp);
       if (trial != nullptr) {
@@ -1371,7 +1375,7 @@ HttpResponse Master::handle_task_logs(const HttpRequest& req) {
       // Log traffic counts as activity for idle-watching (task/idle/),
       // and runs through the experiment's log-pattern policies
       // (reference logpattern/logpattern.go:232).
-      std::lock_guard<std::mutex> lock(mu_);
+      MutexLock lock(mu_);
       for (const auto& entry : logs) {
         auto it = allocations_.find(entry["allocation_id"].as_string());
         if (it == allocations_.end()) continue;
@@ -1432,7 +1436,7 @@ HttpResponse Master::handle_tasks(const HttpRequest& req,
     auto rows = db_.query(sql, params);
     Json tasks = Json::array();
     {
-      std::lock_guard<std::mutex> lock(mu_);
+      MutexLock lock(mu_);
       for (auto& row : rows) {
         Json t = row_to_json(row);
         for (const auto& [aid, a] : allocations_) {
@@ -1489,10 +1493,11 @@ HttpResponse Master::handle_tasks(const HttpRequest& req,
     };
     auto rows = fetch();
     if (rows.empty() && follow) {
-      std::unique_lock<std::mutex> lock(mu_);
-      cv_.wait_for(lock, std::chrono::milliseconds(
-                             static_cast<int>(timeout * 1000)));
-      lock.unlock();
+      {
+        MutexLock lock(mu_);
+        cv_.wait_for(lock.native(), std::chrono::milliseconds(
+                                        static_cast<int>(timeout * 1000)));
+      }
       rows = fetch();
     }
     Json logs = Json::array();
@@ -1573,11 +1578,11 @@ Json Master::model_def_file_tree(const std::string& hash,
                                  const std::string& b64) {
   // LRU by content hash: listing a sweep's shared tarball once, not per
   // page view (reference master/internal/cache/file_cache.go).
-  static std::mutex cache_mu;
+  static Mutex cache_mu;
   static std::map<std::string, Json> cache;
   static std::deque<std::string> order;  // front = LRU victim
   if (!hash.empty()) {
-    std::lock_guard<std::mutex> lock(cache_mu);
+    MutexLock lock(cache_mu);
     auto it = cache.find(hash);
     if (it != cache.end()) {
       // refresh recency
@@ -1653,7 +1658,7 @@ Json Master::model_def_file_tree(const std::string& hash,
     off += 512 + ((static_cast<size_t>(size) + 511) / 512) * 512;
   }
   if (!hash.empty()) {
-    std::lock_guard<std::mutex> lock(cache_mu);
+    MutexLock lock(cache_mu);
     if (cache.emplace(hash, files).second) {
       order.push_back(hash);
       while (order.size() > 16) {

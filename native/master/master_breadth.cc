@@ -60,7 +60,7 @@ HttpResponse Master::handle_workspaces(const HttpRequest& req,
     if (ctx.role == "viewer") {
       return json_resp(403, err_body("viewer role cannot create workspaces"));
     }
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     int64_t wid_new =
         db_.insert("INSERT INTO workspaces (name, user_id) VALUES (?, ?)",
                    {body["name"], Json(ctx.uid)});
@@ -114,7 +114,7 @@ HttpResponse Master::handle_projects(const HttpRequest& req,
     if (!can_create(ctx, wid)) {
       return json_resp(403, err_body("not authorized for this workspace"));
     }
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     int64_t pid_new = db_.insert(
         "INSERT INTO projects (name, description, workspace_id, user_id) "
         "VALUES (?, ?, ?, ?)",
@@ -231,7 +231,7 @@ HttpResponse Master::handle_models(const HttpRequest& req,
     if (!can_create(ctx, body["workspace_id"].as_int(1))) {
       return json_resp(403, err_body("not authorized for this workspace"));
     }
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     int64_t mid_new = db_.insert(
         "INSERT INTO models (name, description, metadata, labels, user_id, "
         "workspace_id) VALUES (?, ?, ?, ?, ?, ?)",
@@ -301,7 +301,7 @@ HttpResponse Master::handle_models(const HttpRequest& req,
               "registered"));
         }
         AuthCtx vctx = auth_ctx(req);
-        std::lock_guard<std::mutex> lock(mu_);
+        MutexLock lock(mu_);
         Json ver = register_model_version_locked(
             name, uuid, body["source_experiment_id"].as_int(-1),
             crows[0]["trial_id"].as_int(-1),
@@ -420,7 +420,7 @@ HttpResponse Master::handle_job_queue(const HttpRequest& req) {
   if (req.method == "POST" && !auth_ctx(req).admin) {
     return json_resp(403, err_body("admin role required"));
   }
-  std::lock_guard<std::mutex> lock(mu_);
+  MutexLock lock(mu_);
   // POST /api/v1/job-queues/reorder {allocation_id, ahead_of|behind}
   // (reference job queue UpdateJobQueue ahead-of/behind ops): reposition a
   // QUEUED allocation relative to another by adopting the target's

@@ -308,7 +308,7 @@ HttpResponse Master::handle_compile_cache(
     if (!files.is_object()) {
       return json_resp(400, err_body("files object required"));
     }
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     int64_t stored = 0;
     for (const auto& [name, b64] : files.as_object()) {
       if (!b64.is_string() || b64.as_string().empty()) continue;
@@ -397,7 +397,7 @@ HttpResponse Master::handle_compile_jobs(
     if (state != "DONE" && state != "FAILED") {
       return json_resp(400, err_body("state must be DONE or FAILED"));
     }
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     db_.exec(
         "UPDATE compile_jobs SET state=?, "
         "fingerprint=CASE WHEN ? != '' THEN ? ELSE fingerprint END, "
@@ -419,7 +419,7 @@ HttpResponse Master::handle_compile_jobs(
     Json body = Json::parse_or_null(req.body);
     std::string from = body["from"].as_string("");
     if (from.empty()) return json_resp(400, err_body("from required"));
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     auto n = db_.exec(
         "INSERT INTO compile_artifacts (signature, filename, blob_hash, "
         "size_bytes) SELECT ?, filename, blob_hash, size_bytes "

@@ -791,7 +791,7 @@ HttpResponse Master::handle_deployments(
         return json_resp(400, err);
       }
     }
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     DeploymentState dep;
     dep.id = "deploy-" + random_hex(4);
     for (auto& c : dep.id) c = static_cast<char>(tolower(c));
@@ -890,7 +890,7 @@ HttpResponse Master::handle_deployments(
         "target_replicas, created_at, end_time FROM deployments "
         "ORDER BY created_at DESC");
     Json deps = Json::array();
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     for (auto& row : rows) {
       Json d = Json(JsonObject(row.begin(), row.end()));
       auto it = deployments_.find(row["id"].as_string());
@@ -940,7 +940,7 @@ HttpResponse Master::handle_deployments(
       req.method == "GET") {
     const std::string& rid = parts[3];
     {
-      std::lock_guard<std::mutex> lock(mu_);
+      MutexLock lock(mu_);
       if (!deployments_.count(dep_id)) {
         for (const auto& [id, dep] : deployments_) {
           if (dep.name == dep_id) {
@@ -986,7 +986,7 @@ HttpResponse Master::handle_deployments(
   // the same call with the prior version.
   if (parts.size() == 3 && parts[2] == "update" && req.method == "POST") {
     Json body = Json::parse_or_null(req.body);
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     auto it = deployments_.find(dep_id);
     if (it == deployments_.end()) {
       return json_resp(404, err_body("no such deployment"));
@@ -1020,7 +1020,7 @@ HttpResponse Master::handle_deployments(
   //   {abort: true}    drain the canary replicas, keep stable untouched
   if (parts.size() == 3 && parts[2] == "canary" && req.method == "POST") {
     Json body = Json::parse_or_null(req.body);
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     auto it = deployments_.find(dep_id);
     if (it == deployments_.end()) {
       return json_resp(404, err_body("no such deployment"));
@@ -1127,7 +1127,7 @@ HttpResponse Master::handle_deployments(
       return json_resp(400, err_body("target required"));
     }
     int target = static_cast<int>(body["target"].as_int());
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     auto it = deployments_.find(dep_id);
     if (it == deployments_.end()) {
       return json_resp(404, err_body("no such deployment"));
@@ -1154,7 +1154,7 @@ HttpResponse Master::handle_deployments(
   // (no drain — kill is the operator's hard stop; `scale` to min first
   // for a graceful teardown).
   if (parts.size() == 3 && parts[2] == "kill" && req.method == "POST") {
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     auto it = deployments_.find(dep_id);
     if (it == deployments_.end()) {
       return json_resp(404, err_body("no such deployment"));
@@ -1186,7 +1186,7 @@ HttpResponse Master::handle_deployments(
     Json d = Json(JsonObject(rows[0].begin(), rows[0].end()));
     d["config"] = Json::parse_or_null(d["config"].as_string());
     Json replicas = Json::array();
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     double t = now();
     auto it = deployments_.find(dep_id);
     if (it != deployments_.end()) {
@@ -1297,7 +1297,7 @@ HttpResponse Master::handle_deployments(
 HttpResponse Master::handle_serve_stats(const HttpRequest& req,
                                         const std::string& alloc_id) {
   Json body = Json::parse_or_null(req.body);
-  std::lock_guard<std::mutex> lock(mu_);
+  MutexLock lock(mu_);
   auto it = allocations_.find(alloc_id);
   if (it == allocations_.end()) {
     return json_resp(404, err_body("unknown allocation"));
@@ -1464,7 +1464,7 @@ HttpResponse Master::handle_request_spans(const HttpRequest& req,
   }
   std::string scope, task_id;
   {
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     auto it = allocations_.find(alloc_id);
     if (it == allocations_.end()) {
       return json_resp(404, err_body("unknown allocation"));
@@ -1509,7 +1509,7 @@ HttpResponse Master::handle_serve_router(
   double slo_ms = 0;
   double cold_budget = 30.0;
   {
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     if (!deployments_.count(dep_id)) {
       for (const auto& [id, dep] : deployments_) {
         if (dep.name == dep_id) {
@@ -1575,12 +1575,12 @@ HttpResponse Master::handle_serve_router(
   // (replicas crashed / still starting with target already nonzero)
   // answers 503 with a Retry-After computed from the observed spawn +
   // warm-AOT restore time instead of surfacing a connection error.
+  bool record_cold = false;
+  int64_t hold_start_us = 0, hold_end_us = 0;
+  double cold_wait_ms = 0;
+  std::string cold_replica, cold_source;
   {
-    bool record_cold = false;
-    int64_t hold_start_us = 0, hold_end_us = 0;
-    double cold_wait_ms = 0;
-    std::string cold_replica, cold_source;
-    std::unique_lock<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     auto dit = deployments_.find(dep_id);
     if (dit == deployments_.end()) {
       return json_resp(404, err_body("no such deployment"));
@@ -1631,7 +1631,8 @@ HttpResponse Master::handle_serve_router(
           Clock::now() + std::chrono::milliseconds(static_cast<int64_t>(
                              (dep.cold_start_since + cold_budget - t) *
                              1000));
-      cv_.wait_until(lock, deadline, [&] {
+      cv_.wait_until(lock.native(), deadline, [&] {
+        mu_.AssertHeld();
         return !running_ || ready_count(/*warm=*/true) > 0;
       });
       hold_end_us = trace::now_us();
@@ -1663,22 +1664,22 @@ HttpResponse Master::handle_serve_router(
     } else {
       dep.cold_start_since = 0;
     }
-    lock.unlock();
-    if (record_cold) {
-      // The first request across a scale-from-zero wake carries the
-      // cold-start phase on its trace: how long the router held it and
-      // whether the replica's engine deserialized (warm AOT) or traced.
-      Json attrs = Json::object();
-      attrs["deployment"] = dep_id;
-      attrs["budget_s"] = cold_budget;
-      attrs["wait_ms"] = cold_wait_ms;
-      attrs["replica"] = cold_replica;
-      attrs["engine_source"] = cold_source;
-      record_request_span(
-          dep_id, rid,
-          trace::make_span(rid, "serve.cold_start", hold_start_us,
-                           hold_end_us, rid, attrs));
-    }
+  }
+  if (record_cold) {
+    // The first request across a scale-from-zero wake carries the
+    // cold-start phase on its trace: how long the router held it and
+    // whether the replica's engine deserialized (warm AOT) or traced.
+    // Runs after the lock scope — record_request_span takes the db lock.
+    Json attrs = Json::object();
+    attrs["deployment"] = dep_id;
+    attrs["budget_s"] = cold_budget;
+    attrs["wait_ms"] = cold_wait_ms;
+    attrs["replica"] = cold_replica;
+    attrs["engine_source"] = cold_source;
+    record_request_span(dep_id, rid,
+                        trace::make_span(rid, "serve.cold_start",
+                                         hold_start_us, hold_end_us, rid,
+                                         attrs));
   }
 
   // At most two attempts: the retry is ONLY taken for a connection-level
@@ -1694,7 +1695,7 @@ HttpResponse Master::handle_serve_router(
     bool pick_canary = false;
     int64_t full_retry_after = 0;
     {
-      std::lock_guard<std::mutex> lock(mu_);
+      MutexLock lock(mu_);
       auto dit = deployments_.find(dep_id);
       if (dit == deployments_.end()) {
         return json_resp(404, err_body("no such deployment"));
@@ -1871,7 +1872,7 @@ HttpResponse Master::handle_serve_router(
                            t_done_us, rid, attrs));
     }
 
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     auto dit = deployments_.find(dep_id);
     DeploymentState* dep =
         dit != deployments_.end() ? &dit->second : nullptr;

@@ -988,7 +988,7 @@ void Master::snapshot_experiment_locked(ExperimentState& exp) {
       {Json(exp.id), Json(snap.dump())});
 }
 
-void Master::restore_experiments() {
+void Master::restore_experiments_locked() {
   auto rows = db_.query(
       "SELECT e.id, e.state, e.config, e.owner_id, e.project_id, "
       "p.workspace_id, s.content FROM experiments e "

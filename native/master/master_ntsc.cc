@@ -145,7 +145,7 @@ HttpResponse Master::handle_runs(const HttpRequest& req,
     Json runs = Json::array();
     const std::string want_state = req.query_param("state");
     {
-      std::lock_guard<std::mutex> lock(mu_);
+      MutexLock lock(mu_);
       for (auto& row : rows) {
         Json r = row_to_json(row);
         Json cfg = Json::parse_or_null(r["config"].as_string());
@@ -277,7 +277,7 @@ void Master::tunnel_pump(Stream client, int target_fd,
       double t = now();
       if (t - last_touch > 2.0) {  // throttle mu_ takes
         last_touch = t;
-        std::lock_guard<std::mutex> lock(mu_);
+        MutexLock lock(mu_);
         for (auto& [aid, a] : allocations_) {
           if (a.task_id == task_id) a.last_activity = t;
         }
@@ -349,7 +349,7 @@ HttpResponse Master::handle_proxy(const HttpRequest& req,
   std::string target;
   std::string proxy_secret;
   {
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     for (auto& [aid, a] : allocations_) {
       if (a.task_id == task_id && !a.proxy_addresses.empty() &&
           a.state != "TERMINATED") {
@@ -594,7 +594,7 @@ HttpResponse Master::handle_ntsc(const HttpRequest& req,
         return json_resp(400, err);
       }
     }
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     int64_t uid = ctx.uid;
 
     std::string task_id =
@@ -684,7 +684,7 @@ HttpResponse Master::handle_ntsc(const HttpRequest& req,
         "FROM tasks WHERE type=? ORDER BY start_time DESC",
         {Json(meta.type)});
     Json tasks = Json::array();
-    std::lock_guard<std::mutex> lock(mu_);
+    MutexLock lock(mu_);
     for (auto& row : rows) {
       Json t = row_to_json(row);
       t["config"] = Json::parse_or_null(t["config"].as_string());
@@ -722,7 +722,7 @@ HttpResponse Master::handle_ntsc(const HttpRequest& req,
                     trows[0]["workspace_id"].as_int(1))) {
         return json_resp(403, err_body("not authorized for this task"));
       }
-      std::lock_guard<std::mutex> lock(mu_);
+      MutexLock lock(mu_);
       kill_task_tree_locked(task_id);
       return json_resp(200, Json::object());
     }
@@ -732,7 +732,7 @@ HttpResponse Master::handle_ntsc(const HttpRequest& req,
       if (rows.empty()) return json_resp(404, err_body("no such task"));
       Json t = row_to_json(rows[0]);
       t["config"] = Json::parse_or_null(t["config"].as_string());
-      std::lock_guard<std::mutex> lock(mu_);
+      MutexLock lock(mu_);
       for (const auto& [aid, a] : allocations_) {
         if (a.task_id == task_id && a.state != "TERMINATED") {
           t["allocation_state"] = a.state;

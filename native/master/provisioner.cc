@@ -67,14 +67,14 @@ bool Provisioner::observe(const std::string& pool,
 }
 
 std::vector<ProvNode> Provisioner::nodes() const {
-  std::lock_guard<std::mutex> lock(st_->mu);
+  MutexLock lock(st_->mu);
   std::vector<ProvNode> out;
   for (const auto& [name, n] : st_->nodes) out.push_back(n);
   return out;
 }
 
 int64_t Provisioner::create_failures_total() const {
-  std::lock_guard<std::mutex> lock(st_->mu);
+  MutexLock lock(st_->mu);
   return st_->create_failures_total;
 }
 
@@ -136,7 +136,7 @@ bool Provisioner::observe_gcp(const std::string& pool,
   int joining = 0;
   std::vector<std::string> never_joined;
   {
-    std::lock_guard<std::mutex> lock(st_->mu);
+    MutexLock lock(st_->mu);
     for (const auto& [name, n] : st_->nodes) {
       if (n.pool != pool || n.state == "DELETING" || is_agent(name)) {
         continue;
@@ -175,7 +175,7 @@ bool Provisioner::observe_gcp(const std::string& pool,
       // the next cooldown lapse.
       bool backed_off;
       {
-        std::lock_guard<std::mutex> lock(st_->mu);
+        MutexLock lock(st_->mu);
         auto bit = st_->backoff_until.find(pool);
         backed_off = bit != st_->backoff_until.end() && now < bit->second;
       }
@@ -212,7 +212,7 @@ bool Provisioner::observe_gcp(const std::string& pool,
     }
     std::string node_state;
     {
-      std::lock_guard<std::mutex> lock(st_->mu);
+      MutexLock lock(st_->mu);
       auto nit = st_->nodes.find(aid);
       if (nit == st_->nodes.end()) continue;
       node_state = nit->second.state;
@@ -241,7 +241,7 @@ bool Provisioner::observe_gcp(const std::string& pool,
   for (auto it = idle_since_.begin(); it != idle_since_.end();) {
     bool this_pool;
     {
-      std::lock_guard<std::mutex> lock(st_->mu);
+      MutexLock lock(st_->mu);
       auto nit = st_->nodes.find(it->first);
       this_pool = nit != st_->nodes.end() && nit->second.pool == pool;
     }
@@ -257,7 +257,7 @@ bool Provisioner::observe_gcp(const std::string& pool,
 void Provisioner::launch_node(const std::string& pool, double now) {
   std::string name;
   {
-    std::lock_guard<std::mutex> lock(st_->mu);
+    MutexLock lock(st_->mu);
     // Skip names still present in tracking (e.g. adopted after a master
     // restart) so we never create over an existing node.
     do {
@@ -298,7 +298,7 @@ void Provisioner::launch_node(const std::string& pool, double now) {
                                const std::string& why) {
     std::cerr << "provisioner: create " << name << " failed: " << why
               << std::endl;
-    std::lock_guard<std::mutex> lock(st->mu);
+    MutexLock lock(st->mu);
     st->nodes.erase(name);
     int& consec = st->create_failures[pool];
     consec = std::min(consec + 1, 30);  // 2^30 s is already "forever"
@@ -330,7 +330,7 @@ void Provisioner::launch_node(const std::string& pool, double now) {
                           r.body);
         return;
       }
-      std::lock_guard<std::mutex> lock(st->mu);
+      MutexLock lock(st->mu);
       st->create_failures.erase(pool);
       st->backoff_until.erase(pool);
     } catch (const std::exception& e) {
@@ -341,7 +341,7 @@ void Provisioner::launch_node(const std::string& pool, double now) {
 
 void Provisioner::delete_node(const std::string& name, double now) {
   {
-    std::lock_guard<std::mutex> lock(st_->mu);
+    MutexLock lock(st_->mu);
     auto it = st_->nodes.find(name);
     if (it == st_->nodes.end()) return;
     it->second.state = "DELETING";
@@ -364,7 +364,7 @@ void Provisioner::delete_node(const std::string& name, double now) {
       std::cerr << "provisioner: delete " << name << " failed: " << e.what()
                 << ", will retry" << std::endl;
     }
-    std::lock_guard<std::mutex> lock(st->mu);
+    MutexLock lock(st->mu);
     if (gone) {
       st->nodes.erase(name);
     } else {
@@ -384,7 +384,7 @@ void Provisioner::reconcile(double now) {
   // Re-issue stale DELETEs (failed attempt cleared deleting_since).
   std::vector<std::string> redo;
   {
-    std::lock_guard<std::mutex> lock(st_->mu);
+    MutexLock lock(st_->mu);
     for (auto& [name, n] : st_->nodes) {
       if (n.state == "DELETING" && n.deleting_since == 0) {
         n.deleting_since = now;  // claimed; delete_node re-stamps anyway
@@ -423,7 +423,7 @@ void Provisioner::reconcile(double now) {
       page_token = resp["nextPageToken"].as_string("");
       if (page_token.empty()) break;
     }
-    std::lock_guard<std::mutex> lock(st->mu);
+    MutexLock lock(st->mu);
     for (auto it = st->nodes.begin(); it != st->nodes.end();) {
       const ProvNode& n = it->second;
       bool present = listed.count(it->first) > 0;

@@ -30,6 +30,7 @@
 #include <vector>
 
 #include "../common/json.h"
+#include "../common/mutex.h"
 
 namespace det {
 
@@ -184,17 +185,23 @@ class KubernetesResourceManager : public ResourceManager {
   void api_delete_pod_async(const std::string& name);
   Json api_list_pods();
 
+  // not-guarded: cfg_/hooks_ are immutable after the constructor.
   KubernetesRmConfig cfg_;
   RmHooks hooks_;
+  // not-guarded: pods_/last_reconcile_ are only touched under the master
+  // mutex (the rm.h contract — every ResourceManager method runs under
+  // mu_); the poller thread never reads them.
   std::map<std::string, Pod> pods_;  // by pod name
   double last_reconcile_ = 0;
   // Pod list snapshot refreshed by a background poller OUTSIDE the master
   // lock (a blocking LIST under mu_ would stall the whole control plane
   // whenever the API server is slow); tick() consumes the latest snapshot.
-  std::shared_ptr<const Json> live_snapshot_;
-  std::shared_ptr<std::mutex> snapshot_mu_ = std::make_shared<std::mutex>();
+  // The mutex is shared with the poller thread (which outlives any single
+  // tick) — the shared_ptr pins it across destruction races.
+  std::shared_ptr<Mutex> snapshot_mu_ = std::make_shared<Mutex>();
+  std::shared_ptr<const Json> live_snapshot_ GUARDED_BY(*snapshot_mu_);
   std::shared_ptr<std::atomic<bool>> poller_run_;
-  std::thread poller_;
+  std::thread poller_;  // not-guarded: joined only by the destructor
 
  public:
   ~KubernetesResourceManager() override;
@@ -292,15 +299,16 @@ class Provisioner {
   // Node tracking shared with the detached I/O threads: they capture the
   // shared_ptr, so a master shutdown mid-request can't use-after-free.
   struct State {
-    std::mutex mu;
-    std::map<std::string, ProvNode> nodes;  // instances WE manage
-    int seq = 0;
+    Mutex mu;
+    // instances WE manage
+    std::map<std::string, ProvNode> nodes GUARDED_BY(mu);
+    int seq GUARDED_BY(mu) = 0;
     // Create-failure backoff, written by the detached create threads and
     // read by the launch decision: consecutive failures per pool, the
     // earliest next attempt per pool, and the lifetime failure counter.
-    std::map<std::string, int> create_failures;
-    std::map<std::string, double> backoff_until;
-    int64_t create_failures_total = 0;
+    std::map<std::string, int> create_failures GUARDED_BY(mu);
+    std::map<std::string, double> backoff_until GUARDED_BY(mu);
+    int64_t create_failures_total GUARDED_BY(mu) = 0;
   };
 
   bool observe_webhook(const std::string& pool, const ScalingSnapshot& snap,

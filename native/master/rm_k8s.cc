@@ -47,7 +47,7 @@ KubernetesResourceManager::KubernetesResourceManager(KubernetesRmConfig cfg,
       Json list = api_list_pods();
       if (list.is_object()) {
         auto snap = std::make_shared<const Json>(std::move(list));
-        std::lock_guard<std::mutex> lock(*mu);
+        MutexLock lock(*mu);
         live_snapshot_ = snap;
       }
       for (int i = 0; i < 10 && *run; ++i) {
@@ -330,7 +330,7 @@ void KubernetesResourceManager::tick(double now) {
   last_reconcile_ = now;
   std::shared_ptr<const Json> snap;
   {
-    std::lock_guard<std::mutex> lock(*snapshot_mu_);
+    MutexLock lock(*snapshot_mu_);
     snap = live_snapshot_;
   }
   if (!snap || !snap->is_object()) return;  // no fresh LIST yet

@@ -18,6 +18,7 @@
 #include <vector>
 
 #include "../agent/backoff.h"
+#include "../common/faultpoint.h"
 #include "../common/json.h"
 #include "../master/preflight.h"
 #include "../master/scheduler_fit.h"
@@ -766,6 +767,41 @@ static void test_backoff_jitter_bounds_and_spread() {
   CHECK(capped < 10.0);
 }
 
+// ---------------------------------------------------------- fault points
+
+static void test_faultpoint_catalogue_and_counted_arm() {
+  // Regression: the master fired master.resize.offer.drop and
+  // provisioner.create.fail but the kKnown catalogue didn't list them
+  // (surfaced by the NL004 registry lint) — the debug route could not
+  // discover them, and docs/chaos.md drifted. Every fired point must be
+  // listable.
+  Json listed = det::faults::list();
+  std::set<std::string> names;
+  for (const auto& p : listed["points"].as_array())
+    names.insert(p["name"].as_string());
+  CHECK(names.count("master.resize.offer.drop") == 1);
+  CHECK(names.count("provisioner.create.fail") == 1);
+
+  // Counted arm through the public API: fires exactly `count` times,
+  // then auto-disarms back to the no-op fast path.
+  std::string err;
+  CHECK(det::faults::arm("provisioner.create.fail", "error", 2, 0.0, &err));
+  CHECK(err.empty());
+  CHECK(det::faults::any_armed());
+  CHECK(FAULT_POINT("provisioner.create.fail") ==
+        det::faults::Action::kError);
+  CHECK(FAULT_POINT("provisioner.create.fail") ==
+        det::faults::Action::kError);
+  CHECK(FAULT_POINT("provisioner.create.fail") ==
+        det::faults::Action::kNone);
+  // A malformed mode is rejected, not silently armed.
+  CHECK(!det::faults::arm("provisioner.create.fail", "explode", 0, 0.0,
+                          &err));
+  CHECK(!err.empty());
+  det::faults::disarm_all();
+  CHECK(!det::faults::any_armed());
+}
+
 // -------------------------------------------------------------- driver
 
 int main() {
@@ -802,6 +838,7 @@ int main() {
       {"preflight_canary_fraction", test_preflight_canary_fraction},
       {"preflight_suppress_and_gate", test_preflight_suppress_and_gate},
       {"backoff_jitter", test_backoff_jitter_bounds_and_spread},
+      {"faultpoint_catalogue", test_faultpoint_catalogue_and_counted_arm},
   };
   for (auto& t : tests) {
     int before = g_failures;

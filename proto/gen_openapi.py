@@ -247,6 +247,13 @@ ROUTES += [
     ("post", "/api/v1/compile_jobs/{signature}/link", "compile",
      "Share another signature's artifacts ({from}) after a fingerprint "
      "match — executable sharing without recompiling"),
+    # Chaos/debug surface (docs/chaos.md): admin-gated fault injection.
+    ("get", "/api/v1/debug/faults", "debug",
+     "List compiled-in fault points and the currently armed set"),
+    ("post", "/api/v1/debug/faults", "debug",
+     "Arm ({point, mode, count?, probability?} or {spec}) or disarm "
+     "({point, mode: off}; no point = disarm all) fault points at "
+     "runtime"),
 ]
 
 

@@ -356,6 +356,77 @@ class TestDataLoaderRule:
         assert out[0].level == "warning"
 
 
+class TestThreadStopRule:
+    """DTL106 — `_stop` shadowing on threading.Thread subclasses crashes
+    join() at thread exit (Thread._stop() is an internal method)."""
+
+    def test_dtl106_instance_event(self):
+        out = lint_source(
+            "import threading\n"
+            "class Worker(threading.Thread):\n"
+            "    def __init__(self):\n"
+            "        super().__init__()\n"
+            "        self._stop = threading.Event()\n", "t.py")
+        assert codes(out) == ["DTL106"]
+        assert "_stop_evt" in out[0].message
+        assert out[0].level == "error"
+
+    def test_dtl106_class_attribute(self):
+        assert codes(lint_source(
+            "from threading import Thread\n"
+            "class Worker(Thread):\n"
+            "    _stop = None\n", "t.py")) == ["DTL106"]
+
+    def test_dtl106_method(self):
+        assert codes(lint_source(
+            "import threading\n"
+            "class Worker(threading.Thread):\n"
+            "    def _stop(self):\n"
+            "        pass\n", "t.py")) == ["DTL106"]
+
+    def test_dtl106_subclass_of_subclass(self):
+        assert codes(lint_source(
+            "import threading\n"
+            "class Base(threading.Thread):\n"
+            "    pass\n"
+            "class Worker(Base):\n"
+            "    def run(self):\n"
+            "        self._stop = threading.Event()\n", "t.py")) == ["DTL106"]
+
+    def test_dtl106_negative_stop_evt(self):
+        assert codes(lint_source(
+            "import threading\n"
+            "class Worker(threading.Thread):\n"
+            "    def __init__(self):\n"
+            "        super().__init__()\n"
+            "        self._stop_evt = threading.Event()\n", "t.py")) == []
+
+    def test_dtl106_negative_not_a_thread(self):
+        assert codes(lint_source(
+            "import threading\n"
+            "class Manager:\n"
+            "    def __init__(self):\n"
+            "        self._stop = threading.Event()\n", "t.py")) == []
+
+    def test_dtl106_noqa_suppression(self):
+        out = lint_source(
+            "import threading\n"
+            "class Worker(threading.Thread):\n"
+            "    def __init__(self):\n"
+            "        self._stop = threading.Event()  # det: noqa[DTL106]\n",
+            "t.py")
+        assert codes(out) == []
+        assert [d.code for d in out if d.suppressed] == ["DTL106"]
+
+    def test_dtl106_tree_is_clean(self):
+        """No Thread subclass in the tree shadows `_stop` (the long-running
+        watchers use `_stop_evt`)."""
+        from determined_tpu.analysis.astlint import lint_paths
+
+        diags = lint_paths([os.path.join(REPO, "determined_tpu")])
+        assert [d for d in diags if d.code == "DTL106"] == []
+
+
 # ---------------------------------------------------------------------------
 # config rules (DTL201-DTL202) — python side; native mirror in
 # native/tests/test_native.cc
