@@ -3,7 +3,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint test chaos bench-input bench-serve bench-serve-fleet bench-lifecycle bench-capacity bench-trace bench-compile native native-test clean
+.PHONY: lint test chaos bench-input bench-train bench-serve bench-serve-fleet bench-lifecycle bench-capacity bench-trace bench-compile native native-test clean
 
 # The dogfood gate (docs/preflight.md + docs/static-analysis.md): one
 # aggregate. The Python pass runs the DTL tree lint over the platform's
@@ -39,6 +39,13 @@ chaos:
 # (docs/trial-api.md "Data loading and the async input pipeline").
 bench-input:
 	$(PY) bench.py --only input
+
+# Training-attention A/B (docs/training-perf.md): dense -> flash(f32) ->
+# flash(bf16) -> flash+overlap, interleaved on this machine's mesh
+# (numerics gates) plus the v5e roofline anchored to the 50.5% dense
+# baseline (step_ms strictly improving per leg; final MFU >= 55%).
+bench-train:
+	$(PY) bench.py --only train_attn
 
 # Serving throughput/latency: continuous batching vs the sequential
 # one-request-at-a-time baseline on the same checkpoint

@@ -99,6 +99,200 @@ def _peak_flops() -> float:
     return 197e12
 
 
+def train_attn_bench() -> None:
+    """`make bench-train` (docs/training-perf.md): the four-leg training-
+    attention A/B — dense → flash(f32) → flash(bf16) → flash+overlap.
+
+    Two tiers, mirroring bench-compile's measured+modeled split:
+
+    (a) MEASURED — all four legs run interleaved on THIS machine's mesh
+        (same devices, same init, same batches; only the `optimizations`
+        knob changes): per-leg step_ms and one-step loss, gating the
+        numerics contract (flash ≡ dense arithmetic; bf16 within
+        tolerance). Caveat, printed in the JSON: on a CPU bench host the
+        pallas legs execute in *interpret mode* (the correctness path
+        tier-1 uses), so CPU step_ms for flash legs measures the
+        interpreter, not the kernel — the wiring and numerics are what
+        the measured tier gates there.
+
+    (b) MODELED — a v5e roofline for the full-size workload (gpt2-124M,
+        seq 1024, per-chip batch 16), anchored to the recorded 50.5%-MFU
+        dense baseline: the model only *differences* the attention and
+        comm terms each leg changes (full-vs-causal FLOPs, fp32-vs-bf16
+        MXU rate on the probability matmuls, materialized-score HBM
+        traffic, exposed all-gather time), with every constant stated in
+        the output. Gate: modeled step_ms strictly improves per leg and
+        the final leg's MFU >= 55%.
+    """
+    import os
+
+    if "jax" not in sys.modules:
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=8")
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import optax
+
+    from determined_tpu.models import gpt2
+    from determined_tpu.parallel.mesh import AXIS_ORDER, MeshConfig
+    from determined_tpu.parallel.sharding import LogicalRules
+    from determined_tpu.train import create_train_state, make_train_step
+
+    # Small enough to finish under interpret-mode pallas on CPU, but with a
+    # pallas-supported geometry (seq % 128 == 0, head dim 64).
+    B, S = 8, 128
+    n_dev = len(jax.devices())
+    fsdp = n_dev if n_dev in (2, 4, 8) else 1
+    shape = MeshConfig(data=1, fsdp=fsdp).resolve(fsdp).sizes()
+    mesh = jax.sharding.Mesh(
+        np.asarray(jax.devices()[:fsdp]).reshape(shape), AXIS_ORDER)
+    rules = LogicalRules()
+
+    def leg_cfg(impl, bf16=False, overlap=False):
+        return gpt2.Config(
+            vocab_size=512, n_positions=S, d_model=256, n_layer=2, n_head=4,
+            remat=False, attention_impl=impl, attention_bf16=bf16,
+            overlap_allgather=overlap)
+
+    legs = [
+        ("dense", leg_cfg("dense")),
+        ("flash_f32", leg_cfg("pallas")),
+        ("flash_bf16", leg_cfg("pallas", bf16=True)),
+        ("flash_bf16_overlap", leg_cfg("pallas", bf16=True, overlap=True)),
+    ]
+    batch = {"tokens": np.random.default_rng(0).integers(
+        0, 512, size=(B, S + 1)).astype(np.int32)}
+
+    def run_leg(cfg):
+        tx = optax.adamw(3e-4)
+        with mesh:
+            state = create_train_state(
+                lambda r: gpt2.init(r, cfg), tx, jax.random.PRNGKey(0))
+            step = make_train_step(
+                lambda p, b, r: gpt2.loss_fn(p, b, cfg, rules), tx,
+                mesh=mesh, rules=rules)
+            state, m = step(state, batch, jax.random.PRNGKey(1))  # compile
+            first_loss = float(m["loss"])
+            n_calls = 3
+            t0 = time.time()
+            for i in range(n_calls):
+                state, m = step(state, batch, jax.random.PRNGKey(2 + i))
+            float(m["loss"])
+            return (time.time() - t0) / n_calls * 1e3, first_loss
+
+    # Interleave two full rounds and keep each leg's best pass so process
+    # warmup (allocator, caches) doesn't bias whichever leg runs first.
+    measured = {name: {"step_ms": float("inf"), "loss": None}
+                for name, _ in legs}
+    for _ in range(2):
+        for name, cfg in legs:
+            ms, loss = run_leg(cfg)
+            if ms < measured[name]["step_ms"]:
+                measured[name] = {"step_ms": round(ms, 1),
+                                  "loss": round(loss, 4)}
+
+    d_loss = measured["dense"]["loss"]
+    f32_delta = abs(measured["flash_f32"]["loss"] - d_loss)
+    bf16_delta = abs(measured["flash_bf16"]["loss"] - d_loss)
+    backend = jax.default_backend()
+    print(json.dumps({
+        "metric": "train_attn_loss_parity",
+        "value": round(f32_delta, 5),
+        "unit": "|loss(flash_f32) - loss(dense)| one step, same init/batch "
+                "(gate: < 0.05; bf16 leg < 0.1)",
+        "vs_baseline": 1.0,
+        "detail": {
+            "legs": measured,
+            "bf16_delta": round(bf16_delta, 5),
+            "mesh": dict(zip(AXIS_ORDER, shape)),
+            "backend": backend,
+            "caveat": (None if backend in ("tpu", "axon") else
+                       "CPU host: pallas legs run in interpret mode, so "
+                       "their step_ms measures the interpreter — numerics "
+                       "and wiring are the gates here; kernel-speed gates "
+                       "live in the modeled tier below"),
+        },
+    }))
+    assert f32_delta < 0.05, measured
+    assert bf16_delta < 0.10, measured
+    assert (abs(measured["flash_bf16_overlap"]["loss"]
+                - measured["flash_bf16"]["loss"]) < 0.05), measured
+
+    # ---- (b) v5e roofline, anchored to the 50.5% dense baseline --------
+    PEAK = 197e12          # v5e bf16 MXU peak, FLOP/s
+    FP32_RATE = PEAK / 4   # fp32 matmul throughput on the same MXU
+    HBM_BW = 819e9         # v5e HBM bandwidth, B/s
+    AG_BW = 9e10           # effective per-chip fsdp all-gather BW, B/s
+    EXPOSED = 0.6          # fraction of all-gather time XLA fails to hide
+    SCORE_PASSES = 8       # fp32 HBM passes over [B,H,S,S] scores (dense
+    #                        fwd write+read, probs write+read, bwd x4)
+    BASE_MFU = 0.505       # the recorded dense-path baseline (BENCH_r*)
+
+    mcfg = gpt2.Config()   # gpt2-124M, the north-star per-chip workload
+    MB, MS = 16, 1024
+    tokens = MB * MS
+    useful = gpt2.flops_per_token(mcfg, MS) * tokens
+    L, D, H = mcfg.n_layer, mcfg.d_model, mcfg.n_head
+
+    t_base = 6.0 * gpt2.param_count(mcfg) * tokens / PEAK
+    attn_causal = 6.0 * L * D * MS * tokens   # fwd+bwd causal matmul FLOPs
+    attn_full = 2.0 * attn_causal             # dense computes the full S^2
+    t_scores = SCORE_PASSES * MB * H * MS * MS * 4 / HBM_BW
+    layer_bytes = (gpt2.param_count(mcfg) / L) * 2  # bf16 layer params
+    t_ag = 3 * L * layer_bytes / AG_BW        # fwd + bwd re-gather + RS
+
+    def leg_time(attn_s, comm_s):
+        return t_base + attn_s + comm_s + t_other
+
+    t_dense_attn = attn_full / PEAK + t_scores
+    # Calibrate the residual (remat recompute, layernorms, host gaps, ...)
+    # so the dense leg reproduces the recorded baseline exactly; every
+    # other leg reuses it — the model only differences what each leg
+    # changes.
+    t_other = (useful / (BASE_MFU * PEAK)
+               - (t_base + t_dense_attn + EXPOSED * t_ag))
+
+    modeled = {}
+    for name, attn_s, comm_s in [
+        ("dense", t_dense_attn, EXPOSED * t_ag),
+        # flash f32: causal-only FLOPs, no score traffic; the P-side
+        # matmuls (half the attention FLOPs) run at the fp32 MXU rate.
+        ("flash_f32",
+         0.5 * attn_causal / PEAK + 0.5 * attn_causal / FP32_RATE,
+         EXPOSED * t_ag),
+        ("flash_bf16", attn_causal / PEAK, EXPOSED * t_ag),
+        # overlap: the one-layer-ahead prefetch hides the gather behind
+        # the previous layer's compute; ~5% residual exposure remains.
+        ("flash_bf16_overlap", attn_causal / PEAK, 0.05 * t_ag),
+    ]:
+        t = leg_time(attn_s, comm_s)
+        modeled[name] = {"step_ms": round(t * 1e3, 1),
+                         "mfu": round(useful / (t * PEAK), 4)}
+
+    final = modeled["flash_bf16_overlap"]["mfu"]
+    print(json.dumps({
+        "metric": "train_attn_modeled_mfu",
+        "value": final,
+        "unit": "modeled MFU, gpt2-124M seq=1024 B=16/chip on v5e "
+                "(dense baseline calibrated to the recorded 50.5%; "
+                "gate: >= 0.55, step_ms strictly improving per leg)",
+        "vs_baseline": round(final / BASE_MFU, 3),
+        "detail": {
+            "legs": modeled,
+            "assumptions": {
+                "peak_bf16_flops": PEAK, "fp32_matmul_flops": FP32_RATE,
+                "hbm_bw": HBM_BW, "allgather_bw": AG_BW,
+                "exposed_ag_fraction": EXPOSED,
+                "score_hbm_passes": SCORE_PASSES,
+                "calibrated_other_ms": round(t_other * 1e3, 1),
+            },
+        },
+    }))
+    ms_seq = [modeled[n]["step_ms"] for n, _ in legs]
+    assert all(a > b for a, b in zip(ms_seq, ms_seq[1:])), modeled
+    assert final >= 0.55, modeled
+
+
 def input_pipeline_bench() -> None:
     """Async input pipeline A/B (`make bench-input`): the same slow-host
     loader + fixed-cost step, synchronous vs DevicePrefetcher. Reports the
@@ -1734,6 +1928,7 @@ def main() -> int:
         "resnet": lambda: __import__("bench_resnet").main(),
         "asha": lambda: __import__("bench_asha").main(),
         "input": input_pipeline_bench,
+        "train_attn": train_attn_bench,
         "serve": serve_bench,
         "serve_fleet": serve_fleet_bench,
         "lifecycle": lifecycle_bench,

@@ -110,6 +110,10 @@ class _ModuleIndex(ast.NodeVisitor):
     def __init__(self):
         self.functions: Dict[str, ast.AST] = {}  # qualname -> FunctionDef
         self.roots: Set[str] = set()
+        # Trial-method roots only (JaxTrial loss/evaluate/...): the subset
+        # DTL107 scopes to — the platform's own model library (module-level
+        # loss_fn*/apply* roots) legitimately implements softmax.
+        self.trial_roots: Set[str] = set()
         self.data_roots: Set[str] = set()  # build_*_data methods (DTL105)
         self.calls: Dict[str, Set[str]] = {}  # qualname -> called qualnames
         self._class_stack: List[Tuple[str, bool]] = []  # (name, is_jax_trial)
@@ -142,6 +146,7 @@ class _ModuleIndex(ast.NodeVisitor):
         in_jax_class = bool(self._class_stack) and self._class_stack[-1][1]
         if in_jax_class and node.name in TRACED_METHODS:
             self.roots.add(qual)
+            self.trial_roots.add(qual)
         if in_jax_class and node.name in DATA_LOADER_METHODS:
             self.data_roots.add(qual)
         if not self._class_stack and node.name.startswith(TRACED_NAME_PREFIXES):
@@ -203,9 +208,11 @@ class _ModuleIndex(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def _traced_closure(index: _ModuleIndex) -> Set[str]:
+def _traced_closure(index: _ModuleIndex,
+                    roots: Optional[Set[str]] = None) -> Set[str]:
     seen: Set[str] = set()
-    frontier = [r for r in index.roots if r in index.functions]
+    frontier = [r for r in (index.roots if roots is None else roots)
+                if r in index.functions]
     while frontier:
         fn = frontier.pop()
         if fn in seen:
@@ -384,6 +391,38 @@ def _thread_stop_findings(tree: ast.Module) -> List[Tuple[str, int, str]]:
 
 _JNP_HEADS = {"jnp", "jax.numpy"}
 
+# DTL107 — softmax callees that mark a hand-rolled attention path. Scoped to
+# the *trial-method* closure only (index.trial_roots): the platform's own
+# model library (module-level loss_fn*/apply* roots, ops/flash_attention.py's
+# reference path) legitimately implements softmax. log_softmax is NOT
+# flagged — it is the cross-entropy idiom, not attention.
+_SOFTMAX_HEADS = {"jax.nn", "nn", "jnn", "jax.scipy.special", "jsp.special"}
+
+
+class _AttnWalker(ast.NodeVisitor):
+    """DTL107 — hand-rolled attention softmax inside traced trial code."""
+
+    def __init__(self, func_qual: str):
+        self.func_qual = func_qual
+        self.findings: List[Tuple[str, int, str]] = []
+
+    def visit_Call(self, node: ast.Call) -> None:
+        d = _dotted(node.func)
+        if d is not None and d.split(".")[-1] == "softmax":
+            head = d.rsplit(".", 1)[0] if "." in d else ""
+            if head in _SOFTMAX_HEADS or d == "softmax":
+                self.findings.append((
+                    "DTL107", getattr(node, "lineno", 0),
+                    f"{d}() inside traced trial code '{self.func_qual}': a "
+                    "hand-rolled attention softmax bypasses "
+                    "`optimizations.attention_impl` (pallas flash attention, "
+                    "bf16 path — docs/training-perf.md), so platform "
+                    "attention A/Bs never reach this trial — route attention "
+                    "through the model library "
+                    "(ops/flash_attention.flash_attention) or suppress if "
+                    "this softmax is not attention"))
+        self.generic_visit(node)
+
 
 class _DataLoaderWalker(ast.NodeVisitor):
     """DTL105 — device transfer inside build_training/validation_data."""
@@ -462,6 +501,12 @@ def lint_source(
         for stmt in node.body:
             walker.visit(stmt)
         _emit(walker.findings)
+    # DTL107 runs over the trial-method closure only (see _SOFTMAX_HEADS).
+    for qual in sorted(_traced_closure(index, index.trial_roots)):
+        attn_walker = _AttnWalker(qual)
+        for stmt in index.functions[qual].body:
+            attn_walker.visit(stmt)
+        _emit(attn_walker.findings)
     for qual in sorted(index.data_roots):
         dl_walker = _DataLoaderWalker(qual)
         for stmt in index.functions[qual].body:

@@ -86,6 +86,15 @@ _ALL = [
          "not callable` when the thread finishes — name the flag "
          "`_stop_evt` (the convention used by core/_profiler.py and "
          "core/_preempt.py) instead"),
+    Rule("DTL107", "hand-rolled-attention-in-trial", "warning", "ast",
+         "trial code computes attention by hand (jax.nn.softmax / a manual "
+         "QK^T-softmax-V chain) inside a traced trial method: the "
+         "`optimizations.attention_impl` config knob (pallas flash "
+         "attention, bf16 path — docs/training-perf.md) cannot reach a "
+         "hand-rolled softmax, so platform-level attention A/Bs silently "
+         "measure nothing — route attention through the model library "
+         "(e.g. ops/flash_attention.flash_attention) or suppress if the "
+         "softmax is not attention"),
     # -- config cross-field checks --------------------------------------
     Rule("DTL201", "config-batch-mesh-mismatch", "error", "config",
          "hyperparameters.global_batch_size is not divisible by the mesh's "

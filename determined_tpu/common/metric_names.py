@@ -176,7 +176,8 @@ SPAN_NAMES: Dict[str, Tuple[str, str]] = {
         "when the partition is real, delivered in chaos runs)"),
     "harness.compile": (
         "harness", "First executable acquisition (AOT load or "
-                   "trace+compile); cache_hit/signature in attrs"),
+                   "trace+compile); cache_hit/signature/attention_impl "
+                   "in attrs"),
     "harness.restore": (
         "harness", "Checkpoint restore (lineage walk included)"),
     "harness.reshard": (

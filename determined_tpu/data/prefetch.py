@@ -97,18 +97,31 @@ class PrefetchConfig:
 def shard_batch(batch: Any, sharding) -> Any:
     """Device-put a host batch with the mesh's batch sharding.
 
+    `sharding` is either a single `Sharding` applied to every leaf or a
+    pytree of per-leaf shardings (the jitted step's exact input
+    `NamedSharding`s — `train.step.step_input_shardings` — so batches
+    arrive already in the step's declared in_shardings and XLA inserts no
+    resharding copy on the hot path).
+
     Single-process: one `jax.device_put` over the whole pytree (non-blocking
     dispatch). Multi-host: per-leaf `make_array_from_process_local_data`, so
     each process transfers only its local shard of the global batch.
     """
     import jax
     import numpy as np
+    from jax.sharding import Sharding
 
     if jax.process_count() > 1:
+        if isinstance(sharding, Sharding):
+            return jax.tree_util.tree_map(
+                lambda x: jax.make_array_from_process_local_data(
+                    sharding, np.asarray(x)),
+                batch,
+            )
         return jax.tree_util.tree_map(
-            lambda x: jax.make_array_from_process_local_data(
-                sharding, np.asarray(x)),
-            batch,
+            lambda x, s: jax.make_array_from_process_local_data(
+                s, np.asarray(x)),
+            batch, sharding,
         )
     return jax.device_put(batch, sharding)
 

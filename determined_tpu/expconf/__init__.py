@@ -229,8 +229,42 @@ def validate(config: Dict[str, Any]) -> List[str]:
     _validate_health(config.get("health"), errors)
     _validate_preemption(config.get("preemption"), errors)
     _validate_compile(config.get("compile"), errors)
+    _validate_optimizations(config.get("optimizations"), errors)
 
     return errors
+
+
+# The TPU meaning of the `optimizations:` block (the torch-era keys —
+# aggregation_frequency etc. — are shimmed away; see shim()).
+OPTIMIZATION_KEYS = ("attention_impl", "attention_bf16",
+                     "overlap_allgather", "prepartition_inputs")
+ATTENTION_IMPLS = ("auto", "pallas", "reference", "dense")
+
+
+def _validate_optimizations(block: Any, errors: List[str]) -> None:
+    """`optimizations:` — training-step performance knobs
+    (docs/training-perf.md): attention kernel selection, the bf16
+    attention path, the one-layer-ahead fsdp all-gather overlap, and
+    pre-partitioned step inputs."""
+    if block is None:
+        return
+    if not isinstance(block, dict):
+        errors.append("optimizations must be a mapping")
+        return
+    unknown = sorted(set(block) - set(OPTIMIZATION_KEYS))
+    if unknown:
+        errors.append(
+            f"optimizations: unknown keys {unknown}; valid: "
+            f"{', '.join(OPTIMIZATION_KEYS)}")
+    impl = block.get("attention_impl")
+    if impl is not None and impl not in ATTENTION_IMPLS:
+        errors.append(
+            f"optimizations.attention_impl {impl!r} must be one of "
+            f"{'|'.join(ATTENTION_IMPLS)}")
+    for flag in ("attention_bf16", "overlap_allgather",
+                 "prepartition_inputs"):
+        if flag in block and not isinstance(block[flag], bool):
+            errors.append(f"optimizations.{flag} must be a bool")
 
 
 def _validate_compile(block: Any, errors: List[str]) -> None:
@@ -842,8 +876,12 @@ def shim(config: Dict[str, Any]) -> Dict[str, Any]:
         "sync_halving" → async_halving (semantics preserved; the legacy
         names stay accepted by validate for byte-for-byte old configs)
       - resources.slots → resources.slots_per_trial
-      - dropped with a warning: optimizations (torch-specific),
-        bind_mounts (no containers), data_layers, entrypoint_script
+      - optimizations: the torch-era keys (aggregation_frequency, ...)
+        are dropped per-key with a warning; the TPU keys
+        (attention_impl, attention_bf16, overlap_allgather,
+        prepartition_inputs) are kept. A block left empty is dropped.
+      - dropped with a warning: bind_mounts (no containers),
+        data_layers, entrypoint_script
     """
     import warnings
 
@@ -866,8 +904,24 @@ def shim(config: Dict[str, Any]) -> Dict[str, Any]:
             isinstance(res.get("slots"), int):
         res["slots_per_trial"] = res.pop("slots")
 
-    for dropped in ("optimizations", "bind_mounts", "data_layers",
-                    "entrypoint_script"):
+    opt = c.get("optimizations")
+    if isinstance(opt, dict):
+        for legacy in sorted(set(opt) - set(OPTIMIZATION_KEYS)):
+            warnings.warn(
+                f"expconf: `optimizations.{legacy}` is a torch-era knob "
+                "with no meaning on the TPU platform and is ignored",
+                stacklevel=2)
+            opt.pop(legacy)
+        if not opt:
+            c.pop("optimizations")
+    elif "optimizations" in c:
+        warnings.warn(
+            "expconf: `optimizations` must be a mapping of TPU knobs "
+            "(attention_impl, ...); the legacy form is ignored",
+            stacklevel=2)
+        c.pop("optimizations")
+
+    for dropped in ("bind_mounts", "data_layers", "entrypoint_script"):
         if dropped in c:
             warnings.warn(
                 f"expconf: `{dropped}` has no meaning on the TPU platform "
@@ -951,6 +1005,12 @@ def apply_defaults(config: Dict[str, Any]) -> Dict[str, Any]:
         cc.setdefault("bucket_batch_sizes", False)
         cc.setdefault("max_executables", 8)
         cc.setdefault("upload", True)
+    opt = c.setdefault("optimizations", {})
+    if isinstance(opt, dict):
+        opt.setdefault("attention_impl", "auto")
+        opt.setdefault("attention_bf16", False)
+        opt.setdefault("overlap_allgather", False)
+        opt.setdefault("prepartition_inputs", True)
     health = c.setdefault("health", {})
     if isinstance(health, dict):
         health.setdefault("on_nan", "warn")

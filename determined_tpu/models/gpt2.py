@@ -11,8 +11,13 @@ harness/determined/transformers/_hf_callback.py) but re-designed for the MXU:
   - logical-axis sharding annotations (batch/embed/heads/mlp/vocab) so the
     same model runs DP, FSDP, TP or any combination by swapping rules
   - optional `jax.checkpoint` rematerialisation of each block
-  - attention pluggable: "dot" (XLA-fused) or "flash" (pallas kernel,
-    determined_tpu.ops.flash_attention)
+  - attention pluggable via `optimizations.attention_impl`
+    (auto | pallas | reference | dense — ops/flash_attention.py; plus the
+    context-parallel "ring"/"ulysses" strategies) with an optional
+    bf16-probabilities mode (`attention_bf16`)
+  - optional comm/compute overlap (`overlap_allgather`): the layers scan
+    carries the current layer's fsdp-gathered params while the next
+    layer's all-gather is issued a step ahead (docs/training-perf.md)
 """
 
 from __future__ import annotations
@@ -44,9 +49,20 @@ class Config:
     # outputs and recomputes only elementwise/softmax (less recompute, more
     # HBM); see jax.checkpoint_policies.
     remat_policy: Optional[str] = "dots"
-    # "flash" = pallas fused kernel on TPU (falls back to the XLA path on CPU
-    # meshes / unsupported shapes); "dot" = XLA; "ring" = context-parallel.
+    # `optimizations.attention_impl`: "auto" = pallas flash kernel on TPU,
+    # jnp reference elsewhere; "pallas"/"reference" force one side;
+    # "dense" = legacy XLA path (A/B baseline). Legacy spellings accepted:
+    # "flash" == auto, "dot" == dense. "ring"/"ulysses" = context-parallel.
     attention_impl: str = "flash"
+    # `optimizations.attention_bf16`: cast attention probabilities to bf16
+    # for the P·V / dS·K matmuls (MXU bf16 path); the online-softmax
+    # statistics stay fp32 regardless. Numerics gate: tests/test_models.py.
+    attention_bf16: bool = False
+    # `optimizations.overlap_allgather`: restructure the layers scan so each
+    # layer's fsdp param all-gather is issued one layer ahead of its use
+    # (carry holds the gathered slice; gather overlaps the previous layer's
+    # compute). No-op unless the rules map params onto a >1 "fsdp" axis.
+    overlap_allgather: bool = False
     layer_norm_eps: float = 1e-5
     # Unroll factor for the layers scan. 0 = full unroll: removes the
     # per-layer stacked-param dynamic-slice and scan-carry stacking overhead
@@ -207,10 +223,6 @@ def _layer_norm(x, scale, bias, eps):
 
 def _attention(q, k, v, cfg: Config, rules: Optional[LogicalRules]):
     """q,k,v: [B, S, H, Dh]. Causal self-attention."""
-    if cfg.attention_impl == "flash":
-        from determined_tpu.ops.flash_attention import flash_attention
-
-        return flash_attention(q, k, v, causal=True)
     if cfg.attention_impl == "ring":
         from determined_tpu.ops.ring_attention import ring_attention
 
@@ -219,13 +231,70 @@ def _attention(q, k, v, cfg: Config, rules: Optional[LogicalRules]):
         from determined_tpu.ops.ulysses import ulysses_attention
 
         return ulysses_attention(q, k, v, causal=True)
-    scale = 1.0 / math.sqrt(q.shape[-1])
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    s = q.shape[1]
-    mask = jnp.tril(jnp.ones((s, s), jnp.bool_))
-    logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    from determined_tpu.ops.flash_attention import flash_attention
+
+    return flash_attention(q, k, v, causal=True, impl=cfg.attention_impl,
+                           bf16=cfg.attention_bf16)
+
+
+def _fsdp_stripped_entry(entry):
+    """One PartitionSpec entry with the fsdp mesh axis removed."""
+    if entry is None:
+        return None
+    if isinstance(entry, (tuple, list)):
+        kept = tuple(a for a in entry if a != "fsdp")
+        # len() of a Python axis-name tuple, not a traced shape.
+        return kept[0] if len(kept) == 1 else (kept or None)  # det: noqa[DTL104]
+    return None if entry == "fsdp" else entry
+
+
+def _gather_block_params(lp, cfg: Config, rules: LogicalRules):
+    """Constrain one layer's param slice to its fsdp-UNsharded layout.
+
+    Each leaf keeps every mesh axis its logical spec resolves to except
+    "fsdp" — i.e. tensor-parallel shards stay sharded, only the fsdp
+    split is gathered. Placing this constraint where the slice enters the
+    scan carry is what lets the partitioner issue layer N+1's all-gather
+    while layer N's matmuls run (`overlap_allgather`)."""
+    axes = param_logical_axes(cfg)["blocks"]
+
+    def one(p, leaf_axes):
+        spec = rules.spec(tuple(leaf_axes)[1:])  # drop stacked layers dim
+        stripped = jax.sharding.PartitionSpec(
+            *[_fsdp_stripped_entry(e) for e in spec])
+        try:
+            return jax.lax.with_sharding_constraint(p, stripped)
+        except (ValueError, RuntimeError):  # no mesh context (eager use)
+            return p
+
+    return jax.tree.map(one, lp, axes)
+
+
+def _scan_overlap(block, x, blocks, cfg: Config, rules: LogicalRules,
+                  unroll: int):
+    """Layers scan with the fsdp all-gather issued one layer ahead.
+
+    The carry holds the CURRENT layer's already-gathered params; xs are
+    the block stack rolled by −1 so iteration i delivers layer i+1's
+    shards. The body constrains the incoming slice to the fsdp-stripped
+    spec BEFORE running the current block, so the gather collective for
+    the next layer overlaps this layer's compute instead of serializing
+    in front of it. Arithmetic is identical to the plain scan (asserted
+    in tests/test_models.py); the final iteration's rolled-around gather
+    of layer 0 is dead and DCE'd or wasted-but-harmless.
+    """
+    first = jax.tree.map(lambda p: p[0], blocks)
+    rest = jax.tree.map(lambda p: jnp.roll(p, -1, axis=0), blocks)
+    gathered0 = _gather_block_params(first, cfg, rules)
+
+    def body(carry, lp_next):
+        xx, lp = carry
+        lp_next = _gather_block_params(lp_next, cfg, rules)
+        xx, aux = block(xx, lp)
+        return (xx, lp_next), aux
+
+    (x, _), auxs = jax.lax.scan(body, (x, gathered0), rest, unroll=unroll)
+    return x, auxs
 
 
 def _block(x, lp, cfg: Config, rules: Optional[LogicalRules]):
@@ -381,7 +450,11 @@ def apply(
         return x, aux
 
     unroll = cfg.scan_unroll if cfg.scan_unroll > 0 else cfg.n_layer
-    x, auxs = jax.lax.scan(scan_body, x, params["blocks"], unroll=unroll)
+    if cfg.overlap_allgather and rules is not None:
+        x, auxs = _scan_overlap(block, x, params["blocks"], cfg, rules,
+                                unroll)
+    else:
+        x, auxs = jax.lax.scan(scan_body, x, params["blocks"], unroll=unroll)
     x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"], cfg.layer_norm_eps)
     logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(dt))
     logits = shard_logical(logits, ("batch", "seq", "vocab"), rules)

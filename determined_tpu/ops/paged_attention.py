@@ -43,20 +43,19 @@ import functools
 import jax
 import jax.numpy as jnp
 
-try:  # pallas is optional at import time (matches ops/pallas_attention.py)
+from determined_tpu.ops._pallas_common import (
+    HAVE_PALLAS,
+    NEG_INF,
+    finish_softmax_scratch,
+    init_softmax_scratch,
+    interpret_default as _interpret_default,
+    online_softmax_update,
+    softmax_scratch,
+)
+
+if HAVE_PALLAS:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
-
-    HAVE_PALLAS = True
-except ImportError:  # pragma: no cover - pallas not in this build
-    HAVE_PALLAS = False
-
-NEG_INF = -1e30
-
-
-def _interpret_default() -> bool:
-    """Pallas TPU kernels run interpreted off-TPU (tier-1 on CPU)."""
-    return jax.default_backend() != "tpu"
 
 
 # ---------------------------------------------------------------------------
@@ -104,9 +103,7 @@ def _paged_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(b == 0)
     def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[...] = jnp.zeros_like(l_ref)
+        init_softmax_scratch(acc_ref, m_ref, l_ref)
 
     pos = pos_ref[s]
 
@@ -123,19 +120,12 @@ def _paged_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
         idx = b * block_size + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_size), 1)
         st = jnp.where(idx <= pos, st, NEG_INF)
-        m_prev, l_prev = m_ref[...], l_ref[...]
-        m_new = jnp.maximum(m_prev, jnp.max(st, axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(st - m_new)
-        m_ref[...] = m_new
-        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)                # [H, Dh]
+        online_softmax_update(st, v, acc_ref, m_ref, l_ref,
+                              (((1,), (1,)), ((0,), (0,))))    # [H, Dh]
 
     @pl.when(b == mb - 1)
     def _finish():
-        o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+        finish_softmax_scratch(o_ref, acc_ref, l_ref, idx=0)
 
 
 def paged_attention_pallas(
@@ -168,11 +158,7 @@ def paged_attention_pallas(
         ],
         out_specs=pl.BlockSpec(
             (1, nh, dh), lambda s, b, tbl, pos: (s, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((nh, dh), jnp.float32),  # acc
-            pltpu.VMEM((nh, 1), jnp.float32),   # running max
-            pltpu.VMEM((nh, 1), jnp.float32),   # running normalizer
-        ],
+        scratch_shapes=softmax_scratch(nh, dh),  # fp32 acc/m/l in VMEM
     )
     kernel = functools.partial(
         _paged_kernel, block_size=bs, scale=1.0 / (dh ** 0.5))

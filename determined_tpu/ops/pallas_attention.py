@@ -1,322 +1,13 @@
-"""Pallas TPU flash attention (fwd + custom-vjp bwd).
+"""Compatibility shim — the Pallas flash-attention training kernel moved
+to `ops/flash_attention.py` (PR 18), which unifies the kernel, the jnp
+reference path, and the `optimizations.attention_impl` dispatcher in one
+module and shares its grid/scratch plumbing with the decode kernel via
+`ops/_pallas_common.py`. Import from `determined_tpu.ops.flash_attention`
+in new code."""
 
-The MFU-critical kernel for the GPT-2 north star (BASELINE.md; SURVEY.md §7
-"Hard parts" (f): ≥40% MFU demands fused attention). Tiled causal attention
-with online softmax: the S×S logits matrix never round-trips through HBM —
-each [block_q, block_k] tile lives in VMEM, is accumulated in fp32, and only
-the [S, D] output (plus per-row logsumexp stats for the backward) is written
-back.
-
-Layout: kernels operate on [BH, S, D] (batch×heads flattened); the public
-wrapper accepts the model's [B, S, H, D] and transposes. Block sizes default
-to MXU/VMEM-friendly 256/512 tiles; the grid walks (bh, q-block) with the
-K/V buffers for a given bh held in VMEM across its q blocks (pallas skips
-the re-fetch when a block index repeats between consecutive programs).
-
-Backward is the standard two-kernel flash split:
-  - dq kernel: grid over q blocks, inner loop over visible k blocks;
-  - dk/dv kernel: grid over k blocks, inner loop over visible q blocks;
-with p = exp(s - L) recomputed from the saved logsumexp L (no max pass
-needed) and delta = rowsum(dO ∘ O) precomputed in XLA.
-"""
-
-from __future__ import annotations
-
-import functools
-import math
-from typing import Tuple
-
-import jax
-import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
-
-NEG_INF = -1e30
-
-
-def _pick_blocks(s: int) -> Tuple[int, int]:
-    """(block_q, block_k) tuned for v5e VMEM; both divide s (s % 128 == 0)."""
-    block_q = min(512, s)
-    block_k = min(512, s)
-    while s % block_q:
-        block_q //= 2
-    while s % block_k:
-        block_k //= 2
-    return block_q, block_k
-
-
-# --------------------------------------------------------------------------
-# forward
-# --------------------------------------------------------------------------
-
-
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
-                causal):
-    qi = pl.program_id(1)
-    block_q = q_ref.shape[1]
-    d = q_ref.shape[2]
-    s = k_ref.shape[1]
-    num_k = s // block_k
-
-    q = q_ref[0]  # [block_q, d]
-
-    acc = jnp.zeros((block_q, d), jnp.float32)
-    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q, 1), jnp.float32)
-
-    if causal:
-        # Only k blocks that intersect the visible triangle.
-        upper = jax.lax.div(qi * block_q + block_q - 1, block_k) + 1
-    else:
-        upper = num_k
-
-    def body(j, carry):
-        acc, m, l = carry
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
-        st = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [block_q, block_k]
-        if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            st = jnp.where(rows >= cols, st, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(st, axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(st - m_new)
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        acc = acc * alpha + pv
-        return acc, m_new, l
-
-    acc, m, l = jax.lax.fori_loop(0, upper, body, (acc, m, l))
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l)  # [block_q, 1]
-
-
-def _flash_fwd(q, k, v, causal: bool):
-    """q,k,v: [BH, S, D] → (o [BH,S,D], lse [BH,S] fp32)."""
-    bh, s, d = q.shape
-    block_q, block_k = _pick_blocks(s)
-    scale = 1.0 / math.sqrt(d)
-    grid = (bh, s // block_q)
-    kernel = functools.partial(
-        _fwd_kernel, scale=scale, block_k=block_k, causal=causal)
-    flops_per_bh = 4 * s * s * d * (0.5 if causal else 1.0)
-    o, lse = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            # trailing unit dim: TPU block tiling needs the last dim to match
-            # the array (per-row stats can't be a bare [bh, s] block)
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
-        ],
-        cost_estimate=pl.CostEstimate(
-            flops=int(flops_per_bh * bh),
-            bytes_accessed=int(3 * bh * s * d * q.dtype.itemsize),
-            transcendentals=int(bh * s * s * (0.5 if causal else 1.0)),
-        ),
-    )(q, k, v)
-    return o, lse
-
-
-# --------------------------------------------------------------------------
-# backward
-# --------------------------------------------------------------------------
-
-
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               scale, block_k, causal):
-    qi = pl.program_id(1)
-    block_q = q_ref.shape[1]
-    d = q_ref.shape[2]
-    s = k_ref.shape[1]
-    num_k = s // block_k
-
-    q = q_ref[0]
-    do = do_ref[0]
-    lse = lse_ref[0]     # [block_q, 1]
-    delta = delta_ref[0]
-
-    if causal:
-        upper = jax.lax.div(qi * block_q + block_q - 1, block_k) + 1
-    else:
-        upper = num_k
-
-    def body(j, dq):
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
-        st = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        p = jnp.exp(st - lse)  # ≤ 1; lse is the exact logsumexp
-        if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            p = jnp.where(rows >= cols, p, 0.0)
-        dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = (p * (dp - delta) * scale).astype(k_blk.dtype)
-        dq = dq + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return dq
-
-    dq = jax.lax.fori_loop(0, upper, body, jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
-
-
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
-                dv_ref, *, scale, block_q, causal):
-    ki = pl.program_id(1)
-    block_k = k_ref.shape[1]
-    d = k_ref.shape[2]
-    s = q_ref.shape[1]
-    num_q = s // block_q
-
-    k = k_ref[0]  # [block_k, d]
-    v = v_ref[0]
-
-    lower = jax.lax.div(ki * block_k, block_q) if causal else 0
-
-    def body(i, carry):
-        dk, dv = carry
-        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :]
-        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[0, pl.ds(i * block_q, block_q), :]    # [block_q, 1]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q), :]
-        st = jax.lax.dot_general(
-            q_blk, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [block_q, block_k]
-        p = jnp.exp(st - lse)
-        if causal:
-            rows = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            p = jnp.where(rows >= cols, p, 0.0)
-        pt = p.astype(do_blk.dtype)
-        dv = dv + jax.lax.dot_general(
-            pt, do_blk, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [block_k, d]
-        dp = jax.lax.dot_general(
-            do_blk, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [block_q, block_k]
-        ds = (p * (dp - delta) * scale).astype(q_blk.dtype)
-        dk = dk + jax.lax.dot_general(
-            ds, q_blk, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [block_k, d]
-        return dk, dv
-
-    dk, dv = jax.lax.fori_loop(
-        lower, num_q, body,
-        (jnp.zeros((block_k, d), jnp.float32),
-         jnp.zeros((block_k, d), jnp.float32)))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
-
-
-def _flash_bwd(q, k, v, o, lse, do, causal: bool):
-    bh, s, d = q.shape
-    block_q, block_k = _pick_blocks(s)
-    scale = 1.0 / math.sqrt(d)
-    # delta_i = sum_d dO_id * O_id — cheap elementwise reduce; let XLA fuse.
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
-                    keepdims=True)  # [bh, s, 1]
-
-    dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, block_k=block_k,
-                          causal=causal),
-        grid=(bh, s // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-    )(q, k, v, do, lse, delta)
-
-    dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, block_q=block_q,
-                          causal=causal),
-        grid=(bh, s // block_k),
-        in_specs=[
-            pl.BlockSpec((1, s, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, s, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, s, 1), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, s, 1), lambda b, j: (b, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
-        ],
-    )(q, k, v, do, lse, delta)
-    return dq, dk, dv
-
-
-# --------------------------------------------------------------------------
-# public op with custom vjp
-# --------------------------------------------------------------------------
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _flash(q, k, v, causal):
-    o, _ = _flash_fwd(q, k, v, causal)
-    return o
-
-
-def _flash_vjp_fwd(q, k, v, causal):
-    o, lse = _flash_fwd(q, k, v, causal)
-    return o, (q, k, v, o, lse)
-
-
-def _flash_vjp_bwd(causal, res, do):
-    q, k, v, o, lse = res
-    return _flash_bwd(q, k, v, o, lse, do, causal)
-
-
-_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
-
-
-def pallas_flash_attention(q, k, v, causal: bool = True) -> jax.Array:
-    """q,k,v: [B, S, H, D] → [B, S, H, D]. Causal fused attention."""
-    b, s, h, d = q.shape
-    to3 = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    o = _flash(to3(q), to3(k), to3(v), causal)
-    return o.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+from determined_tpu.ops.flash_attention import (  # noqa: F401
+    _flash,
+    _flash_bwd,
+    _flash_fwd,
+    pallas_flash_attention,
+)

@@ -40,12 +40,21 @@ def make_train_step(
     rules: Optional[LogicalRules] = None,
     donate_state: bool = True,
     stateful: bool = False,
+    input_sharding: Any = None,
 ):
     """Build `step(state, batch, rng) -> (state, metrics)`, jitted.
 
     Stateless (default): loss_fn(params, batch, rng) -> loss | (loss, metrics).
     Stateful (BatchNorm etc.): loss_fn(params, extra, batch, rng) ->
     (loss, metrics, new_extra); new_extra lands in state.extra.
+
+    `input_sharding` (a `NamedSharding` pytree prefix or per-leaf tree —
+    `step_input_shardings`) is declared as the batch argument's
+    in_shardings: with the DevicePrefetcher placing batches with the same
+    shardings, XLA's compiled argument layout equals the arrival layout
+    and no resharding copy precedes the first layer (the pre-partitioned
+    input contract; asserted on compiled HLO in tests). State and rng
+    shardings stay inferred from the arguments.
 
     metrics always include `loss` and `grad_norm` (fp32 scalars, replicated).
     """
@@ -77,7 +86,11 @@ def make_train_step(
                    "all_finite": all_finite.astype(jnp.float32), **aux}
         return new_state, metrics
 
-    return jax.jit(step, donate_argnums=(0,) if donate_state else ())
+    kwargs: Dict[str, Any] = {}
+    if input_sharding is not None:
+        kwargs["in_shardings"] = (None, input_sharding, None)
+    return jax.jit(step, donate_argnums=(0,) if donate_state else (),
+                   **kwargs)
 
 
 def make_multi_step(
@@ -87,6 +100,7 @@ def make_multi_step(
     mesh: Optional[Mesh] = None,
     rules: Optional[LogicalRules] = None,
     donate_state: bool = True,
+    input_sharding: Any = None,
 ):
     """Build `multi_step(state, batches, rng) -> (state, metrics)` running
     `steps_per_call` optimizer steps inside ONE jitted call via `lax.scan`.
@@ -128,7 +142,11 @@ def make_multi_step(
         )
         return state, jax.tree_util.tree_map(lambda m: m.mean(axis=0), metrics)
 
-    return jax.jit(multi_step, donate_argnums=(0,) if donate_state else ())
+    kwargs: Dict[str, Any] = {}
+    if input_sharding is not None:
+        kwargs["in_shardings"] = (None, input_sharding, None)
+    return jax.jit(multi_step, donate_argnums=(0,) if donate_state else (),
+                   **kwargs)
 
 
 def _constrain_batch(batch: Any, mesh: Optional[Mesh], rules: LogicalRules,
@@ -160,11 +178,50 @@ def batch_sharding(mesh: Mesh, rules: Optional[LogicalRules] = None) -> NamedSha
     return NamedSharding(mesh, PartitionSpec(rules.mesh_axes("batch")))
 
 
+def step_input_shardings(
+    mesh: Mesh,
+    rules: Optional[LogicalRules] = None,
+    batch: Any = None,
+    leading_dims: int = 1,
+) -> Any:
+    """The jitted step's exact batch-argument `NamedSharding`s.
+
+    One source of truth for both sides of the pre-partitioned input
+    contract (`optimizations.prepartition_inputs`): the DevicePrefetcher
+    device_puts batches with these shardings and make_train_step /
+    make_multi_step declare the same value as `input_sharding`, so the
+    compiled step finds its inputs already laid out and inserts no
+    resharding copy before the first layer.
+
+    Without `batch` the single batch-dim sharding is returned — jit and
+    device_put both accept it as a pytree prefix covering every leaf.
+    With an example `batch`, a matching per-leaf tree is returned
+    (sub-`leading_dims`-rank leaves replicate — same rank guard as
+    `_constrain_batch`). leading_dims=2 is the multi-step window layout
+    ([steps, batch, ...]: steps axis unsharded).
+    """
+    rules = rules or LogicalRules()
+    batch_axes = rules.mesh_axes("batch")
+    spec = (PartitionSpec(None, batch_axes) if leading_dims == 2
+            else PartitionSpec(batch_axes))
+    sharded = NamedSharding(mesh, spec)
+    if batch is None:
+        return sharded
+
+    def leaf(x):
+        if getattr(x, "ndim", 0) < leading_dims:  # det: noqa[DTL104]
+            return NamedSharding(mesh, PartitionSpec())
+        return sharded
+
+    return jax.tree_util.tree_map(leaf, batch)
+
+
 def make_eval_step(
     eval_fn: Callable[..., Dict[str, jax.Array]],
     mesh: Optional[Mesh] = None,
     rules: Optional[LogicalRules] = None,
     stateful: bool = False,
+    input_sharding: Any = None,
 ):
     """Build `eval_step(state, batch) -> metrics` (per-batch sums/means).
 
@@ -178,4 +235,7 @@ def make_eval_step(
             return eval_fn(state.params, state.extra, batch)
         return eval_fn(state.params, batch)
 
-    return jax.jit(step)
+    kwargs: Dict[str, Any] = {}
+    if input_sharding is not None:
+        kwargs["in_shardings"] = (None, input_sharding)
+    return jax.jit(step, **kwargs)

@@ -39,7 +39,12 @@ from determined_tpu.train.health import (
     PreemptionConfig,
 )
 from determined_tpu.train.state import TrainState, create_train_state
-from determined_tpu.train.step import batch_sharding, make_eval_step, make_train_step
+from determined_tpu.train.step import (
+    batch_sharding,
+    make_eval_step,
+    make_train_step,
+    step_input_shardings,
+)
 from determined_tpu.train.trial import JaxTrial
 from determined_tpu.train.watchdog import StepWatchdog
 
@@ -49,7 +54,8 @@ logger = logging.getLogger("determined_tpu.train")
 
 
 def _timed_first_call(fn, tracer, executable: str, install,
-                      farm=None, compile_cfg=None, report=None):
+                      farm=None, compile_cfg=None, report=None,
+                      extra_attrs=None):
     """Wrap a jitted step so its FIRST invocation is the compile-farm
     integration point (docs/compile-farm.md):
 
@@ -98,6 +104,8 @@ def _timed_first_call(fn, tracer, executable: str, install,
             attrs = {"executable": executable, "cache_hit": cache_hit}
             if farm is not None and farm.signature:
                 attrs["signature"] = farm.signature
+            if extra_attrs:
+                attrs.update(extra_attrs)
             tracer.emit("harness.compile", t0_us, trace_mod.now_us(), attrs)
         if report is not None:
             report(executable, compile_ms, cache_hit)
@@ -156,6 +164,10 @@ class Trainer:
         self._farm: Optional[FarmClient] = None
         self._compile_cfg: Optional[CompileConfig] = None
         self._compile_events: list = []
+        # Resolved `optimizations.attention_impl` (auto → pallas/reference
+        # by backend) — attached to the harness.compile span and the
+        # compile-event metrics flush so A/B runs are attributable.
+        self._attention_impl: Optional[str] = None
 
     # -- setup ---------------------------------------------------------
 
@@ -252,13 +264,26 @@ class Trainer:
                 {"executable": executable, "compile_ms": compile_ms,
                  "cache_hit": cache_hit})
 
+        from determined_tpu.ops.flash_attention import resolve_attention_impl
+
+        opt = self._optimizations_config(self.core)
+        self._attention_impl = resolve_attention_impl(
+            opt.get("attention_impl"))
+        span_attrs = {"attention_impl": self._attention_impl}
+        # Pre-partitioned step inputs (docs/training-perf.md): declare the
+        # batch argument's in_shardings; fit() hands the DevicePrefetcher
+        # the same value, so arrivals already match the compiled layout.
+        in_shard = (step_input_shardings(self.mesh, self.rules)
+                    if opt.get("prepartition_inputs", True) else None)
         self._train_step = _timed_first_call(
             make_train_step(
                 loss, tx, mesh=self.mesh, rules=self.rules,
                 donate_state=trial.donate_state, stateful=trial.stateful,
+                input_sharding=in_shard,
             ),
             tracer, "train_step", install_train,
-            farm=self._farm, compile_cfg=self._compile_cfg, report=report)
+            farm=self._farm, compile_cfg=self._compile_cfg, report=report,
+            extra_attrs=span_attrs)
         has_eval = type(trial).evaluate is not JaxTrial.evaluate
         if pipelined and trial.supports_pipelined_eval():
             mesh = self.mesh
@@ -268,10 +293,11 @@ class Trainer:
                         params, batch, mesh
                     ),
                     mesh=self.mesh, rules=self.rules, stateful=trial.stateful,
+                    input_sharding=in_shard,
                 ),
                 tracer, "eval_step", install_eval,
                 farm=self._farm, compile_cfg=self._compile_cfg,
-                report=report)
+                report=report, extra_attrs=span_attrs)
         elif has_eval:
             if pipelined:
                 logger.warning(
@@ -283,11 +309,11 @@ class Trainer:
             self._eval_step = _timed_first_call(
                 make_eval_step(
                     trial.evaluate, mesh=self.mesh, rules=self.rules,
-                    stateful=trial.stateful,
+                    stateful=trial.stateful, input_sharding=in_shard,
                 ),
                 tracer, "eval_step", install_eval,
                 farm=self._farm, compile_cfg=self._compile_cfg,
-                report=report)
+                report=report, extra_attrs=span_attrs)
         else:
             self._eval_step = None
 
@@ -316,6 +342,15 @@ class Trainer:
         if core is not None and core.info is not None and core.info.trial:
             expconf = core.info.trial.config
         return CompileConfig.resolve(self.trial, expconf)
+
+    def _optimizations_config(self, core) -> Dict[str, Any]:
+        """The validated `optimizations:` block ({} outside a cluster run;
+        callers .get() with the documented defaults)."""
+        if core is not None and core.info is not None and core.info.trial:
+            block = (core.info.trial.config or {}).get("optimizations")
+            if isinstance(block, dict):
+                return block
+        return {}
 
     def fit(
         self,
@@ -364,7 +399,10 @@ class Trainer:
             data_iter = bucketed_iter(data_iter, self._compile_cfg)
         prefetcher: Optional[DevicePrefetcher] = None
         if self._pf_cfg.enabled:
-            sharding = (batch_sharding(self.mesh, self.rules)
+            # step_input_shardings == the train step's declared batch
+            # in_shardings (pre-partitioned input contract): arrivals are
+            # already in the compiled layout, no resharding copy on entry.
+            sharding = (step_input_shardings(self.mesh, self.rules)
                         if self._pf_cfg.shard else None)
             prefetcher = DevicePrefetcher(
                 data_iter, sharding=sharding, depth=self._pf_cfg.depth,
@@ -581,6 +619,10 @@ class Trainer:
             host["compile_ms"] = sum(e["compile_ms"] for e in events)
             host["compile_cache_hit"] = (
                 1.0 if all(e["cache_hit"] for e in events) else 0.0)
+            if self._attention_impl is not None:
+                # Rides the same once-per-compile flush as compile_ms so
+                # A/B dashboards can attribute the run's kernel choice.
+                host["attention_impl"] = self._attention_impl
         # The divergence sentinel's event channel: a non-finite step marks
         # this flush's report so dashboards/webhooks see `divergence: 1`
         # exactly where the loss went bad (train/health.py).

@@ -44,6 +44,19 @@ class TrialContext:
         return int(self._global_batch_size)
 
     @property
+    def optimizations(self) -> Dict[str, Any]:
+        """The experiment config's `optimizations:` block (validated +
+        default-filled by expconf.check): attention_impl, attention_bf16,
+        overlap_allgather, prepartition_inputs. Empty dict when the trial
+        runs without a core context (unit tests, bare scripts) — callers
+        use .get() with the documented defaults."""
+        info = getattr(self.core, "info", None)
+        trial_info = getattr(info, "trial", None)
+        cfg = getattr(trial_info, "config", None) or {}
+        block = cfg.get("optimizations") if isinstance(cfg, dict) else None
+        return dict(block) if isinstance(block, dict) else {}
+
+    @property
     def per_device_batch_size(self) -> int:
         return max(1, self.global_batch_size // max(1, self.n_devices))
 

@@ -32,6 +32,10 @@ class GPT2Trial(JaxTrial):
             "large": gpt2.Config.large,
         }[size]()
         seq_len = int(context.hparams.get("seq_len", 1024))
+        # `optimizations:` config block (validated by expconf; see
+        # docs/training-perf.md). The block wins over the legacy
+        # attention_impl hparam so platform-level A/Bs need no trial edit.
+        opt = context.optimizations
         self.cfg = gpt2.Config(
             vocab_size=base.vocab_size,
             # Long-context runs (long_context.yaml) train past the preset's
@@ -41,7 +45,11 @@ class GPT2Trial(JaxTrial):
             n_layer=base.n_layer,
             n_head=base.n_head,
             remat=bool(context.hparams.get("remat", True)),
-            attention_impl=context.hparams.get("attention_impl", "flash"),
+            attention_impl=opt.get(
+                "attention_impl",
+                context.hparams.get("attention_impl", "flash")),
+            attention_bf16=bool(opt.get("attention_bf16", False)),
+            overlap_allgather=bool(opt.get("overlap_allgather", False)),
             scan_unroll=int(context.hparams.get("scan_unroll", 0)),
             # MoE: num_experts > 1 routes every block's FFN over the mesh
             # `expert` axis (ops/moe.py).

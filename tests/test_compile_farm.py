@@ -247,6 +247,51 @@ def test_fingerprint_sensitivity():
     assert f16 != base
 
 
+def test_fingerprint_attention_impl_sensitivity():
+    """`optimizations.attention_impl` is program identity (docs/
+    training-perf.md): dense and reference trace to the SAME jaxpr (same
+    arithmetic — the farm shares one executable), while the pallas kernel
+    (and its bf16 variant) are different XLA programs and must fingerprint
+    apart, or a warm farm would serve a dense executable to a flash trial."""
+    from determined_tpu.models import gpt2
+
+    def make_trial(impl, bf16=False):
+        # pallas-supported geometry: d_model/n_head = 64, s % 128 == 0
+        cfg = gpt2.Config(vocab_size=128, n_positions=128, d_model=256,
+                          n_layer=1, n_head=4, remat=False,
+                          attention_impl=impl, attention_bf16=bf16)
+
+        class AttnTrial(JaxTrial):
+            prefetch = False
+
+            def init_params(self, rng):
+                return gpt2.init(rng, cfg)
+
+            def loss(self, params, batch, rng):
+                return gpt2.loss_fn(params, batch, cfg)
+
+            def optimizer(self):
+                return optax.adamw(1e-3)
+
+            def build_training_data(self):
+                drng = np.random.default_rng(0)
+                while True:
+                    yield {"tokens": drng.integers(0, 128, size=(2, 129))
+                           .astype(np.int32)}
+
+        return AttnTrial(TrialContext())
+
+    dense, _ = step_fingerprint(make_trial("dense"), 1)
+    reference, _ = step_fingerprint(make_trial("reference"), 1)
+    assert reference == dense  # identical arithmetic => shared executable
+
+    pallas, _ = step_fingerprint(make_trial("pallas"), 1)
+    assert pallas != dense
+
+    pallas_bf16, _ = step_fingerprint(make_trial("pallas", bf16=True), 1)
+    assert pallas_bf16 != pallas
+
+
 # -------------------------------------------------------------- AOT runtime
 
 

@@ -93,6 +93,58 @@ class TestMeshValidation:
             expconf.check(c)
 
 
+class TestOptimizationsBlock:
+    """`optimizations:` — TPU training-perf knobs (docs/training-perf.md),
+    validated at submit so a typo'd attention_impl fails before compile."""
+
+    def test_valid_block(self):
+        c = base_config(optimizations={
+            "attention_impl": "pallas",
+            "attention_bf16": True,
+            "overlap_allgather": True,
+            "prepartition_inputs": False,
+        })
+        assert expconf.validate(c) == []
+
+    @pytest.mark.parametrize("impl", ["auto", "pallas", "reference", "dense"])
+    def test_every_impl_accepted(self, impl):
+        c = base_config(optimizations={"attention_impl": impl})
+        assert expconf.validate(c) == []
+
+    def test_bad_impl_rejected(self):
+        c = base_config(optimizations={"attention_impl": "palas"})
+        assert any("attention_impl" in e and "palas" in e
+                   for e in expconf.validate(c))
+
+    def test_unknown_key_rejected(self):
+        c = base_config(optimizations={"attension_bf16": True})
+        assert any("attension_bf16" in e for e in expconf.validate(c))
+
+    def test_non_bool_flag_rejected(self):
+        c = base_config(optimizations={"attention_bf16": "yes"})
+        assert any("attention_bf16" in e for e in expconf.validate(c))
+
+    def test_must_be_mapping(self):
+        c = base_config(optimizations=["attention_impl"])
+        assert any("optimizations" in e and "mapping" in e
+                   for e in expconf.validate(c))
+
+    def test_defaults_fill_block(self):
+        out = expconf.apply_defaults(base_config())
+        assert out["optimizations"] == {
+            "attention_impl": "auto",
+            "attention_bf16": False,
+            "overlap_allgather": False,
+            "prepartition_inputs": True,
+        }
+
+    def test_defaults_keep_explicit_values(self):
+        out = expconf.apply_defaults(
+            base_config(optimizations={"attention_impl": "dense"}))
+        assert out["optimizations"]["attention_impl"] == "dense"
+        assert out["optimizations"]["prepartition_inputs"] is True
+
+
 class TestDefaults:
     def test_no_dead_tpu_block(self):
         # The mesh config has exactly one home: hyperparameters.mesh.
@@ -141,9 +193,13 @@ class TestLegacyShims:
         with warnings.catch_warnings(record=True) as w:
             warnings.simplefilter("always")
             out = expconf.check(cfg)
-        assert "bind_mounts" not in out and "optimizations" not in out
+        assert "bind_mounts" not in out
+        # The torch-era key is shimmed away; the block itself survives as
+        # the TPU optimizations knobs, filled with defaults.
+        assert "aggregation_frequency" not in out["optimizations"]
+        assert out["optimizations"]["attention_impl"] == "auto"
         joined = " ".join(str(x.message) for x in w)
-        assert "bind_mounts" in joined and "optimizations" in joined
+        assert "bind_mounts" in joined and "aggregation_frequency" in joined
 
     def test_legacy_adaptive_runs_through(self):
         out = expconf.check({

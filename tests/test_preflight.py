@@ -427,6 +427,69 @@ class TestThreadStopRule:
         assert [d for d in diags if d.code == "DTL106"] == []
 
 
+class TestAttnRule:
+    """DTL107 — hand-rolled attention softmax inside traced trial code
+    bypasses `optimizations.attention_impl` kernel selection."""
+
+    def test_dtl107_softmax_in_loss(self):
+        out = _lint("        p = jax.nn.softmax(batch)\n")
+        assert codes(out) == ["DTL107"]
+        assert "attention_impl" in out[0].message
+        assert out[0].level == "warning"
+
+    def test_dtl107_helper_closure(self):
+        # A same-class helper called from loss() is linted as trial code.
+        src = (
+            "import jax\n"
+            "from determined_tpu.train import JaxTrial\n"
+            "class T(JaxTrial):\n"
+            "    def _attn(self, q, k, v):\n"
+            "        return jax.nn.softmax(q @ k.T) @ v\n"
+            "    def loss(self, params, batch, rng):\n"
+            "        return self._attn(batch, batch, batch)\n"
+        )
+        assert codes(lint_source(src, "t.py")) == ["DTL107"]
+
+    def test_dtl107_negative_model_library_fn(self):
+        # Module-level apply*/loss_fn* roots are the model *library* idiom
+        # (ops/flash_attention.py's reference path) — not trial code.
+        src = (
+            "import jax\n"
+            "def apply_attention(q, k, v):\n"
+            "    return jax.nn.softmax(q @ k.T) @ v\n"
+        )
+        assert codes(lint_source(src, "t.py")) == []
+
+    def test_dtl107_negative_log_softmax(self):
+        # log_softmax is the cross-entropy idiom, not attention.
+        assert codes(_lint(
+            "        p = jax.nn.log_softmax(batch)\n")) == []
+
+    def test_dtl107_negative_torch_trial(self):
+        src = (
+            "import torch\n"
+            "class MyTrial(PyTorchTrial):\n"
+            "    def loss(self, params, batch, rng):\n"
+            "        return torch.nn.softmax(batch)\n"
+        )
+        assert codes(lint_source(src, "t.py")) == []
+
+    def test_dtl107_noqa_suppression(self):
+        out = _lint(
+            "        p = jax.nn.softmax(batch)  # det: noqa[DTL107]\n")
+        assert codes(out) == []
+        assert [d.code for d in out if d.suppressed] == ["DTL107"]
+
+    def test_dtl107_tree_is_clean(self):
+        """The platform's own trials (examples/) route attention through
+        the model library; none hand-roll softmax in traced methods."""
+        from determined_tpu.analysis.astlint import lint_paths
+
+        diags = lint_paths([os.path.join(REPO, "determined_tpu"),
+                            os.path.join(REPO, "examples")])
+        assert [d for d in diags if d.code == "DTL107"] == []
+
+
 # ---------------------------------------------------------------------------
 # config rules (DTL201-DTL202) — python side; native mirror in
 # native/tests/test_native.cc
