@@ -3,7 +3,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint test chaos bench-input bench-train bench-serve bench-serve-fleet bench-lifecycle bench-capacity bench-trace bench-compile native native-test clean
+.PHONY: lint test chaos bench-input bench-train bench-serve bench-serve-fleet bench-lifecycle bench-capacity bench-elastic bench-trace bench-compile bench-master-load native native-test clean
 
 # The dogfood gate (docs/preflight.md + docs/static-analysis.md): one
 # aggregate. The Python pass runs the DTL tree lint over the platform's
@@ -32,7 +32,7 @@ chaos:
 		tests/test_chaos.py tests/test_selfheal.py tests/test_preemption.py \
 		tests/test_serving.py tests/test_deployments.py tests/test_elastic.py \
 		tests/test_observability.py tests/test_compile_farm.py \
-		tests/test_fencing.py \
+		tests/test_fencing.py tests/test_overload.py \
 		-q -m slow
 
 # Async input pipeline A/B: prefetch on/off step time + input_wait_ms
@@ -101,6 +101,18 @@ bench-compile:
 # ingest throughput on the real master under concurrent batched POSTs.
 bench-trace:
 	$(PY) bench.py --only trace
+
+# Master overload bench (docs/cluster-ops.md "Overload, quotas & fair use"):
+# thousands of short-trial writers + concurrent list/read pollers + one
+# adversarial tenant against the real master. Gates: group-commit cuts
+# hot-path DB transactions >= 5x (COUNTED via det_master_db_tx_total, not
+# timed), write p99 stays under gate at 1k+ trials with readers attached,
+# db.tx.stall loses and duplicates ZERO metric reports (idempotent retry
+# through the batch queue), and a tenant at 10x its fair share cannot move
+# a well-behaved tenant's p99 past the solo gate while trial-critical
+# routes never shed (det_master_shed_total for that family stays 0).
+bench-master-load:
+	$(PY) bench_asha.py --master-load
 
 native:
 	$(MAKE) -C native

@@ -146,8 +146,19 @@ def _follow_experiment(session: Session, eid: int) -> int:
         time.sleep(1.0)
 
 
+def _page_params(args) -> dict:
+    # Server-side pagination (master answers 400 past the caps).
+    params = {}
+    if getattr(args, "limit", None) is not None:
+        params["limit"] = args.limit
+    if getattr(args, "offset", None) is not None:
+        params["offset"] = args.offset
+    return params
+
+
 def cmd_experiment_list(session: Session, args) -> int:
-    exps = session.get("/api/v1/experiments")["experiments"]
+    exps = session.get("/api/v1/experiments",
+                       params=_page_params(args) or None)["experiments"]
     rows = [
         {
             "id": e["id"],
@@ -184,7 +195,8 @@ def cmd_experiment_wait(session: Session, args) -> int:
 
 
 def cmd_trial_list(session: Session, args) -> int:
-    trials = session.get(f"/api/v1/experiments/{args.experiment_id}/trials")["trials"]
+    trials = session.get(f"/api/v1/experiments/{args.experiment_id}/trials",
+                         params=_page_params(args) or None)["trials"]
     rows = [
         {
             "id": t["id"],
@@ -246,9 +258,8 @@ def cmd_trial_logs(session: Session, args) -> int:
 
 
 def cmd_checkpoint_list(session: Session, args) -> int:
-    cps = session.get(f"/api/v1/experiments/{args.experiment_id}/checkpoints")[
-        "checkpoints"
-    ]
+    cps = session.get(f"/api/v1/experiments/{args.experiment_id}/checkpoints",
+                      params=_page_params(args) or None)["checkpoints"]
     rows = [
         {
             "uuid": c["uuid"],
@@ -277,8 +288,9 @@ def _show_task_state(t: dict) -> str:
 
 
 def cmd_task_list(session: Session, args) -> int:
-    params = {"type": args.type} if args.type else None
-    tasks = session.get("/api/v1/tasks", params=params)["tasks"]
+    params = {"type": args.type} if args.type else {}
+    params.update(_page_params(args))
+    tasks = session.get("/api/v1/tasks", params=params or None)["tasks"]
     rows = [
         {
             "id": t["id"],
@@ -1055,7 +1067,11 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("-f", "--follow", action="store_true")
     c.add_argument("--project-id", type=int, default=1)
     c.set_defaults(func=cmd_experiment_create)
-    exp.add_parser("list").set_defaults(func=cmd_experiment_list)
+    el = exp.add_parser("list")
+    el.add_argument("--limit", type=int, default=None,
+                    help="page size (server caps at 1000)")
+    el.add_argument("--offset", type=int, default=None)
+    el.set_defaults(func=cmd_experiment_list)
     for verb in ("describe", "activate", "pause", "cancel", "kill", "archive",
                  "unarchive", "delete"):
         v = exp.add_parser(verb)
@@ -1069,6 +1085,9 @@ def build_parser() -> argparse.ArgumentParser:
         dest="subcommand", required=True)
     t = tr.add_parser("list")
     t.add_argument("experiment_id", type=int)
+    t.add_argument("--limit", type=int, default=None,
+                   help="page size (server caps at 1000)")
+    t.add_argument("--offset", type=int, default=None)
     t.set_defaults(func=cmd_trial_list)
     t = tr.add_parser("describe")
     t.add_argument("id", type=int)
@@ -1085,6 +1104,9 @@ def build_parser() -> argparse.ArgumentParser:
     cp = sub.add_parser("checkpoint").add_subparsers(dest="subcommand", required=True)
     c = cp.add_parser("list")
     c.add_argument("experiment_id", type=int)
+    c.add_argument("--limit", type=int, default=None,
+                   help="page size (server caps at 1000)")
+    c.add_argument("--offset", type=int, default=None)
     c.set_defaults(func=cmd_checkpoint_list)
     c = cp.add_parser("describe")
     c.add_argument("uuid")
@@ -1098,6 +1120,9 @@ def build_parser() -> argparse.ArgumentParser:
     tl = tk.add_parser("list")
     tl.add_argument("--type", default=None,
                     help="TRIAL|COMMAND|NOTEBOOK|SHELL|TENSORBOARD|GENERIC|GC")
+    tl.add_argument("--limit", type=int, default=None,
+                    help="page size (server caps at 1000)")
+    tl.add_argument("--offset", type=int, default=None)
     tl.set_defaults(func=cmd_task_list)
 
     for cli_name, kind in (("cmd", "commands"), ("notebook", "notebooks"),

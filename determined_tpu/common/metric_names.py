@@ -113,6 +113,27 @@ MASTER_METRICS: Dict[str, Tuple[str, str]] = {
     "det_lease_expirations_total": (
         "counter", "Agent ownership leases that lapsed without a heartbeat "
         "renewal; the agent is expected to have self-fenced its tasks"),
+    "det_master_db_tx_total": (
+        "counter", "Explicit DB transactions opened (BEGIN IMMEDIATE). The "
+        "group-commit bench gates on the COUNTED ratio of this with "
+        "batching on vs off (docs/cluster-ops.md 'Overload, quotas & "
+        "fair use')"),
+    "det_master_write_queue_depth": (
+        "gauge", "Writes parked in the group-commit queue awaiting the "
+        "next flush; at queue_cap new writes get 429 + Retry-After"),
+    "det_master_write_batch_events": (
+        "histogram", "Writes coalesced per group-commit flush (batch "
+        "size distribution; 1 everywhere means batching is buying "
+        "nothing)"),
+    "det_master_write_flush_seconds": (
+        "histogram", "Group-commit flush transaction latency — the "
+        "brownout controller's 'DB write latency' signal"),
+    "det_master_shed_total": (
+        "counter", "Interactive requests shed with the brownout 503 by "
+        "route family; trial-critical families NEVER appear here"),
+    "det_rate_limited_total": (
+        "counter", "Requests refused with 429 by the per-tenant token "
+        "bucket, labeled by the charged principal"),
 }
 
 AGENT_METRICS: Dict[str, Tuple[str, str]] = {

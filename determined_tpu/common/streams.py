@@ -34,8 +34,12 @@ class StreamClient:
         if entities:
             params["entities"] = ",".join(entities)
         out = self._session.get("/api/v1/stream", params=params)
-        self.dropped = self.dropped or bool(out.get("dropped"))
         events = out.get("events", [])
+        # Overflow surfaces twice: the response-level `dropped` flag and a
+        # synthetic `resync` event at the head of the batch — a consumer
+        # that only walks events still learns it must re-list.
+        self.dropped = (self.dropped or bool(out.get("dropped"))
+                        or any(e.get("entity") == "resync" for e in events))
         if events:
             self.since = events[-1]["seq"]
         return events

@@ -57,6 +57,14 @@ const KnownPoint kKnown[] = {
     {"api.write.stale_epoch", "master",
      "force the stale-epoch 409 fence on state-mutating POSTs that carry "
      "X-Allocation-Epoch"},
+    {"db.tx.stall", "master",
+     "stall (mode delay-<ms>) or fail (mode error) every DB transaction — "
+     "a slow/sick database; group-commit backpressure must turn this into "
+     "429s, not unbounded queue growth"},
+    {"api.overload.force_shed", "master",
+     "force the brownout shed decision on while armed: interactive "
+     "list/read RPCs get the distinct 503, trial-critical routes must "
+     "still pass"},
 };
 
 struct FaultState {

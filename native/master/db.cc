@@ -122,7 +122,16 @@ int64_t Db::last_insert_id() {
 }
 
 void Db::tx(const std::function<void()>& fn) {
+  // Chaos: a slow or sick database. delay-<ms> stalls every transaction
+  // (fired BEFORE the lock so concurrent callers each pay the stall, like
+  // a saturated disk); error fails it (callers 5xx, idempotent clients
+  // retry). The group-commit queue must turn a sustained stall into 429
+  // backpressure instead of unbounded growth (docs/chaos.md).
+  if (FAULT_POINT("db.tx.stall") == faults::Action::kError) {
+    throw std::runtime_error("injected fault: db.tx.stall");
+  }
   std::lock_guard<std::recursive_mutex> lock(mu_);
+  tx_count_.fetch_add(1, std::memory_order_relaxed);
   exec("BEGIN IMMEDIATE");
   try {
     fn();
@@ -630,6 +639,19 @@ ALTER TABLE deployment_replicas ADD COLUMN canary INTEGER NOT NULL DEFAULT 0;
       // restores the fence along with the allocation.
       {27, R"sql(
 ALTER TABLE allocations ADD COLUMN epoch INTEGER NOT NULL DEFAULT 0;
+)sql"},
+      // Overload-safe pagination (docs/cluster-ops.md "Overload, quotas &
+      // fair use"): the list endpoints that used to full-scan now page
+      // with limit/offset, and each ORDER BY walks a covering index
+      // instead of sorting the table under the shared db mutex —
+      // trials-per-experiment by id, checkpoint lineage newest-first,
+      // tasks newest-first (with and without the type filter).
+      {28, R"sql(
+CREATE INDEX idx_trials_experiment_id ON trials(experiment_id, id);
+CREATE INDEX idx_checkpoints_lineage
+  ON checkpoints(trial_id, steps_completed DESC, report_time DESC);
+CREATE INDEX idx_tasks_start_time ON tasks(start_time DESC);
+CREATE INDEX idx_tasks_type_start ON tasks(type, start_time DESC);
 )sql"},
   };
   return kMigrations;

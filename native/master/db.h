@@ -9,6 +9,7 @@
 
 #pragma once
 
+#include <atomic>
 #include <cstdint>
 #include <functional>
 #include <map>
@@ -55,9 +56,16 @@ class Db {
   // Run fn inside a transaction (BEGIN IMMEDIATE … COMMIT/ROLLBACK).
   void tx(const std::function<void()>& fn);
 
+  // Explicit transactions opened so far (BEGIN IMMEDIATE, committed or
+  // rolled back). Exposed as det_master_db_tx_total: the group-commit
+  // bench gates on a COUNTED ratio of hot-path transactions, not an
+  // estimate (docs/cluster-ops.md "Overload, quotas & fair use").
+  int64_t tx_count() const { return tx_count_.load(); }
+
  private:
   sqlite3* db_ = nullptr;
   std::recursive_mutex mu_;
+  std::atomic<int64_t> tx_count_{0};
 };
 
 // The full schema, exposed for introspection/tests.

@@ -81,6 +81,21 @@ constexpr double kQueueWaitBuckets[] = {0.1, 0.5, 1.0, 5.0, 15.0, 60.0,
                                         300.0};
 constexpr size_t kQueueWaitBucketCount =
     sizeof(kQueueWaitBuckets) / sizeof(kQueueWaitBuckets[0]);
+// Group-commit batch sizes (det_master_write_batch_events): powers of two
+// up to the default max_batch.
+constexpr double kBatchSizeBuckets[] = {1, 2, 4, 8, 16, 32, 64, 128, 256};
+constexpr size_t kBatchSizeBucketCount =
+    sizeof(kBatchSizeBuckets) / sizeof(kBatchSizeBuckets[0]);
+
+void observe_hist(Hist* h, double v, const double* buckets,
+                  size_t n_buckets) {
+  if (h->counts.empty()) h->counts.assign(n_buckets, 0);
+  for (size_t i = 0; i < n_buckets; ++i) {
+    if (v <= buckets[i]) h->counts[i]++;
+  }
+  h->sum += v;
+  h->count++;
+}
 
 std::vector<std::string> split_path(const std::string& path) {
   std::vector<std::string> parts;
@@ -222,6 +237,46 @@ MasterConfig MasterConfig::from_json(const Json& j) {
   }
   if (c.provisioner.compile_demand_max_slots < 0) {
     c.provisioner.compile_demand_max_slots = c.provisioner.slots_per_node;
+  }
+  // Overload protection (docs/cluster-ops.md "Overload, quotas & fair
+  // use"): group-commit batching, per-tenant rate limits, brownout
+  // shedding thresholds.
+  const Json& ov = j["overload"];
+  if (ov.is_object()) {
+    const Json& gc = ov["group_commit"];
+    if (gc.is_bool()) {
+      c.group_commit = gc.as_bool();
+    } else if (gc.is_object()) {
+      c.group_commit = gc["enabled"].as_bool(c.group_commit);
+      c.group_commit_window_ms =
+          gc["window_ms"].as_double(c.group_commit_window_ms);
+      c.group_commit_max_batch = static_cast<int>(
+          gc["max_batch"].as_int(c.group_commit_max_batch));
+      c.group_commit_queue_cap = static_cast<int>(
+          gc["queue_cap"].as_int(c.group_commit_queue_cap));
+    }
+    const Json& rl = ov["rate_limit"];
+    if (rl.is_object()) {
+      c.rate_limit_rps = rl["rps"].as_double(c.rate_limit_rps);
+      c.rate_limit_burst = rl["burst"].as_double(c.rate_limit_burst);
+      for (const auto& [tenant, w] : rl["tenant_weights"].as_object()) {
+        c.tenant_weights[tenant] = w.as_double(1.0);
+      }
+    }
+    const Json& sh = ov["shedding"];
+    if (sh.is_object()) {
+      c.shed_queue_frac = sh["queue_frac"].as_double(c.shed_queue_frac);
+      c.shed_db_ms = sh["db_ms"].as_double(c.shed_db_ms);
+      c.shed_recover_frac =
+          sh["recover_frac"].as_double(c.shed_recover_frac);
+      c.shed_recover_db_ms =
+          sh["recover_db_ms"].as_double(c.shed_recover_db_ms);
+      c.shed_recover_hold_s =
+          sh["recover_hold_seconds"].as_double(c.shed_recover_hold_s);
+    }
+  }
+  if (j["stream_backlog_cap"].is_number()) {
+    c.stream_backlog_cap = static_cast<int>(j["stream_backlog_cap"].as_int());
   }
   return c;
 }
@@ -384,6 +439,15 @@ int Master::start() {
   int port = server_.listen(cfg_.host, cfg_.port,
                             [this](const HttpRequest& r) { return handle(r); });
   running_ = true;
+  if (cfg_.group_commit) {
+    // Flip accepting BEFORE the first request can arrive so an early
+    // batch_write never enqueues into a queue nobody drains.
+    {
+      MutexLock lock(batcher_.mu);
+      batcher_.accepting = true;
+    }
+    batch_thread_ = std::thread([this] { batch_flush_loop(); });
+  }
   scheduler_thread_ = std::thread([this] { scheduler_loop(); });
   server_.start();
   return port;
@@ -405,6 +469,14 @@ void Master::stop() {
   tunnels_run_ = false;  // live ws/tcp tunnels exit their pump loops
   cv_.notify_all();
   if (scheduler_thread_.joinable()) scheduler_thread_.join();
+  {
+    // Stop accepting batched writes; the flusher drains what is already
+    // queued (waiting handlers complete), then exits.
+    MutexLock lock(batcher_.mu);
+    batcher_.accepting = false;
+    batcher_.cv.notify_all();
+  }
+  if (batch_thread_.joinable()) batch_thread_.join();
   server_.stop();
 }
 
@@ -425,7 +497,41 @@ HttpResponse Master::handle(const HttpRequest& req) {
     api_stats_.requests_by_status[500]++;
     return injected;
   }
-  HttpResponse resp = route_idempotent(req);
+  // Admission control + brownout shedding sit in front of routing: both
+  // refuse BEFORE any side effect, so the refused request is always safe
+  // to retry (the harness Session honors Retry-After on 429/503). Debug
+  // routes are exempt — an operator must be able to disarm faults and
+  // inspect the master mid-storm.
+  HttpResponse resp;
+  bool refused = false;
+  if (!debug_route) {
+    std::string tenant;
+    double retry_after_s = 1;
+    if (!admit_request(req, &tenant, &retry_after_s)) {
+      Json body = err_body("rate limit exceeded: token over fair share");
+      body["rate_limited"] = true;
+      body["token"] = tenant;
+      resp = json_resp(429, body);
+      resp.headers["Retry-After"] =
+          std::to_string(static_cast<int>(retry_after_s));
+      refused = true;
+    } else if (browned_out_.load(std::memory_order_relaxed) &&
+               sheddable_route(req.method, route_family(req.path))) {
+      const std::string family = route_family(req.path);
+      {
+        MutexLock lock(shed_.mu);
+        shed_.by_family[family]++;
+      }
+      Json body =
+          err_body("master overloaded: interactive request shed (brownout)");
+      body["shed"] = true;
+      body["route_family"] = family;
+      resp = json_resp(503, body);
+      resp.headers["Retry-After"] = std::to_string(write_retry_after_s());
+      refused = true;
+    }
+  }
+  if (!refused) resp = route_idempotent(req);
   if (!debug_route && !resp.hijack &&
       FAULT_POINT("api.response.drop") == faults::Action::kDrop) {
     // The request WAS processed; the reply is lost. The client's retry
@@ -472,26 +578,342 @@ HttpResponse Master::route_idempotent(const HttpRequest& req) {
   int64_t uid = auth_user(req);
   if (uid < 0) return route(req);  // will 401 on the normal path
   const std::string key = std::to_string(uid) + ":" + it->second;
-  auto rows = db_.query(
-      "SELECT status, body FROM idempotency_keys WHERE key=?", {Json(key)});
-  if (!rows.empty()) {
-    fleet_.replay_hits.fetch_add(1);
-    HttpResponse r = HttpResponse::json(
-        static_cast<int>(rows[0]["status"].as_int(200)),
-        rows[0]["body"].as_string());
-    r.headers["x-idempotent-replay"] = "true";
-    return r;
+  // In-flight gate: a retry whose original is still executing (e.g.
+  // parked in a group-commit batch) must WAIT, not re-execute — the
+  // replay row only exists after the original commits. Same-key requests
+  // serialize here; distinct keys are untouched.
+  {
+    MutexLock lock(inflight_.mu);
+    while (inflight_.keys.count(key) != 0) {
+      inflight_.cv.wait(lock.native());
+    }
+    inflight_.keys.insert(key);
   }
-  HttpResponse r = route(req);
-  // 5xx responses are NOT recorded: the operation may not have applied,
-  // and the retry must re-execute it.
-  if (r.status < 500 && !r.hijack) {
-    db_.exec(
-        "INSERT OR REPLACE INTO idempotency_keys (key, status, body) "
-        "VALUES (?, ?, ?)",
-        {Json(key), Json(static_cast<int64_t>(r.status)), Json(r.body)});
+  HttpResponse r;
+  try {
+    auto rows = db_.query(
+        "SELECT status, body FROM idempotency_keys WHERE key=?", {Json(key)});
+    if (!rows.empty()) {
+      fleet_.replay_hits.fetch_add(1);
+      r = HttpResponse::json(static_cast<int>(rows[0]["status"].as_int(200)),
+                             rows[0]["body"].as_string());
+      r.headers["x-idempotent-replay"] = "true";
+    } else {
+      r = route(req);
+      // 5xx responses are NOT recorded: the operation may not have
+      // applied, and the retry must re-execute it. 429s are NOT
+      // recorded either: an admission/backpressure refusal ran with
+      // zero side effects, so the retry must re-execute — recording it
+      // would replay the refusal forever even after the queue drains.
+      if (r.status < 500 && r.status != 429 && !r.hijack) {
+        db_.exec(
+            "INSERT OR REPLACE INTO idempotency_keys (key, status, body) "
+            "VALUES (?, ?, ?)",
+            {Json(key), Json(static_cast<int64_t>(r.status)), Json(r.body)});
+      }
+    }
+  } catch (...) {
+    MutexLock lock(inflight_.mu);
+    inflight_.keys.erase(key);
+    inflight_.cv.notify_all();
+    throw;
+  }
+  {
+    MutexLock lock(inflight_.mu);
+    inflight_.keys.erase(key);
+    inflight_.cv.notify_all();
   }
   return r;
+}
+
+// ---------------------------------------------------------------------------
+// Overload protection (docs/cluster-ops.md "Overload, quotas & fair use").
+// ---------------------------------------------------------------------------
+
+Master::BatchResult Master::batch_write(std::function<void()> fn) {
+  {
+    MutexLock lock(batcher_.mu);
+    if (batcher_.accepting) {
+      if (static_cast<int>(batcher_.queue.size()) >=
+          cfg_.group_commit_queue_cap) {
+        // Backpressure: nothing was enqueued, nothing ran — the caller's
+        // 429 is retry-safe by construction. This is the bound that keeps
+        // a stalled DB (db.tx.stall) from growing the queue without
+        // limit.
+        return BatchResult::kBusy;
+      }
+      auto state = std::make_shared<std::pair<bool, bool>>(false, false);
+      batcher_.queue.push_back({std::move(fn), state});
+      batcher_.cv.notify_all();
+      while (!state->first) batcher_.cv.wait(lock.native());
+      return state->second ? BatchResult::kCommitted : BatchResult::kFailed;
+    }
+  }
+  // Batching off (config) or flusher not running (shutdown, tests): the
+  // old one-transaction-per-POST path.
+  try {
+    db_.tx(fn);
+  } catch (...) {
+    return BatchResult::kFailed;
+  }
+  return BatchResult::kCommitted;
+}
+
+void Master::batch_write_nowait(std::function<void()> fn) {
+  {
+    MutexLock lock(batcher_.mu);
+    if (batcher_.accepting) {
+      if (static_cast<int>(batcher_.queue.size()) >=
+          cfg_.group_commit_queue_cap) {
+        return;  // dropped; the write is idempotent and re-issued later
+      }
+      batcher_.queue.push_back({std::move(fn), nullptr});
+      batcher_.cv.notify_all();
+      return;
+    }
+  }
+  try {
+    db_.tx(fn);
+  } catch (...) {
+  }
+}
+
+void Master::batch_flush_loop() {
+  while (true) {
+    std::vector<WriteBatcher::Entry> batch;
+    {
+      MutexLock lock(batcher_.mu);
+      while (batcher_.queue.empty() && batcher_.accepting) {
+        batcher_.cv.wait(lock.native());
+      }
+      if (batcher_.queue.empty() && !batcher_.accepting) return;
+      // Gather window: wait for stragglers so one COMMIT carries a whole
+      // tick's worth of reports — bounded by window_ms, cut short by
+      // max_batch or shutdown.
+      auto deadline =
+          Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                             std::chrono::duration<double, std::milli>(
+                                 cfg_.group_commit_window_ms));
+      while (static_cast<int>(batcher_.queue.size()) <
+                 cfg_.group_commit_max_batch &&
+             batcher_.accepting && Clock::now() < deadline) {
+        if (batcher_.cv.wait_until(lock.native(), deadline) ==
+            std::cv_status::timeout) {
+          break;
+        }
+      }
+      size_t take = std::min(
+          batcher_.queue.size(),
+          static_cast<size_t>(std::max(1, cfg_.group_commit_max_batch)));
+      batch.reserve(take);
+      for (size_t i = 0; i < take; ++i) {
+        batch.push_back(std::move(batcher_.queue.front()));
+        batcher_.queue.pop_front();
+      }
+    }
+    // Run the whole batch inside ONE transaction, batcher_.mu released:
+    // producers keep enqueueing the next batch while this one commits.
+    double t0 = now();
+    std::vector<bool> oks(batch.size(), true);
+    bool batch_ok = true;
+    try {
+      db_.tx([&] {
+        for (auto& e : batch) e.fn();
+      });
+    } catch (...) {
+      batch_ok = false;
+    }
+    if (!batch_ok) {
+      // Isolate the poison entry: re-run each standalone so one bad write
+      // (or a transient injected db.tx.stall error) cannot fail every
+      // neighbor in the batch.
+      for (size_t i = 0; i < batch.size(); ++i) {
+        try {
+          db_.tx([&] { batch[i].fn(); });
+        } catch (...) {
+          oks[i] = false;
+        }
+      }
+    }
+    double ms = (now() - t0) * 1000.0;
+    {
+      MutexLock lock(batcher_.mu);
+      batcher_.flush_ewma_ms = batcher_.flush_ewma_ms == 0
+                                   ? ms
+                                   : 0.8 * batcher_.flush_ewma_ms + 0.2 * ms;
+      batcher_.flushes++;
+      observe_hist(&batcher_.batch_hist, static_cast<double>(batch.size()),
+                   kBatchSizeBuckets, kBatchSizeBucketCount);
+      observe_hist(&batcher_.flush_hist, ms / 1000.0, kApiLatencyBuckets,
+                   kApiLatencyBucketCount);
+      for (size_t i = 0; i < batch.size(); ++i) {
+        if (batch[i].state) {
+          batch[i].state->first = true;
+          batch[i].state->second = oks[i];
+        }
+      }
+      batcher_.cv.notify_all();
+    }
+  }
+}
+
+HttpResponse Master::write_refused_resp(BatchResult br) {
+  Json body =
+      br == BatchResult::kBusy
+          ? err_body("write queue at capacity: backpressure (DB slow or "
+                     "master overloaded)")
+          : err_body("write transaction failed; retry with the same "
+                     "idempotency key");
+  body["overloaded"] = true;
+  HttpResponse r =
+      json_resp(br == BatchResult::kBusy ? 429 : 503, body);
+  r.headers["Retry-After"] = std::to_string(write_retry_after_s());
+  return r;
+}
+
+int Master::write_retry_after_s() {
+  MutexLock lock(batcher_.mu);
+  // One flush drains up to max_batch entries roughly every
+  // max(window, observed flush latency): estimate the backlog drain time
+  // (same hint math as the serve router's 429s).
+  double per_flush_s =
+      std::max(cfg_.group_commit_window_ms, batcher_.flush_ewma_ms) / 1000.0;
+  double flushes_needed =
+      cfg_.group_commit_max_batch > 0
+          ? static_cast<double>(batcher_.queue.size()) /
+                cfg_.group_commit_max_batch
+          : 0;
+  int s = static_cast<int>(std::ceil(flushes_needed * per_flush_s));
+  return std::max(1, std::min(s, 30));
+}
+
+bool Master::admit_request(const HttpRequest& req, std::string* tenant,
+                           double* retry_after_s) {
+  if (cfg_.rate_limit_rps <= 0) return true;  // limiter disabled
+  auto it = req.headers.find("authorization");
+  if (it == req.headers.end() || it->second.rfind("Bearer ", 0) != 0) {
+    return true;  // unauthenticated: 401s on the normal path, not charged
+  }
+  const std::string token = it->second.substr(7);
+  double t = now();
+  std::string user;
+  {
+    MutexLock lock(limiter_.mu);
+    auto cached = limiter_.ident.find(token);
+    if (cached != limiter_.ident.end() && t - cached->second.second < 5.0) {
+      user = cached->second.first;
+    }
+  }
+  if (user.empty()) {
+    auto rows = db_.query(
+        "SELECT u.username FROM user_sessions s "
+        "JOIN users u ON u.id = s.user_id WHERE s.token=? AND "
+        "(s.expires_at IS NULL OR s.expires_at > datetime('now')) AND "
+        "u.active=1",
+        {Json(token)});
+    if (rows.empty()) return true;  // invalid token: normal 401 path
+    user = rows[0]["username"].as_string();
+    MutexLock lock(limiter_.mu);
+    // The identity cache must not become its own leak under token churn.
+    if (limiter_.ident.size() > 10000) limiter_.ident.clear();
+    limiter_.ident[token] = {user, t};
+  }
+  double weight = 1.0;
+  auto w = cfg_.tenant_weights.find(user);
+  if (w != cfg_.tenant_weights.end()) {
+    weight = std::max(0.01, w->second);
+  } else if (user == "determined-agent") {
+    // Node daemons carry every task's heartbeats/metrics — effectively
+    // the cluster's own traffic, not one tenant's. Overridable via
+    // tenant_weights like any other principal.
+    weight = 100.0;
+  }
+  double rate = cfg_.rate_limit_rps * weight;
+  double burst =
+      (cfg_.rate_limit_burst > 0 ? cfg_.rate_limit_burst
+                                 : 2 * cfg_.rate_limit_rps) *
+      weight;
+  MutexLock lock(limiter_.mu);
+  RateLimiter::Bucket& b = limiter_.buckets[user];
+  if (b.last == 0) b.tokens = burst;  // first sight: full bucket
+  b.tokens = std::min(burst, b.tokens + (t - b.last) * rate);
+  b.last = t;
+  if (b.tokens >= 1.0) {
+    b.tokens -= 1.0;
+    return true;
+  }
+  b.limited++;
+  *tenant = user;
+  *retry_after_s = std::max(1.0, std::ceil((1.0 - b.tokens) / rate));
+  return false;
+}
+
+bool Master::sheddable_route(const std::string& method,
+                             const std::string& family) {
+  if (method != "GET") return false;
+  // Interactive list/read families only. NEVER here: trials (metric
+  // reports, searcher long-polls), checkpoints, allocations (preemption
+  // long-polls, leases), task (log shipping), agents (heartbeats), auth,
+  // master, debug, stream, serve, proxy, metrics, deployments.
+  static const std::set<std::string> kSheddable = {
+      "experiments", "tasks", "workspaces", "projects", "models",
+      "templates",   "runs",  "users",      "ui"};
+  return kSheddable.count(family) != 0;
+}
+
+void Master::evaluate_overload() {
+  bool forced =
+      FAULT_POINT("api.overload.force_shed") != faults::Action::kNone;
+  double queue_frac = 0;
+  double ewma_ms = 0;
+  {
+    MutexLock lock(batcher_.mu);
+    if (batcher_.queue.empty()) {
+      // The EWMA only updates on flushes; with no write traffic it would
+      // pin the brownout on forever. Decay it toward zero when idle
+      // (halves in ~1.3s at the 200ms tick).
+      batcher_.flush_ewma_ms *= 0.9;
+    }
+    queue_frac = cfg_.group_commit_queue_cap > 0
+                     ? static_cast<double>(batcher_.queue.size()) /
+                           cfg_.group_commit_queue_cap
+                     : 0;
+    ewma_ms = batcher_.flush_ewma_ms;
+  }
+  bool over = forced || queue_frac >= cfg_.shed_queue_frac ||
+              ewma_ms >= cfg_.shed_db_ms;
+  MutexLock lock(shed_.mu);
+  if (over) {
+    shed_.recover_since = 0;
+    if (!browned_out_.exchange(true)) {
+      std::cerr << "master: brownout ON (write queue " << queue_frac * 100
+                << "%, flush EWMA " << ewma_ms << "ms"
+                << (forced ? ", forced by fault point" : "") << ")"
+                << std::endl;
+    }
+    return;
+  }
+  if (!browned_out_.load()) return;
+  // Recovery hysteresis: both signals must stay under the (lower)
+  // recovery thresholds for recover_hold_s before shedding stops — a
+  // brownout that flapped 5x/second would be worse than either steady
+  // state.
+  if (queue_frac > cfg_.shed_recover_frac ||
+      ewma_ms > cfg_.shed_recover_db_ms) {
+    shed_.recover_since = 0;
+    return;
+  }
+  double t = now();
+  if (shed_.recover_since == 0) {
+    shed_.recover_since = t;
+    return;
+  }
+  if (t - shed_.recover_since >= cfg_.shed_recover_hold_s) {
+    browned_out_ = false;
+    shed_.recover_since = 0;
+    std::cerr << "master: brownout OFF (recovered for "
+              << cfg_.shed_recover_hold_s << "s)" << std::endl;
+  }
 }
 
 // /api/v1/debug/faults — runtime chaos control (docs/chaos.md).
@@ -907,10 +1329,14 @@ void Master::publish_locked(const std::string& entity, Json payload) {
   ev.entity = entity;
   ev.payload = std::move(payload);
   stream_events_.push_back(std::move(ev));
-  // Bounded ring: clients that fall further behind than this must
-  // re-list; the response's `dropped` flag tells them (reference stream
-  // subscribers resync from the DB on overflow).
-  while (stream_events_.size() > 4096) stream_events_.pop_front();
+  // Bounded ring (cfg stream_backlog_cap): one stalled CLI/WebUI watcher
+  // can never grow master memory unboundedly. Clients that fall further
+  // behind must re-list; the response's `dropped` flag AND a synthetic
+  // `resync` event tell them (reference stream subscribers resync from
+  // the DB on overflow).
+  const size_t cap =
+      static_cast<size_t>(std::max(16, cfg_.stream_backlog_cap));
+  while (stream_events_.size() > cap) stream_events_.pop_front();
   cv_.notify_all();
 }
 
@@ -976,6 +1402,31 @@ HttpResponse Master::handle_stream(const HttpRequest& req) {
       if (cv_.wait_until(lock.native(), deadline) == std::cv_status::timeout) break;
       collect(&events, &dropped);
     }
+  }
+  if (dropped) {
+    // Explicit resync marker as event[0]: a subscriber that only walks
+    // events (never the dropped flag) still learns it must re-list its
+    // mirrored entities. seq keeps the batch ascending — one less than
+    // the first surviving event, or the current counter when nothing
+    // survived (master restart: the client's cursor moves BACK to the
+    // new counter so subsequent polls work).
+    MutexLock lock(mu_);
+    const auto& arr = events.as_array();
+    int64_t marker_seq =
+        arr.empty() ? stream_seq_
+                    : std::max<int64_t>(0, arr.front()["seq"].as_int() - 1);
+    Json payload = Json::object();
+    payload["since"] = since;
+    payload["latest_seq"] = stream_seq_;
+    payload["reason"] = "backlog overflow: re-list mirrored entities";
+    Json marker = Json::object();
+    marker["seq"] = marker_seq;
+    marker["entity"] = "resync";
+    marker["payload"] = std::move(payload);
+    Json merged = Json::array();
+    merged.push_back(std::move(marker));
+    for (const auto& e : arr) merged.push_back(e);
+    events = std::move(merged);
   }
   Json out = Json::object();
   out["events"] = events;
@@ -1276,6 +1727,41 @@ HttpResponse Master::handle_prometheus_metrics() {
     for (const auto& [route, n] : fence_stats_.by_route) {
       out << "det_fenced_writes_total{route=\"" << route << "\"} " << n
           << "\n";
+    }
+  }
+  // Overload protection (docs/cluster-ops.md "Overload, quotas & fair
+  // use"): COUNTED transactions (the group-commit bench gates on this
+  // ratio), write-queue depth, batch-size + flush-latency histograms,
+  // shed + rate-limit counters.
+  out << "# TYPE det_master_db_tx_total counter\n"
+      << "det_master_db_tx_total " << db_.tx_count() << "\n";
+  {
+    MutexLock lock(batcher_.mu);
+    out << "# TYPE det_master_write_queue_depth gauge\n"
+        << "det_master_write_queue_depth " << batcher_.queue.size() << "\n"
+        << "# TYPE det_master_write_batch_events histogram\n";
+    emit_hist(out, "det_master_write_batch_events", "", batcher_.batch_hist,
+              kBatchSizeBuckets, kBatchSizeBucketCount);
+    out << "# TYPE det_master_write_flush_seconds histogram\n";
+    emit_hist(out, "det_master_write_flush_seconds", "", batcher_.flush_hist,
+              kApiLatencyBuckets, kApiLatencyBucketCount);
+  }
+  {
+    MutexLock lock(shed_.mu);
+    out << "# TYPE det_master_shed_total counter\n";
+    for (const auto& [family, n] : shed_.by_family) {
+      out << "det_master_shed_total{route_family=\"" << family << "\"} " << n
+          << "\n";
+    }
+  }
+  {
+    MutexLock lock(limiter_.mu);
+    out << "# TYPE det_rate_limited_total counter\n";
+    for (const auto& [user, b] : limiter_.buckets) {
+      if (b.limited > 0) {
+        out << "det_rate_limited_total{token=\"" << user << "\"} "
+            << b.limited << "\n";
+      }
     }
   }
   {

@@ -395,6 +395,10 @@ void Master::scheduler_loop() {
         sweep_context_blobs_locked();
       }
     }
+    // Brownout decision (docs/cluster-ops.md "Overload, quotas & fair
+    // use"): every tick, mu_ released — it reads the batcher's queue
+    // depth + flush EWMA under the batcher's own lock.
+    evaluate_overload();
     // Hourly retention sweeps (reference internal/logretention/) run with
     // mu_ RELEASED — a big DELETE must not stall the scheduler or API
     // handlers (the db has its own lock).

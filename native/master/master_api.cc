@@ -47,6 +47,33 @@ bool is_terminal(const std::string& state) {
 
 Json row_to_json(const Row& row) { return Json(JsonObject(row.begin(), row.end())); }
 
+// limit/offset with sane caps — 400 on abuse instead of SQLite's
+// "LIMIT -1 = unlimited" (a full-table scan a hostile caller could
+// trigger at will).
+bool parse_page(const HttpRequest& req, int64_t def_limit, int64_t max_limit,
+                int64_t* limit, int64_t* offset, HttpResponse* bad) {
+  *limit = to_id(req.query_param("limit", std::to_string(def_limit)));
+  *offset = to_id(req.query_param("offset", "0"));
+  if (*limit < 1 || *limit > max_limit) {
+    *bad = json_resp(400, err_body("limit must be in [1, " +
+                                   std::to_string(max_limit) + "]"));
+    return false;
+  }
+  if (*offset < 0) {
+    *bad = json_resp(400, err_body("offset must be >= 0"));
+    return false;
+  }
+  return true;
+}
+
+Json page_obj(const Json& total, int64_t offset, int64_t limit) {
+  Json pg = Json::object();
+  pg["total"] = total;
+  pg["offset"] = offset;
+  pg["limit"] = limit;
+  return pg;
+}
+
 }  // namespace
 
 // ---------------------------------------------------------------------------
@@ -130,8 +157,9 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
     }
     std::string where = "WHERE 1=1";
     for (const auto& c : conds) where += " AND " + c;
-    int64_t limit = to_id(req.query_param("limit", "200"));
-    int64_t offset = to_id(req.query_param("offset", "0"));
+    int64_t limit = 0, offset = 0;
+    HttpResponse bad;
+    if (!parse_page(req, 200, 1000, &limit, &offset, &bad)) return bad;
     auto total_rows = db_.query(
         "SELECT COUNT(*) AS n FROM experiments " + where, params);
     auto rows = db_.query(
@@ -212,13 +240,22 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
     return json_resp(200, Json::object());
   }
 
-  // GET /api/v1/experiments/{id}/trials
+  // GET /api/v1/experiments/{id}/trials[?limit=&offset=] — paginated
+  // (covering index idx_trials_experiment_id): a 10k-trial sweep must not
+  // make every list call a full-table scan.
   if (parts.size() == 3 && parts[2] == "trials" && req.method == "GET") {
+    int64_t limit = 0, offset = 0;
+    HttpResponse bad;
+    if (!parse_page(req, 200, 1000, &limit, &offset, &bad)) return bad;
+    auto total_rows = db_.query(
+        "SELECT COUNT(*) AS n FROM trials WHERE experiment_id=?",
+        {Json(eid)});
     auto rows = db_.query(
         "SELECT id, request_id, state, hparams, restarts, run_id, "
         "total_batches, searcher_metric_value, latest_checkpoint, "
         "summary_metrics, start_time, end_time FROM trials "
-        "WHERE experiment_id=? ORDER BY id",
+        "WHERE experiment_id=? ORDER BY id LIMIT " + std::to_string(limit) +
+            " OFFSET " + std::to_string(offset),
         {Json(eid)});
     Json trials = Json::array();
     {
@@ -251,6 +288,9 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
     }
     Json out = Json::object();
     out["trials"] = trials;
+    out["pagination"] = page_obj(
+        total_rows.empty() ? Json(static_cast<int64_t>(0)) : total_rows[0]["n"],
+        offset, limit);
     return json_resp(200, out);
   }
 
@@ -316,10 +356,19 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
 
   // GET /api/v1/experiments/{id}/checkpoints
   if (parts.size() == 3 && parts[2] == "checkpoints" && req.method == "GET") {
+    int64_t limit = 0, offset = 0;
+    HttpResponse bad;
+    if (!parse_page(req, 200, 1000, &limit, &offset, &bad)) return bad;
+    auto total_rows = db_.query(
+        "SELECT COUNT(*) AS n FROM checkpoints c JOIN trials t ON "
+        "c.trial_id = t.id WHERE t.experiment_id=?",
+        {Json(eid)});
     auto rows = db_.query(
         "SELECT c.uuid, c.trial_id, c.state, c.report_time, c.resources, "
         "c.metadata, c.steps_completed FROM checkpoints c JOIN trials t ON "
-        "c.trial_id = t.id WHERE t.experiment_id=? ORDER BY c.report_time",
+        "c.trial_id = t.id WHERE t.experiment_id=? ORDER BY c.report_time "
+        "LIMIT " + std::to_string(limit) + " OFFSET " +
+            std::to_string(offset),
         {Json(eid)});
     Json cps = Json::array();
     for (auto& row : rows) {
@@ -330,6 +379,9 @@ HttpResponse Master::handle_experiments(const HttpRequest& req,
     }
     Json out = Json::object();
     out["checkpoints"] = cps;
+    out["pagination"] = page_obj(
+        total_rows.empty() ? Json(static_cast<int64_t>(0)) : total_rows[0]["n"],
+        offset, limit);
     return json_resp(200, out);
   }
 
@@ -575,17 +627,26 @@ HttpResponse Master::handle_trials(const HttpRequest& req,
   // verification (core/_checkpoint.py lineage()).
   if (parts.size() == 3 && parts[2] == "checkpoints" &&
       req.method == "GET") {
+    int64_t limit = 0, offset = 0;
+    HttpResponse bad;
+    if (!parse_page(req, 200, 1000, &limit, &offset, &bad)) return bad;
     std::string state = req.query_param("state", "");
-    std::string sql =
-        "SELECT uuid, state, steps_completed, report_time, metadata "
-        "FROM checkpoints WHERE trial_id=?";
+    std::string where = "WHERE trial_id=?";
     std::vector<Json> args{Json(tid)};
     if (!state.empty()) {
-      sql += " AND state=?";
+      where += " AND state=?";
       args.push_back(Json(state));
     }
-    sql += " ORDER BY steps_completed DESC, report_time DESC";
-    auto rows = db_.query(sql, args);
+    auto total_rows =
+        db_.query("SELECT COUNT(*) AS n FROM checkpoints " + where, args);
+    // Covering index idx_checkpoints_lineage matches this exact order —
+    // the restore fallback walk stays an index scan at any lineage depth.
+    auto rows = db_.query(
+        "SELECT uuid, state, steps_completed, report_time, metadata "
+        "FROM checkpoints " + where +
+            " ORDER BY steps_completed DESC, report_time DESC LIMIT " +
+            std::to_string(limit) + " OFFSET " + std::to_string(offset),
+        args);
     Json cps = Json::array();
     for (auto& row : rows) {
       Json c = row_to_json(row);
@@ -594,6 +655,9 @@ HttpResponse Master::handle_trials(const HttpRequest& req,
     }
     Json out = Json::object();
     out["checkpoints"] = cps;
+    out["pagination"] = page_obj(
+        total_rows.empty() ? Json(static_cast<int64_t>(0)) : total_rows[0]["n"],
+        offset, limit);
     return json_resp(200, out);
   }
 
@@ -612,8 +676,12 @@ HttpResponse Master::handle_trials(const HttpRequest& req,
       return json_resp(400, err_body("spans array required"));
     }
     const std::string trial_trace = trows[0]["trace_id"].as_string();
+    // Group commit: the span inserts ride a shared transaction with every
+    // other write queued this flush window (docs/cluster-ops.md
+    // "Overload, quotas & fair use"). By-reference captures are safe —
+    // batch_write blocks until the flush that carries this closure.
     int64_t ingested = 0;
-    db_.tx([&] {
+    BatchResult br = batch_write([&] {
       for (const Json& sp : body["spans"].as_array()) {
         if (!sp.is_object() || sp["name"].as_string().empty() ||
             sp["span_id"].as_string().empty()) {
@@ -627,6 +695,7 @@ HttpResponse Master::handle_trials(const HttpRequest& req,
         ++ingested;
       }
     });
+    if (br != BatchResult::kCommitted) return write_refused_resp(br);
     fleet_.spans_ingested.fetch_add(ingested);
     Json out = Json::object();
     out["ingested"] = ingested;
@@ -750,7 +819,12 @@ HttpResponse Master::handle_trials(const HttpRequest& req,
     int64_t run_id = body["trial_run_id"].as_int(0);
     HttpResponse fenced;
     if (fence_stale_epoch(req, tid, "metrics", &fenced)) return fenced;
-    db_.tx([&] {
+    // Group commit: the report's raw insert + summary rollup share one
+    // transaction with every other report queued this flush window —
+    // under a metric storm the master commits once per window instead of
+    // once per POST. A full queue refuses with 429 BEFORE any side
+    // effect; the harness retries with the same idempotency key.
+    BatchResult br = batch_write([&] {
       db_.exec(
           "INSERT INTO raw_metrics (trial_id, trial_run_id, group_name, "
           "total_batches, metrics) VALUES (?, ?, ?, ?, ?)",
@@ -817,6 +891,7 @@ HttpResponse Master::handle_trials(const HttpRequest& req,
           "summary_metrics=?, last_activity=datetime('now') WHERE id=?",
           {Json(batches), Json(summary.dump()), Json(tid)});
     });
+    if (br != BatchResult::kCommitted) return write_refused_resp(br);
     {
       MutexLock lock(mu_);
       ExperimentState* exp = nullptr;
@@ -1358,7 +1433,10 @@ HttpResponse Master::handle_task_logs(const HttpRequest& req) {
         }
       }
     }
-    db_.tx([&] {
+    // Group commit: one shipped batch of lines shares a transaction with
+    // every other write queued this flush window. The agent retries a
+    // refused ship with the same idempotency key.
+    BatchResult br = batch_write([&] {
       for (const auto& entry : logs) {
         db_.exec(
             "INSERT INTO task_logs (task_id, allocation_id, agent_id, "
@@ -1371,6 +1449,7 @@ HttpResponse Master::handle_task_logs(const HttpRequest& req) {
              entry["timestamp"]});
       }
     });
+    if (br != BatchResult::kCommitted) return write_refused_resp(br);
     {
       // Log traffic counts as activity for idle-watching (task/idle/),
       // and runs through the experiment's log-pattern policies
@@ -1423,17 +1502,27 @@ HttpResponse Master::handle_tasks(const HttpRequest& req,
   // GET /api/v1/tasks[?type=] — all task rows (trials, NTSC, generic, GC)
   // with live allocation state overlay (reference GetTasks).
   if (parts.size() == 1 && req.method == "GET") {
-    std::string sql =
-        "SELECT id, type, state, owner_id, workspace_id, parent_id, "
-        "start_time, end_time FROM tasks";
+    // Paginated (indexes idx_tasks_start_time / idx_tasks_type_start):
+    // the old fixed LIMIT 500 silently truncated AND still sorted the
+    // whole table.
+    int64_t limit = 0, offset = 0;
+    HttpResponse bad;
+    if (!parse_page(req, 200, 1000, &limit, &offset, &bad)) return bad;
+    std::string where;
     std::vector<Json> params;
     const std::string type = req.query_param("type");
     if (!type.empty()) {
-      sql += " WHERE type=?";
+      where = " WHERE type=?";
       params.push_back(Json(type));
     }
-    sql += " ORDER BY start_time DESC LIMIT 500";
-    auto rows = db_.query(sql, params);
+    auto total_rows =
+        db_.query("SELECT COUNT(*) AS n FROM tasks" + where, params);
+    auto rows = db_.query(
+        "SELECT id, type, state, owner_id, workspace_id, parent_id, "
+        "start_time, end_time FROM tasks" + where +
+            " ORDER BY start_time DESC LIMIT " + std::to_string(limit) +
+            " OFFSET " + std::to_string(offset),
+        params);
     Json tasks = Json::array();
     {
       MutexLock lock(mu_);
@@ -1449,6 +1538,9 @@ HttpResponse Master::handle_tasks(const HttpRequest& req,
     }
     Json out = Json::object();
     out["tasks"] = tasks;
+    out["pagination"] = page_obj(
+        total_rows.empty() ? Json(static_cast<int64_t>(0)) : total_rows[0]["n"],
+        offset, limit);
     return json_resp(200, out);
   }
 
@@ -1480,15 +1572,22 @@ HttpResponse Master::handle_tasks(const HttpRequest& req,
     return json_resp(200, out);
   }
 
-  // GET /api/v1/tasks/{id}/logs?offset=&follow=&timeout_seconds=
+  // GET /api/v1/tasks/{id}/logs?offset=&follow=&timeout_seconds=&limit=
   if (parts.size() == 3 && parts[2] == "logs" && req.method == "GET") {
     int64_t offset = to_id(req.query_param("offset", "0"));
     bool follow = req.query_param("follow") == "true";
     double timeout = std::stod(req.query_param("timeout_seconds", "30"));
+    // offset here is a log-id cursor, not a row skip; only limit needs
+    // the abuse cap (idx_task_logs_task keeps the fetch an index scan).
+    int64_t limit = to_id(req.query_param("limit", "1000"));
+    if (limit < 1 || limit > 5000) {
+      return json_resp(400, err_body("limit must be in [1, 5000]"));
+    }
     auto fetch = [&] {
       return db_.query(
           "SELECT id, agent_id, rank_id, level, stdtype, log, timestamp "
-          "FROM task_logs WHERE task_id=? AND id>? ORDER BY id LIMIT 1000",
+          "FROM task_logs WHERE task_id=? AND id>? ORDER BY id LIMIT " +
+              std::to_string(limit),
           {Json(task_id), Json(offset)});
     };
     auto rows = fetch();

@@ -1358,10 +1358,21 @@ HttpResponse Master::handle_serve_stats(const HttpRequest& req,
       return json_resp(409, err);
     }
   }
-  db_.exec(
-      "UPDATE deployment_replicas SET state='ACTIVE' WHERE deployment_id=? "
-      "AND task_id=? AND state='STARTING'",
-      {Json(dep->id), Json(r.task_id)});
+  // Group commit, fire-and-forget (handler holds mu_; the flusher never
+  // takes mu_, so enqueueing here cannot deadlock). The flip is
+  // idempotent — STARTING→ACTIVE guarded by the WHERE — and the next
+  // heartbeat re-issues it if a full queue dropped this one. By-VALUE
+  // captures: the closure outlives this stack frame.
+  {
+    const std::string dep_id = dep->id;
+    const std::string task_id = r.task_id;
+    batch_write_nowait([this, dep_id, task_id] {
+      db_.exec(
+          "UPDATE deployment_replicas SET state='ACTIVE' "
+          "WHERE deployment_id=? AND task_id=? AND state='STARTING'",
+          {Json(dep_id), Json(task_id)});
+    });
+  }
   it->second.last_activity = now();
   if (first_report) cv_.notify_all();
   return json_resp(200, Json::object());

@@ -60,7 +60,8 @@ ROUTES = [
     ("get", "/api/v1/experiments/{id}", "experiments", "Get experiment"),
     ("delete", "/api/v1/experiments/{id}", "experiments",
      "Delete a terminal experiment"),
-    ("get", "/api/v1/experiments/{id}/trials", "experiments", "List trials"),
+    ("get", "/api/v1/experiments/{id}/trials", "experiments",
+     "List trials (paginated: limit/offset)"),
     ("post", "/api/v1/experiments/{id}/trials", "experiments",
      "Create a trial on an unmanaged experiment"),
     ("post", "/api/v1/experiments/{id}/complete", "experiments",
@@ -104,7 +105,7 @@ ROUTES = [
     ("get", "/api/v1/trials/{id}/logs", "trials", "Trial log alias"),
     ("get", "/api/v1/trials/{id}/checkpoints", "trials",
      "Checkpoint lineage, newest first; ?state= filters (COMPLETED = the "
-     "restore-fallback chain)"),
+     "restore-fallback chain); paginated: limit/offset"),
     ("get", "/api/v1/allocations/{id}", "allocations", "Introspect"),
     ("get", "/api/v1/allocations/{id}/size_history", "allocations",
      "Elastic allocation-size transitions (shrink on drain, grow-back), "
@@ -139,12 +140,13 @@ ROUTES = [
     ("post", "/api/v1/task/logs", "logs",
      "Batched task-log shipping (agent / task owner)"),
     ("get", "/api/v1/tasks", "tasks",
-     "List all tasks (trials/NTSC/generic/GC), optional ?type="),
+     "List all tasks (trials/NTSC/generic/GC), optional ?type=; "
+     "paginated: limit/offset"),
     ("get", "/api/v1/tasks/{id}", "tasks", "Get task"),
     ("get", "/api/v1/tasks/{id}/context", "tasks",
      "Model-def tarball for the task"),
     ("get", "/api/v1/tasks/{id}/logs", "tasks",
-     "Task logs (offset/follow/timeout_seconds)"),
+     "Task logs (offset/follow/timeout_seconds; limit caps the batch)"),
     ("get", "/api/v1/runs", "runs", "Flat runs view over trials"),
     ("post", "/api/v1/runs/move", "runs", "Move runs' experiments"),
     ("get", "/api/v1/job-queues", "jobs", "Queue introspection"),
@@ -257,6 +259,18 @@ ROUTES += [
 ]
 
 
+# Paginated list endpoints: limit/offset with sane caps — the master
+# answers 400 on abuse instead of letting a hostile caller force a
+# full-table scan (docs/cluster-ops.md "Overload, quotas & fair use").
+PAGINATED = {
+    ("get", "/api/v1/experiments"),
+    ("get", "/api/v1/experiments/{id}/trials"),
+    ("get", "/api/v1/experiments/{id}/checkpoints"),
+    ("get", "/api/v1/trials/{id}/checkpoints"),
+    ("get", "/api/v1/tasks"),
+}
+
+
 def build() -> dict:
     paths: dict = {}
     for method, path, tag, summary in ROUTES:
@@ -270,6 +284,34 @@ def build() -> dict:
             "summary": summary,
             "responses": {"200": {"description": "OK"}},
         }
+        if (method, path) in PAGINATED:
+            params += [
+                {"name": "limit", "in": "query", "required": False,
+                 "schema": {"type": "integer", "minimum": 1,
+                            "maximum": 1000, "default": 200}},
+                {"name": "offset", "in": "query", "required": False,
+                 "schema": {"type": "integer", "minimum": 0, "default": 0}},
+            ]
+            op["responses"]["400"] = {
+                "description": "limit/offset out of range"}
+        if (method, path) == ("get", "/api/v1/tasks/{id}/logs"):
+            params.append(
+                {"name": "limit", "in": "query", "required": False,
+                 "schema": {"type": "integer", "minimum": 1,
+                            "maximum": 5000, "default": 1000}})
+            op["responses"]["400"] = {"description": "limit out of range"}
+        # Overload contract: admission control and brownout shedding sit
+        # in front of routing, so every non-debug operation can answer
+        # 429 (over fair-share rate limit, or write queue at capacity)
+        # or 503 (brownout shed / failed write) with a Retry-After the
+        # client should honor before retrying.
+        if not path.startswith("/api/v1/debug/"):
+            op["responses"]["429"] = {
+                "description": "Rate limited or write backpressure; "
+                               "retry after Retry-After seconds"}
+            op["responses"]["503"] = {
+                "description": "Brownout shed (interactive reads only) "
+                               "or write failure; honor Retry-After"}
         if params:
             op["parameters"] = params
         if path not in ("/api/v1/auth/login", "/api/v1/master"):
